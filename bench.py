@@ -1,0 +1,116 @@
+"""Benchmark — GPT-2 training MFU on the local TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+North star (BASELINE.json): GPT-2 ZeRO-3 at ≥45% MFU → vs_baseline = MFU/45.
+
+Model flops per step use the standard 6·N·T (+ attention) accounting; peak
+chip flops resolved from the device kind.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+PEAK_BF16_FLOPS = {
+    # per-chip dense bf16 peak
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,   # v6e
+}
+
+
+def peak_flops(device):
+    kind = getattr(device, "device_kind", "")
+    for key, val in PEAK_BF16_FLOPS.items():
+        if kind.startswith(key):
+            return val
+    return 197e12
+
+
+def model_flops_per_token(cfg):
+    """6N + attention term (12·L·S·E per token)."""
+    # weight matmuls fwd+bwd: 6 * (params participating in matmuls)
+    matmul_params = cfg.n_layer * 12 * cfg.n_embd * cfg.n_embd \
+        + cfg.vocab_size * cfg.n_embd
+    flops = 6 * matmul_params
+    # attention scores+context: fwd 2*2*S*E, ×3 for fwd+bwd
+    flops += 12 * cfg.n_layer * cfg.n_positions * cfg.n_embd
+    return flops
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+
+    dev = jax.devices()[0]
+    mesh = make_mesh(MeshConfig(data=1), devices=[dev])
+
+    seq = 1024
+    model_cfg = GPT2Config(vocab_size=50304, n_positions=seq, n_embd=1024,
+                           n_layer=24, n_head=16, dtype=jnp.bfloat16,
+                           scan_layers=True, remat=True)
+    batch_size = 8
+
+    cfg = {
+        "train_batch_size": batch_size,
+        "gradient_accumulation_steps": 1,
+        "zero_optimization": {"stage": 3},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "steps_per_print": 1000,
+    }
+    model = GPT2LMHeadModel(model_cfg)
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=model, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, 50304, size=(batch_size, seq))
+             .astype(np.int32)}
+
+    # warmup (compile)
+    for _ in range(2):
+        loss = engine.train_batch(batch)
+    jax.block_until_ready(engine.state.params)
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = engine.train_batch(batch)
+    jax.block_until_ready(engine.state.params)
+    dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_step = batch_size * seq
+    flops_per_step = model_flops_per_token(model_cfg) * tokens_per_step
+    achieved = flops_per_step / dt
+    mfu = achieved / peak_flops(dev)
+    samples_per_sec = batch_size / dt
+
+    result = {
+        "metric": "gpt2_345m_zero3_mfu",
+        "value": round(mfu * 100, 2),
+        "unit": "%MFU",
+        "vs_baseline": round(mfu / 0.45, 3),
+        "detail": {
+            "samples_per_sec_per_chip": round(samples_per_sec, 2),
+            "tokens_per_sec": round(tokens_per_step / dt, 1),
+            "step_time_ms": round(dt * 1000, 2),
+            "achieved_tflops": round(achieved / 1e12, 2),
+            "device": getattr(dev, "device_kind", str(dev)),
+            "loss": float(jax.device_get(loss)),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

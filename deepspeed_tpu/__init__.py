@@ -1,0 +1,127 @@
+"""deepspeed_tpu — a TPU-native large-model training framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of 2021-era DeepSpeed
+(reference: deepspeed/__init__.py:54 `initialize`, :203 `add_config_arguments`):
+ZeRO-style partitioned data parallelism expressed as GSPMD sharding over a
+`jax.sharding.Mesh`, pipeline + tensor + sequence parallelism over ICI,
+host/NVMe offload through a native C++ async-IO tier, Pallas kernels for the
+hot ops, and an engine/config/checkpoint stack mirroring the reference's user
+API.
+
+Typical use::
+
+    import deepspeed_tpu as dstpu
+
+    engine, _, loader, scheduler = dstpu.initialize(
+        config="ds_config.json", model=model, training_data=data)
+    for batch in loader:
+        loss = engine.train_batch(batch)
+"""
+
+from deepspeed_tpu.version import __version__, git_hash, git_branch
+
+from deepspeed_tpu.config.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.runtime.lr_schedules import add_tuning_arguments
+from deepspeed_tpu.parallel.mesh import (
+    MeshConfig,
+    make_mesh,
+    init_distributed,
+)
+from deepspeed_tpu.runtime.pipe.module import PipelineModule, LayerSpec, TiedLayerSpec
+from deepspeed_tpu.utils import logging as _logging
+
+from deepspeed_tpu import ops  # noqa: F401
+from deepspeed_tpu import models  # noqa: F401
+
+logger = _logging.logger
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mesh=None,
+               mpu=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               config_params=None,
+               rng=None):
+    """Initialize the engine — mirrors ``deepspeed.initialize``
+    (reference deepspeed/__init__.py:54).
+
+    Arguments:
+        args: optional argparse namespace carrying ``deepspeed_config``.
+        model: a flax ``nn.Module`` (or any object with ``.init``/``.apply``)
+            or a :class:`~deepspeed_tpu.runtime.pipe.module.PipelineModule`.
+        optimizer: optional pre-built optimizer (an optax-style gradient
+            transform); overrides the config's optimizer section.
+        model_parameters: optional pre-initialized parameter pytree; if
+            omitted the engine initializes parameters from ``rng``.
+        training_data: optional dataset (anything indexable / iterable).
+        lr_scheduler: optional schedule fn ``step -> lr`` overriding config.
+        mesh: optional ``jax.sharding.Mesh``; built from config if omitted.
+        mpu: model-parallelism "unit" for parity with the reference
+            (engine.py:636-641) — an object exposing axis sizes; superseded
+            by ``mesh`` on TPU.
+        config: path to a JSON config, a dict, or a DeepSpeedConfig.
+        config_params: legacy alias for ``config``.
+        rng: optional ``jax.random.PRNGKey`` used for parameter init.
+
+    Returns:
+        A tuple ``(engine, optimizer, training_dataloader, lr_scheduler)``
+        exactly like the reference.
+    """
+    from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+
+    if config is None:
+        config = config_params
+    if config is None and args is not None:
+        config = getattr(args, "deepspeed_config", None)
+    if config is None:
+        raise ValueError(
+            "DeepSpeed requires --deepspeed_config to specify configuration file")
+
+    engine_cls = DeepSpeedEngine
+    if isinstance(model, PipelineModule):
+        engine_cls = PipelineEngine
+
+    engine = engine_cls(args=args,
+                        model=model,
+                        optimizer=optimizer,
+                        model_parameters=model_parameters,
+                        training_data=training_data,
+                        lr_scheduler=lr_scheduler,
+                        mesh=mesh,
+                        mpu=mpu,
+                        collate_fn=collate_fn,
+                        config=config,
+                        rng=rng)
+
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def add_config_arguments(parser):
+    """Add ``--deepspeed``/``--deepspeed_config`` CLI flags — parity with
+    reference deepspeed/__init__.py:160-201."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed",
+                       default=False,
+                       action="store_true",
+                       help="Enable DeepSpeed (helper flag to ease transition)")
+    group.add_argument("--deepspeed_config",
+                       default=None,
+                       type=str,
+                       help="DeepSpeed json configuration file.")
+    group.add_argument("--deepscale",
+                       default=False,
+                       action="store_true",
+                       help="Deprecated alias of --deepspeed")
+    group.add_argument("--deepscale_config",
+                       default=None,
+                       type=str,
+                       help="Deprecated alias of --deepspeed_config")
+    return parser

@@ -1,0 +1,1 @@
+from deepspeed_tpu.config.config import DeepSpeedConfig, DeepSpeedConfigError

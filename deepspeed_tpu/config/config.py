@@ -1,0 +1,446 @@
+"""Typed config system — TPU-native rebuild of deepspeed/runtime/config.py:653.
+
+A JSON file (or dict) becomes a `DeepSpeedConfig` with the same key schema as
+the reference, including the batch-size triangle solver
+(`_set_batch_related_parameters`, reference config.py:837-888):
+
+    train_batch_size == micro_batch_per_device * gradient_accumulation_steps * dp_world_size
+
+Any two of the three determine the third; given only one, the others default
+to make the identity hold.
+"""
+
+import json
+import os
+
+from deepspeed_tpu.config import constants as C
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+def get_scalar_param(d, name, default):
+    return d.get(name, default)
+
+
+class ZeroOffloadConfig:
+    """`offload_param` / `offload_optimizer` schema — reference
+    zero/offload_config.py."""
+
+    def __init__(self, d):
+        d = d or {}
+        self.device = get_scalar_param(d, C.OFFLOAD_DEVICE, C.OFFLOAD_NONE_DEVICE)
+        self.nvme_path = get_scalar_param(d, C.OFFLOAD_NVME_PATH, None)
+        self.buffer_count = int(get_scalar_param(d, C.OFFLOAD_BUFFER_COUNT, 5))
+        self.buffer_size = int(get_scalar_param(d, C.OFFLOAD_BUFFER_SIZE, int(1e8)))
+        self.pin_memory = bool(get_scalar_param(d, C.OFFLOAD_PIN_MEMORY, False))
+        self.max_in_cpu = int(get_scalar_param(d, C.OFFLOAD_MAX_IN_CPU, int(1e9)))
+        self.pipeline_read = bool(get_scalar_param(d, C.OFFLOAD_PIPELINE_READ, False))
+        self.pipeline_write = bool(get_scalar_param(d, C.OFFLOAD_PIPELINE_WRITE, False))
+        self.fast_init = bool(get_scalar_param(d, C.OFFLOAD_FAST_INIT, False))
+
+    @property
+    def enabled(self):
+        return self.device not in (None, C.OFFLOAD_NONE_DEVICE)
+
+    def repr_dict(self):
+        return {"device": self.device, "nvme_path": self.nvme_path,
+                "buffer_count": self.buffer_count, "buffer_size": self.buffer_size}
+
+
+class DeepSpeedZeroConfig:
+    """ZeRO section — reference zero/config.py:14."""
+
+    def __init__(self, param_dict):
+        zero_dict = param_dict.get(C.ZERO_OPTIMIZATION, {})
+        if isinstance(zero_dict, bool):  # legacy "zero_optimization": true == stage 1
+            zero_dict = {C.ZERO_STAGE: 1 if zero_dict else 0}
+        self.stage = int(get_scalar_param(zero_dict, C.ZERO_STAGE, C.ZERO_STAGE_DEFAULT))
+        self.reduce_bucket_size = int(
+            get_scalar_param(zero_dict, C.ZERO_REDUCE_BUCKET_SIZE,
+                             C.ZERO_REDUCE_BUCKET_SIZE_DEFAULT))
+        self.allgather_bucket_size = int(
+            get_scalar_param(zero_dict, C.ZERO_ALLGATHER_BUCKET_SIZE,
+                             C.ZERO_ALLGATHER_BUCKET_SIZE_DEFAULT))
+        self.overlap_comm = bool(
+            get_scalar_param(zero_dict, C.ZERO_OVERLAP_COMM, C.ZERO_OVERLAP_COMM_DEFAULT))
+        self.reduce_scatter = bool(
+            get_scalar_param(zero_dict, C.ZERO_REDUCE_SCATTER, C.ZERO_REDUCE_SCATTER_DEFAULT))
+        self.contiguous_gradients = bool(
+            get_scalar_param(zero_dict, C.ZERO_CONTIGUOUS_GRADIENTS,
+                             C.ZERO_CONTIGUOUS_GRADIENTS_DEFAULT))
+        self.allgather_partitions = bool(
+            get_scalar_param(zero_dict, C.ZERO_ALLGATHER_PARTITIONS,
+                             C.ZERO_ALLGATHER_PARTITIONS_DEFAULT))
+        self.elastic_checkpoint = bool(
+            get_scalar_param(zero_dict, C.ZERO_ELASTIC_CHECKPOINT,
+                             C.ZERO_ELASTIC_CHECKPOINT_DEFAULT))
+        self.load_from_fp32_weights = bool(
+            get_scalar_param(zero_dict, C.ZERO_LOAD_FROM_FP32_WEIGHTS,
+                             C.ZERO_LOAD_FROM_FP32_WEIGHTS_DEFAULT))
+
+        # legacy stage-2 flat flag (reference zero/config.py cpu_offload)
+        cpu_offload = bool(get_scalar_param(zero_dict, C.ZERO_CPU_OFFLOAD,
+                                            C.ZERO_CPU_OFFLOAD_DEFAULT))
+        cpu_offload_params = bool(get_scalar_param(zero_dict, C.ZERO_CPU_OFFLOAD_PARAMS, False))
+
+        self.offload_param = ZeroOffloadConfig(zero_dict.get(C.ZERO_OFFLOAD_PARAM))
+        self.offload_optimizer = ZeroOffloadConfig(zero_dict.get(C.ZERO_OFFLOAD_OPTIMIZER))
+        if cpu_offload and not self.offload_optimizer.enabled:
+            self.offload_optimizer.device = C.OFFLOAD_CPU_DEVICE
+        if cpu_offload_params and not self.offload_param.enabled:
+            self.offload_param.device = C.OFFLOAD_CPU_DEVICE
+
+        # stage-3 tuning knobs
+        self.prefetch_bucket_size = int(
+            get_scalar_param(zero_dict, C.ZERO_PREFETCH_BUCKET_SIZE,
+                             C.ZERO_PREFETCH_BUCKET_SIZE_DEFAULT))
+        self.param_persistence_threshold = int(
+            get_scalar_param(zero_dict, C.ZERO_PARAM_PERSISTENCE_THRESHOLD,
+                             C.ZERO_PARAM_PERSISTENCE_THRESHOLD_DEFAULT))
+        self.max_live_parameters = int(
+            get_scalar_param(zero_dict, C.ZERO_MAX_LIVE_PARAMETERS,
+                             C.ZERO_MAX_LIVE_PARAMETERS_DEFAULT))
+        self.max_reuse_distance = int(
+            get_scalar_param(zero_dict, C.ZERO_MAX_REUSE_DISTANCE,
+                             C.ZERO_MAX_REUSE_DISTANCE_DEFAULT))
+        self.gather_fp16_weights_on_model_save = bool(
+            get_scalar_param(zero_dict, C.ZERO_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE,
+                             C.ZERO_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE_DEFAULT))
+
+        if not 0 <= self.stage <= 3:
+            raise DeepSpeedConfigError(f"invalid ZeRO stage {self.stage}")
+
+    @property
+    def cpu_offload(self):
+        return self.offload_optimizer.enabled
+
+    def repr_dict(self):
+        return {
+            "stage": self.stage,
+            "reduce_bucket_size": self.reduce_bucket_size,
+            "allgather_bucket_size": self.allgather_bucket_size,
+            "overlap_comm": self.overlap_comm,
+            "reduce_scatter": self.reduce_scatter,
+            "offload_param": self.offload_param.repr_dict(),
+            "offload_optimizer": self.offload_optimizer.repr_dict(),
+        }
+
+
+class ActivationCheckpointingConfig:
+    """reference activation_checkpointing/config.py."""
+
+    def __init__(self, param_dict):
+        d = param_dict.get(C.ACTIVATION_CHECKPOINTING, {})
+        self.partition_activations = bool(d.get(C.ACT_CKPT_PARTITION_ACTIVATIONS, False))
+        self.cpu_checkpointing = bool(d.get(C.ACT_CKPT_CPU_CHECKPOINTING, False))
+        self.contiguous_memory_optimization = bool(
+            d.get(C.ACT_CKPT_CONTIGUOUS_MEMORY_OPTIMIZATION, False))
+        self.number_checkpoints = d.get(C.ACT_CKPT_NUMBER_CHECKPOINTS, None)
+        self.synchronize_checkpoint_boundary = bool(
+            d.get(C.ACT_CKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY, False))
+        self.profile = bool(d.get(C.ACT_CKPT_PROFILE, False))
+
+
+class FlopsProfilerConfig:
+    def __init__(self, param_dict):
+        d = param_dict.get(C.FLOPS_PROFILER, {})
+        self.enabled = bool(d.get(C.FLOPS_PROFILER_ENABLED, C.FLOPS_PROFILER_ENABLED_DEFAULT))
+        self.profile_step = int(d.get(C.FLOPS_PROFILER_PROFILE_STEP,
+                                      C.FLOPS_PROFILER_PROFILE_STEP_DEFAULT))
+        self.module_depth = int(d.get(C.FLOPS_PROFILER_MODULE_DEPTH,
+                                      C.FLOPS_PROFILER_MODULE_DEPTH_DEFAULT))
+        self.top_modules = int(d.get(C.FLOPS_PROFILER_TOP_MODULES,
+                                     C.FLOPS_PROFILER_TOP_MODULES_DEFAULT))
+        self.detailed = bool(d.get(C.FLOPS_PROFILER_DETAILED,
+                                   C.FLOPS_PROFILER_DETAILED_DEFAULT))
+
+
+class PLDConfig:
+    def __init__(self, param_dict):
+        d = param_dict.get(C.PROGRESSIVE_LAYER_DROP, {})
+        self.enabled = bool(d.get(C.PLD_ENABLED, C.PLD_ENABLED_DEFAULT))
+        self.theta = float(d.get(C.PLD_THETA, C.PLD_THETA_DEFAULT))
+        self.gamma = float(d.get(C.PLD_GAMMA, C.PLD_GAMMA_DEFAULT))
+
+
+class AioConfig:
+    """reference swap_tensor/aio_config.py:18."""
+
+    def __init__(self, param_dict):
+        d = param_dict.get(C.AIO, {})
+        self.block_size = int(d.get(C.AIO_BLOCK_SIZE, C.AIO_BLOCK_SIZE_DEFAULT))
+        self.queue_depth = int(d.get(C.AIO_QUEUE_DEPTH, C.AIO_QUEUE_DEPTH_DEFAULT))
+        self.thread_count = int(d.get(C.AIO_THREAD_COUNT, C.AIO_THREAD_COUNT_DEFAULT))
+        self.single_submit = bool(d.get(C.AIO_SINGLE_SUBMIT, C.AIO_SINGLE_SUBMIT_DEFAULT))
+        self.overlap_events = bool(d.get(C.AIO_OVERLAP_EVENTS, C.AIO_OVERLAP_EVENTS_DEFAULT))
+
+
+class TensorboardConfig:
+    def __init__(self, param_dict):
+        d = param_dict.get(C.TENSORBOARD, {})
+        self.enabled = bool(d.get(C.TENSORBOARD_ENABLED, C.TENSORBOARD_ENABLED_DEFAULT))
+        self.output_path = d.get(C.TENSORBOARD_OUTPUT_PATH, C.TENSORBOARD_OUTPUT_PATH_DEFAULT)
+        self.job_name = d.get(C.TENSORBOARD_JOB_NAME, C.TENSORBOARD_JOB_NAME_DEFAULT)
+
+
+class SparseAttentionConfig:
+    """Sparse-attention section parser — reference config.py:236-406. Produces
+    the kwargs for the layout generators in
+    deepspeed_tpu/ops/sparse_attention/sparsity_config.py."""
+
+    def __init__(self, param_dict):
+        d = param_dict.get(C.SPARSE_ATTENTION, None)
+        self.enabled = d is not None
+        d = d or {}
+        self.mode = d.get(C.SPARSE_MODE, C.SPARSE_MODE_DEFAULT)
+        self.block = int(d.get(C.SPARSE_BLOCK, C.SPARSE_BLOCK_DEFAULT))
+        self.different_layout_per_head = bool(
+            d.get(C.SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
+                  C.SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT))
+        self.num_local_blocks = int(d.get(C.SPARSE_NUM_LOCAL_BLOCKS,
+                                          C.SPARSE_NUM_LOCAL_BLOCKS_DEFAULT))
+        self.num_global_blocks = int(d.get(C.SPARSE_NUM_GLOBAL_BLOCKS,
+                                           C.SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT))
+        self.attention = d.get(C.SPARSE_ATTENTION_TYPE, C.SPARSE_ATTENTION_TYPE_DEFAULT)
+        self.horizontal_global_attention = bool(
+            d.get(C.SPARSE_HORIZONTAL_GLOBAL_ATTENTION,
+                  C.SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT))
+        self.num_different_global_patterns = int(
+            d.get(C.SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS,
+                  C.SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS_DEFAULT))
+        self.num_random_blocks = int(d.get(C.SPARSE_NUM_RANDOM_BLOCKS,
+                                           C.SPARSE_NUM_RANDOM_BLOCKS_DEFAULT))
+        self.local_window_blocks = d.get(C.SPARSE_LOCAL_WINDOW_BLOCKS,
+                                         C.SPARSE_LOCAL_WINDOW_BLOCKS_DEFAULT)
+        self.global_block_indices = d.get(C.SPARSE_GLOBAL_BLOCK_INDICES,
+                                          C.SPARSE_GLOBAL_BLOCK_INDICES_DEFAULT)
+        self.global_block_end_indices = d.get(C.SPARSE_GLOBAL_BLOCK_END_INDICES,
+                                              C.SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT)
+        self.num_sliding_window_blocks = int(
+            d.get(C.SPARSE_NUM_SLIDING_WINDOW_BLOCKS,
+                  C.SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT))
+
+
+class PipelineConfig:
+    """tpu-native pipeline section (the reference configures PP through
+    PipelineModule constructor args instead)."""
+
+    def __init__(self, param_dict):
+        d = param_dict.get(C.PIPELINE, {})
+        self.stages = int(d.get(C.PIPELINE_STAGES, 1))
+        self.partition = d.get(C.PIPELINE_PARTITION, "parameters")
+        self.seed_layers = bool(d.get(C.PIPELINE_SEED_LAYERS, False))
+        self.activation_checkpoint_interval = int(
+            d.get(C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL, 0))
+
+
+class MeshConfigSection:
+    """tpu-native: logical mesh axis sizes. -1 on the data axis means
+    "whatever is left" after the explicit axes divide the device count."""
+
+    def __init__(self, param_dict):
+        d = param_dict.get(C.MESH, {})
+        self.data = int(d.get(C.MESH_DATA, -1))
+        self.model = int(d.get(C.MESH_MODEL, 1))
+        self.pipe = int(d.get(C.MESH_PIPE, 1))
+        self.seq = int(d.get(C.MESH_SEQ, 1))
+        self.expert = int(d.get(C.MESH_EXPERT, 1))
+
+
+class DeepSpeedConfig:
+    """Full config object — reference runtime/config.py:653.
+
+    ``config``: path to json, a json string, or a dict.
+    ``world_size``: data-parallel world size used by the batch triangle
+    (reference passes mpu; here callers pass the mesh's dp axis size).
+    """
+
+    @staticmethod
+    def load_param_dict(config):
+        """Resolve a path / JSON string / dict / DeepSpeedConfig into the raw
+        param dict without running validation."""
+        if isinstance(config, DeepSpeedConfig):
+            return config._param_dict
+        if isinstance(config, str):
+            if os.path.exists(config):
+                with open(config) as f:
+                    return json.load(f)
+            try:
+                return json.loads(config)
+            except json.JSONDecodeError:
+                raise DeepSpeedConfigError(
+                    f"Expected a string path to an existing deepspeed config, "
+                    f"or a valid JSON string, but received: {config}")
+        if isinstance(config, dict):
+            return dict(config)
+        raise DeepSpeedConfigError(
+            f"Expected a string path, JSON string, or dict; got {type(config)}")
+
+    def __init__(self, config, mpu=None, world_size=None):
+        self._param_dict = self.load_param_dict(config)
+
+        if world_size is not None:
+            self.world_size = int(world_size)
+        elif mpu is not None and hasattr(mpu, "get_data_parallel_world_size"):
+            self.world_size = mpu.get_data_parallel_world_size()
+        else:
+            self.world_size = 1
+
+        self._initialize_params(self._param_dict)
+        self._set_batch_related_parameters()
+        self._do_sanity_check()
+
+    # -- params ------------------------------------------------------------
+    def _initialize_params(self, pd):
+        self.train_batch_size = pd.get(C.TRAIN_BATCH_SIZE, C.TRAIN_BATCH_SIZE_DEFAULT)
+        self.train_micro_batch_size_per_gpu = pd.get(
+            C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+            pd.get(C.TRAIN_MICRO_BATCH_SIZE_PER_CHIP,
+                   C.TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT))
+        self.gradient_accumulation_steps = pd.get(C.GRADIENT_ACCUMULATION_STEPS,
+                                                  C.GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+        self.steps_per_print = pd.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = pd.get(C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+        self.seed = int(pd.get(C.SEED, C.SEED_DEFAULT))
+
+        self.disable_allgather = pd.get(C.DISABLE_ALLGATHER, C.DISABLE_ALLGATHER_DEFAULT)
+        self.allreduce_always_fp32 = pd.get(C.ALLREDUCE_ALWAYS_FP32,
+                                            C.ALLREDUCE_ALWAYS_FP32_DEFAULT)
+        self.prescale_gradients = pd.get(C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = pd.get(C.GRADIENT_PREDIVIDE_FACTOR,
+                                                C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+        self.sparse_gradients_enabled = pd.get(C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
+
+        self.zero_config = DeepSpeedZeroConfig(pd)
+        self.zero_optimization_stage = self.zero_config.stage
+        self.zero_enabled = self.zero_optimization_stage > 0
+
+        self.activation_checkpointing_config = ActivationCheckpointingConfig(pd)
+        self.flops_profiler_config = FlopsProfilerConfig(pd)
+        self.pld_config = PLDConfig(pd)
+        self.aio_config = AioConfig(pd)
+        self.tensorboard_config = TensorboardConfig(pd)
+        self.sparse_attention_config = SparseAttentionConfig(pd)
+        self.pipeline_config = PipelineConfig(pd)
+        self.mesh_config = MeshConfigSection(pd)
+
+        self.gradient_clipping = pd.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
+
+        # precision: reference fp16 section kept for parity; "bf16" section and
+        # "precision" key are the tpu-native way.
+        fp16 = pd.get(C.FP16, {})
+        self.fp16_enabled = bool(fp16.get(C.FP16_ENABLED, C.FP16_ENABLED_DEFAULT))
+        self.loss_scale = fp16.get(C.FP16_LOSS_SCALE, C.FP16_LOSS_SCALE_DEFAULT)
+        self.initial_scale_power = fp16.get(C.FP16_INITIAL_SCALE_POWER,
+                                            C.FP16_INITIAL_SCALE_POWER_DEFAULT)
+        self.loss_scale_window = fp16.get(C.FP16_LOSS_SCALE_WINDOW,
+                                          C.FP16_LOSS_SCALE_WINDOW_DEFAULT)
+        self.hysteresis = fp16.get(C.FP16_HYSTERESIS, C.FP16_HYSTERESIS_DEFAULT)
+        self.min_loss_scale = fp16.get(C.FP16_MIN_LOSS_SCALE, C.FP16_MIN_LOSS_SCALE_DEFAULT)
+
+        bf16 = pd.get(C.BF16, pd.get(C.BFLOAT16, {}))
+        self.bf16_enabled = bool(bf16.get(C.BF16_ENABLED, C.BF16_ENABLED_DEFAULT))
+        precision = pd.get(C.PRECISION, None)
+        if precision is not None:
+            self.bf16_enabled = precision in ("bfloat16", "bf16")
+            self.fp16_enabled = precision in ("float16", "fp16")
+
+        self.optimizer_name = None
+        self.optimizer_params = None
+        opt = pd.get(C.OPTIMIZER, None)
+        if opt:
+            self.optimizer_name = opt.get(C.TYPE, C.OPTIMIZER_TYPE_DEFAULT)
+            if self.optimizer_name:
+                self.optimizer_name = self.optimizer_name.lower()
+            self.optimizer_params = opt.get(C.OPTIMIZER_PARAMS, {})
+        self.optimizer_legacy_fusion = bool(
+            (opt or {}).get(C.LEGACY_FUSION, C.LEGACY_FUSION_DEFAULT))
+
+        self.scheduler_name = None
+        self.scheduler_params = None
+        sched = pd.get(C.SCHEDULER, None)
+        if sched:
+            self.scheduler_name = sched.get(C.TYPE, C.SCHEDULER_TYPE_DEFAULT)
+            self.scheduler_params = sched.get(C.SCHEDULER_PARAMS, {})
+
+        self.wall_clock_breakdown = pd.get(C.WALL_CLOCK_BREAKDOWN,
+                                           C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = pd.get(C.MEMORY_BREAKDOWN, C.MEMORY_BREAKDOWN_DEFAULT)
+
+        quantize = pd.get(C.QUANTIZE_TRAINING, {})
+        if isinstance(quantize, dict):
+            self.quantize_training_enabled = bool(
+                quantize.get(C.QUANTIZE_TRAINING_ENABLED, False))
+            self.quantize_training_params = quantize
+        else:
+            self.quantize_training_enabled = False
+            self.quantize_training_params = {}
+
+        self.elasticity_enabled = bool(
+            pd.get(C.ELASTICITY, {}).get(C.ENABLED, C.ENABLED_DEFAULT))
+        self.elasticity_params = pd.get(C.ELASTICITY, {})
+
+    # -- batch triangle ----------------------------------------------------
+    def _batch_assertion(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        assert train_batch > 0, f"Train batch size: {train_batch} has to be greater than 0"
+        assert micro_batch > 0, f"Micro batch size per gpu: {micro_batch} has to be greater than 0"
+        assert grad_acc > 0, f"Gradient accumulation steps: {grad_acc} has to be greater than 0"
+        assert train_batch == micro_batch * grad_acc * self.world_size, (
+            f"Check batch related parameters. train_batch_size is not equal "
+            f"to micro_batch_per_gpu * gradient_acc_step * world_size "
+            f"{train_batch} != {micro_batch} * {grad_acc} * {self.world_size}")
+
+    def _set_batch_related_parameters(self):
+        """Solve the batch triangle — logic mirrors reference config.py:837-888."""
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+
+        # all three provided → validate
+        if all(x is not None for x in (train_batch, micro_batch, grad_acc)):
+            pass
+        # two of three
+        elif train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= self.world_size
+            self.gradient_accumulation_steps = grad_acc
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // self.world_size
+            micro_batch //= grad_acc
+            self.train_micro_batch_size_per_gpu = micro_batch
+        elif micro_batch is not None and grad_acc is not None:
+            self.train_batch_size = micro_batch * grad_acc * self.world_size
+        # one of three
+        elif train_batch is not None:
+            self.gradient_accumulation_steps = 1
+            self.train_micro_batch_size_per_gpu = train_batch // self.world_size
+        elif micro_batch is not None:
+            self.train_batch_size = micro_batch * self.world_size
+            self.gradient_accumulation_steps = 1
+        else:
+            raise DeepSpeedConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu needs "
+                "to be provided")
+        self._batch_assertion()
+
+    def _do_sanity_check(self):
+        if self.fp16_enabled and self.bf16_enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+        if self.zero_enabled and self.optimizer_name is not None:
+            if self.optimizer_name not in C.DEEPSPEED_OPTIMIZERS + ["sgd"]:
+                logger.warning(
+                    f"optimizer {self.optimizer_name} is not a built-in optimizer; "
+                    f"ZeRO sharding will still be applied to its state pytree")
+
+    def print(self, name="DeepSpeedConfig"):
+        logger.info("{}:".format(name))
+        for k in sorted(vars(self)):
+            if k.startswith("_"):
+                continue
+            logger.info("  {} {}".format(k, getattr(self, k)))

@@ -1,0 +1,70 @@
+"""Environment report — rebuild of deepspeed/env_report.py:109 (`ds_report`):
+prints the install/compatibility matrix for this machine: jax/flax versions,
+backend + devices, Pallas availability, native C++ op status.
+"""
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+OKAY = f"{GREEN}[OKAY]{END}"
+NO = f"{YELLOW}[NO]{END}"
+
+
+def _try_version(modname):
+    try:
+        mod = __import__(modname)
+        return getattr(mod, "__version__", "unknown")
+    except Exception:
+        return None
+
+
+def main():
+    print("-" * 60)
+    print("DeepSpeed-TPU general environment info:")
+    print("-" * 60)
+    for mod in ("jax", "jaxlib", "flax", "optax", "numpy"):
+        v = _try_version(mod)
+        print(f"{mod:<20} {v if v else NO}")
+
+    import deepspeed_tpu
+    print(f"{'deepspeed_tpu':<20} {deepspeed_tpu.__version__} "
+          f"(git {deepspeed_tpu.git_hash()})")
+
+    print("-" * 60)
+    print("Accelerator:")
+    try:
+        import jax
+        devs = jax.devices()
+        print(f"backend              {jax.default_backend()}")
+        print(f"devices              {len(devs)} x "
+              f"{getattr(devs[0], 'device_kind', devs[0].platform)}")
+    except Exception as e:
+        print(f"devices              {RED}[FAIL]{END} {e}")
+
+    print("-" * 60)
+    print("op compatibility:")
+    rows = []
+    try:
+        import jax.experimental.pallas  # noqa: F401
+        rows.append(("pallas kernels", OKAY))
+    except Exception:
+        rows.append(("pallas kernels", NO))
+    try:
+        from deepspeed_tpu.ops.native import cpu_adam
+        rows.append(("cpu_adam (C++ SIMD)", OKAY if cpu_adam.load() else NO))
+    except Exception:
+        rows.append(("cpu_adam (C++ SIMD)", NO))
+    try:
+        from deepspeed_tpu.ops.native import aio
+        rows.append(("async_io (C++)", OKAY if aio.load() else NO))
+    except Exception:
+        rows.append(("async_io (C++)", NO))
+    for name, status in rows:
+        print(f"{name:<20} {status}")
+    print("-" * 60)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,9 @@
+from deepspeed_tpu.models.gpt2 import (
+    GPT2Config,
+    GPT2LMHeadModel,
+    gpt2_tiny,
+    gpt2_small,
+    gpt2_medium,
+    gpt2_large,
+    gpt2_xl,
+)

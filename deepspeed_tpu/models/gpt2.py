@@ -1,0 +1,203 @@
+"""GPT-2 family — the flagship model, TPU-first.
+
+The reference trains GPT-2 through the external Megatron-LM client
+(tests/model/Megatron_GPT2, SURVEY §4); here the model is in-tree flax with:
+
+- bf16 activations, fp32 params (master-weight policy handled by the engine)
+- optional `scan` over layers (one compiled block body — fast compiles for
+  48-layer 1.5B configs, and the natural layout for pipeline stages)
+- optional remat (activation checkpointing, reference
+  activation_checkpointing/checkpointing.py analog via jax.checkpoint)
+- flash attention via Pallas on TPU
+- logical parameter axes for GSPMD: TP over heads/mlp/vocab, ZeRO-3 over the
+  remaining large axis (see deepspeed_tpu/runtime/zero/partition.py)
+- progressive layer drop keep-prob input (reference
+  runtime/progressive_layer_drop.py:5 passes theta into fwd kwargs)
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16          # activation/compute dtype (MXU-friendly)
+    param_dtype: Any = jnp.float32     # master params
+    remat: bool = False
+    scan_layers: bool = True
+    use_flash: Optional[bool] = None   # None = auto (TPU yes)
+    tie_word_embeddings: bool = True
+
+    @property
+    def head_dim(self):
+        return self.n_embd // self.n_head
+
+    def num_params(self):
+        V, P, E, L = self.vocab_size, self.n_positions, self.n_embd, self.n_layer
+        per_layer = 12 * E * E + 13 * E
+        return V * E + P * E + L * per_layer + 2 * E
+
+
+class SelfAttention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        B, S, E = x.shape
+        qkv = nn.Dense(3 * E, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       kernel_init=nn.initializers.normal(0.02), name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, S, cfg.n_head, cfg.head_dim).transpose(0, 2, 1, 3)
+
+        out = dot_product_attention(heads(q), heads(k), heads(v), causal=True,
+                                    use_flash=cfg.use_flash)
+        out = out.transpose(0, 2, 1, 3).reshape(B, S, E)
+        out = nn.Dense(E, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       kernel_init=nn.initializers.normal(
+                           0.02 / np.sqrt(2 * cfg.n_layer)),
+                       name="c_proj")(out)
+        if cfg.dropout > 0:
+            out = nn.Dropout(cfg.dropout)(out, deterministic=deterministic)
+        return out
+
+
+class MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=nn.initializers.normal(0.02), name="c_fc")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.n_embd, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     kernel_init=nn.initializers.normal(
+                         0.02 / np.sqrt(2 * cfg.n_layer)),
+                     name="c_proj")(h)
+        if cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return h
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block (GPT-2 style). ``keep_prob`` implements
+    progressive layer drop: output = x + keep * sublayer(x) with the engine
+    feeding the PLD theta schedule."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True, keep_prob=1.0):
+        cfg = self.config
+        # keep dtype stable under a traced keep_prob (PLD schedule is fp32)
+        keep = jnp.asarray(keep_prob, x.dtype)
+        ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                           param_dtype=cfg.param_dtype, name="ln_1")(x)
+        x = x + keep * SelfAttention(cfg, name="attn")(ln1, deterministic)
+        ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                           param_dtype=cfg.param_dtype, name="ln_2")(x)
+        x = x + keep * MLP(cfg, name="mlp")(ln2, deterministic)
+        return x
+
+
+class ScanBody(nn.Module):
+    """One scanned layer step: returns (carry, None) as nn.scan requires."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic, keep_prob):
+        block = Block
+        if self.config.remat:
+            block = nn.remat(Block, prevent_cse=False, static_argnums=(2,))
+        return block(self.config, name="blk")(x, deterministic, keep_prob), None
+
+
+class GPT2LMHeadModel(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic=True, keep_prob=1.0):
+        cfg = self.config
+        B, S = input_ids.shape
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (cfg.n_positions, cfg.n_embd), cfg.param_dtype)
+        x = wte[input_ids].astype(cfg.dtype) + wpe[None, :S].astype(cfg.dtype)
+
+        if cfg.scan_layers:
+            scanned = nn.scan(ScanBody,
+                              variable_axes={"params": 0},
+                              split_rngs={"params": True, "dropout": True},
+                              in_axes=(nn.broadcast, nn.broadcast),
+                              length=cfg.n_layer)
+            x, _ = scanned(cfg, name="h")(x, deterministic, keep_prob)
+        else:
+            block = Block
+            if cfg.remat:
+                block = nn.remat(Block, prevent_cse=False, static_argnums=(2,))
+            for i in range(cfg.n_layer):
+                x = block(cfg, name=f"h_{i}")(x, deterministic, keep_prob)
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="ln_f")(x)
+        if cfg.tie_word_embeddings:
+            logits = jnp.einsum("bse,ve->bsv", x, wte.astype(cfg.dtype))
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                              param_dtype=cfg.param_dtype, name="lm_head")(x)
+        return logits
+
+
+def lm_loss(logits, labels, ignore_index=-100):
+    """Next-token cross entropy in fp32. ``labels`` must be the UNSHIFTED
+    token ids (typically ``labels is input_ids``); the shift happens here
+    (logits[:, :-1] vs labels[:, 1:]). Do not pre-shift."""
+    logits = logits[:, :-1].astype(jnp.float32)
+    targets = labels[:, 1:]
+    valid = targets != ignore_index
+    targets = jnp.where(valid, targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    ll = jnp.where(valid, ll, 0.0)
+    return -ll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+# -- presets ---------------------------------------------------------------
+
+def gpt2_tiny(**kw):
+    return GPT2Config(vocab_size=512, n_positions=128, n_embd=64, n_layer=2,
+                      n_head=2, **kw)
+
+
+def gpt2_small(**kw):
+    return GPT2Config(n_embd=768, n_layer=12, n_head=12, **kw)
+
+
+def gpt2_medium(**kw):
+    return GPT2Config(n_embd=1024, n_layer=24, n_head=16, **kw)
+
+
+def gpt2_large(**kw):
+    return GPT2Config(n_embd=1280, n_layer=36, n_head=20, **kw)
+
+
+def gpt2_xl(**kw):
+    """The 1.5B north-star config (SURVEY §6: 48L/1600h)."""
+    return GPT2Config(n_embd=1600, n_layer=48, n_head=25, **kw)

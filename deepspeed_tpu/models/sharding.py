@@ -1,0 +1,53 @@
+"""Model-specific tensor-parallel sharding rules.
+
+The reference delegated TP math to Megatron via the `mpu` object (SURVEY
+§2.3); on TPU TP is just PartitionSpecs over the 'model' mesh axis — XLA
+splits the matmuls and inserts the psums. These rules give Megatron-style
+column/row parallel layouts for the in-tree models.
+"""
+
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.mesh import MODEL_AXIS
+
+
+def _gpt2_leaf_spec(path_names, shape):
+    """Megatron TP layout:
+      c_attn / c_fc kernels  → column parallel (shard output dim)
+      c_proj kernels         → row parallel (shard input dim)
+      wte                    → vocab parallel
+      layernorm, biases of row-parallel, wpe → replicated
+    Works for both scanned params (leading layer dim) and per-layer trees.
+    """
+    name = path_names[-1]
+    parent = path_names[-2] if len(path_names) >= 2 else ""
+    ndim = len(shape)
+
+    def spec_last(axis_name):
+        return P(*([None] * (ndim - 1) + [axis_name]))
+
+    def spec_dim(d, axis_name):
+        s = [None] * ndim
+        s[d] = axis_name
+        return P(*s)
+
+    if name == "wte":
+        return spec_dim(0, MODEL_AXIS)
+    if name == "wpe":
+        return P(*([None] * ndim))
+    if parent in ("c_attn", "c_fc"):
+        # column parallel: kernel [.., in, out] shard out; bias [.., out] shard out
+        return spec_last(MODEL_AXIS)
+    if parent == "c_proj" and name == "kernel":
+        # row parallel: shard the contracting (second-to-last) dim
+        return spec_dim(ndim - 2, MODEL_AXIS)
+    return P(*([None] * ndim))
+
+
+def gpt2_tp_specs(params):
+    """PartitionSpec tree matching a GPT2LMHeadModel params tree."""
+    def walk(tree, path):
+        if isinstance(tree, dict):
+            return {k: walk(v, path + (k,)) for k, v in tree.items()}
+        return _gpt2_leaf_spec(path, tree.shape)
+    return walk(params, ())

@@ -1,0 +1,4 @@
+from deepspeed_tpu.ops.adam import FusedAdam, DeepSpeedCPUAdam
+from deepspeed_tpu.ops.lamb import FusedLamb
+from deepspeed_tpu.ops.sgd import SGD
+from deepspeed_tpu.ops import sparse_attention  # noqa: F401

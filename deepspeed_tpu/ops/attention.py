@@ -1,0 +1,58 @@
+"""Attention dispatch: Pallas flash attention on TPU, jnp reference elsewhere.
+
+This is the TPU answer to the reference's fused softmax/attention CUDA kernels
+(csrc/transformer/softmax_kernels.cu and the attention-score path of
+ds_transformer_cuda.cpp): one fused kernel that never materializes the
+[S, S] score matrix in HBM.
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def reference_attention(q, k, v, causal=False, bias=None, scale=None,
+                        segment_ids=None):
+    """Pure-XLA attention on [B, H, S, D] tensors. Numerically the ground
+    truth for the Pallas kernels (the test methodology of the reference's
+    test_cuda_forward.py, SURVEY §4)."""
+    B, H, S, D = q.shape
+    scale = scale if scale is not None else 1.0 / np.sqrt(D)
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    neg = jnp.float32(-1e30)
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((S, k.shape[2]), dtype=bool))
+        scores = jnp.where(causal_mask[None, None], scores, neg)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        scores = jnp.where(seg_mask, scores, neg)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhst,bhtd->bhsd", probs.astype(q.dtype), v)
+
+
+@functools.lru_cache(maxsize=1)
+def _on_tpu():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def dot_product_attention(q, k, v, causal=False, bias=None, scale=None,
+                          segment_ids=None, use_flash=None):
+    """[B, H, S, D] attention. ``use_flash=None`` auto-selects the Pallas
+    flash kernel on TPU for flash-compatible shapes."""
+    if use_flash is None:
+        use_flash = _on_tpu() and bias is None and segment_ids is None
+    if use_flash:
+        try:
+            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        except Exception:
+            pass
+    return reference_attention(q, k, v, causal=causal, bias=bias, scale=scale,
+                               segment_ids=segment_ids)

@@ -1,0 +1,66 @@
+"""Optimizer base protocol for the TPU engine.
+
+The reference wraps torch optimizers (mutable ``param_groups``); here an
+optimizer is a pure function pair over pytrees:
+
+    state = opt.init(params)
+    new_params, new_state = opt.step(params, grads, state, lr)
+
+State entries mirror params' tree structure leaf-for-leaf (``exp_avg`` etc.),
+which is what lets ZeRO stages 1-3 shard optimizer state with the same
+PartitionSpecs as the parameters (SURVEY §7 design stance). ``lr`` is traced,
+so LR schedules run under jit without recompilation.
+
+Any optax ``GradientTransformation`` can be adapted via :class:`OptaxOptimizer`.
+"""
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+class TpuOptimizer:
+    """Base class: subclasses implement init/step as pure functions."""
+
+    #: state-tree fields that have the same shape as the params tree; ZeRO
+    #: uses this to extend param shardings onto the optimizer state.
+    param_like_state_fields = ()
+
+    def init(self, params) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def step(self, params, grads, state, lr):
+        raise NotImplementedError
+
+    # torch-API-style param-group compat used by LR schedulers
+    @property
+    def defaults(self):
+        return dataclasses.asdict(self) if dataclasses.is_dataclass(self) else {}
+
+
+class OptaxOptimizer(TpuOptimizer):
+    """Adapter for an optax GradientTransformation. The optax state tuple
+    does not mirror the params-tree structure, so ZeRO leaves it replicated
+    (no entry in param_like_state_fields); use the native optimizers for
+    sharded optimizer state."""
+
+    param_like_state_fields = ()
+
+    def __init__(self, tx):
+        self.tx = tx
+
+    def init(self, params):
+        return {"optax": self.tx.init(params)}
+
+    def step(self, params, grads, state, lr):
+        # lr is ignored here — bake schedules into the optax chain instead.
+        updates, new_inner = self.tx.update(grads, state["optax"], params)
+        new_params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return new_params, {"optax": new_inner}
+
+
+def tree_zeros_like(params, dtype=None):
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, dtype or p.dtype), params)

@@ -1,0 +1,120 @@
+"""Block-sparse attention Pallas kernel — the TPU replacement for the
+reference's Triton SDD/DSD/DDS matmuls + block softmax
+(ops/sparse_attention/matmul.py:16, softmax.py:17).
+
+Strategy (splash-attention style): the static layout [H, nb, nb] is
+compiled into, per (head, q-block), the list of active k-blocks; the kernel
+iterates only those, with online softmax — so compute and HBM traffic scale
+with nnz blocks, matching the reference's 6x speedup story (SURVEY §6).
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _interpret_default():
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+def _layout_tables(layout):
+    """layout [H, nb, nb] → (counts [H, nb], cols [H, nb, max_nnz]) padded
+    with zeros; static host-side preprocessing."""
+    H, nb, _ = layout.shape
+    counts = layout.sum(axis=2).astype(np.int32)
+    max_nnz = int(counts.max()) if counts.size else 0
+    cols = np.zeros((H, nb, max(max_nnz, 1)), np.int32)
+    for h in range(H):
+        for r in range(nb):
+            idx = np.nonzero(layout[h, r])[0]
+            cols[h, r, :len(idx)] = idx
+    return counts, cols, max(max_nnz, 1)
+
+
+def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_ref, v_ref, o_ref,
+                   *, scale, block):
+    q = q_ref[0].astype(jnp.float32)  # [block, D]
+    nnz = counts_ref[0, 0]
+
+    def body(j, carry):
+        o_acc, m_acc, l_acc = carry
+        kb = cols_ref[0, 0, j]
+        k = k_ref[0, pl.ds(kb * block, block), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block, block), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        m_new = jnp.maximum(m_acc, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_acc - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_acc * alpha + jnp.sum(p, axis=1)
+        o_new = o_acc * alpha[:, None] + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((q.shape[0], q.shape[1]), jnp.float32)
+    m0 = jnp.full((q.shape[0],), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((q.shape[0],), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, nnz, body, (o0, m0, l0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o = jnp.where((l > 0)[:, None], o / l_safe[:, None], 0.0)
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+def blocksparse_attention(q, k, v, layout, block, scale=None,
+                          key_padding_mask=None, attn_mask=None,
+                          interpret=None):
+    """[B, H, S, D] attention restricted to `layout` [H, S//block, S//block].
+
+    Extra element-level masks are not supported in the kernel path (the
+    reference applied them inside the Triton softmax); callers pass masks via
+    the dense fallback in sparse_self_attention.py.
+    """
+    if key_padding_mask is not None or attn_mask is not None:
+        raise NotImplementedError("mask args use the dense fallback path")
+    B, H, S, D = q.shape
+    nb = S // block
+    layout = np.asarray(layout)[:, :nb, :nb]
+    if layout.shape[0] == 1 and H > 1:
+        layout = np.broadcast_to(layout, (H, nb, nb))
+    scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
+    if interpret is None:
+        interpret = _interpret_default()
+    if S % block or block < 8:
+        raise NotImplementedError("layout block too small for kernel tiling")
+
+    counts, cols, max_nnz = _layout_tables(layout)
+    counts = jnp.asarray(counts)  # [H, nb]
+    cols = jnp.asarray(cols)      # [H, nb, max_nnz]
+
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    # expand tables to BH by head index
+    head_idx = np.arange(B * H) % H
+    counts_bh = counts[head_idx]          # [BH, nb]
+    cols_bh = cols[head_idx]              # [BH, nb, max_nnz]
+
+    kernel = functools.partial(_bs_fwd_kernel, scale=scale, block=block)
+    o = pl.pallas_call(
+        kernel,
+        grid=(B * H, nb),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, max_nnz), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        interpret=interpret,
+    )(counts_bh, cols_bh, qf, kf, vf)
+    return o.reshape(B, H, S, D)

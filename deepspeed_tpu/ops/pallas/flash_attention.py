@@ -1,0 +1,278 @@
+"""Flash attention — the TPU replacement for the reference's fused attention
+CUDA path (csrc/transformer/softmax_kernels.cu + the score/context matmuls in
+ds_transformer_cuda.cpp): one Pallas kernel per pass that never materializes
+the [S, S] score matrix in HBM, with online softmax and a recompute-based
+backward (custom VJP), accumulating in fp32 on the MXU.
+
+Layout: q/k/v as [B, H, S, D] → kernels run on [B*H] × q-block grid; K/V for
+one (batch, head) live in VMEM (S·D·2 bytes each — fits comfortably for
+S ≤ 8k at D=128; beyond that, sequence parallelism splits S first, see
+deepspeed_tpu/parallel/ring_attention.py).
+
+On non-TPU backends the kernels run in interpreter mode so unit tests check
+the same code path numerically against the jnp reference (the
+test_cuda_forward.py methodology, SURVEY §4).
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _interpret_default():
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_k, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+    num_kb = seq_len // block_k
+
+    def body(kb, carry):
+        o_acc, m_acc, l_acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m_acc, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_acc - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_acc * alpha + jnp.sum(p, axis=1)
+        o_new = o_acc * alpha[:, None] + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+
+    if causal:
+        # only k-blocks up to the diagonal contribute
+        upper = (qi + 1) * block_q
+        num_active = (upper + block_k - 1) // block_k
+        o, m, l = jax.lax.fori_loop(0, num_active, body, (o0, m0, l0))
+    else:
+        o, m, l = jax.lax.fori_loop(0, num_kb, body, (o0, m0, l0))
+
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (o / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, :, 0] = m + jnp.log(l_safe)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    BH, S, D = q.shape
+    grid = (BH, S // block_q)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, seq_len=S)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------- backward
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, block_q, block_k, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+    num_kb = seq_len // block_k
+
+    def body(kb, dq_acc):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq_acc + jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros_like(q)
+    if causal:
+        num_active = ((qi + 1) * block_q + block_k - 1) // block_k
+        dq = jax.lax.fori_loop(0, num_active, body, dq0)
+    else:
+        dq = jax.lax.fori_loop(0, num_kb, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+                    seq_len):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)   # [block_k, D]
+    v = v_ref[0].astype(jnp.float32)
+    num_qb = seq_len // block_q
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q), 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv_new = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_new = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros_like(k)
+    dv0 = jnp.zeros_like(v)
+    if causal:
+        # q-blocks at/after this k-block
+        first_active = (ki * block_k) // block_q
+        dk, dv = jax.lax.fori_loop(first_active, num_qb, body, (dk0, dv0))
+    else:
+        dk, dv = jax.lax.fori_loop(0, num_qb, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, interpret):
+    BH, S, D = q.shape
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)[:, :, None]  # [BH, S, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=S),
+        grid=(BH, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=S),
+        grid=(BH, S // block_k),
+        in_specs=[
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, 1), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------- public op
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_attention_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_attention_bwd(scale, causal, block_q, block_k, interpret,
+                         residuals, do):
+    q, k, v, o, lse = residuals
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, scale, causal,
+                            block_q, block_k, interpret)
+    return dq, dk, dv
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None, interpret=None):
+    """[B, H, S, D] flash attention. Falls back to the jnp reference for
+    shapes the kernel can't tile (tiny S/D in unit tests)."""
+    B, H, S, D = q.shape
+    scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
+    if interpret is None:
+        interpret = _interpret_default()
+    block_q = block_q or min(256 if not interpret else 64, S)
+    block_k = block_k or min(256 if not interpret else 64, S)
+    if S % block_q or S % block_k:
+        from deepspeed_tpu.ops.attention import reference_attention
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    o = _flash_attention(qf, kf, vf, scale, causal, block_q, block_k,
+                         bool(interpret))
+    return o.reshape(B, H, S, D)

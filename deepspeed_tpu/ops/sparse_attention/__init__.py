@@ -1,0 +1,9 @@
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    SparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    VariableSparsityConfig,
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+)
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import SparseSelfAttention

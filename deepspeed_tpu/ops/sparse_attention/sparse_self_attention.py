@@ -1,0 +1,98 @@
+"""Block-sparse self-attention op.
+
+Rebuild of deepspeed/ops/sparse_attention/sparse_self_attention.py:14: QK^T /
+softmax / PV restricted to a block layout. The reference lowers to Triton
+SDD/DSD/DDS block matmuls (matmul.py:16) + block softmax (softmax.py:17); on
+TPU we lower to the Pallas block-sparse kernel
+(deepspeed_tpu/ops/pallas/blocksparse.py) when running on TPU, and to an
+XLA dense-with-mask fallback elsewhere (tests, CPU). Both paths compute
+identical numerics: softmax over only the blocks present in the layout.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    SparsityConfig, FixedSparsityConfig)
+
+
+def _expand_layout_mask(layout, block, seq_len):
+    """[H, nb, nb] 0/1 block layout → [H, S, S] boolean element mask."""
+    nb = seq_len // block
+    layout = np.asarray(layout)[:, :nb, :nb]
+    mask = np.repeat(np.repeat(layout, block, axis=1), block, axis=2)
+    return jnp.asarray(mask.astype(bool))
+
+
+def sparse_attention(q, k, v, layout, block, key_padding_mask=None,
+                     attn_mask=None, scale=None):
+    """Masked attention with a static block-sparse layout.
+
+    q/k/v: [B, H, S, D]. layout: [H, S//block, S//block] ndarray.
+    Returns [B, H, S, D].
+    """
+    B, H, S, D = q.shape
+    scale = scale if scale is not None else 1.0 / np.sqrt(D)
+    mask = _expand_layout_mask(layout, block, S)  # [H, S, S]
+
+    use_pallas = False
+    try:
+        use_pallas = jax.default_backend() == "tpu"
+    except Exception:
+        pass
+    if use_pallas:
+        try:
+            from deepspeed_tpu.ops.pallas.blocksparse import blocksparse_attention
+            return blocksparse_attention(q, k, v, np.asarray(layout), block,
+                                         scale=scale,
+                                         key_padding_mask=key_padding_mask,
+                                         attn_mask=attn_mask)
+        except NotImplementedError:
+            pass
+
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k) * scale
+    neg = jnp.finfo(scores.dtype).min
+    scores = jnp.where(mask[None], scores, neg)
+    if attn_mask is not None:
+        scores = jnp.where(attn_mask.astype(bool), scores, neg)
+    if key_padding_mask is not None:
+        scores = jnp.where(key_padding_mask[:, None, None, :].astype(bool), scores, neg)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    # rows with no allowed keys produce uniform junk; zero them like the
+    # reference's block softmax (absent rows never contribute)
+    any_allowed = mask.any(axis=-1)[None, :, :, None]
+    probs = jnp.where(any_allowed, probs, 0.0)
+    return jnp.einsum("bhst,bhtd->bhsd", probs, v)
+
+
+class SparseSelfAttention:
+    """Module-style wrapper mirroring the reference class
+    (sparse_self_attention.py:14): holds a SparsityConfig, caches layouts per
+    sequence length, applies sparse attention to [B, H, S, D] q/k/v."""
+
+    def __init__(self, sparsity_config=None, key_padding_mask_mode="add",
+                 attn_mask_mode="mul", max_seq_length=2048):
+        self.sparsity_config = sparsity_config or FixedSparsityConfig(num_heads=4)
+        assert isinstance(self.sparsity_config, SparsityConfig)
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self._layout_cache = {}
+
+    def get_layout(self, seq_len):
+        if seq_len not in self._layout_cache:
+            self._layout_cache[seq_len] = self.sparsity_config.make_layout(seq_len)
+        return self._layout_cache[seq_len]
+
+    def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
+                 attn_mask=None):
+        assert query.dtype in (jnp.float32, jnp.bfloat16, jnp.float16), (
+            "sparse attention supports float dtypes")
+        S = query.shape[-2]
+        layout = self.get_layout(S)
+        # "add" mask mode means additive -inf masks in the reference; we accept
+        # boolean masks and treat mode only for parity bookkeeping.
+        return sparse_attention(query, key, value, layout,
+                                self.sparsity_config.block,
+                                key_padding_mask=key_padding_mask,
+                                attn_mask=attn_mask)

@@ -1,0 +1,398 @@
+"""Block-sparse attention layout generators.
+
+Behavioral rebuild of the reference's layout family
+(deepspeed/ops/sparse_attention/sparsity_config.py:94 Fixed, :243 Variable,
+:421 BigBird, :544 BSLongformer) producing `[num_heads, num_blocks,
+num_blocks]` 0/1 layouts consumed by the Pallas block-sparse kernels
+(deepspeed_tpu/ops/pallas/blocksparse.py). Implemented on numpy — layouts
+are host-side static data baked into the kernel grid at trace time.
+
+TPU note: the reference's Triton kernels used block=16 defaults; on TPU the
+MXU/VMEM tiling prefers block sizes that are multiples of 128 in the lane
+dim, so `block` here defaults to 128 for kernel use, while any value is legal
+for layout math (kept at 16 by the config-schema default for config parity).
+"""
+
+import random
+
+import numpy as np
+
+
+class SparsityConfig:
+    """Base: holds head count, block size, per-head layout switch."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False):
+        self.num_heads = num_heads
+        self.block = block
+        self.different_layout_per_head = different_layout_per_head
+        self.num_layout_heads = num_heads if different_layout_per_head else 1
+
+    def setup_layout(self, seq_len):
+        if seq_len % self.block != 0:
+            raise ValueError(
+                f"Sequence length {seq_len} must be divisible by block size {self.block}")
+        num_blocks = seq_len // self.block
+        return np.zeros((self.num_heads, num_blocks, num_blocks), dtype=np.int64)
+
+    def check_and_propagate_first_head_layout(self, layout):
+        if not self.different_layout_per_head:
+            layout[1:] = layout[0]
+        return layout
+
+    def make_layout(self, seq_len):
+        raise NotImplementedError
+
+
+class DenseSparsityConfig(SparsityConfig):
+    """All-ones layout: lets the sparse kernel path run dense (reference
+    sparsity_config.py:60-ish Dense class)."""
+
+    def make_layout(self, seq_len):
+        layout = self.setup_layout(seq_len)
+        layout[:, :, :] = 1
+        return layout
+
+
+class FixedSparsityConfig(SparsityConfig):
+    """'Fixed' pattern (Sparse Transformers, Child et al. 2019): local windows
+    of `num_local_blocks`, plus global attention to the last
+    `num_global_blocks` block-columns of each window; optionally different
+    global offsets per head group and horizontal (row) global attention."""
+
+    def __init__(self,
+                 num_heads,
+                 block=16,
+                 different_layout_per_head=False,
+                 num_local_blocks=4,
+                 num_global_blocks=1,
+                 attention="bidirectional",
+                 horizontal_global_attention=False,
+                 num_different_global_patterns=1):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_local_blocks = num_local_blocks
+        if num_local_blocks % num_global_blocks != 0:
+            raise ValueError(
+                f"Number of blocks in a local window ({num_local_blocks}) must be "
+                f"dividable by number of global blocks ({num_global_blocks})")
+        self.num_global_blocks = num_global_blocks
+        if attention not in ("unidirectional", "bidirectional"):
+            raise NotImplementedError(
+                "only unidirectional or bidirectional attentions are supported")
+        self.attention = attention
+        if attention != "bidirectional" and horizontal_global_attention:
+            raise ValueError(
+                "only bidirectional attention can support horizontal global attention")
+        self.horizontal_global_attention = horizontal_global_attention
+        if num_different_global_patterns > 1 and not different_layout_per_head:
+            raise ValueError(
+                "number of different global attentions is only valid if "
+                "different layouts are generated per head")
+        if num_different_global_patterns > (num_local_blocks // num_global_blocks):
+            raise ValueError(
+                f"Number of layout versions ({num_different_global_patterns}) cannot "
+                f"be larger than number of local window blocks divided by number of "
+                f"global blocks")
+        self.num_different_global_patterns = num_different_global_patterns
+
+    def set_local_layout(self, h, layout):
+        num_blocks = layout.shape[1]
+        for i in range(0, num_blocks, self.num_local_blocks):
+            end = min(i + self.num_local_blocks, num_blocks)
+            for row in range(i, end):
+                for col in range(i, (row + 1) if self.attention == "unidirectional" else end):
+                    layout[h, row, col] = 1
+        return layout
+
+    def set_global_layout(self, h, layout):
+        num_blocks = layout.shape[1]
+        first_global_block_idx = (
+            self.num_local_blocks - (1 + h % self.num_different_global_patterns)
+            * self.num_global_blocks)
+        # set all global blocks except the last one if (num_blocks % num_local_blocks) != 0
+        end = num_blocks - (num_blocks % self.num_local_blocks)
+        for i in range(first_global_block_idx, end, self.num_local_blocks):
+            # vertical global attention
+            first_row = 0 if self.attention == "bidirectional" else i
+            # (((i // self.num_local_blocks) + 1) * self.num_local_blocks)
+            layout[h, first_row:, i:i + self.num_global_blocks] = 1
+            # horizontal global attention
+            if self.horizontal_global_attention:
+                layout[h, i:i + self.num_global_blocks, :] = 1
+        # residue block-window shorter than num_local_blocks at the tail
+        if end < num_blocks:
+            start = max(end, num_blocks - self.num_global_blocks)
+            first_row = 0 if self.attention == "bidirectional" else start
+            layout[h, first_row:, start:] = 1
+            if self.horizontal_global_attention:
+                layout[h, start:, :] = 1
+        if self.attention == "unidirectional":
+            layout[h] = np.tril(layout[h])
+        return layout
+
+    def make_layout(self, seq_len):
+        layout = self.setup_layout(seq_len)
+        for h in range(self.num_layout_heads):
+            layout = self.set_local_layout(h, layout)
+            layout = self.set_global_layout(h, layout)
+        return self.check_and_propagate_first_head_layout(layout)
+
+
+class VariableSparsityConfig(SparsityConfig):
+    """'Variable' pattern: random blocks + variable-size local windows +
+    explicit global block indices/ranges (reference sparsity_config.py:243)."""
+
+    def __init__(self,
+                 num_heads,
+                 block=16,
+                 different_layout_per_head=False,
+                 num_random_blocks=0,
+                 local_window_blocks=(4,),
+                 global_block_indices=(0,),
+                 global_block_end_indices=None,
+                 attention="bidirectional",
+                 horizontal_global_attention=False):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_random_blocks = num_random_blocks
+        self.local_window_blocks = list(local_window_blocks)
+        self.global_block_indices = list(global_block_indices)
+        if global_block_end_indices is not None:
+            if len(global_block_indices) != len(global_block_end_indices):
+                raise ValueError(
+                    "global_block_indices and global_block_end_indices must have "
+                    "the same length")
+            for start, end in zip(global_block_indices, global_block_end_indices):
+                if start >= end:
+                    raise ValueError(
+                        f"global block start index ({start}) must be smaller than "
+                        f"its end index ({end})")
+        self.global_block_end_indices = (list(global_block_end_indices)
+                                         if global_block_end_indices is not None else None)
+        if attention not in ("unidirectional", "bidirectional"):
+            raise NotImplementedError(
+                "only unidirectional or bidirectional attentions are supported")
+        self.attention = attention
+        if attention != "bidirectional" and horizontal_global_attention:
+            raise ValueError(
+                "only bidirectional attention can support horizontal global attention")
+        self.horizontal_global_attention = horizontal_global_attention
+
+    def set_random_layout(self, h, layout):
+        num_blocks = layout.shape[1]
+        if num_blocks < self.num_random_blocks:
+            raise ValueError(
+                f"Number of random blocks ({self.num_random_blocks}) must be smaller "
+                f"than overall number of blocks in a row ({num_blocks})")
+        for row in range(num_blocks):
+            rnd_cols = random.sample(range(num_blocks), self.num_random_blocks)
+            layout[h, row, rnd_cols] = 1
+        return layout
+
+    def set_local_layout(self, h, layout):
+        num_blocks = layout.shape[1]
+        start_block_idx = 0
+        end_block_idx = 0
+        for block_size in self.local_window_blocks:
+            end_block_idx += block_size
+            end_block_idx = min(end_block_idx, num_blocks)
+            for row in range(start_block_idx, end_block_idx):
+                for col in range(start_block_idx,
+                                 (row + 1) if self.attention == "unidirectional"
+                                 else end_block_idx):
+                    layout[h, row, col] = 1
+            start_block_idx += block_size
+        # repeat the last window size for remaining blocks
+        for i in range(start_block_idx, num_blocks, self.local_window_blocks[-1]):
+            end_block_idx = min(i + self.local_window_blocks[-1], num_blocks)
+            for row in range(i, end_block_idx):
+                for col in range(i,
+                                 (row + 1) if self.attention == "unidirectional"
+                                 else end_block_idx):
+                    layout[h, row, col] = 1
+        return layout
+
+    def set_global_layout(self, h, layout):
+        num_blocks = layout.shape[1]
+        if self.global_block_end_indices is None:
+            for idx in self.global_block_indices:
+                if idx < num_blocks:
+                    # vertical
+                    first_row = 0 if self.attention == "bidirectional" else idx
+                    layout[h, first_row:, idx] = 1
+                    # horizontal
+                    if self.horizontal_global_attention:
+                        layout[h, idx, :] = 1
+        else:
+            for start, end in zip(self.global_block_indices, self.global_block_end_indices):
+                end = min(end, num_blocks)
+                for idx in range(start, end):
+                    first_row = 0 if self.attention == "bidirectional" else idx
+                    layout[h, first_row:, idx] = 1
+                    if self.horizontal_global_attention:
+                        layout[h, idx, :] = 1
+        if self.attention == "unidirectional":
+            layout[h] = np.tril(layout[h])
+        return layout
+
+    def make_layout(self, seq_len):
+        layout = self.setup_layout(seq_len)
+        for h in range(self.num_layout_heads):
+            layout = self.set_random_layout(h, layout)
+            layout = self.set_local_layout(h, layout)
+            layout = self.set_global_layout(h, layout)
+        return self.check_and_propagate_first_head_layout(layout)
+
+
+class BigBirdSparsityConfig(SparsityConfig):
+    """BigBird (Zaheer et al. 2020): random + sliding window + global
+    first/last blocks (reference sparsity_config.py:421)."""
+
+    def __init__(self,
+                 num_heads,
+                 block=16,
+                 different_layout_per_head=False,
+                 num_random_blocks=1,
+                 num_sliding_window_blocks=3,
+                 num_global_blocks=1):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_random_blocks = num_random_blocks
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.num_global_blocks = num_global_blocks
+
+    def set_random_layout(self, h, layout):
+        num_blocks = layout.shape[1]
+        if num_blocks < self.num_random_blocks:
+            raise ValueError(
+                f"Number of random blocks ({self.num_random_blocks}) must be smaller "
+                f"than overall number of blocks in a row ({num_blocks})")
+        for row in range(num_blocks):
+            rnd_cols = random.sample(range(num_blocks), self.num_random_blocks)
+            layout[h, row, rnd_cols] = 1
+        return layout
+
+    def set_sliding_window_layout(self, h, layout):
+        num_blocks = layout.shape[1]
+        if num_blocks < self.num_sliding_window_blocks:
+            raise ValueError(
+                f"Number of sliding window blocks ({self.num_sliding_window_blocks}) "
+                f"must be smaller than overall number of blocks in a row ({num_blocks})")
+        w = self.num_sliding_window_blocks // 2
+        for row in range(num_blocks):
+            start = max(0, row - w)
+            end = min(row + w + 1, num_blocks)
+            layout[h, row, start:end] = 1
+        return layout
+
+    def set_global_layout_itc(self, h, layout):
+        num_blocks = layout.shape[1]
+        if num_blocks < self.num_global_blocks:
+            raise ValueError(
+                f"Number of global blocks ({self.num_global_blocks}) must be smaller "
+                f"than overall number of blocks in a row ({num_blocks})")
+        layout[h, 0:self.num_global_blocks, :] = 1
+        layout[h, :, 0:self.num_global_blocks] = 1
+        layout[h, -self.num_global_blocks:, :] = 1
+        layout[h, :, -self.num_global_blocks:] = 1
+        return layout
+
+    def make_layout(self, seq_len):
+        layout = self.setup_layout(seq_len)
+        for h in range(self.num_layout_heads):
+            layout = self.set_random_layout(h, layout)
+            layout = self.set_sliding_window_layout(h, layout)
+            layout = self.set_global_layout_itc(h, layout)
+        return self.check_and_propagate_first_head_layout(layout)
+
+
+class BSLongformerSparsityConfig(SparsityConfig):
+    """Block-sparse Longformer: sliding window + explicit global block
+    indices/ranges (reference sparsity_config.py:544)."""
+
+    def __init__(self,
+                 num_heads,
+                 block=16,
+                 different_layout_per_head=False,
+                 num_sliding_window_blocks=3,
+                 global_block_indices=(0,),
+                 global_block_end_indices=None):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.global_block_indices = list(global_block_indices)
+        if global_block_end_indices is not None:
+            if len(global_block_indices) != len(global_block_end_indices):
+                raise ValueError(
+                    "global_block_indices and global_block_end_indices must have "
+                    "the same length")
+            for start, end in zip(global_block_indices, global_block_end_indices):
+                if start >= end:
+                    raise ValueError(
+                        f"global block start index ({start}) must be smaller than "
+                        f"its end index ({end})")
+        self.global_block_end_indices = (list(global_block_end_indices)
+                                         if global_block_end_indices is not None else None)
+
+    def set_sliding_window_layout(self, h, layout):
+        num_blocks = layout.shape[1]
+        if num_blocks < self.num_sliding_window_blocks:
+            raise ValueError(
+                f"Number of sliding window blocks ({self.num_sliding_window_blocks}) "
+                f"must be smaller than overall number of blocks in a row ({num_blocks})")
+        w = self.num_sliding_window_blocks // 2
+        for row in range(num_blocks):
+            start = max(0, row - w)
+            end = min(row + w + 1, num_blocks)
+            layout[h, row, start:end] = 1
+        return layout
+
+    def set_global_layout(self, h, layout):
+        num_blocks = layout.shape[1]
+        if self.global_block_end_indices is None:
+            for idx in self.global_block_indices:
+                if idx < num_blocks:
+                    layout[h, :, idx] = 1
+                    layout[h, idx, :] = 1
+        else:
+            for start, end in zip(self.global_block_indices, self.global_block_end_indices):
+                end = min(end, num_blocks)
+                layout[h, :, start:end] = 1
+                layout[h, start:end, :] = 1
+        return layout
+
+    def make_layout(self, seq_len):
+        layout = self.setup_layout(seq_len)
+        for h in range(self.num_layout_heads):
+            layout = self.set_sliding_window_layout(h, layout)
+            layout = self.set_global_layout(h, layout)
+        return self.check_and_propagate_first_head_layout(layout)
+
+
+def config_to_sparsity(sa_config, num_heads):
+    """Build a SparsityConfig from the json section
+    (deepspeed_tpu/config/config.py SparseAttentionConfig) — the dispatch
+    the reference does in config.py:236-406."""
+    mode = sa_config.mode
+    if mode == "dense":
+        return DenseSparsityConfig(num_heads, sa_config.block,
+                                   sa_config.different_layout_per_head)
+    if mode == "fixed":
+        return FixedSparsityConfig(
+            num_heads, sa_config.block, sa_config.different_layout_per_head,
+            sa_config.num_local_blocks, sa_config.num_global_blocks,
+            sa_config.attention, sa_config.horizontal_global_attention,
+            sa_config.num_different_global_patterns)
+    if mode == "variable":
+        return VariableSparsityConfig(
+            num_heads, sa_config.block, sa_config.different_layout_per_head,
+            sa_config.num_random_blocks, sa_config.local_window_blocks,
+            sa_config.global_block_indices, sa_config.global_block_end_indices,
+            sa_config.attention, sa_config.horizontal_global_attention)
+    if mode == "bigbird":
+        return BigBirdSparsityConfig(
+            num_heads, sa_config.block, sa_config.different_layout_per_head,
+            sa_config.num_random_blocks, sa_config.num_sliding_window_blocks,
+            sa_config.num_global_blocks)
+    if mode == "bslongformer":
+        return BSLongformerSparsityConfig(
+            num_heads, sa_config.block, sa_config.different_layout_per_head,
+            sa_config.num_sliding_window_blocks, sa_config.global_block_indices,
+            sa_config.global_block_end_indices)
+    raise NotImplementedError(f"Given sparsity mode, {mode}, has not been implemented yet!")

@@ -1,0 +1,7 @@
+from deepspeed_tpu.parallel.mesh import MeshConfig, make_mesh, init_distributed
+from deepspeed_tpu.parallel.topology import (
+    ProcessTopology,
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+    PipelineParallelGrid,
+)

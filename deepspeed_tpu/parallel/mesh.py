@@ -1,0 +1,151 @@
+"""Device mesh construction — the TPU-native communication substrate.
+
+Replaces the reference's process-group machinery (torch.distributed/NCCL init
+in deepspeed/utils/distributed.py:12-142 and the group building in
+deepspeed/runtime/pipe/topology.py:252-455). On TPU every collective is an
+axis-scoped XLA op over a `jax.sharding.Mesh`; "creating a process group"
+becomes naming a mesh axis.
+
+Canonical axis order (outer→inner): ``('pipe', 'data', 'seq', 'model')`` —
+pipe outermost so stages land on contiguous sub-slices (cheap DCN hops between
+stages, fat ICI inside a stage for data/model collectives), matching the
+reference's topology axis order ['pipe','data','model']
+(pipe/topology.py:246).
+"""
+
+import dataclasses
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, PartitionSpec, NamedSharding
+
+from deepspeed_tpu.utils.logging import logger, log_dist
+
+# Mesh axis names. ZeRO shards over DATA_AXIS; tensor parallelism over
+# MODEL_AXIS; pipeline stages over PIPE_AXIS; ring-attention/sequence
+# parallelism over SEQ_AXIS; MoE experts over EXPERT_AXIS (aliased onto data).
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+EXPERT_AXIS = "expert"
+
+AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+_initialized = False
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     auto_mpi_discovery: bool = True):
+    """Multi-host initialization — parity with reference
+    deepspeed/utils/distributed.py:12 (init_distributed + mpi_discovery).
+
+    Single-process (the common TPU-VM single-host case and all unit tests) is
+    a no-op. Multi-host: uses explicit args, else env vars
+    (``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID``), else OpenMPI
+    env discovery (OMPI_COMM_WORLD_*), mirroring the reference's fallbacks.
+    """
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("COORDINATOR_ADDRESS")
+    if num_processes is None and os.environ.get("NUM_PROCESSES"):
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and os.environ.get("PROCESS_ID"):
+        process_id = int(os.environ["PROCESS_ID"])
+
+    # MPI discovery fallback (reference utils/distributed.py:54-142)
+    if auto_mpi_discovery and num_processes is None and "OMPI_COMM_WORLD_SIZE" in os.environ:
+        num_processes = int(os.environ["OMPI_COMM_WORLD_SIZE"])
+        process_id = int(os.environ["OMPI_COMM_WORLD_RANK"])
+
+    if coordinator_address and num_processes and num_processes > 1:
+        log_dist(f"jax.distributed.initialize({coordinator_address}, "
+                 f"n={num_processes}, id={process_id})", ranks=[0])
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    _initialized = True
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Logical parallelism degrees. ``data=-1`` absorbs the remaining devices.
+
+    The product pipe*data*seq*model must equal the device count (after -1
+    resolution)."""
+    data: int = -1
+    model: int = 1
+    pipe: int = 1
+    seq: int = 1
+    expert: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        explicit = self.model * self.pipe * self.seq
+        data = self.data
+        if data == -1:
+            assert n_devices % explicit == 0, (
+                f"device count {n_devices} not divisible by pipe*seq*model={explicit}")
+            data = n_devices // explicit
+        total = data * explicit
+        assert total == n_devices, (
+            f"mesh {self.pipe}x{data}x{self.seq}x{self.model} != {n_devices} devices")
+        return MeshConfig(data=data, model=self.model, pipe=self.pipe,
+                          seq=self.seq, expert=self.expert)
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence] = None,
+              axis_order: Sequence[str] = AXIS_ORDER) -> Mesh:
+    """Build the global device mesh.
+
+    Prefers ``jax.experimental.mesh_utils.create_device_mesh`` so the logical
+    mesh lines up with the physical ICI torus; falls back to a plain reshape
+    for CPU meshes used in tests.
+    """
+    if devices is None:
+        devices = jax.devices()
+    config = (config or MeshConfig()).resolve(len(devices))
+    shape = tuple({
+        PIPE_AXIS: config.pipe,
+        DATA_AXIS: config.data,
+        SEQ_AXIS: config.seq,
+        MODEL_AXIS: config.model,
+    }[a] for a in axis_order)
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+    except Exception:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names=tuple(axis_order))
+
+
+def single_device_mesh() -> Mesh:
+    return Mesh(np.asarray(jax.devices()[:1]).reshape((1, 1, 1, 1)), AXIS_ORDER)
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis] if axis in mesh.axis_names else 1
+
+
+def dp_world_size(mesh: Optional[Mesh]) -> int:
+    if mesh is None:
+        return 1
+    return mesh_axis_size(mesh, DATA_AXIS) * mesh_axis_size(mesh, EXPERT_AXIS)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Input batches shard over (data,) on dim 0 and seq axis on dim 1 when a
+    sequence axis exists."""
+    if mesh_axis_size(mesh, SEQ_AXIS) > 1:
+        return NamedSharding(mesh, PartitionSpec(DATA_AXIS, SEQ_AXIS))
+    return NamedSharding(mesh, PartitionSpec(DATA_AXIS))

@@ -1,0 +1,250 @@
+"""Cartesian process topology — API-parity rebuild of
+deepspeed/runtime/pipe/topology.py:12-455.
+
+On TPU the *communication* side of this file is obsolete — mesh axes replace
+process groups (see mesh.py). What survives is the pure coordinate math:
+rank ↔ (pipe, data, model) mapping used for checkpoint naming, stage
+assignment and grid bookkeeping. `PipelineParallelGrid` keeps the reference's
+accessor surface (get_stage_id, get_data_parallel_rank, …) but is backed by a
+`jax.sharding.Mesh` when one is supplied.
+"""
+
+from collections import namedtuple
+from itertools import product
+
+
+class ProcessTopology:
+    """Maps n-dim cartesian coordinates to linear ranks, axes major→minor.
+
+    Mirrors reference pipe/topology.py:12 (ProcessCoord namedtuples, filter
+    queries, etc.)."""
+
+    def __init__(self, axes, dims):
+        self.axes = axes
+        self.dims = dims
+        self.ProcessCoord = namedtuple("ProcessCoord", axes)
+        self.mapping = {}
+        ranges = [range(d) for d in dims]
+        for global_rank, coord in enumerate(product(*ranges)):
+            key = {axis: coord[self.axes.index(axis)] for axis in self.axes}
+            key = self.ProcessCoord(**key)
+            self.mapping[key] = global_rank
+
+    def get_rank(self, **coord_kwargs):
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError(f"get_rank() does not support slices, use filter_match")
+        key = self.ProcessCoord(**coord_kwargs)
+        assert key in self.mapping, f"key {coord_kwargs} invalid"
+        return self.mapping[key]
+
+    def get_axis_names(self):
+        return self.axes
+
+    def get_rank_repr(self, rank, omit_axes=("data", "pipe"), inner_sep="_", outer_sep="-"):
+        """String used in checkpoint filenames (reference topology.py:87):
+        e.g. mp_rank_00 style naming omits data/pipe axes."""
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.get_axis_names() if a not in omit_axes]
+        names = []
+        for ax in axes:
+            ax_rank = getattr(self.get_coord(rank=rank), ax)
+            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis):
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank):
+        for coord, idx in self.mapping.items():
+            if idx == rank:
+                return coord
+        raise ValueError(f"rank {rank} not found in topology.")
+
+    def get_axis_comm_lists(self, axis):
+        """All groups of ranks that vary along ``axis`` with other coords
+        fixed — the reference built process groups from these lists
+        (topology.py:139)."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        ranges = [range(self.get_dim(a)) for a in other_axes]
+        for coord in product(*ranges):
+            other_keys = {a: coord[other_axes.index(a)] for a in other_axes}
+            sub_list = []
+            for axis_key in range(self.get_dim(axis)):
+                key = self.ProcessCoord(**other_keys, **{axis: axis_key})
+                sub_list.append(self.mapping[key])
+            lists.append(sub_list)
+        return lists
+
+    def filter_match(self, **filter_kwargs):
+        """Ranks whose coords match all kwargs (reference topology.py:167)."""
+        def _filter_helper(x):
+            for key, val in filter_kwargs.items():
+                if getattr(x, key) != val:
+                    return False
+            return True
+        coords = filter(_filter_helper, self.mapping.keys())
+        return [self.mapping[coord] for coord in coords]
+
+    def get_axis_list(self, axis, idx):
+        return self.filter_match(**{axis: idx})
+
+    def world_size(self):
+        return len(self.mapping)
+
+    def __str__(self):
+        return str(self.mapping)
+
+
+def _prime_factors(N):
+    """Prime factorization in increasing order (reference topology.py:230)."""
+    if N <= 0:
+        raise ValueError("Factorization requires N > 0")
+    primes = []
+    while N % 2 == 0:
+        primes.append(2)
+        N //= 2
+    p = 3
+    while p * p <= N:
+        while N % p == 0:
+            primes.append(p)
+            N //= p
+        p += 2
+    if N > 1:
+        primes.append(N)
+    return primes
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """Hybrid pipeline+data topology; DP innermost for intra-node allreduce
+    bandwidth (reference topology.py:235)."""
+
+    def __init__(self, num_pp, num_dp):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """3D topology for DP×PP×TP (reference topology.py:246)."""
+
+    def __init__(self, num_pp, num_mp, num_dp):
+        super().__init__(axes=["pipe", "data", "model"], dims=[num_pp, num_dp, num_mp])
+
+
+class PipelineParallelGrid:
+    """Rank-bookkeeping for a hybrid grid — reference topology.py:252-455.
+
+    The reference builds torch process groups here; on TPU the mesh axes carry
+    the collectives, so this class only answers "who am I" queries. ``mesh``
+    (optional) ties it to a real jax Mesh; ``process_id`` selects this
+    process's coordinates (defaults to jax.process_index for multi-host)."""
+
+    def __init__(self, topology=None, process_group=None, mesh=None,
+                 world_size=None, global_rank=0):
+        if topology is None:
+            if mesh is not None:
+                num_pp = mesh.shape.get("pipe", 1)
+                num_mp = mesh.shape.get("model", 1)
+                num_dp = (mesh.size // (num_pp * num_mp))
+                topology = PipeModelDataParallelTopology(num_pp=num_pp,
+                                                         num_mp=num_mp,
+                                                         num_dp=num_dp)
+            else:
+                ws = world_size or 1
+                topology = PipeDataParallelTopology(num_pp=1, num_dp=ws)
+        self._topo = topology
+        self.mesh = mesh
+        self.global_rank = global_rank
+        self.world_size = topology.world_size()
+
+        self.data_parallel_size = max(self._topo.get_dim("data"), 1)
+        self.pipe_parallel_size = max(self._topo.get_dim("pipe"), 1)
+        self.model_parallel_size = max(self._topo.get_dim("model"), 1)
+        assert self.world_size == (self.data_parallel_size * self.pipe_parallel_size
+                                   * self.model_parallel_size)
+
+        self.stage_id = self.get_stage_id()
+        self.data_parallel_id = self.get_data_parallel_id()
+
+        # p2p pair lists kept for schedule bookkeeping (reference
+        # _build_p2p_groups topology.py:373); on TPU these become ppermute
+        # source/dest index pairs over the pipe axis.
+        self.p2p_matrix = self._build_p2p_pairs()
+
+    def _build_p2p_pairs(self):
+        pairs = []
+        if self.pipe_parallel_size <= 1:
+            return pairs
+        for rank in range(self.world_size):
+            coord = self._topo.get_coord(rank)
+            stage = getattr(coord, "pipe", 0)
+            next_stage = (stage + 1) % self.pipe_parallel_size
+            kwargs = coord._asdict()
+            kwargs["pipe"] = next_stage
+            pairs.append((rank, self._topo.get_rank(**kwargs)))
+        return pairs
+
+    def get_stage_id(self):
+        if "pipe" not in self._topo.get_axis_names():
+            return 0
+        return getattr(self._topo.get_coord(rank=self.global_rank), "pipe", 0)
+
+    def get_data_parallel_id(self):
+        if "data" not in self._topo.get_axis_names():
+            return 0
+        return getattr(self._topo.get_coord(rank=self.global_rank), "data", 0)
+
+    # -- reference accessor surface (topology.py:395-455) ------------------
+    def get_global_rank(self):
+        return self.global_rank
+
+    def get_pipe_parallel_rank(self):
+        return self.get_stage_id()
+
+    def get_pipe_parallel_world_size(self):
+        return self.pipe_parallel_size
+
+    def get_data_parallel_rank(self):
+        return self.get_data_parallel_id()
+
+    def get_data_parallel_world_size(self):
+        return self.data_parallel_size
+
+    def get_model_parallel_rank(self):
+        if "model" not in self._topo.get_axis_names():
+            return 0
+        return getattr(self._topo.get_coord(rank=self.global_rank), "model", 0)
+
+    def get_model_parallel_world_size(self):
+        return self.model_parallel_size
+
+    # mesh-era group accessors: return the axis name to use in collectives
+    def get_pipe_parallel_group(self):
+        return "pipe"
+
+    def get_data_parallel_group(self):
+        return "data"
+
+    def get_model_parallel_group(self):
+        return "model"
+
+    def get_slice_parallel_group(self):
+        # alias of model group, as in reference topology.py:455
+        return "model"
+
+    def topology(self):
+        return self._topo
+
+    def stage_to_global(self, stage_id, **kwargs):
+        me = self._topo.get_coord(self.global_rank)
+        transform = me._replace(pipe=stage_id, **kwargs)._asdict()
+        return self._topo.get_rank(**transform)
+
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    def is_last_stage(self):
+        return self.stage_id == self.pipe_parallel_size - 1

@@ -1,0 +1,110 @@
+"""Data loading — rebuild of deepspeed/runtime/dataloader.py:10,33.
+
+`DeepSpeedDataLoader` shards a dataset over the data-parallel axis and yields
+numpy batches ready for `jax.device_put` with the engine's batch sharding.
+`RepeatingLoader` is the reference's infinite wrapper, verbatim semantics.
+
+Works with: torch Datasets/DataLoaders (torch-cpu is in-image), numpy arrays,
+or any indexable. No torch import unless the dataset is a torch object.
+"""
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class RepeatingLoader:
+    """Wrap an iterator to restart on StopIteration (reference :10)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            batch = next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            batch = next(self.data_iter)
+        return batch
+
+
+def _to_numpy(x):
+    if isinstance(x, np.ndarray):
+        return x
+    if hasattr(x, "numpy"):  # torch tensor
+        return x.detach().cpu().numpy() if hasattr(x, "detach") else x.numpy()
+    return np.asarray(x)
+
+
+def default_collate(samples):
+    """Stack a list of samples (each a tuple/dict/array) into a batch."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([_to_numpy(s[k]) for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(np.stack([_to_numpy(s[i]) for s in samples])
+                           for i in range(len(first)))
+    return np.stack([_to_numpy(s) for s in samples])
+
+
+class DeepSpeedDataLoader:
+    """DP-sharded loader (reference :33). Each data-parallel rank sees a
+    disjoint strided shard; batch order reshuffles per epoch with a seeded
+    permutation so all ranks agree without communication."""
+
+    def __init__(self,
+                 dataset,
+                 batch_size,
+                 data_parallel_world_size=1,
+                 data_parallel_rank=0,
+                 collate_fn=None,
+                 shuffle=True,
+                 seed=1234,
+                 drop_last=True,
+                 local_rank=0):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.dp_world_size = int(data_parallel_world_size)
+        self.dp_rank = int(data_parallel_rank)
+        self.collate_fn = collate_fn or default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        try:
+            self._n = len(dataset)
+        except TypeError:
+            raise ValueError("DeepSpeedDataLoader requires a sized dataset")
+        shard = self._n // self.dp_world_size
+        self.len = shard // self.batch_size
+        if not drop_last and shard % self.batch_size:
+            self.len += 1
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __len__(self):
+        return self.len
+
+    def __iter__(self):
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self.epoch)
+            order = rng.permutation(self._n)
+        else:
+            order = np.arange(self._n)
+        # strided DP shard, same convention as torch DistributedSampler
+        my_idx = order[self.dp_rank::self.dp_world_size]
+        usable = (len(my_idx) // self.batch_size) * self.batch_size
+        if self.drop_last:
+            my_idx = my_idx[:usable]
+        for i in range(0, len(my_idx), self.batch_size):
+            batch_idx = my_idx[i:i + self.batch_size]
+            if len(batch_idx) < self.batch_size and self.drop_last:
+                break
+            samples = [self.dataset[int(j)] for j in batch_idx]
+            yield self.collate_fn(samples)
+        self.epoch += 1

@@ -1,0 +1,2 @@
+from deepspeed_tpu.runtime.fp16.onebit.adam import OnebitAdam
+from deepspeed_tpu.runtime.fp16.onebit.lamb import OnebitLamb

@@ -1,0 +1,94 @@
+"""1-bit LAMB — rebuild of deepspeed/runtime/fp16/onebit/lamb.py:11.
+
+Warmup phase (step < freeze_step): exact LAMB, while recording the running
+ratio of ||update||/||momentum|| ("scaling coefficient") per tensor, which
+the compressed phase reuses — the reference freezes both the variance and
+the lamb coefficient bounds at freeze_step (:175-210, 1-bit LAMB paper
+arXiv:2104.06069).
+
+Compressed phase: momentum sign-compressed with error feedback (as 1-bit
+Adam); the frozen per-tensor scaling coefficient replaces a fresh trust
+ratio (which would need the uncompressed update norm).
+"""
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optimizer import TpuOptimizer, tree_zeros_like
+
+
+def _tree_scalar_like(params, value):
+    return jax.tree_util.tree_map(
+        lambda p: jnp.asarray(value, jnp.float32), params)
+
+
+@dataclasses.dataclass
+class OnebitLamb(TpuOptimizer):
+    lr: float = 1e-3
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    freeze_step: int = 100000
+    max_coeff: float = 10.0
+    min_coeff: float = 0.01
+    coeff_beta: float = 0.9
+
+    param_like_state_fields = ("exp_avg", "exp_avg_sq", "worker_error")
+
+    def init(self, params):
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "exp_avg": tree_zeros_like(params, jnp.float32),
+            "exp_avg_sq": tree_zeros_like(params, jnp.float32),
+            "worker_error": tree_zeros_like(params, jnp.float32),
+            "lamb_coeff": _tree_scalar_like(params, 1.0),
+        }
+
+    def step(self, params, grads, state, lr=None):
+        lr = self.lr if lr is None else lr
+        beta1, beta2 = self.betas
+        count = state["step"] + 1
+        frozen = count > self.freeze_step
+
+        def update_leaf(p, g, m, v, e, coeff):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            m_new = beta1 * m + (1.0 - beta1) * g32
+            v_new = jnp.where(frozen, v, beta2 * v + (1.0 - beta2) * g32 * g32)
+
+            corrected = m_new + e
+            scale = jnp.mean(jnp.abs(corrected))
+            compressed = jnp.sign(corrected) * scale
+            e_new = jnp.where(frozen, corrected - compressed, e)
+            m_eff = jnp.where(frozen, compressed, m_new)
+
+            update = m_eff / (jnp.sqrt(v_new) + self.eps)
+            if self.weight_decay != 0.0:
+                update = update + self.weight_decay * p32
+
+            p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+            u_norm = jnp.sqrt(jnp.sum(update * update))
+            fresh = jnp.where((p_norm > 0) & (u_norm > 0),
+                              p_norm / jnp.maximum(u_norm, 1e-12),
+                              jnp.float32(1.0))
+            fresh = jnp.clip(fresh, self.min_coeff, self.max_coeff)
+            # running estimate during warmup, frozen afterwards (:188)
+            coeff_new = jnp.where(
+                frozen, coeff,
+                self.coeff_beta * coeff + (1.0 - self.coeff_beta) * fresh)
+            trust = jnp.where(frozen, coeff_new, fresh)
+
+            p_new = p32 - lr * trust * update
+            return p_new.astype(p.dtype), m_new, v_new, e_new, coeff_new
+
+        flat = jax.tree_util.tree_map(update_leaf, params, grads,
+                                      state["exp_avg"], state["exp_avg_sq"],
+                                      state["worker_error"], state["lamb_coeff"])
+        pick = lambda i: jax.tree_util.tree_map(  # noqa: E731
+            lambda t: t[i], flat, is_leaf=lambda x: isinstance(x, tuple))
+        return pick(0), {"step": count, "exp_avg": pick(1),
+                         "exp_avg_sq": pick(2), "worker_error": pick(3),
+                         "lamb_coeff": pick(4)}

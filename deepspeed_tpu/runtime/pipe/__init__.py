@@ -1,0 +1,2 @@
+from deepspeed_tpu.runtime.pipe.module import PipelineModule, LayerSpec, TiedLayerSpec
+from deepspeed_tpu.runtime.pipe import schedule

@@ -1,0 +1,261 @@
+"""Pipeline module — rebuild of deepspeed/runtime/pipe/module.py:25,73,87.
+
+`LayerSpec` delays layer construction so each stage only materializes its own
+layers (the reference's motivation, module.py:25). `PipelineModule` expresses
+a sequential model as specs, partitions them into stages
+(uniform / parameters / type:regex — module.py:355-410), and exposes
+`init`/`apply` so it drops into the engine like any flax model.
+
+TPU mapping: stage s's layers live on the mesh's 'pipe' axis coordinate s;
+the PipelineEngine runs the 1F1B schedule with ppermute transfers between
+stage sub-meshes (pipe/engine.py here). With pipe=1 the module is just a
+sequential container (and still exercises partitioning logic for tests).
+"""
+
+import re
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class LayerSpec:
+    """Builds-on-demand layer description (reference module.py:25)."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not issubclass(typename, object):
+            raise RuntimeError("LayerSpec only supports classes")
+
+    def build(self, log=False):
+        if log:
+            logger.info(f"building {repr(self)}")
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        return f"LayerSpec({self.typename.__name__})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose parameters are shared with every other layer of the same
+    ``key`` (reference module.py:73 — embedding/unembedding tying). The
+    forward_fn selects how the shared module is applied at this position."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None,
+                 tied_weight_attr="embedding", **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+def partition_uniform(num_items, num_parts):
+    """Even split boundaries: len == num_parts+1 (reference
+    runtime/utils.py partition_uniform)."""
+    parts = [0] * (num_parts + 1)
+    chunk = num_items // num_parts
+    residual = num_items - chunk * num_parts
+    for p in range(1, num_parts + 1):
+        parts[p] = parts[p - 1] + chunk + (1 if p <= residual else 0)
+    return parts
+
+
+def partition_balanced(weights, num_parts, eps=1e-3):
+    """Boundaries minimizing the max part weight — binary search over
+    capacity + greedy packing (reference runtime/utils.py
+    partition_balanced semantics)."""
+    weights = [float(w) for w in weights]
+    n = len(weights)
+    if num_parts >= n:
+        return partition_uniform(n, num_parts)
+
+    def feasible(cap):
+        parts, load, used = [0], 0.0, 1
+        for i, w in enumerate(weights):
+            if load + w > cap and load > 0:
+                used += 1
+                parts.append(i)
+                load = 0.0
+                if used > num_parts:
+                    return None
+            load += w
+        parts.append(n)
+        while len(parts) < num_parts + 1:
+            parts.insert(-1, parts[-1])
+        return parts
+
+    lo, hi = max(weights), sum(weights)
+    best = feasible(hi)
+    while hi - lo > eps * max(sum(weights), 1.0):
+        mid = (lo + hi) / 2
+        cand = feasible(mid)
+        if cand is not None:
+            best, hi = cand, mid
+        else:
+            lo = mid
+    return best
+
+
+class PipelineModule:
+    """See module docstring. Key ctor args mirror the reference
+    (module.py:87): layers, num_stages, topology, loss_fn, seed_layers,
+    partition_method, activation_checkpoint_interval."""
+
+    def __init__(self,
+                 layers,
+                 num_stages: Optional[int] = None,
+                 topology=None,
+                 loss_fn: Optional[Callable] = None,
+                 seed_layers: bool = False,
+                 base_seed: int = 1234,
+                 partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 0,
+                 checkpointable_layers=None):
+        self._layer_specs = list(layers)
+        self._num_layers = len(self._layer_specs)
+        self.loss_fn = loss_fn
+        self.seed_layers = seed_layers
+        self.base_seed = base_seed
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.checkpointable_layers = checkpointable_layers
+
+        if num_stages is None and topology is None:
+            num_stages = 1
+        if topology is not None and num_stages is None:
+            num_stages = topology.get_dim("pipe")
+        self.num_stages = num_stages
+        self.topology = topology
+
+        # build every layer (single-program SPMD: all stages traced together,
+        # GSPMD places each stage's params on its pipe coordinate)
+        self.forward_funcs: List[Any] = []
+        self.tied_modules = {}
+        self.tied_specs = {}
+        for i, spec in enumerate(self._layer_specs):
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in self.tied_modules:
+                    self.tied_modules[spec.key] = spec.build()
+                    self.tied_specs[spec.key] = spec
+                self.forward_funcs.append((spec.key, spec.forward_fn))
+            elif isinstance(spec, LayerSpec):
+                self.forward_funcs.append(spec.build())
+            elif callable(spec):
+                self.forward_funcs.append(spec)
+            else:
+                raise TypeError(f"Layer specification {spec} is not supported")
+
+        self.parts = None  # stage boundaries; set by _partition_layers
+        self._partition_layers_static()
+
+    # -- partitioning ------------------------------------------------------
+    def _layer_weights_by_class(self, regex):
+        pattern = re.compile(regex)
+        weights = []
+        for f in self.forward_funcs:
+            cls = type(f[0] if isinstance(f, tuple) else f).__name__
+            weights.append(1.0 if pattern.search(cls) else 0.0)
+        return weights
+
+    def _partition_layers_static(self):
+        """Partition without parameter counts (uniform / type:regex). The
+        'parameters' method refines boundaries at init() when shapes are
+        known (the reference counts torch params eagerly, module.py:388)."""
+        method = (self.partition_method or "uniform").lower()
+        if method.startswith("type:"):
+            weights = self._layer_weights_by_class(method[5:])
+            if sum(weights) == 0:
+                weights = [1.0] * self._num_layers
+            self.parts = partition_balanced(weights, self.num_stages)
+        else:
+            self.parts = partition_uniform(self._num_layers, self.num_stages)
+
+    def _partition_layers_by_params(self, params):
+        counts = []
+        for i in range(self._num_layers):
+            sub = params.get(f"layer_{i}", {})
+            counts.append(sum(int(np.prod(p.shape))
+                              for p in jax.tree_util.tree_leaves(sub)) + 1.0)
+        self.parts = partition_balanced(counts, self.num_stages)
+        for s in range(self.num_stages):
+            logger.info(f"pipeline stage {s}: layers "
+                        f"[{self.parts[s]}, {self.parts[s+1]}) "
+                        f"params={sum(counts[self.parts[s]:self.parts[s+1]])/1e6:.2f}M")
+
+    def stage_of_layer(self, layer_idx):
+        for s in range(self.num_stages):
+            if self.parts[s] <= layer_idx < self.parts[s + 1]:
+                return s
+        return self.num_stages - 1
+
+    def stage_layers(self, stage_id):
+        return list(range(self.parts[stage_id], self.parts[stage_id + 1]))
+
+    # -- flax-like interface ----------------------------------------------
+    def _apply_layer(self, idx, layer_params, x, tied_params):
+        f = self.forward_funcs[idx]
+        if isinstance(f, tuple):  # tied layer
+            key, forward_fn = f
+            module = self.tied_modules[key]
+            p = tied_params[key]
+            if forward_fn is not None:
+                return forward_fn(module, p, x)
+            return module.apply({"params": p}, x)
+        if hasattr(f, "apply") and hasattr(f, "init"):
+            return f.apply({"params": layer_params}, x)
+        return f(x)
+
+    def init(self, rng, x):
+        params = {}
+        tied = {}
+        h = x
+        for i, f in enumerate(self.forward_funcs):
+            if self.seed_layers:
+                rng = jax.random.fold_in(jax.random.PRNGKey(self.base_seed), i)
+            if isinstance(f, tuple):
+                key, forward_fn = f
+                module = self.tied_modules[key]
+                if key not in tied:
+                    rng, sub = jax.random.split(rng)
+                    tied[key] = module.init(sub, h)["params"]
+                h = self._apply_layer(i, None, h, tied)
+            elif hasattr(f, "init"):
+                rng, sub = jax.random.split(rng)
+                variables = f.init(sub, h)
+                params[f"layer_{i}"] = variables.get("params", variables)
+                h = self._apply_layer(i, params[f"layer_{i}"], h, tied)
+            else:
+                h = f(h)
+        params["tied"] = tied
+        if (self.partition_method or "").lower() == "parameters":
+            self._partition_layers_by_params(params)
+        return {"params": params}
+
+    def apply(self, variables, x, **kwargs):
+        params = variables["params"]
+        tied = params.get("tied", {})
+        h = x
+        for i in range(self._num_layers):
+            layer_params = params.get(f"layer_{i}")
+            if self.activation_checkpoint_interval > 0 and \
+                    i % self.activation_checkpoint_interval == 0:
+                h = jax.checkpoint(
+                    lambda p, hh, idx=i: self._apply_layer(idx, p, hh, tied)
+                )(layer_params, h)
+            else:
+                h = self._apply_layer(i, layer_params, h, tied)
+        return h
+
+    def __call__(self, x):
+        raise RuntimeError("PipelineModule must be used through an engine")
+
+    def num_layers(self):
+        return self._num_layers
+
+    def topology_grid(self):
+        return self.topology

@@ -1,0 +1,106 @@
+"""Mixed precision — rebuild of deepspeed/runtime/fp16/loss_scaler.py:56,79
+and the FP16_Optimizer overflow machinery (fused_optimizer.py:17).
+
+TPU-native stance: bf16 is the default mixed-precision mode and needs *no*
+loss scaling (same exponent range as fp32). fp16 parity mode implements the
+reference's dynamic loss scaler as pure jit-able state:
+
+    scale doubles every `scale_window` overflow-free steps,
+    halves (×1/scale_factor) on overflow with `hysteresis` grace,
+    clamped at `min_scale`; overflowed steps skip the update
+    (reference fused_optimizer.py:194-246 skip semantics).
+"""
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionConfig:
+    compute_dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    fp16: bool = False               # fp16 parity mode (dynamic loss scale)
+    static_loss_scale: float = 0     # >0 → static scale (reference loss_scale)
+    initial_scale_power: int = 32
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+
+    @staticmethod
+    def from_ds_config(cfg):
+        if cfg.fp16_enabled:
+            return PrecisionConfig(compute_dtype=jnp.float16, fp16=True,
+                                   static_loss_scale=cfg.loss_scale,
+                                   initial_scale_power=cfg.initial_scale_power,
+                                   loss_scale_window=cfg.loss_scale_window,
+                                   hysteresis=cfg.hysteresis,
+                                   min_loss_scale=cfg.min_loss_scale)
+        if cfg.bf16_enabled:
+            return PrecisionConfig(compute_dtype=jnp.bfloat16)
+        return PrecisionConfig(compute_dtype=jnp.float32)
+
+    @property
+    def dynamic(self):
+        return self.fp16 and not self.static_loss_scale
+
+
+def init_scaler_state(cfg: PrecisionConfig) -> Dict[str, jax.Array]:
+    if cfg.static_loss_scale:
+        scale = float(cfg.static_loss_scale)
+    elif cfg.fp16:
+        scale = float(2.0 ** cfg.initial_scale_power)
+    else:
+        scale = 1.0
+    return {
+        "loss_scale": jnp.asarray(scale, jnp.float32),
+        "good_steps": jnp.zeros((), jnp.int32),
+        "hysteresis": jnp.asarray(cfg.hysteresis, jnp.int32),
+        "overflow": jnp.zeros((), jnp.bool_),
+        "skipped_steps": jnp.zeros((), jnp.int32),
+    }
+
+
+def grads_finite(grads) -> jax.Array:
+    leaves = jax.tree_util.tree_leaves(grads)
+    finites = [jnp.all(jnp.isfinite(g)) for g in leaves]
+    return jnp.stack(finites).all() if finites else jnp.asarray(True)
+
+
+def update_scaler(state, cfg: PrecisionConfig, finite: jax.Array):
+    """One scaler transition (reference DynamicLossScaler.update_scale,
+    loss_scaler.py:79). Static mode only records the overflow bit."""
+    if not cfg.dynamic:
+        return {**state, "overflow": ~finite,
+                "skipped_steps": state["skipped_steps"] + (~finite).astype(jnp.int32)}
+    scale = state["loss_scale"]
+    good = state["good_steps"]
+    hyst = state["hysteresis"]
+
+    # overflow path
+    new_hyst = jnp.maximum(hyst - 1, 1)
+    drop_scale = jnp.maximum(scale / 2.0, cfg.min_loss_scale)
+    o_scale = jnp.where(hyst <= 1, drop_scale, scale)
+    # clean path
+    grow = (good + 1) >= cfg.loss_scale_window
+    c_scale = jnp.where(grow, scale * 2.0, scale)
+    c_good = jnp.where(grow, 0, good + 1)
+
+    return {
+        "loss_scale": jnp.where(finite, c_scale, o_scale),
+        "good_steps": jnp.where(finite, c_good, 0),
+        "hysteresis": jnp.where(finite, jnp.asarray(cfg.hysteresis, jnp.int32),
+                                new_hyst),
+        "overflow": ~finite,
+        "skipped_steps": state["skipped_steps"] + (~finite).astype(jnp.int32),
+    }
+
+
+def cast_to_compute(tree, cfg: PrecisionConfig):
+    def cast(x):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(cfg.compute_dtype)
+        return x
+    return jax.tree_util.tree_map(cast, tree)

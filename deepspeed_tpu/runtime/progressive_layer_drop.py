@@ -1,0 +1,30 @@
+"""Progressive Layer Drop — rebuild of
+deepspeed/runtime/progressive_layer_drop.py:5.
+
+theta(t) = (1 - theta_base) * exp(-gamma * t) + theta_base, fed to the model
+as a per-step keep probability (the reference passes
+``progressive_layer_drop=pld`` into forward kwargs, engine.py:1018-1019).
+Here `theta_at` is jnp-traceable so it evaluates inside the jitted step.
+"""
+
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta=0.5, gamma=0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def theta_at(self, step):
+        step = jnp.asarray(step, jnp.float32)
+        return (1.0 - self.theta) * jnp.exp(-self.gamma * step) + self.theta
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self):
+        return self.current_theta
+
+    def update_state(self, global_step):
+        self.current_theta = float(self.theta_at(global_step))

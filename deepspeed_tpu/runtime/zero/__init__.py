@@ -1,0 +1,4 @@
+from deepspeed_tpu.runtime.zero.partition import (
+    ZeroPartitioner,
+    shard_spec_for_leaf,
+)

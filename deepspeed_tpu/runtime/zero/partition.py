@@ -1,0 +1,158 @@
+"""ZeRO stages 1/2/3 as GSPMD sharding rules.
+
+The reference implements ZeRO as ~6,300 lines of imperative partition
+bookkeeping (zero/stage1.py:57, stage2.py:68, stage3.py:581,
+partition_parameters.py:450-545). On TPU the same *memory states* are
+expressed declaratively and XLA inserts the collectives:
+
+  stage 0: params, grads, optimizer state all replicated over the data axis
+           (plain DP — grad psum).
+  stage 1: optimizer state sharded over the data axis; grads replicated
+           (all-reduce), each shard of the update computed locally, updated
+           params all-gathered — exactly the reference's sub-partition
+           scheme (stage1.py:305) with XLA choosing the bucketing.
+  stage 2: + gradients sharded: the grad sharding constraint turns the
+           backward all-reduce into reduce-scatter (+ all-gather of updated
+           params) — the reference's IPG-bucket reduce-scatter
+           (stage2.py:614-746).
+  stage 3: + parameters sharded at rest. Forward/backward all-gathers each
+           layer's params just-in-time; with scanned layers XLA overlaps the
+           gather of layer i+1 with compute of layer i — the reference's
+           PartitionedParameterCoordinator prefetch (stage3.py:287-447)
+           falls out of the schedule.
+
+Sharding choice per tensor: the largest dimension not already occupied by a
+tensor-parallel axis, provided it divides by the data-axis size; otherwise
+the tensor stays replicated (the analog of the reference's
+`param_persistence_threshold` — small tensors aren't worth partitioning,
+stage3.py constants ZERO_PARAM_PERSISTENCE_THRESHOLD).
+"""
+
+from typing import Optional
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
+
+def shard_spec_for_leaf(shape,
+                        dp_size: int,
+                        base_spec: Optional[PartitionSpec] = None,
+                        min_size: int = 0,
+                        axis_name: str = mesh_lib.DATA_AXIS) -> PartitionSpec:
+    """Extend ``base_spec`` (TP sharding) with a data-axis shard on the
+    largest free, divisible dimension. Returns base_spec unchanged if no
+    dimension qualifies or the tensor is below ``min_size`` elements."""
+    base = tuple(base_spec) if base_spec is not None else ()
+    base = base + (None,) * (len(shape) - len(base))
+    if dp_size <= 1 or int(np.prod(shape or (1,))) < max(min_size, dp_size):
+        return PartitionSpec(*base)
+    # candidate dims: unsharded, divisible by dp, largest first
+    candidates = sorted(
+        (d for d in range(len(shape))
+         if base[d] is None and shape[d] % dp_size == 0 and shape[d] >= dp_size),
+        key=lambda d: shape[d], reverse=True)
+    if not candidates:
+        return PartitionSpec(*base)
+    d = candidates[0]
+    new = list(base)
+    new[d] = axis_name
+    return PartitionSpec(*new)
+
+
+class ZeroPartitioner:
+    """Produces NamedShardings for params / grads / optimizer state given the
+    configured ZeRO stage. ``tp_specs`` is an optional pytree of
+    PartitionSpec matching the params tree carrying tensor-parallel axes."""
+
+    def __init__(self, mesh: Mesh, stage: int, tp_specs=None,
+                 param_persistence_threshold: int = 0):
+        assert 0 <= stage <= 3
+        self.mesh = mesh
+        self.stage = stage
+        self.tp_specs = tp_specs
+        self.dp = mesh_lib.mesh_axis_size(mesh, mesh_lib.DATA_AXIS)
+        self.min_size = int(param_persistence_threshold)
+
+    # -- spec trees --------------------------------------------------------
+    def _base_spec(self, path, leaf):
+        if self.tp_specs is None:
+            return None
+        # tp_specs is a matching tree; fetch by path
+        sub = self.tp_specs
+        try:
+            for p in path:
+                key = getattr(p, "key", None)
+                if key is None:
+                    key = getattr(p, "idx", None)
+                if key is None:
+                    key = getattr(p, "name", None)
+                sub = sub[key]
+            return sub
+        except (KeyError, TypeError, IndexError):
+            return None
+
+    def _zero_spec(self, path, leaf):
+        base = self._base_spec(path, leaf)
+        return shard_spec_for_leaf(leaf.shape, self.dp, base,
+                                   min_size=self.min_size)
+
+    def _tp_only_spec(self, path, leaf):
+        base = self._base_spec(path, leaf)
+        base = tuple(base) if base is not None else ()
+        base = base + (None,) * (len(leaf.shape) - len(base))
+        return PartitionSpec(*base)
+
+    def param_specs(self, params):
+        """Stage 3 shards params at rest; stages 0-2 keep them replicated
+        (modulo TP axes)."""
+        fn = self._zero_spec if self.stage >= 3 else self._tp_only_spec
+        return jax.tree_util.tree_map_with_path(fn, params)
+
+    def grad_specs(self, params):
+        """Stage >=2: sharded grads (reduce-scatter); else same as params."""
+        fn = self._zero_spec if self.stage >= 2 else self._tp_only_spec
+        return jax.tree_util.tree_map_with_path(fn, params)
+
+    def opt_param_like_specs(self, params):
+        """Stage >=1: shard optimizer moments like stage-3 params."""
+        fn = self._zero_spec if self.stage >= 1 else self._tp_only_spec
+        return jax.tree_util.tree_map_with_path(fn, params)
+
+    # -- sharding trees ----------------------------------------------------
+    def _named(self, spec_tree):
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), spec_tree,
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+    def param_shardings(self, params):
+        return self._named(self.param_specs(params))
+
+    def grad_shardings(self, params):
+        return self._named(self.grad_specs(params))
+
+    def opt_state_shardings(self, opt_state, params, param_like_fields):
+        """Build shardings for the optimizer-state dict: fields listed in
+        ``param_like_fields`` mirror the param tree and get ZeRO specs;
+        everything else (step counters, scalars) is replicated."""
+        moment_shardings = self._named(self.opt_param_like_specs(params))
+        out = {}
+        for key, sub in opt_state.items():
+            if key in param_like_fields:
+                out[key] = moment_shardings
+            else:
+                out[key] = jax.tree_util.tree_map(
+                    lambda _: NamedSharding(self.mesh, PartitionSpec()), sub)
+        return out
+
+    def constrain_grads(self, grads):
+        """Apply the stage>=2 reduce-scatter constraint inside the train step."""
+        if self.stage < 2:
+            return grads
+        specs = self.grad_specs(grads)
+        return jax.tree_util.tree_map(
+            lambda g, s: jax.lax.with_sharding_constraint(
+                g, NamedSharding(self.mesh, s)),
+            grads, specs)

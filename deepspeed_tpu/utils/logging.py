@@ -1,0 +1,49 @@
+"""Rank-aware logging — TPU-native rebuild of deepspeed/utils/logging.py:7,40.
+
+On TPU-VM there is one process per host; "rank" here is ``jax.process_index``.
+"""
+
+import logging
+import sys
+import functools
+
+LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"
+
+
+class LoggerFactory:
+    @staticmethod
+    def create_logger(name=None, level=logging.INFO):
+        if name is None:
+            raise ValueError("name for logger cannot be None")
+        formatter = logging.Formatter(LOG_FORMAT)
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            handler = logging.StreamHandler(stream=sys.stdout)
+            handler.setFormatter(formatter)
+            logger_.addHandler(handler)
+        return logger_
+
+
+logger = LoggerFactory.create_logger(name="deepspeed_tpu", level=logging.INFO)
+
+
+@functools.lru_cache(maxsize=None)
+def _process_index():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log on selected process ranks only (reference utils/logging.py:40).
+
+    ``ranks=None`` or ``[-1]`` logs everywhere; otherwise only listed
+    ``jax.process_index`` values log, prefixed with the rank.
+    """
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")

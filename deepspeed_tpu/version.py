@@ -1,0 +1,21 @@
+"""Version info (reference: version.txt + setup.py git-hash embedding)."""
+
+import subprocess
+
+__version__ = "0.1.0"
+
+
+def _git(cmd):
+    try:
+        return subprocess.check_output(["git"] + cmd,
+                                       stderr=subprocess.DEVNULL).decode().strip()
+    except Exception:
+        return "unknown"
+
+
+def git_hash():
+    return _git(["rev-parse", "--short", "HEAD"])
+
+
+def git_branch():
+    return _git(["rev-parse", "--abbrev-ref", "HEAD"])

@@ -1,0 +1,21 @@
+"""Packaging — the reference ships setup.py with AOT op builds (setup.py:89);
+here there is nothing to precompile for the JAX path, and the native C++
+host libraries (deepspeed_tpu/csrc) build lazily via the op builder at
+first use (deepspeed_tpu/ops/native)."""
+
+from setuptools import setup, find_packages
+
+setup(
+    name="deepspeed_tpu",
+    version="0.1.0",
+    description="TPU-native large-model training framework "
+                "(DeepSpeed-capability rebuild on JAX/XLA/Pallas)",
+    packages=find_packages(include=["deepspeed_tpu", "deepspeed_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=["jax", "flax", "numpy"],
+    entry_points={
+        "console_scripts": [
+            "dstpu_report=deepspeed_tpu.env_report:main",
+        ],
+    },
+)

@@ -1,0 +1,42 @@
+"""Test harness: single-process multi-device simulation.
+
+The reference spawns N processes with real NCCL for every distributed test
+(tests/unit/common.py:16 @distributed_test). On TPU/JAX we instead force the
+CPU backend to expose 8 virtual devices, so every mesh/sharding/collective
+path runs in-process (SURVEY §4 'lesson for the TPU rebuild'). This must run
+before jax initializes, hence module-level in conftest.
+"""
+
+import os
+
+# hard override: the machine env may preset JAX_PLATFORMS to a TPU plugin,
+# and a sitecustomize may have imported jax already — set both the env var
+# and the live config.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags +
+                               " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture(autouse=True)
+def _np_seed():
+    np.random.seed(0)

@@ -1,0 +1,60 @@
+"""Model-zoo fixtures — the analog of the reference's tests/unit/simple_model.py
+(SimpleModel, LinearStack, pipeline variants; SURVEY §4)."""
+
+import numpy as np
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+class SimpleModel(nn.Module):
+    """Two-layer MLP classifier (reference SimpleModel: Linear+CrossEntropy)."""
+    hidden_dim: int = 16
+    n_classes: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.hidden_dim)(x)
+        h = nn.relu(h)
+        return nn.Dense(self.n_classes)(h)
+
+
+class LinearStack(nn.Module):
+    """Stack of equal Linear layers (reference LinearStack — used for
+    pipeline partitioning tests)."""
+    num_layers: int = 4
+    hidden_dim: int = 16
+
+    @nn.compact
+    def __call__(self, x):
+        for _ in range(self.num_layers):
+            x = nn.Dense(self.hidden_dim, use_bias=False)(x)
+        return x
+
+
+def random_dataset(n=64, dim=8, n_classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(n, dim).astype(np.float32)
+    ys = rng.randint(0, n_classes, size=(n,)).astype(np.int32)
+    return [(xs[i], ys[i]) for i in range(n)]
+
+
+def random_batch(batch_size=8, dim=8, n_classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(batch_size, dim).astype(np.float32),
+            rng.randint(0, n_classes, size=(batch_size,)).astype(np.int32))
+
+
+def token_batch(batch_size=4, seq=16, vocab=512, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"input_ids": rng.randint(0, vocab, size=(batch_size, seq)).astype(np.int32)}
+
+
+def base_config(**overrides):
+    cfg = {
+        "train_batch_size": 8,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 100,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    }
+    cfg.update(overrides)
+    return cfg

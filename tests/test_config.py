@@ -1,0 +1,147 @@
+"""Config tests — modeled on the reference's test_config.py/test_ds_config.py
+coverage of the batch triangle (config.py:837) and section parsing."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.config.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def test_batch_triangle_all_given():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 8,
+    }, world_size=1)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_triangle_infers_gas():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 4,
+    }, world_size=2)
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_batch_triangle_infers_micro():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 32,
+        "gradient_accumulation_steps": 4,
+    }, world_size=2)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_batch_triangle_infers_train_batch():
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+    }, world_size=4)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_triangle_only_train_batch():
+    cfg = DeepSpeedConfig({"train_batch_size": 16}, world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_triangle_mismatch_raises():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig({
+            "train_batch_size": 33,
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 8,
+        }, world_size=1)
+
+
+def test_batch_none_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, world_size=1)
+
+
+def test_zero_section():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {"stage": 2, "reduce_bucket_size": 1000,
+                              "offload_optimizer": {"device": "cpu"}},
+    }, world_size=1)
+    assert cfg.zero_enabled
+    assert cfg.zero_optimization_stage == 2
+    assert cfg.zero_config.reduce_bucket_size == 1000
+    assert cfg.zero_config.offload_optimizer.enabled
+    assert cfg.zero_config.cpu_offload
+
+
+def test_zero_legacy_bool():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "zero_optimization": True},
+                          world_size=1)
+    assert cfg.zero_optimization_stage == 1
+
+
+def test_zero_invalid_stage():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "zero_optimization": {"stage": 5}}, world_size=1)
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}}, world_size=1)
+
+
+def test_fp16_section_defaults():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True}},
+                          world_size=1)
+    assert cfg.fp16_enabled
+    assert cfg.initial_scale_power == 32
+    assert cfg.loss_scale_window == 1000
+
+
+def test_precision_key():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "precision": "bfloat16"},
+                          world_size=1)
+    assert cfg.bf16_enabled and not cfg.fp16_enabled
+
+
+def test_optimizer_scheduler_sections():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.001, "betas": [0.8, 0.99]}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+    }, world_size=1)
+    assert cfg.optimizer_name == "adam"
+    assert cfg.optimizer_params["betas"] == [0.8, 0.99]
+    assert cfg.scheduler_name == "WarmupLR"
+
+
+def test_config_from_json_file(tmp_path):
+    path = tmp_path / "ds_config.json"
+    path.write_text(json.dumps({"train_batch_size": 8}))
+    cfg = DeepSpeedConfig(str(path), world_size=1)
+    assert cfg.train_batch_size == 8
+
+
+def test_config_from_json_string():
+    cfg = DeepSpeedConfig('{"train_batch_size": 8}', world_size=1)
+    assert cfg.train_batch_size == 8
+
+
+def test_sparse_attention_section():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "sparse_attention": {"mode": "bigbird", "block": 32,
+                             "num_random_blocks": 2},
+    }, world_size=1)
+    sa = cfg.sparse_attention_config
+    assert sa.enabled and sa.mode == "bigbird" and sa.block == 32
+    assert sa.num_random_blocks == 2
+
+
+def test_micro_batch_per_chip_alias():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_chip": 4}, world_size=2)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.train_batch_size == 8

@@ -1,0 +1,75 @@
+"""LR schedule tests — the reference's test_lr_schedulers.py analog."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    LRRangeTest, OneCycle, WarmupLR, WarmupDecayLR, get_lr_schedule)
+
+
+def test_warmup_lr_ramps_then_flat():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10,
+                 warmup_type="linear")
+    assert float(s.lr_at(0)) == pytest.approx(0.0)
+    assert float(s.lr_at(5)) == pytest.approx(0.05)
+    assert float(s.lr_at(10)) == pytest.approx(0.1)
+    assert float(s.lr_at(100)) == pytest.approx(0.1)
+
+
+def test_warmup_lr_log_monotone():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=100)
+    vals = [float(s.lr_at(t)) for t in range(0, 120, 10)]
+    assert all(b >= a - 1e-9 for a, b in zip(vals, vals[1:]))
+    assert vals[-1] == pytest.approx(0.1, rel=1e-2)
+
+
+def test_warmup_decay_lr():
+    s = WarmupDecayLR(total_num_steps=100, warmup_min_lr=0.0,
+                      warmup_max_lr=0.1, warmup_num_steps=10,
+                      warmup_type="linear")
+    assert float(s.lr_at(10)) == pytest.approx(0.1)
+    assert float(s.lr_at(55)) == pytest.approx(0.05)
+    assert float(s.lr_at(100)) == pytest.approx(0.0, abs=1e-8)
+    assert float(s.lr_at(200)) == pytest.approx(0.0, abs=1e-8)
+
+
+def test_lr_range_test():
+    s = LRRangeTest(lr_range_test_min_lr=0.01, lr_range_test_step_size=10,
+                    lr_range_test_step_rate=1.0)
+    assert float(s.lr_at(0)) == pytest.approx(0.01)
+    assert float(s.lr_at(10)) == pytest.approx(0.02)
+    s2 = LRRangeTest(lr_range_test_min_lr=0.01, lr_range_test_step_size=10,
+                     lr_range_test_step_rate=1.0, lr_range_test_staircase=True)
+    assert float(s2.lr_at(9)) == pytest.approx(0.01)
+    assert float(s2.lr_at(10)) == pytest.approx(0.02)
+
+
+def test_one_cycle():
+    s = OneCycle(cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=10)
+    assert float(s.lr_at(0)) == pytest.approx(0.01)
+    assert float(s.lr_at(10)) == pytest.approx(0.1)
+    assert float(s.lr_at(20)) == pytest.approx(0.01)
+    # momentum cycles inversely
+    assert float(s.mom_at(0)) == pytest.approx(0.9)
+    assert float(s.mom_at(10)) == pytest.approx(0.8)
+
+
+def test_get_lr_schedule_dispatch():
+    s = get_lr_schedule("WarmupLR", {"warmup_max_lr": 0.5})
+    assert isinstance(s, WarmupLR)
+    with pytest.raises(ValueError):
+        get_lr_schedule("Nope", {})
+
+
+def test_torch_style_interface():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10,
+                 warmup_type="linear")
+    s.step()
+    s.step()
+    assert s.last_batch_iteration == 1
+    lr = s.get_lr()[0]
+    assert 0 < lr <= 0.1
+    sd = s.state_dict()
+    s2 = WarmupLR()
+    s2.load_state_dict(sd)
+    assert s2.last_batch_iteration == 1

@@ -1,0 +1,91 @@
+"""1-bit optimizer tests — the reference's test_onebit.py role: warmup phase
+matches Adam exactly; compressed phase keeps training and maintains error
+feedback."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.runtime.fp16.onebit import OnebitAdam, OnebitLamb
+from deepspeed_tpu.ops.adam import FusedAdam
+from tests.simple_model import SimpleModel, random_batch, base_config
+
+
+def _params():
+    return {"w": jnp.asarray(np.random.RandomState(0).randn(8, 16), jnp.float32),
+            "b": jnp.zeros((16,), jnp.float32)}
+
+
+def _grads():
+    return {"w": jnp.asarray(np.random.RandomState(1).randn(8, 16), jnp.float32),
+            "b": jnp.ones((16,), jnp.float32)}
+
+
+def test_onebit_adam_warmup_matches_adam():
+    p = _params()
+    g = _grads()
+    ob = OnebitAdam(lr=1e-2, freeze_step=100, weight_decay=0.0)
+    ad = FusedAdam(lr=1e-2, adam_w_mode=False, bias_correction=False,
+                   weight_decay=0.0)
+    s_ob, s_ad = ob.init(p), ad.init(p)
+    p_ob, p_ad = p, p
+    for _ in range(3):
+        p_ob, s_ob = ob.step(p_ob, g, s_ob)
+        p_ad, s_ad = ad.step(p_ad, g, s_ad)
+    for a, b in zip(jax.tree_util.tree_leaves(p_ob),
+                    jax.tree_util.tree_leaves(p_ad)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+def test_onebit_adam_compressed_phase():
+    p = _params()
+    g = _grads()
+    ob = OnebitAdam(lr=1e-3, freeze_step=2)
+    s = ob.init(p)
+    for i in range(6):
+        p, s = ob.step(p, g, s)
+    # variance frozen after step 2, error feedback nonzero
+    assert float(jnp.abs(s["worker_error"]["w"]).sum()) > 0
+    assert np.isfinite(np.asarray(p["w"])).all()
+
+
+def test_onebit_adam_variance_frozen():
+    p, g = _params(), _grads()
+    ob = OnebitAdam(lr=1e-3, freeze_step=1)
+    s = ob.init(p)
+    p, s = ob.step(p, g, s)       # step 1: warmup (count=1 <= freeze)
+    v_after_freeze = np.asarray(s["exp_avg_sq"]["w"]).copy()
+    p, s = ob.step(p, g, s)       # step 2: compressed
+    np.testing.assert_array_equal(v_after_freeze, np.asarray(s["exp_avg_sq"]["w"]))
+
+
+def test_onebit_lamb_trains_engine():
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+    cfg = base_config()
+    cfg["optimizer"] = {"type": "OneBitLamb",
+                        "params": {"lr": 1e-2, "freeze_step": 5}}
+    mesh = make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                       mesh=mesh)
+    batch = random_batch()
+    l0 = float(engine.train_batch(batch))
+    for _ in range(20):
+        l1 = float(engine.train_batch(batch))
+    assert l1 < l0
+
+
+def test_onebit_adam_engine_name():
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+    cfg = base_config()
+    cfg["optimizer"] = {"type": "OneBitAdam",
+                        "params": {"lr": 1e-2, "freeze_step": 5}}
+    mesh = make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                       mesh=mesh)
+    assert isinstance(engine.optimizer, OnebitAdam)
+    batch = random_batch()
+    l0 = float(engine.train_batch(batch))
+    for _ in range(10):
+        l1 = float(engine.train_batch(batch))
+    assert np.isfinite(l1)

@@ -1,0 +1,130 @@
+"""Pipeline tests — the reference's test_pipe_schedule.py / test_pipe_module.py
+roles: schedule invariants and module partitioning/execution."""
+
+import numpy as np
+import jax.numpy as jnp
+import flax.linen as nn
+import pytest
+
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.runtime.pipe import schedule as S
+from deepspeed_tpu.runtime.pipe.module import (
+    PipelineModule, LayerSpec, partition_uniform, partition_balanced)
+from tests.simple_model import base_config, random_batch
+
+
+def _flat(sched):
+    return [c for step in sched.steps() for c in step]
+
+
+def test_train_schedule_counts():
+    for stages in (2, 4):
+        for mb in (2, 4, 8):
+            for stage_id in range(stages):
+                sched = S.TrainSchedule(micro_batches=mb, stages=stages,
+                                        stage_id=stage_id)
+                cmds = _flat(sched)
+                fwd = [c for c in cmds if isinstance(c, S.ForwardPass)]
+                bwd = [c for c in cmds if isinstance(c, S.BackwardPass)]
+                assert len(fwd) == mb
+                assert len(bwd) == mb
+                assert sum(isinstance(c, S.OptimizerStep) for c in cmds) == 1
+
+
+def test_train_schedule_fwd_before_bwd_per_buffer():
+    sched = S.TrainSchedule(micro_batches=4, stages=2, stage_id=1)
+    seen_fwd = set()
+    for step in sched.steps():
+        for cmd in step:
+            if isinstance(cmd, S.ForwardPass):
+                seen_fwd.add(cmd.buffer_id)
+            if isinstance(cmd, S.BackwardPass):
+                assert cmd.buffer_id in seen_fwd
+
+
+def test_train_schedule_send_recv_pairing():
+    """Across adjacent stages, sends on stage s must match recvs on s+1."""
+    mb, stages = 4, 2
+    s0 = _flat(S.TrainSchedule(mb, stages, 0))
+    s1 = _flat(S.TrainSchedule(mb, stages, 1))
+    sends0 = sum(isinstance(c, S.SendActivation) for c in s0)
+    recvs1 = sum(isinstance(c, S.RecvActivation) for c in s1)
+    assert sends0 == recvs1 == mb
+    sends_g1 = sum(isinstance(c, S.SendGrad) for c in s1)
+    recvs_g0 = sum(isinstance(c, S.RecvGrad) for c in s0)
+    assert sends_g1 == recvs_g0 == mb
+
+
+def test_inference_schedule():
+    sched = S.InferenceSchedule(micro_batches=3, stages=2, stage_id=0)
+    cmds = _flat(sched)
+    assert sum(isinstance(c, S.ForwardPass) for c in cmds) == 3
+    assert sum(isinstance(c, S.LoadMicroBatch) for c in cmds) == 3
+    assert not any(isinstance(c, S.BackwardPass) for c in cmds)
+
+
+def test_num_pipe_buffers():
+    # reference pipe/schedule.py:243-247: stages - stage_id + 1, >= 2
+    sched = S.TrainSchedule(micro_batches=8, stages=4, stage_id=0)
+    assert sched.num_pipe_buffers() == 5
+    sched = S.TrainSchedule(micro_batches=8, stages=4, stage_id=3)
+    assert sched.num_pipe_buffers() == 2
+    sched = S.TrainSchedule(micro_batches=2, stages=4, stage_id=0)
+    assert sched.num_pipe_buffers() == 2
+
+
+def test_partition_uniform():
+    assert partition_uniform(8, 4) == [0, 2, 4, 6, 8]
+    assert partition_uniform(7, 2) == [0, 4, 7]
+
+
+def test_partition_balanced():
+    parts = partition_balanced([1, 1, 1, 100], 2)
+    assert parts[0] == 0 and parts[-1] == 4
+    # the heavy item must sit alone in the last part
+    assert parts[1] == 3
+    parts = partition_balanced([1] * 8, 4)
+    assert parts == [0, 2, 4, 6, 8]
+
+
+def test_pipeline_module_runs():
+    layers = [LayerSpec(nn.Dense, 16) for _ in range(4)]
+    pipe = PipelineModule(layers=layers, num_stages=2,
+                          partition_method="uniform")
+    import jax
+    x = jnp.ones((2, 16))
+    variables = pipe.init(jax.random.PRNGKey(0), x)
+    out = pipe.apply(variables, x)
+    assert out.shape == (2, 16)
+    assert pipe.parts == [0, 2, 4]
+
+
+def test_pipeline_module_parameters_partition():
+    layers = [LayerSpec(nn.Dense, 4), LayerSpec(nn.Dense, 64),
+              LayerSpec(nn.Dense, 4), LayerSpec(nn.Dense, 4)]
+    pipe = PipelineModule(layers=layers, num_stages=2,
+                          partition_method="parameters")
+    import jax
+    pipe.init(jax.random.PRNGKey(0), jnp.ones((2, 64)))
+    assert pipe.parts[0] == 0 and pipe.parts[-1] == 4
+    assert len(pipe.parts) == 3
+
+
+def test_pipeline_engine_single_stage_trains():
+    import jax
+
+    def loss_fn(out, y):
+        logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, y[..., None], axis=-1).mean()
+
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+    layers = [LayerSpec(nn.Dense, 32), LayerSpec(nn.Dense, 4)]
+    pipe = PipelineModule(layers=layers, num_stages=1, loss_fn=loss_fn)
+    engine, _, _, _ = dstpu.initialize(
+        config=base_config(), model=pipe,
+        mesh=make_mesh(MeshConfig(data=1), devices=jax.devices()[:1]))
+    x, y = random_batch(batch_size=8)
+    l0 = float(engine.train_batch((x, y)))
+    for _ in range(20):
+        l1 = float(engine.train_batch((x, y)))
+    assert l1 < l0

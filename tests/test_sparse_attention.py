@@ -1,0 +1,134 @@
+"""Sparse-attention tests — the reference's test_sparse_attention.py role:
+layout generators produce the documented patterns; sparse attention matches
+dense attention when the layout is dense, and masks correctly otherwise."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (
+    DenseSparsityConfig, FixedSparsityConfig, VariableSparsityConfig,
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, SparseSelfAttention)
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import sparse_attention
+from deepspeed_tpu.ops.attention import reference_attention
+
+
+def test_dense_layout_all_ones():
+    layout = DenseSparsityConfig(num_heads=2, block=16).make_layout(64)
+    assert layout.shape == (2, 4, 4)
+    assert layout.sum() == 2 * 16
+
+
+def test_fixed_layout_local_blocks():
+    cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=2,
+                              num_global_blocks=1)
+    layout = cfg.make_layout(128)  # 8 blocks
+    # diagonal (self) blocks always attended
+    for i in range(8):
+        assert layout[0, i, i] == 1
+    # local windows of 2: block 0 attends block 1
+    assert layout[0, 0, 1] == 1
+    # global column: last block of each window attended by all rows
+    assert layout[0, :, 1].all()
+
+
+def test_fixed_unidirectional_causal():
+    cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=4,
+                              attention="unidirectional")
+    layout = cfg.make_layout(128)
+    assert np.array_equal(layout[0], np.tril(layout[0]))
+
+
+def test_fixed_bad_args():
+    with pytest.raises(ValueError):
+        FixedSparsityConfig(num_heads=1, num_local_blocks=4, num_global_blocks=3)
+    with pytest.raises(ValueError):
+        FixedSparsityConfig(num_heads=1, attention="unidirectional",
+                            horizontal_global_attention=True)
+
+
+def test_seq_len_not_divisible_raises():
+    cfg = DenseSparsityConfig(num_heads=1, block=16)
+    with pytest.raises(ValueError):
+        cfg.make_layout(100)
+
+
+def test_variable_layout_globals():
+    cfg = VariableSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                 local_window_blocks=[2],
+                                 global_block_indices=[0])
+    layout = cfg.make_layout(128)
+    assert layout[0, :, 0].all()  # global column 0
+    assert layout[0].sum() >= 8   # randoms + locals present
+
+
+def test_bigbird_layout():
+    cfg = BigBirdSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                num_sliding_window_blocks=3, num_global_blocks=1)
+    layout = cfg.make_layout(128)
+    # global first/last row+col
+    assert layout[0, 0, :].all() and layout[0, :, 0].all()
+    assert layout[0, -1, :].all() and layout[0, :, -1].all()
+    # sliding window around diagonal
+    for i in range(1, 7):
+        assert layout[0, i, i - 1] and layout[0, i, i] and layout[0, i, i + 1]
+
+
+def test_bslongformer_layout():
+    cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[0])
+    layout = cfg.make_layout(128)
+    assert layout[0, 0, :].all() and layout[0, :, 0].all()
+    assert layout[0, 3, 2] and layout[0, 3, 3] and layout[0, 3, 4]
+    assert not layout[0, 3, 6]
+
+
+def test_different_layout_per_head_propagation():
+    cfg = BigBirdSparsityConfig(num_heads=4, block=16,
+                                different_layout_per_head=False)
+    layout = cfg.make_layout(128)
+    for h in range(1, 4):
+        assert np.array_equal(layout[h], layout[0])
+
+
+def test_sparse_attention_dense_layout_matches_reference():
+    rng = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(jax.random.fold_in(rng, i), (2, 2, 64, 16))
+               for i in range(3))
+    layout = DenseSparsityConfig(num_heads=2, block=16).make_layout(64)
+    out = sparse_attention(q, k, v, layout, block=16)
+    ref = reference_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_sparse_attention_blocks_masked():
+    """keys outside the layout must not influence the output."""
+    rng = jax.random.PRNGKey(1)
+    q = jax.random.normal(rng, (1, 1, 64, 8))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (1, 1, 64, 8))
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (1, 1, 64, 8))
+    # only diagonal blocks allowed
+    layout = np.zeros((1, 4, 4), np.int64)
+    for i in range(4):
+        layout[0, i, i] = 1
+    out = sparse_attention(q, k, v, layout, block=16)
+    # perturb keys/values in off-diagonal region for row block 0
+    k2 = k.at[:, :, 16:, :].set(999.0)
+    v2 = v.at[:, :, 16:, :].set(999.0)
+    out2 = sparse_attention(q, k2, v2, layout, block=16)
+    np.testing.assert_allclose(np.asarray(out[:, :, :16]),
+                               np.asarray(out2[:, :, :16]), rtol=1e-5)
+
+
+def test_sparse_self_attention_module():
+    mod = SparseSelfAttention(
+        FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2))
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (2, 2, 64, 16))
+    out = mod(q, q, q)
+    assert out.shape == q.shape
+    assert np.isfinite(np.asarray(out)).all()
+    assert 64 in mod._layout_cache

@@ -1,0 +1,90 @@
+"""Topology tests — mirrors the reference's pure-python test_topology.py."""
+
+import pytest
+
+from deepspeed_tpu.parallel.topology import (
+    ProcessTopology, PipeDataParallelTopology, PipeModelDataParallelTopology,
+    PipelineParallelGrid, _prime_factors)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.world_size() == 4
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+    assert topo.get_axis_comm_lists("row") == [[0, 2], [1, 3]]
+    assert topo.get_axis_comm_lists("col") == [[0, 1], [2, 3]]
+
+
+def test_topology_dims():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=3, num_dp=4)
+    assert topo.get_dim("pipe") == 2
+    assert topo.get_dim("data") == 4
+    assert topo.get_dim("model") == 3
+    assert topo.world_size() == 24
+
+
+def test_topology_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    ranks = topo.filter_match(pipe=0, data=1)
+    assert len(ranks) == 2
+    for r in ranks:
+        coord = topo.get_coord(r)
+        assert coord.pipe == 0 and coord.data == 1
+
+
+def test_topology_coord_roundtrip():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=2)
+    for rank in range(topo.world_size()):
+        coord = topo.get_coord(rank)
+        assert topo.get_rank(**coord._asdict()) == rank
+
+
+def test_topology_invalid_rank():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    with pytest.raises(ValueError):
+        topo.get_coord(99)
+
+
+def test_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    # omits data/pipe by default, leaving the model coordinate
+    assert topo.get_rank_repr(rank=0) == "model_00"
+
+
+def test_prime_factors():
+    assert _prime_factors(12) == [2, 2, 3]
+    assert _prime_factors(7) == [7]
+    assert _prime_factors(1) == []
+
+
+def test_grid_accessors():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo, global_rank=5)
+    assert grid.pipe_parallel_size == 2
+    assert grid.data_parallel_size == 2
+    assert grid.model_parallel_size == 2
+    coord = topo.get_coord(5)
+    assert grid.get_stage_id() == coord.pipe
+    assert grid.get_data_parallel_rank() == coord.data
+    assert grid.get_model_parallel_rank() == coord.model
+
+
+def test_grid_p2p_pairs():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo)
+    assert len(grid.p2p_matrix) == 4
+    for src, dst in grid.p2p_matrix:
+        c_src, c_dst = topo.get_coord(src), topo.get_coord(dst)
+        assert c_dst.pipe == (c_src.pipe + 1) % 2
+        assert c_dst.data == c_src.data
+
+
+def test_grid_stage_to_global():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo, global_rank=0)
+    other = grid.stage_to_global(stage_id=1)
+    assert topo.get_coord(other).pipe == 1
+    assert topo.get_coord(other).data == topo.get_coord(0).data
