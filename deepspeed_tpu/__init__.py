@@ -33,6 +33,8 @@ from deepspeed_tpu.utils import logging as _logging
 
 from deepspeed_tpu import ops  # noqa: F401
 from deepspeed_tpu import models  # noqa: F401
+from deepspeed_tpu.runtime import zero  # noqa: F401  (deepspeed.zero parity)
+from deepspeed_tpu import runtime  # noqa: F401
 
 logger = _logging.logger
 

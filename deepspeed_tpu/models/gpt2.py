@@ -66,8 +66,18 @@ class SelfAttention(nn.Module):
         def heads(t):
             return t.reshape(B, S, cfg.n_head, cfg.head_dim).transpose(0, 2, 1, 3)
 
-        out = dot_product_attention(heads(q), heads(k), heads(v), causal=True,
-                                    use_flash=cfg.use_flash)
+        # sequence parallelism: when the active mesh has a seq axis, run
+        # ring attention over it instead of letting GSPMD gather full K/V
+        from deepspeed_tpu.parallel import mesh as mesh_lib
+        mesh = mesh_lib.current_mesh()
+        if mesh is not None and mesh.shape.get(mesh_lib.SEQ_AXIS, 1) > 1 \
+                and S % mesh.shape[mesh_lib.SEQ_AXIS] == 0:
+            from deepspeed_tpu.parallel.ring_attention import ring_attention
+            out = ring_attention(heads(q), heads(k), heads(v), mesh,
+                                 causal=True)
+        else:
+            out = dot_product_attention(heads(q), heads(k), heads(v),
+                                        causal=True, use_flash=cfg.use_flash)
         out = out.transpose(0, 2, 1, 3).reshape(B, S, E)
         out = nn.Dense(E, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                        kernel_init=nn.initializers.normal(
@@ -182,8 +192,9 @@ def lm_loss(logits, labels, ignore_index=-100):
 # -- presets ---------------------------------------------------------------
 
 def gpt2_tiny(**kw):
-    return GPT2Config(vocab_size=512, n_positions=128, n_embd=64, n_layer=2,
-                      n_head=2, **kw)
+    base = dict(vocab_size=512, n_positions=128, n_embd=64, n_layer=2, n_head=2)
+    base.update(kw)
+    return GPT2Config(**base)
 
 
 def gpt2_small(**kw):

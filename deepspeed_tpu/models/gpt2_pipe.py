@@ -1,0 +1,105 @@
+"""GPT-2 with pipeline-parallel blocks — the in-tree model the reference
+expresses as PipelineModule(LayerSpec(GPT2Block)...) (pipe/module.py:87).
+
+Embedding and LM head run outside the pipeline (replicated w.r.t. the pipe
+axis, sharded over data/model as usual); the L transformer blocks are
+stage-stacked [S, L/S, ...], sharded over the 'pipe' mesh axis, and executed
+by the SPMD collective pipeline (parallel/pipeline_spmd.py). Composes with
+ZeRO (data axis) and TP (model axis) since the pipeline shard_maps only the
+pipe axis.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel, Block
+from deepspeed_tpu.models.sharding import _gpt2_leaf_spec
+from deepspeed_tpu.parallel import mesh as mesh_lib
+from deepspeed_tpu.parallel.pipeline_spmd import (
+    spmd_pipeline, stack_stage_params)
+from jax.sharding import PartitionSpec as P
+
+
+class GPT2PipeModel:
+    """flax-like (init/apply) wrapper. ``num_microbatches`` is the pipeline
+    µbatch count (the reference's engine.micro_batches,
+    pipe/engine.py:105); must divide the batch size."""
+
+    def __init__(self, config: GPT2Config, mesh, num_microbatches: Optional[int] = None):
+        if not config.scan_layers:
+            config = GPT2Config(**{**config.__dict__, "scan_layers": True})
+        self.config = config
+        self.mesh = mesh
+        self.num_stages = mesh_lib.mesh_axis_size(mesh, mesh_lib.PIPE_AXIS)
+        assert config.n_layer % max(self.num_stages, 1) == 0, (
+            f"n_layer={config.n_layer} must divide into {self.num_stages} stages")
+        self.num_microbatches = num_microbatches or max(self.num_stages, 1)
+        self._inner = GPT2LMHeadModel(config)
+
+    # introspection stub so the engine's loss-fn resolver sees the kwargs
+    def __call__(self, input_ids, deterministic=True, keep_prob=1.0):
+        raise RuntimeError("use .apply(variables, ...)")
+
+    def init(self, rng, input_ids):
+        variables = self._inner.init(rng, input_ids)
+        params = dict(variables["params"])
+        blocks = params.pop("h")["blk"]  # leaves [L, ...]
+        params["h_stages"] = stack_stage_params(blocks, max(self.num_stages, 1))
+        return {"params": params}
+
+    def _unstacked(self, params):
+        inner = dict(params)
+        stages = inner.pop("h_stages")
+        inner["h"] = {"blk": jax.tree_util.tree_map(
+            lambda x: x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:]),
+            stages)}
+        return inner
+
+    def apply(self, variables, input_ids, deterministic=True, keep_prob=1.0):
+        params = variables["params"]
+        cfg = self.config
+        B, T = input_ids.shape
+        M = self.num_microbatches
+        assert B % M == 0, (f"batch {B} not divisible by "
+                            f"num_microbatches {M}")
+
+        wte = params["wte"]
+        wpe = params["wpe"]
+        x = wte[input_ids].astype(cfg.dtype) + wpe[None, :T].astype(cfg.dtype)
+
+        def stage_fn(stage_params, h):
+            def one_layer(carry, layer_params):
+                out = Block(cfg).apply({"params": layer_params}, carry,
+                                       deterministic, keep_prob)
+                return out, None
+            h, _ = jax.lax.scan(one_layer, h, stage_params)
+            return h
+
+        mb = x.reshape((M, B // M) + x.shape[1:])
+        h = spmd_pipeline(stage_fn, params["h_stages"], mb, self.mesh)
+        x = h.reshape(B, T, cfg.n_embd)
+
+        from flax import linen as nn
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype).apply(
+            {"params": params["ln_f"]}, x)
+        logits = jnp.einsum("bse,ve->bsv", x, wte.astype(cfg.dtype))
+        return logits
+
+    def param_partition_specs(self, params_shapes):
+        """Base sharding specs: 'pipe' on the stage dim of h_stages,
+        Megatron TP axes elsewhere (consumed by the engine's
+        ZeroPartitioner as base specs)."""
+        def walk(tree, path):
+            if isinstance(tree, dict):
+                return {k: walk(v, path + (k,)) for k, v in tree.items()}
+            spec = _gpt2_leaf_spec(path, tree.shape)
+            if path and path[0] == "h_stages":
+                spec = P(mesh_lib.PIPE_AXIS, *tuple(spec)[1:]) \
+                    if len(spec) >= 1 else P(mesh_lib.PIPE_AXIS)
+            return spec
+        tree = params_shapes.get("params", params_shapes) \
+            if isinstance(params_shapes, dict) else params_shapes
+        return walk(tree, ())

@@ -35,6 +35,19 @@ EXPERT_AXIS = "expert"
 AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
 
 _initialized = False
+_current_mesh: Optional[Mesh] = None
+
+
+def set_current_mesh(mesh: Optional[Mesh]):
+    """Engine-scoped mesh registry: model code (e.g. ring attention inside
+    SelfAttention) can discover the active mesh without threading it through
+    flax module attributes."""
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _current_mesh
 
 
 def init_distributed(coordinator_address: Optional[str] = None,

@@ -1,0 +1,129 @@
+"""SPMD collective pipeline — the TPU-native execution of the reference's
+1F1B instruction schedule (deepspeed/runtime/pipe/engine.py:1209 interpreter
++ p2p broadcast groups, pipe/p2p.py:31-55).
+
+Instead of N processes interpreting per-rank instruction lists and
+exchanging activations over NCCL p2p, the whole pipeline is ONE jitted SPMD
+program: stage-stacked parameters are sharded over the 'pipe' mesh axis, a
+`lax.scan` steps the schedule clock, and `lax.ppermute` rotates activations
+stage→stage over ICI. Autodiff through the scan gives the backward pipeline
+(reverse ppermute) for free — no SendGrad/RecvGrad bookkeeping.
+
+Schedule shape: GPipe-style fill/drain (M microbatches over S stages,
+M + S - 1 ticks). The 1F1B memory profile of the reference
+(pipe/schedule.py:182) is recovered by remat-ing each stage body: live
+activation state is O(mb) per stage instead of O(M·mb).
+
+Terminology map (reference → here):
+  SendActivation/RecvActivation → lax.ppermute(out, 'pipe', ring)
+  LoadMicroBatch                → jnp.where(stage_idx == 0, microbatch[t], ...)
+  ForwardPass                   → stage_fn under scan
+  BackwardPass/SendGrad/RecvGrad→ autodiff of the above
+  ReduceGrads                   → GSPMD grad psum over 'data' (outside)
+  num_pipe_buffers              → 1 live state + remat (see above)
+"""
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
+
+def stack_stage_params(params, num_stages):
+    """[L, ...] layer-stacked pytree → [S, L//S, ...] stage-stacked."""
+    def reshape(x):
+        L = x.shape[0]
+        assert L % num_stages == 0, (
+            f"layer count {L} not divisible by {num_stages} stages")
+        return x.reshape((num_stages, L // num_stages) + x.shape[1:])
+    return jax.tree_util.tree_map(reshape, params)
+
+
+def unstack_stage_params(params):
+    """[S, L//S, ...] → [L, ...]."""
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:]), params)
+
+
+def spmd_pipeline(stage_fn: Callable,
+                  stage_params,
+                  microbatches,
+                  mesh,
+                  batch_spec: P = None):
+    """Run M microbatches through S pipeline stages.
+
+    stage_fn(stage_local_params, x) -> y with y.shape == x.shape; applied by
+    every stage to the activation it holds (all layers of that stage).
+    stage_params: pytree with leading stage dim S on every leaf.
+    microbatches: [M, mb, ...] activations entering stage 0.
+    Returns [M, mb, ...] outputs of the last stage (replicated over 'pipe').
+    """
+    S = mesh.shape[mesh_lib.PIPE_AXIS]
+    if S == 1:
+        squeezed = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+        return jax.lax.map(lambda x: stage_fn(squeezed, x), microbatches)
+
+    M = microbatches.shape[0]
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    # shard_map ONLY over the pipe axis: data/seq/model stay in GSPMD "auto"
+    # mode, so stage_fn composes with ZeRO/TP shardings untouched.
+    if batch_spec is None:
+        batch_spec = P()  # replicated w.r.t. pipe; data sharding is auto
+
+    param_specs = jax.tree_util.tree_map(
+        lambda x: P(mesh_lib.PIPE_AXIS, *([None] * (x.ndim - 1))), stage_params)
+
+    # boundary activations cross the shard_map edge in f32: the backward of a
+    # pipe-replicated bf16 input is a bf16 all-reduce over the manual axis,
+    # which crashes XLA-CPU's AllReducePromotion pass. Compute stays in the
+    # caller's dtype inside the stages.
+    act_dtype = microbatches.dtype
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        axis_names=frozenset({mesh_lib.PIPE_AXIS}),
+        in_specs=(param_specs, batch_spec),
+        out_specs=batch_spec)
+    def run(params_local, mb_local):
+        # make the replicated microbatch buffer pipe-varying HERE, in f32:
+        # pcast's transpose is the psum of the input cotangent over 'pipe',
+        # and it must not run in bf16 (see note above)
+        mb_local = jax.lax.pcast(
+            mb_local, (mesh_lib.PIPE_AXIS,), to="varying").astype(act_dtype)
+        local = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        idx = jax.lax.axis_index(mesh_lib.PIPE_AXIS)
+        body = jax.checkpoint(lambda x: stage_fn(local, x), prevent_cse=False)
+
+        def tick(state, t):
+            # LoadMicroBatch on stage 0; upstream activation elsewhere
+            feed = jax.lax.dynamic_index_in_dim(
+                mb_local, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+            x = jnp.where(idx == 0, feed, state)
+            out = body(x)
+            # Send/RecvActivation: rotate one hop around the pipe ring
+            nxt = jax.lax.ppermute(out, mesh_lib.PIPE_AXIS, perm)
+            return nxt, out
+
+        # pcast's transpose is a psum over 'pipe'; route it through f32
+        # (same XLA-CPU bf16 AllReducePromotion crash as the output psum)
+        state0 = jax.lax.pcast(
+            jnp.zeros(mb_local.shape[1:], jnp.float32),
+            (mesh_lib.PIPE_AXIS,), to="varying").astype(act_dtype)
+        _, outs = jax.lax.scan(tick, state0, jnp.arange(M + S - 1))
+        # last stage's outs at ticks [S-1, S-1+M) are the results; broadcast
+        # them to every stage so downstream (loss) code is stage-agnostic.
+        # psum in f32: XLA-CPU's AllReducePromotion pass crashes on bf16
+        # all-reduce emitted from manual shard_map regions.
+        result = jax.lax.dynamic_slice_in_dim(outs, S - 1, M, axis=0)
+        masked = jnp.where(idx == S - 1, result,
+                           jnp.zeros_like(result)).astype(jnp.float32)
+        return jax.lax.psum(masked, mesh_lib.PIPE_AXIS)
+
+    # eager shard_map can't trace closed_call (jax.checkpoint); the engine
+    # always calls this under jit — this inner jit covers direct/eager use
+    out = jax.jit(run)(stage_params, microbatches.astype(jnp.float32))
+    return out.astype(act_dtype)

@@ -1,0 +1,144 @@
+"""Activation checkpointing — rebuild of
+deepspeed/runtime/activation_checkpointing/checkpointing.py (1,100 LoC).
+
+The reference re-implements torch checkpointing with four extras
+(config keys :759-838): partition activations across TP ranks, CPU offload
+of the checkpointed activations, contiguous checkpoint buffers, and RNG
+state tracking. The TPU mapping:
+
+  checkpoint(fn)               → jax.checkpoint (rematerialization)
+  partition_activations        → saved residuals carry a sharding constraint
+                                 over the model axis, so each TP rank stores
+                                 1/mp of every checkpoint (reference :351)
+  cpu_checkpointing            → jax.checkpoint policy `offloadable`
+                                 (save_and_offload_only_these_names /
+                                 device→host offload of residuals)
+  contiguous_memory_optimization→ XLA owns layout; accepted and ignored
+  RNG tracking                 → jax threads PRNG keys functionally; nothing
+                                 to restore (reference :198-349 obsolete)
+
+`configure()` + `checkpoint()` keep the reference's module-level API so
+client code ports 1:1.
+"""
+
+import functools
+
+import jax
+from jax.sharding import PartitionSpec
+
+from deepspeed_tpu.parallel import mesh as mesh_lib
+from deepspeed_tpu.utils.logging import logger
+
+_config = {
+    "partition_activations": False,
+    "contiguous_memory_optimization": False,
+    "cpu_checkpointing": False,
+    "number_checkpoints": None,
+    "synchronize_checkpoint_boundary": False,
+    "profile": False,
+    "mesh": None,
+}
+
+
+def configure(mpu_=None,
+              deepspeed_config=None,
+              partition_activations=None,
+              contiguous_checkpointing=None,
+              num_checkpoints=None,
+              checkpoint_in_cpu=None,
+              synchronize=None,
+              profile=None,
+              mesh=None):
+    """Module-level config (reference checkpointing.py:759)."""
+    if deepspeed_config is not None:
+        ac = getattr(deepspeed_config, "activation_checkpointing_config", None)
+        if ac is not None:
+            _config["partition_activations"] = ac.partition_activations
+            _config["contiguous_memory_optimization"] = \
+                ac.contiguous_memory_optimization
+            _config["cpu_checkpointing"] = ac.cpu_checkpointing
+            _config["number_checkpoints"] = ac.number_checkpoints
+            _config["synchronize_checkpoint_boundary"] = \
+                ac.synchronize_checkpoint_boundary
+            _config["profile"] = ac.profile
+    for key, val in [("partition_activations", partition_activations),
+                     ("contiguous_memory_optimization", contiguous_checkpointing),
+                     ("number_checkpoints", num_checkpoints),
+                     ("cpu_checkpointing", checkpoint_in_cpu),
+                     ("synchronize_checkpoint_boundary", synchronize),
+                     ("profile", profile),
+                     ("mesh", mesh)]:
+        if val is not None:
+            _config[key] = val
+
+
+def is_configured():
+    return True
+
+
+def model_parallel_cuda_manual_seed(seed):
+    """Parity no-op: JAX PRNG keys are functional; TP rng split is the
+    caller folding in the axis index (reference :198 tracked CUDA rng)."""
+    logger.debug(f"model_parallel_cuda_manual_seed({seed}): functional PRNG, no-op")
+
+
+def _offload_policy():
+    """Policy saving remat residuals to host memory (cpu_checkpointing)."""
+    try:
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=["ckpt"],
+            offload_src="device", offload_dst="pinned_host")
+    except Exception:
+        # older jax: fall back to nothing-saved (pure recompute)
+        return jax.checkpoint_policies.nothing_saveable
+
+
+def checkpoint(function, *args, **static_kwargs):
+    """Checkpoint a forward function (reference checkpointing.py:744 API:
+    `checkpoint(fn, *args)` runs fn now, recomputes in backward)."""
+    wrapped = checkpoint_wrapper(function, **static_kwargs)
+    return wrapped(*args)
+
+
+def checkpoint_wrapper(function, policy=None):
+    """Return the remat-wrapped function honoring the configured mode."""
+    if policy is None and _config["cpu_checkpointing"]:
+        policy = _offload_policy()
+
+    remat_fn = jax.checkpoint(function, policy=policy, prevent_cse=False) \
+        if policy is not None else jax.checkpoint(function, prevent_cse=False)
+
+    if not _config["partition_activations"]:
+        return remat_fn
+
+    mesh = _config["mesh"]
+
+    @functools.wraps(function)
+    def partitioned(*args):
+        # shard the *inputs* of the checkpointed span over the model axis so
+        # each TP rank stores a 1/mp slice of the boundary activation
+        # (reference partition_activations :351-675); they are all-gathered
+        # on recompute.
+        def shard(x):
+            if mesh is None or not hasattr(x, "ndim") or x.ndim < 2:
+                return x
+            spec = [None] * x.ndim
+            # shard the sequence (second-to-last) dim when divisible
+            d = x.ndim - 2
+            if x.shape[d] % mesh.shape.get(mesh_lib.MODEL_AXIS, 1) == 0:
+                spec[d] = mesh_lib.MODEL_AXIS
+            try:
+                return jax.lax.with_sharding_constraint(
+                    x, jax.sharding.NamedSharding(mesh, PartitionSpec(*spec)))
+            except Exception:
+                return x
+        args = tuple(shard(a) for a in args)
+        return remat_fn(*args)
+
+    return partitioned
+
+
+class CheckpointFunction:
+    """Parity alias for client code importing the autograd Function."""
+    apply = staticmethod(checkpoint)

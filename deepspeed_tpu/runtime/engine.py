@@ -141,6 +141,7 @@ class DeepSpeedEngine:
             mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(
                 data=mc.data, model=mc.model, pipe=mc.pipe, seq=mc.seq))
         self.mesh = mesh
+        mesh_lib.set_current_mesh(mesh)
         self.dp_world_size = mesh_lib.dp_world_size(mesh)
         self._config = DeepSpeedConfig(config, mpu=mpu,
                                        world_size=self.dp_world_size)
@@ -299,22 +300,40 @@ class DeepSpeedEngine:
             return batch[0]
         return batch
 
+    def _maybe_derive_tp_specs(self, x):
+        """Auto-derive Megatron-style TP specs for known in-tree models when
+        the mesh has a model axis (shape-only, via eval_shape)."""
+        if self._param_tp_specs is not None:
+            return
+        # models may publish their own base specs (TP/pipe axes)
+        if hasattr(self.module, "param_partition_specs"):
+            try:
+                shapes = jax.eval_shape(
+                    lambda r, xx: self.module.init(r, xx), self._rng, x)
+                self._param_tp_specs = self.module.param_partition_specs(shapes)
+                self.zero.tp_specs = self._param_tp_specs
+                return
+            except Exception as e:
+                logger.warning(f"model param_partition_specs failed: {e}")
+        if mesh_lib.mesh_axis_size(self.mesh, mesh_lib.MODEL_AXIS) <= 1:
+            return
+        try:
+            from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+            from deepspeed_tpu.models.sharding import gpt2_tp_specs
+            if isinstance(self.module, GPT2LMHeadModel):
+                shapes = jax.eval_shape(
+                    lambda r, xx: self.module.init(r, xx), self._rng, x)
+                self._param_tp_specs = gpt2_tp_specs(
+                    shapes["params"] if "params" in shapes else shapes)
+                self.zero.tp_specs = self._param_tp_specs
+        except Exception as e:
+            logger.warning(f"TP spec auto-derivation failed: {e}")
+
     def _init_state(self, params=None, example_batch=None):
         if params is None:
-            x = self._model_inputs(example_batch)
-            variables = self.module.init(self._rng, jnp.asarray(x))
-            params = variables["params"] if "params" in variables else variables
-        if self._param_tp_specs is None and hasattr(self.module, "config"):
-            try:
-                from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
-                from deepspeed_tpu.models.sharding import gpt2_tp_specs
-                if isinstance(self.module, GPT2LMHeadModel) and \
-                        mesh_lib.mesh_axis_size(self.mesh, mesh_lib.MODEL_AXIS) > 1:
-                    self._param_tp_specs = gpt2_tp_specs(params)
-                    self.zero.tp_specs = self._param_tp_specs
-            except Exception:
-                pass
-
+            x = jnp.asarray(self._model_inputs(example_batch))
+            self._maybe_derive_tp_specs(x)
+            params = self._init_params(x)
         opt_state = self.optimizer.init(params)
         scaler = prec.init_scaler_state(self.precision)
         state = TrainState(params=params, opt_state=opt_state, scaler=scaler,
@@ -338,6 +357,26 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # loss
     # ------------------------------------------------------------------
+    def _init_params(self, x):
+        """Initialize params born-sharded when ZeRO-3 is on (the zero.Init
+        path, partition_parameters.py:265 analog) so the full model never
+        materializes on one device; eager init otherwise."""
+        if self.zero_optimization_stage() >= 3:
+            try:
+                from deepspeed_tpu.runtime.zero.init import sharded_init
+                params, _ = sharded_init(
+                    self.module, self._rng, x, self.mesh,
+                    stage=self.zero_optimization_stage(),
+                    tp_specs=self._param_tp_specs,
+                    param_persistence_threshold=(
+                        self._config.zero_config.param_persistence_threshold))
+                return params
+            except Exception as e:
+                logger.warning(f"sharded init unavailable ({e}); "
+                               f"falling back to eager init")
+        variables = self.module.init(self._rng, x)
+        return variables["params"] if "params" in variables else variables
+
     def _resolve_loss_fn(self) -> Callable:
         if self._loss_fn_user is not None:
             fn = self._loss_fn_user
@@ -366,15 +405,16 @@ class DeepSpeedEngine:
                 kwargs["keep_prob"] = keep_prob
             if accepts_deterministic:
                 kwargs["deterministic"] = not has_dropout
-            rngs = {"dropout": rng} if has_dropout else None
+            if has_dropout:
+                kwargs["rngs"] = {"dropout": rng}
             if isinstance(batch, dict) and "input_ids" in batch:
                 logits = model.apply({"params": params}, batch["input_ids"],
-                                     rngs=rngs, **kwargs)
+                                     **kwargs)
                 labels = batch.get("labels", batch["input_ids"])
                 return lm_loss(logits, labels)
             if isinstance(batch, (tuple, list)) and len(batch) == 2:
                 x, y = batch
-                out = model.apply({"params": params}, x, rngs=rngs, **kwargs)
+                out = model.apply({"params": params}, x, **kwargs)
                 if jnp.issubdtype(jnp.asarray(y).dtype, jnp.integer):
                     logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
                     ll = jnp.take_along_axis(logp, y[..., None], axis=-1)
@@ -382,7 +422,7 @@ class DeepSpeedEngine:
                 return jnp.mean(jnp.square(out.astype(jnp.float32) -
                                            y.astype(jnp.float32)))
             # bare array → LM on itself
-            logits = model.apply({"params": params}, batch, rngs=rngs, **kwargs)
+            logits = model.apply({"params": params}, batch, **kwargs)
             return lm_loss(logits, batch)
         return default_loss
 

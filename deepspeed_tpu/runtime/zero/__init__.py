@@ -2,3 +2,6 @@ from deepspeed_tpu.runtime.zero.partition import (
     ZeroPartitioner,
     shard_spec_for_leaf,
 )
+from deepspeed_tpu.runtime.zero.init import Init, GatheredParameters, sharded_init
+from deepspeed_tpu.runtime.zero.tiling import TiledLinear, TiledLinearReturnBias
+from deepspeed_tpu.runtime.zero.linear import ZeroLinear, memory_efficient_dot

@@ -1,0 +1,82 @@
+"""Tiled linear — rebuild of deepspeed/runtime/zero/tiling.py:26,255.
+
+The reference splits a huge Linear into in/out tile grids so ZeRO-3 can
+fetch and free slices of the weight independently, shrinking the working
+set. On TPU, the equivalent working-set control is remat + sharding
+constraints per tile; the module exists both for API parity and because
+tiling is still useful to bound VMEM/HBM pressure for pathological layer
+shapes (e.g. huge vocab projections).
+"""
+
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+def split_dim(total, splits):
+    """Partition `total` into `splits` near-equal chunk sizes (reference
+    tiling.py partition logic)."""
+    base = total // splits
+    rem = total - base * splits
+    return [base + (1 if i < rem else 0) for i in range(splits)]
+
+
+class TiledLinear(nn.Module):
+    """Linear(in_features → out_features) computed as an
+    in_splits × out_splits grid of sub-linears.
+
+    Matches the reference semantics: input is split along its feature dim;
+    each output tile sums contributions from every input tile; bias only on
+    the (0, j) tiles. Gradients/ZeRO treat each tile as an independent
+    parameter (the point of the exercise).
+    """
+    in_features: int
+    out_features: int
+    in_splits: int = 1
+    out_splits: int = 1
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    input_is_already_split: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        assert self.in_features % 1 == 0
+        in_sizes = split_dim(self.in_features, self.in_splits)
+        out_sizes = split_dim(self.out_features, self.out_splits)
+
+        if self.input_is_already_split:
+            x_tiles = list(x)
+        else:
+            assert x.shape[-1] == self.in_features, (
+                f"input feature dim {x.shape[-1]} != {self.in_features}")
+            offsets = [0]
+            for s in in_sizes:
+                offsets.append(offsets[-1] + s)
+            x_tiles = [x[..., offsets[i]:offsets[i + 1]]
+                       for i in range(self.in_splits)]
+
+        outs = []
+        for j, out_sz in enumerate(out_sizes):
+            acc = None
+            for i in range(self.in_splits):
+                y = nn.Dense(out_sz,
+                             use_bias=(self.use_bias and i == 0),
+                             dtype=self.dtype,
+                             param_dtype=self.param_dtype,
+                             kernel_init=self.kernel_init,
+                             name=f"tile_{i}_{j}")(x_tiles[i])
+                acc = y if acc is None else acc + y
+            outs.append(acc)
+        return jnp.concatenate(outs, axis=-1)
+
+
+class TiledLinearReturnBias(TiledLinear):
+    """Variant returning (output, None) for Megatron-style callers that
+    expect a separate bias return (reference tiling.py:255)."""
+
+    @nn.compact
+    def __call__(self, x):
+        return super().__call__(x), None
