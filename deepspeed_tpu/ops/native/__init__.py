@@ -1,0 +1,1 @@
+from deepspeed_tpu.ops.native.builder import OpBuilder, ALL_OPS

@@ -1,0 +1,119 @@
+"""ctypes binding for the async-IO library (csrc/aio.cpp) — the reference's
+AsyncIOBuilder/aio_handle surface (ops/aio, csrc/aio/py_lib)."""
+
+import ctypes
+
+import numpy as np
+
+from deepspeed_tpu.ops.native.builder import AsyncIOBuilder
+
+_lib = None
+
+
+def load():
+    global _lib
+    if _lib is None:
+        lib = AsyncIOBuilder().load()
+        lib.aio_handle_create.restype = ctypes.c_void_p
+        lib.aio_handle_create.argtypes = [ctypes.c_int64, ctypes.c_int,
+                                          ctypes.c_int, ctypes.c_int,
+                                          ctypes.c_int]
+        lib.aio_handle_destroy.argtypes = [ctypes.c_void_p]
+        lib.aio_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.aio_open.restype = ctypes.c_int
+        lib.aio_close.argtypes = [ctypes.c_int]
+        for fn in (lib.aio_pread, lib.aio_pwrite):
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
+                           ctypes.c_int64, ctypes.c_int64]
+            fn.restype = None
+        for fn in (lib.aio_sync_pread, lib.aio_sync_pwrite):
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
+                           ctypes.c_int64, ctypes.c_int64]
+            fn.restype = ctypes.c_int64
+        lib.aio_handle_wait.argtypes = [ctypes.c_void_p]
+        lib.aio_handle_wait.restype = ctypes.c_int64
+        lib.aio_handle_errors.argtypes = [ctypes.c_void_p]
+        lib.aio_handle_errors.restype = ctypes.c_int64
+        _lib = lib
+    return _lib
+
+
+class AsyncIOHandle:
+    """Python face of aio_handle_t (reference
+    deepspeed_py_aio_handle.cpp:14-33): block_size/queue_depth/
+    single_submit/overlap_events/thread_count knobs, async_pread/pwrite +
+    wait."""
+
+    def __init__(self, block_size=1048576, queue_depth=8, single_submit=False,
+                 overlap_events=True, thread_count=1):
+        self.lib = load()
+        self.block_size = block_size
+        self.queue_depth = queue_depth
+        self.single_submit = single_submit
+        self.overlap_events = overlap_events
+        self.thread_count = thread_count
+        self._h = self.lib.aio_handle_create(
+            block_size, queue_depth, thread_count,
+            int(single_submit), int(overlap_events))
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self.lib.aio_handle_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    # -- file helpers ------------------------------------------------------
+    def open(self, path, for_write):
+        fd = self.lib.aio_open(str(path).encode(), int(for_write))
+        if fd < 0:
+            raise OSError(f"aio_open failed for {path}")
+        return fd
+
+    def close(self, fd):
+        self.lib.aio_close(fd)
+
+    @staticmethod
+    def _buf(arr):
+        assert isinstance(arr, np.ndarray) and arr.flags["C_CONTIGUOUS"]
+        return arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes
+
+    # -- async API (reference async_pread/async_pwrite + wait) -------------
+    def async_pread(self, arr, fd, offset=0):
+        ptr, nbytes = self._buf(arr)
+        self.lib.aio_pread(self._h, fd, ptr, nbytes, offset)
+
+    def async_pwrite(self, arr, fd, offset=0):
+        ptr, nbytes = self._buf(arr)
+        self.lib.aio_pwrite(self._h, fd, ptr, nbytes, offset)
+
+    def wait(self):
+        done = self.lib.aio_handle_wait(self._h)
+        if self.lib.aio_handle_errors(self._h):
+            raise IOError("async IO requests failed")
+        return done
+
+    # -- sync API (reference sync_pread/sync_pwrite) ------------------------
+    def sync_pread(self, arr, path_or_fd, offset=0):
+        fd, opened = self._fd(path_or_fd, False)
+        try:
+            ptr, nbytes = self._buf(arr)
+            return self.lib.aio_sync_pread(self._h, fd, ptr, nbytes, offset)
+        finally:
+            if opened:
+                self.close(fd)
+
+    def sync_pwrite(self, arr, path_or_fd, offset=0):
+        fd, opened = self._fd(path_or_fd, True)
+        try:
+            ptr, nbytes = self._buf(arr)
+            return self.lib.aio_sync_pwrite(self._h, fd, ptr, nbytes, offset)
+        finally:
+            if opened:
+                self.close(fd)
+
+    def _fd(self, path_or_fd, for_write):
+        if isinstance(path_or_fd, int):
+            return path_or_fd, False
+        return self.open(path_or_fd, for_write), True

@@ -1,0 +1,101 @@
+"""Native op build system — rebuild of op_builder/builder.py:81,205,217.
+
+The reference JIT-compiles CUDA extensions through torch's ninja wrapper;
+here each op is a plain C++ shared library compiled with g++ straight from
+deepspeed_tpu/csrc/, cached next to the sources, and loaded with ctypes.
+No nvcc, no compute-capability matrix — the TPU compute path is Pallas; this
+covers host-side ops (SIMD optimizer, async IO).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from deepspeed_tpu.utils.logging import logger
+
+CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc")
+BUILD_DIR = os.path.join(CSRC, "build")
+
+_lock = threading.Lock()
+_cache = {}
+
+
+class OpBuilder:
+    """One source → one .so. ``load()`` compiles on first use (the
+    reference's jit_load path, builder.py:217) and returns the ctypes CDLL.
+    """
+
+    def __init__(self, name, sources, extra_flags=()):
+        self.name = name
+        self.sources = sources
+        self.extra_flags = list(extra_flags)
+
+    def absolute_sources(self):
+        return [os.path.join(CSRC, s) for s in self.sources]
+
+    def so_path(self):
+        return os.path.join(BUILD_DIR, f"lib{self.name}.so")
+
+    def is_compatible(self):
+        from shutil import which
+        return which("g++") is not None
+
+    def command(self):
+        return (["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                 "-march=native", "-fopenmp"]
+                + self.extra_flags
+                + self.absolute_sources()
+                + ["-o", self.so_path()])
+
+    def needs_build(self):
+        so = self.so_path()
+        if not os.path.exists(so):
+            return True
+        so_mtime = os.path.getmtime(so)
+        return any(os.path.getmtime(s) > so_mtime
+                   for s in self.absolute_sources())
+
+    def build(self):
+        os.makedirs(BUILD_DIR, exist_ok=True)
+        cmd = self.command()
+        logger.info(f"[op_builder] building {self.name}: {' '.join(cmd)}")
+        try:
+            subprocess.check_output(cmd, stderr=subprocess.STDOUT)
+        except subprocess.CalledProcessError as e:
+            # retry without -march=native (portable fallback)
+            cmd = [c for c in cmd if c != "-march=native"]
+            try:
+                subprocess.check_output(cmd, stderr=subprocess.STDOUT)
+            except subprocess.CalledProcessError as e2:
+                raise RuntimeError(
+                    f"failed to build {self.name}: {e2.output.decode()}") from e
+
+    def load(self):
+        with _lock:
+            if self.name in _cache:
+                return _cache[self.name]
+            if not self.is_compatible():
+                raise RuntimeError("no C++ compiler available")
+            if self.needs_build():
+                self.build()
+            lib = ctypes.CDLL(self.so_path())
+            _cache[self.name] = lib
+            return lib
+
+
+class CPUAdamBuilder(OpBuilder):
+    def __init__(self):
+        super().__init__("cpu_adam", ["cpu_adam.cpp"])
+
+
+class AsyncIOBuilder(OpBuilder):
+    def __init__(self):
+        super().__init__("aio", ["aio.cpp"], extra_flags=["-pthread"])
+
+
+ALL_OPS = {
+    "cpu_adam": CPUAdamBuilder,
+    "async_io": AsyncIOBuilder,
+}

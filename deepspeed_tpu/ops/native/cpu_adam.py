@@ -1,0 +1,50 @@
+"""ctypes binding for the SIMD CPU Adam library (csrc/cpu_adam.cpp) —
+the reference's pybind layer (csrc/adam/cpu_adam.cpp:684-689) equivalent."""
+
+import ctypes
+
+import numpy as np
+
+from deepspeed_tpu.ops.native.builder import CPUAdamBuilder
+
+_lib = None
+
+
+class _NativeCpuAdam:
+    def __init__(self, lib):
+        self.lib = lib
+        f32p = ctypes.POINTER(ctypes.c_float)
+        lib.ds_adam_step.argtypes = [
+            f32p, f32p, f32p, f32p,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_int, ctypes.c_int]
+        lib.ds_adam_step.restype = None
+        lib.ds_adam_num_threads.restype = ctypes.c_int
+
+    def adam_step(self, params, grads, exp_avg, exp_avg_sq, step, lr,
+                  beta1, beta2, eps, weight_decay, adamw_mode,
+                  bias_correction=True):
+        for arr in (params, grads, exp_avg, exp_avg_sq):
+            assert isinstance(arr, np.ndarray) and arr.dtype == np.float32 \
+                and arr.flags["C_CONTIGUOUS"], "need contiguous fp32 arrays"
+        n = params.size
+        f32p = ctypes.POINTER(ctypes.c_float)
+        self.lib.ds_adam_step(
+            params.ctypes.data_as(f32p), grads.ctypes.data_as(f32p),
+            exp_avg.ctypes.data_as(f32p), exp_avg_sq.ctypes.data_as(f32p),
+            n, int(step), float(lr), float(beta1), float(beta2), float(eps),
+            float(weight_decay), int(bool(adamw_mode)),
+            int(bool(bias_correction)))
+
+    def num_threads(self):
+        return self.lib.ds_adam_num_threads()
+
+
+def load():
+    """Build (if needed) + load the native library; returns the wrapper or
+    raises on toolchain absence."""
+    global _lib
+    if _lib is None:
+        _lib = _NativeCpuAdam(CPUAdamBuilder().load())
+    return _lib

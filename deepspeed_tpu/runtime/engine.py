@@ -200,6 +200,13 @@ class DeepSpeedEngine:
         self.global_samples = 0
         self.scalar_history = []  # tensorboard-lite: list of (step, dict)
 
+        # ZeRO-Offload: optimizer state + fp32 master on host (cpu) or NVMe
+        self._offload_cfg = self._config.zero_config.offload_optimizer
+        self._host_runner = None
+        if self._offload_cfg.enabled and self.precision.fp16:
+            logger.warning("fp16 dynamic loss scaling is not supported with "
+                           "optimizer offload; use bf16")
+
         self._rng = rng if rng is not None else jax.random.PRNGKey(self._config.seed)
         self.state: Optional[TrainState] = None
         self.state_shardings = None
@@ -334,7 +341,21 @@ class DeepSpeedEngine:
             x = jnp.asarray(self._model_inputs(example_batch))
             self._maybe_derive_tp_specs(x)
             params = self._init_params(x)
-        opt_state = self.optimizer.init(params)
+
+        if self._offload_cfg.enabled:
+            # fp32 master + moments to host/NVMe; device keeps compute-dtype
+            # params only (the ZeRO-Offload memory shape)
+            from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+            self._host_runner = HostOffloadOptimizer(
+                params, self.optimizer, self._offload_cfg,
+                self._config.aio_config)
+            params = jax.tree_util.tree_map(
+                lambda p: jnp.asarray(p, self.precision.compute_dtype)
+                if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else
+                jnp.asarray(p), params)
+            opt_state = {}
+        else:
+            opt_state = self.optimizer.init(params)
         scaler = prec.init_scaler_state(self.precision)
         state = TrainState(params=params, opt_state=opt_state, scaler=scaler,
                            global_step=jnp.zeros((), jnp.int32),
@@ -490,7 +511,7 @@ class DeepSpeedEngine:
         batch_sh = mesh_lib.batch_sharding(self.mesh)
         repl = NamedSharding(self.mesh, PartitionSpec())
 
-        def train_batch_fn(state, batch, rng):
+        def accumulate_grads(state, batch, rng):
             # batch leading dim = gas * micro_global; scan over gas chunks
             def to_chunks(x):
                 assert x.shape[0] % gas == 0, (
@@ -518,7 +539,18 @@ class DeepSpeedEngine:
             zero_g = self.zero.constrain_grads(zero_g)
             (grads, loss), _ = jax.lax.scan(micro, (zero_g, jnp.float32(0.0)),
                                             (chunked, rngs))
+            return grads, loss
+
+        def train_batch_fn(state, batch, rng):
+            grads, loss = accumulate_grads(state, batch, rng)
             return self._apply_grads(state, grads, loss)
+
+        def grads_batch_fn(state, batch, rng):
+            # offload path: grads stay on device; host applies the step
+            grads, loss = accumulate_grads(state, batch, rng)
+            return grads, loss, _global_norm(grads)
+
+        self._jit_grads_batch = jax.jit(grads_batch_fn)
 
         def micro_grads_fn(state, batch, rng):
             batch = jax.tree_util.tree_map(
@@ -594,8 +626,11 @@ class DeepSpeedEngine:
             self.flops_profiler.maybe_profile(batch)
 
         self.tput_timer.start()
-        self.state, metrics = self._jit_train_batch(self.state, batch,
-                                                    self._next_rng())
+        if self._host_runner is not None:
+            metrics = self._host_offload_step(batch)
+        else:
+            self.state, metrics = self._jit_train_batch(self.state, batch,
+                                                        self._next_rng())
         self.tput_timer.stop()
 
         gas = self.gradient_accumulation_steps()
@@ -609,6 +644,39 @@ class DeepSpeedEngine:
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(loss)
         return loss
+
+    def _host_offload_step(self, batch):
+        """Device grads → host SIMD Adam (cpu/NVMe state) → device params.
+        The ZeRO-Offload step (reference stage2.py:747-925 + cpu_adam)."""
+        grads, loss, grad_norm = self._jit_grads_batch(self.state, batch,
+                                                       self._next_rng())
+        grads_np = [np.ascontiguousarray(np.asarray(jax.device_get(g),
+                                                    np.float32))
+                    for g in jax.tree_util.tree_leaves(grads)]
+        norm = float(jax.device_get(grad_norm))
+        clip = self._config.gradient_clipping
+        if clip and clip > 0 and norm > clip:
+            coef = clip / (norm + 1e-6)
+            for g in grads_np:
+                g *= coef
+        step_now = int(jax.device_get(self.state.global_step))
+        lr = float(jax.device_get(self._lr_fn()(jnp.asarray(step_now))))
+
+        self._host_runner.step(grads_np, lr)
+        master = self._host_runner.params_tree()
+        new_params = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(
+                np.asarray(p, self.precision.compute_dtype), s),
+            master, self.state_shardings.params)
+        self.state = TrainState(
+            params=new_params,
+            opt_state=self.state.opt_state,
+            scaler=self.state.scaler,
+            global_step=self.state.global_step + 1,
+            skipped_steps=self.state.skipped_steps)
+        return {"loss": loss, "grad_norm": jnp.float32(norm),
+                "lr": jnp.float32(lr), "overflow": jnp.asarray(False),
+                "loss_scale": jnp.float32(1.0)}
 
     def forward(self, batch):
         """Parity shim: computes loss+grads for one micro batch and stashes
@@ -656,9 +724,35 @@ class DeepSpeedEngine:
         assert self._pending_grads is not None, "backward() must precede step()"
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).start()
-        self.state, metrics = self._jit_apply_grads(self.state,
-                                                    self._pending_grads,
-                                                    self._accum_loss)
+        if self._host_runner is not None:
+            grads_np = [np.ascontiguousarray(np.asarray(jax.device_get(g),
+                                                        np.float32))
+                        for g in jax.tree_util.tree_leaves(self._pending_grads)]
+            norm = float(np.sqrt(sum(float(np.sum(g.astype(np.float64) ** 2))
+                                     for g in grads_np)))
+            clip = self._config.gradient_clipping
+            if clip and clip > 0 and norm > clip:
+                for g in grads_np:
+                    g *= clip / (norm + 1e-6)
+            step_now = int(jax.device_get(self.state.global_step))
+            lr = float(jax.device_get(self._lr_fn()(jnp.asarray(step_now))))
+            self._host_runner.step(grads_np, lr)
+            new_params = jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(
+                    np.asarray(p, self.precision.compute_dtype), s),
+                self._host_runner.params_tree(), self.state_shardings.params)
+            self.state = TrainState(
+                params=new_params, opt_state=self.state.opt_state,
+                scaler=self.state.scaler,
+                global_step=self.state.global_step + 1,
+                skipped_steps=self.state.skipped_steps)
+            metrics = {"loss": self._accum_loss, "grad_norm": jnp.float32(norm),
+                       "lr": jnp.float32(lr), "overflow": jnp.asarray(False),
+                       "loss_scale": jnp.float32(1.0)}
+        else:
+            self.state, metrics = self._jit_apply_grads(self.state,
+                                                        self._pending_grads,
+                                                        self._accum_loss)
         self._pending_grads = None
         self._accum_loss = None
         self.global_steps += 1
@@ -736,7 +830,15 @@ class DeepSpeedEngine:
         }
         if isinstance(self.lr_scheduler, _Schedule):
             extra["lr_scheduler"] = self.lr_scheduler.state_dict()
-        ckpt.save_checkpoint(save_dir, tag, self.state, extra,
+        state = self.state
+        if self._host_runner is not None:
+            # persist fp32 master + host moments, not the bf16 device copy
+            state = TrainState(params=self._host_runner.params_tree(),
+                               opt_state=self._host_runner.state_dict(),
+                               scaler=self.state.scaler,
+                               global_step=self.state.global_step,
+                               skipped_steps=self.state.skipped_steps)
+        ckpt.save_checkpoint(save_dir, tag, state, extra,
                              save_latest=save_latest,
                              zero_stage=self.zero_optimization_stage())
         return True
@@ -757,7 +859,10 @@ class DeepSpeedEngine:
             scaler=state_tree["scaler"],
             global_step=jnp.asarray(state_tree["global_step"], jnp.int32),
             skipped_steps=jnp.asarray(state_tree["skipped_steps"], jnp.int32))
-        self._adopt_loaded_state(template)
+        if self._offload_cfg.enabled:
+            self._adopt_loaded_state_offload(template)
+        else:
+            self._adopt_loaded_state(template)
         tag = tag or ckpt.read_latest_tag(load_dir)
         self.global_steps = extra.get("global_steps", 0)
         self.micro_steps = extra.get("micro_steps", 0)
@@ -783,6 +888,23 @@ class DeepSpeedEngine:
         self.state = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(jnp.asarray(x), s),
             template, self.state_shardings)
+
+    def _adopt_loaded_state_offload(self, template: TrainState):
+        from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+        self._host_runner = HostOffloadOptimizer(
+            template.params, self.optimizer, self._offload_cfg,
+            self._config.aio_config)
+        if template.opt_state:
+            self._host_runner.load_state_dict(template.opt_state)
+        device_params = jax.tree_util.tree_map(
+            lambda p: np.asarray(p, self.precision.compute_dtype)
+            if np.issubdtype(np.asarray(p).dtype, np.floating) else
+            np.asarray(p), template.params)
+        surrogate = TrainState(params=device_params, opt_state={},
+                               scaler=template.scaler,
+                               global_step=template.global_step,
+                               skipped_steps=template.skipped_steps)
+        self._adopt_loaded_state(surrogate)
 
     def save_fp16_model(self, save_dir, save_filename="mp_rank_00_model_states.npz"):
         """Gathered model weights only (reference engine.py:1955)."""

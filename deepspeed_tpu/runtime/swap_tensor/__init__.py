@@ -1,0 +1,4 @@
+from deepspeed_tpu.runtime.swap_tensor.swapper import (
+    TensorSwapper,
+    OptimizerStateSwapper,
+)

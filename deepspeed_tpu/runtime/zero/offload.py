@@ -1,0 +1,132 @@
+"""ZeRO-Offload / ZeRO-Infinity runner — the host side of the optimizer step.
+
+Rebuild of the reference's offload architecture (stage2.py:747-925 CPU grad
+path + DeepSpeedCPUAdam + swap_tensor/): the accelerator computes
+loss+gradients in compute dtype; fp32 master params and Adam moments live in
+host DRAM (device="cpu") or NVMe (device="nvme", via the native aio
+swapper); the optimizer step runs in the native SIMD library
+(csrc/cpu_adam.cpp); updated params are pushed back to the device in
+compute dtype.
+
+This trades step latency for HBM: params/grads on device are compute-dtype
+only, optimizer state consumes zero HBM — the reference's "13B on one
+V100" recipe (SURVEY §6).
+"""
+
+from typing import Any, Dict, List
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.config import constants as C
+from deepspeed_tpu.utils.logging import logger
+
+
+class HostOffloadOptimizer:
+    """Holds fp32 master state on host; applies native Adam per leaf."""
+
+    def __init__(self, params_device, optimizer, offload_cfg, aio_cfg=None):
+        self.optimizer = optimizer
+        self.device_nvme = offload_cfg.device == C.OFFLOAD_NVME_DEVICE
+        self.step_count = 0
+
+        leaves, self.treedef = jax.tree_util.tree_flatten(
+            jax.device_get(params_device))
+        self.master: List[np.ndarray] = [
+            np.ascontiguousarray(np.asarray(l, np.float32)) for l in leaves]
+
+        self._native = None
+        try:
+            from deepspeed_tpu.ops.native import cpu_adam as native_cpu_adam
+            self._native = native_cpu_adam.load()
+        except Exception as e:
+            logger.warning(f"native cpu_adam unavailable ({e}); "
+                           f"using numpy fallback")
+
+        self.swapper = None
+        if self.device_nvme:
+            from deepspeed_tpu.runtime.swap_tensor import OptimizerStateSwapper
+            assert offload_cfg.nvme_path, "offload to nvme requires nvme_path"
+            self.swapper = OptimizerStateSwapper(offload_cfg.nvme_path, aio_cfg)
+            for i, m in enumerate(self.master):
+                self.swapper.init_state(i, m.shape)
+            self.m = self.v = None
+        else:
+            self.m = [np.zeros_like(x) for x in self.master]
+            self.v = [np.zeros_like(x) for x in self.master]
+
+    def _hyper(self):
+        opt = self.optimizer
+        betas = getattr(opt, "betas", (0.9, 0.999))
+        return dict(beta1=betas[0], beta2=betas[1],
+                    eps=getattr(opt, "eps", 1e-8),
+                    weight_decay=getattr(opt, "weight_decay", 0.0),
+                    adamw_mode=getattr(opt, "adam_w_mode", True),
+                    bias_correction=getattr(opt, "bias_correction", True))
+
+    def _apply_leaf(self, p, g, m, v, lr, hyper):
+        if self._native is not None:
+            self._native.adam_step(p.reshape(-1), np.ascontiguousarray(
+                g.reshape(-1)), m.reshape(-1), v.reshape(-1),
+                self.step_count, lr, hyper["beta1"], hyper["beta2"],
+                hyper["eps"], hyper["weight_decay"], hyper["adamw_mode"],
+                hyper["bias_correction"])
+            return
+        beta1, beta2 = hyper["beta1"], hyper["beta2"]
+        bc1 = 1 - beta1 ** self.step_count if hyper["bias_correction"] else 1.0
+        bc2 = 1 - beta2 ** self.step_count if hyper["bias_correction"] else 1.0
+        if hyper["weight_decay"] and not hyper["adamw_mode"]:
+            g = g + hyper["weight_decay"] * p
+        m *= beta1
+        m += (1 - beta1) * g
+        v *= beta2
+        v += (1 - beta2) * g * g
+        update = (m / bc1) / (np.sqrt(v / bc2) + hyper["eps"])
+        if hyper["weight_decay"] and hyper["adamw_mode"]:
+            update = update + hyper["weight_decay"] * p
+        p -= lr * update
+
+    def step(self, grads_np: List[np.ndarray], lr: float):
+        self.step_count += 1
+        hyper = self._hyper()
+        n = len(self.master)
+        for i in range(n):
+            g = np.asarray(grads_np[i], np.float32)
+            p = self.master[i]
+            if self.swapper is not None:
+                m, v = self.swapper.fetch(i)
+            else:
+                m, v = self.m[i], self.v[i]
+            self._apply_leaf(p, g, m, v, lr, hyper)
+            if self.swapper is not None:
+                self.swapper.store(i, m, v)
+        return self.master
+
+    def params_tree(self):
+        return jax.tree_util.tree_unflatten(self.treedef, self.master)
+
+    def state_dict(self):
+        if self.swapper is not None:
+            moments = [self.swapper.fetch(i) for i in range(len(self.master))]
+            m = [a for a, _ in moments]
+            v = [b for _, b in moments]
+        else:
+            m, v = self.m, self.v
+        return {
+            "step": self.step_count,
+            "exp_avg": jax.tree_util.tree_unflatten(self.treedef, m),
+            "exp_avg_sq": jax.tree_util.tree_unflatten(self.treedef, v),
+        }
+
+    def load_state_dict(self, sd):
+        self.step_count = int(np.asarray(sd["step"]))
+        m = jax.tree_util.tree_leaves(sd["exp_avg"])
+        v = jax.tree_util.tree_leaves(sd["exp_avg_sq"])
+        for i in range(len(self.master)):
+            mi = np.ascontiguousarray(np.asarray(m[i], np.float32))
+            vi = np.ascontiguousarray(np.asarray(v[i], np.float32))
+            if self.swapper is not None:
+                self.swapper.store(i, mi, vi)
+            else:
+                self.m[i], self.v[i] = mi, vi
