@@ -31,6 +31,7 @@ from deepspeed_tpu.parallel.mesh import (
 from deepspeed_tpu.runtime.pipe.module import PipelineModule, LayerSpec, TiedLayerSpec
 from deepspeed_tpu.utils import logging as _logging
 
+from deepspeed_tpu import elasticity  # noqa: F401
 from deepspeed_tpu import ops  # noqa: F401
 from deepspeed_tpu import models  # noqa: F401
 from deepspeed_tpu.runtime import zero  # noqa: F401  (deepspeed.zero parity)

@@ -289,9 +289,43 @@ class DeepSpeedConfig:
         else:
             self.world_size = 1
 
+        self._apply_elasticity()
         self._initialize_params(self._param_dict)
         self._set_batch_related_parameters()
         self._do_sanity_check()
+
+    def _apply_elasticity(self):
+        """If elastic training is on, the elastic calculator owns the batch
+        triangle — reference config.py:676-728."""
+        from deepspeed_tpu import elasticity as el
+        from deepspeed_tpu.elasticity import constants as EC
+
+        if not el.elasticity_enabled(self._param_dict):
+            return
+        logger.info("elasticity support enabled")
+        final_batch_size, valid_chips, micro_batch_size = el.compute_elastic_config(
+            ds_config=self._param_dict, world_size=self.world_size)
+        elastic_dict = self._param_dict[EC.ELASTICITY]
+        el.ensure_immutable_elastic_config(elastic_dict)
+
+        if not elastic_dict.get(EC.IGNORE_NON_ELASTIC_BATCH_INFO,
+                                EC.IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT):
+            batch_keys = (C.TRAIN_BATCH_SIZE, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                          C.TRAIN_MICRO_BATCH_SIZE_PER_CHIP,
+                          C.GRADIENT_ACCUMULATION_STEPS)
+            if any(k in self._param_dict for k in batch_keys):
+                raise el.ElasticityConfigError(
+                    "Batch-related parameters found in the config but elastic "
+                    "training is enabled, which takes control of them. Set "
+                    f"'{EC.IGNORE_NON_ELASTIC_BATCH_INFO}': true to silently "
+                    "ignore them instead.")
+
+        grad_accum = final_batch_size // (micro_batch_size * self.world_size)
+        logger.info(f"[Elasticity] valid chip counts: {valid_chips}")
+        self._param_dict[C.TRAIN_BATCH_SIZE] = final_batch_size
+        self._param_dict[C.TRAIN_MICRO_BATCH_SIZE_PER_GPU] = micro_batch_size
+        self._param_dict[C.GRADIENT_ACCUMULATION_STEPS] = grad_accum
+        self.elastic_valid_chips = valid_chips
 
     # -- params ------------------------------------------------------------
     def _initialize_params(self, pd):

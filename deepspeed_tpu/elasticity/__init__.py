@@ -1,0 +1,14 @@
+from deepspeed_tpu.elasticity.elasticity import (
+    compute_elastic_config,
+    elasticity_enabled,
+    ensure_immutable_elastic_config,
+    get_candidate_batch_sizes,
+    get_valid_chip_counts,
+    highly_composite_numbers,
+)
+from deepspeed_tpu.elasticity.config import (
+    ElasticityConfig,
+    ElasticityError,
+    ElasticityConfigError,
+    ElasticityIncompatibleWorldSize,
+)

@@ -1,0 +1,94 @@
+"""Elasticity config schema — reference elasticity/config.py:30.
+
+Keys are kept reference-compatible (``min_gpus``/``max_gpus``) and also
+accepted in TPU spelling (``min_chips``/``max_chips``).
+"""
+
+import json
+
+from deepspeed_tpu.elasticity import constants as EC
+
+
+class ElasticityError(Exception):
+    """Base exception for elasticity errors (reference elasticity/config.py:9)."""
+
+
+class ElasticityConfigError(ElasticityError):
+    """Malformed elasticity configuration (reference elasticity/config.py:16)."""
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """World size not in the valid chip-count list (reference
+    elasticity/config.py:23)."""
+
+
+class ElasticityConfig:
+    """Typed view of the ``elasticity`` config section — reference
+    elasticity/config.py:30.
+
+    {
+        "enabled": true,
+        "max_train_batch_size": 2000,
+        "micro_batch_sizes": [2, 4, 6],
+        "min_chips": 1,
+        "max_chips": 10000,
+        "min_time": 20,
+        "version": 0.1
+    }
+    """
+
+    def __init__(self, param_dict):
+        self.enabled = param_dict.get(EC.ENABLED, EC.ENABLED_DEFAULT)
+        if self.enabled:
+            if EC.MAX_ACCEPTABLE_BATCH_SIZE not in param_dict:
+                raise ElasticityConfigError(
+                    f"Elasticity config missing {EC.MAX_ACCEPTABLE_BATCH_SIZE}")
+            if EC.MICRO_BATCHES not in param_dict:
+                raise ElasticityConfigError(
+                    f"Elasticity config missing {EC.MICRO_BATCHES}")
+        self.max_acceptable_batch_size = param_dict.get(
+            EC.MAX_ACCEPTABLE_BATCH_SIZE, EC.MAX_ACCEPTABLE_BATCH_SIZE_DEFAULT)
+        self.micro_batches = param_dict.get(EC.MICRO_BATCHES, EC.MICRO_BATCHES_DEFAULT)
+
+        if not isinstance(self.micro_batches, list):
+            raise ElasticityConfigError(
+                f"Elasticity expected {EC.MICRO_BATCHES} to be a list of ints, "
+                f"got {type(self.micro_batches)}: {self.micro_batches}")
+        if not all(isinstance(m, int) and m > 0 for m in self.micro_batches):
+            raise ElasticityConfigError(
+                f"Elasticity expected {EC.MICRO_BATCHES} to contain only "
+                f"positive integers, got: {self.micro_batches}")
+
+        self.min_chips = param_dict.get(
+            EC.MIN_CHIPS, param_dict.get(EC.MIN_GPUS, EC.MIN_CHIPS_DEFAULT))
+        self.max_chips = param_dict.get(
+            EC.MAX_CHIPS, param_dict.get(EC.MAX_GPUS, EC.MAX_CHIPS_DEFAULT))
+        if self.min_chips < 1 or self.max_chips < 1:
+            raise ElasticityConfigError(
+                f"Elasticity min/max chips must be > 0, given min: "
+                f"{self.min_chips}, max: {self.max_chips}")
+        if self.max_chips < self.min_chips:
+            raise ElasticityConfigError(
+                f"Elasticity min_chips cannot exceed max_chips, given min: "
+                f"{self.min_chips}, max: {self.max_chips}")
+        # reference-compatible aliases
+        self.min_gpus = self.min_chips
+        self.max_gpus = self.max_chips
+
+        self.min_time = param_dict.get(EC.MIN_TIME, EC.MIN_TIME_DEFAULT)
+        if self.min_time < 0:
+            raise ElasticityConfigError(
+                f"Elasticity min_time must be >= 0, given {self.min_time}")
+
+        self.version = param_dict.get(EC.VERSION, EC.VERSION_DEFAULT)
+        self.prefer_larger_batch_size = param_dict.get(
+            EC.PREFER_LARGER_BATCH, EC.PREFER_LARGER_BATCH_DEFAULT)
+        self.ignore_non_elastic_batch_info = param_dict.get(
+            EC.IGNORE_NON_ELASTIC_BATCH_INFO,
+            EC.IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+
+    def repr(self):
+        return self.__dict__
+
+    def __repr__(self):
+        return json.dumps(self.__dict__, sort_keys=True, indent=4)

@@ -1,0 +1,46 @@
+"""Elasticity config keys — reference elasticity/constants.py."""
+
+ELASTICITY = "elasticity"
+
+LATEST_ELASTICITY_VERSION = 0.1
+
+ENABLED = "enabled"
+ENABLED_DEFAULT = False
+
+# Max acceptable train_batch_size
+MAX_ACCEPTABLE_BATCH_SIZE = "max_train_batch_size"
+MAX_ACCEPTABLE_BATCH_SIZE_DEFAULT = 2000
+
+# Acceptable micro batch sizes, same as train_micro_batch_size_per_gpu
+MICRO_BATCHES = "micro_batch_sizes"
+MICRO_BATCHES_DEFAULT = [2, 4, 6]
+
+# Device-count search range. TPU spelling is primary; reference "gpus"
+# spelling accepted for config parity.
+MIN_CHIPS = "min_chips"
+MAX_CHIPS = "max_chips"
+MIN_GPUS = "min_gpus"
+MAX_GPUS = "max_gpus"
+MIN_CHIPS_DEFAULT = 1
+MAX_CHIPS_DEFAULT = 10000
+
+# Minimum running time (minutes) before the scheduler may rescale the job
+MIN_TIME = "min_time"
+MIN_TIME_DEFAULT = 0
+
+PREFER_LARGER_BATCH = "prefer_larger_batch"
+PREFER_LARGER_BATCH_DEFAULT = True
+
+# If elastic mode is enabled, batch info outside the elastic section is
+# ignored; this flag silences the error that otherwise raises.
+IGNORE_NON_ELASTIC_BATCH_INFO = "ignore_non_elastic_batch_info"
+IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT = False
+
+VERSION = "version"
+VERSION_DEFAULT = LATEST_ELASTICITY_VERSION
+
+# Minimum framework version supporting elasticity
+MINIMUM_DEEPSPEED_VERSION = "0.1.0"
+
+# Environment variable carrying the scheduler's view of the elastic config
+DEEPSPEED_ELASTICITY_CONFIG = "DEEPSPEED_ELASTICITY_CONFIG"

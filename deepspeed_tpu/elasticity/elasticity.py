@@ -1,0 +1,218 @@
+"""Elastic batch-size calculator — reference elasticity/elasticity.py.
+
+Given a max acceptable train batch size, candidate micro-batch sizes, and a
+chip-count range, pick one global batch size that factors as
+``micro * grad_accum * world_size`` for as many world sizes as possible, so a
+job rescheduled onto a different chip count keeps the same effective batch
+(and therefore the same convergence behavior).
+
+The reference hard-codes the first 38 highly composite numbers
+(elasticity/elasticity.py:19); here the HCN ladder is generated from the
+prime-factorization characterization (non-increasing exponents over the first
+primes), which is exact and extends to any bound.
+"""
+
+import functools
+import json
+import math
+import os
+import re
+
+from deepspeed_tpu.elasticity import constants as EC
+from deepspeed_tpu.elasticity.config import (
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+)
+from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.version import __version__
+
+_HCN_PRIMES = (2, 3, 5, 7, 11, 13, 17, 19, 23)
+
+
+@functools.lru_cache(maxsize=None)
+def highly_composite_numbers(limit):
+    """All highly composite numbers <= limit.
+
+    Every HCN is a product of the first k primes with non-increasing
+    exponents, so enumerating that (small) candidate set and keeping
+    divisor-count record-setters is exact. Replaces the reference's
+    hard-coded HCN_LIST (elasticity/elasticity.py:19-58).
+    """
+    candidates = []
+
+    def extend(prime_idx, value, ndivisors, max_exp):
+        candidates.append((value, ndivisors))
+        if prime_idx == len(_HCN_PRIMES):
+            return
+        p = _HCN_PRIMES[prime_idx]
+        v = value
+        for exp in range(1, max_exp + 1):
+            v *= p
+            if v > limit:
+                break
+            extend(prime_idx + 1, v, ndivisors * (exp + 1), exp)
+
+    extend(0, 1, 1, max(1, int(math.log2(max(limit, 2)))))
+    candidates.sort()
+    hcns, best = [], 0
+    for value, ndiv in candidates:
+        if ndiv > best:
+            hcns.append(value)
+            best = ndiv
+    return hcns
+
+
+def get_candidate_batch_sizes(base_list, max_acceptable_batch_size):
+    """For each base, the largest base*HCN <= max (reference
+    elasticity/elasticity.py:61-75)."""
+    hcns = highly_composite_numbers(max_acceptable_batch_size)
+    candidates = set()
+    for base in base_list:
+        scaled = [base * h for h in hcns if base * h <= max_acceptable_batch_size]
+        candidates.add(scaled[-1] if scaled else base)
+    return sorted(candidates)
+
+
+def get_valid_chip_counts(batch_size, micro_batches, min_chips, max_chips):
+    """All world sizes w in [min, max] such that batch_size = micro * k * w
+    for some micro-batch and integer k (reference elasticity/elasticity.py:78-93)."""
+    valid = set()
+    for micro in micro_batches:
+        if batch_size % micro:
+            continue
+        slots = batch_size // micro
+        # any divisor of slots is a usable world size (remainder = grad accum)
+        for d in range(1, int(math.isqrt(slots)) + 1):
+            if slots % d == 0:
+                for w in (d, slots // d):
+                    if min_chips <= w <= max_chips:
+                        valid.add(w)
+    return sorted(valid)
+
+
+def _get_compatible_chips(micro_batches, max_acceptable_batch_size,
+                          min_chips=None, max_chips=None, prefer_larger=True):
+    """Pick the batch size with the most compatible chip counts (reference
+    elasticity/elasticity.py:120-170, _get_compatible_gpus_v01)."""
+    min_chips = min_chips or 1
+    max_chips = max_chips or max_acceptable_batch_size // min(micro_batches)
+
+    if not all(m <= max_acceptable_batch_size for m in micro_batches):
+        raise ElasticityConfigError(
+            f"All micro batches {micro_batches} must be <= "
+            f"max_acceptable_batch_size {max_acceptable_batch_size}")
+
+    lcm = functools.reduce(math.lcm, micro_batches)
+    bases = list(micro_batches) + [lcm]
+
+    best_batch, best_valid = min(micro_batches), []
+    for batch_size in get_candidate_batch_sizes(bases, max_acceptable_batch_size):
+        valid = get_valid_chip_counts(batch_size, micro_batches, min_chips, max_chips)
+        better_count = len(valid) > len(best_valid)
+        tie = len(valid) == len(best_valid)
+        preferred = batch_size > best_batch if prefer_larger else batch_size < best_batch
+        if better_count or (tie and preferred):
+            best_batch, best_valid = batch_size, valid
+    return int(best_batch), best_valid
+
+
+def _parse_version(version_str):
+    m = re.search(r"^(\d+)\.(\d+)(?:\.(\d+))?", version_str)
+    if m is None:
+        raise ElasticityError(
+            f"Expecting major.minor[.patch] version format, got {version_str}")
+    return int(m.group(1)), int(m.group(2)), int(m.group(3) or 0)
+
+
+def _compatible_version_check(target_version):
+    if _parse_version(target_version) < _parse_version(EC.MINIMUM_DEEPSPEED_VERSION):
+        raise ElasticityError(
+            f"Target version {target_version} is below minimum "
+            f"{EC.MINIMUM_DEEPSPEED_VERSION} supporting elasticity.")
+    return True
+
+
+def elasticity_enabled(ds_config):
+    """reference elasticity/elasticity.py:201."""
+    if EC.ELASTICITY not in ds_config:
+        return False
+    return ds_config[EC.ELASTICITY].get(EC.ENABLED, EC.ENABLED_DEFAULT)
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict):
+    """Verify the resource scheduler saw the same elastic config the runtime
+    is using (reference elasticity/elasticity.py:206-237)."""
+    if EC.DEEPSPEED_ELASTICITY_CONFIG not in os.environ:
+        logger.warning(
+            f"{EC.DEEPSPEED_ELASTICITY_CONFIG} env var not found; cannot "
+            "guarantee the resource scheduler will scale this job with "
+            "compatible chip counts.")
+        return
+    scheduler = ElasticityConfig(
+        json.loads(os.environ[EC.DEEPSPEED_ELASTICITY_CONFIG]))
+    runtime = ElasticityConfig(runtime_elastic_config_dict)
+    for field in ("max_acceptable_batch_size", "micro_batches", "version"):
+        if getattr(runtime, field) != getattr(scheduler, field):
+            raise ElasticityConfigError(
+                f"Elastic config '{field}={getattr(scheduler, field)}' seen "
+                f"by the resource scheduler does not match the runtime value "
+                f"{field}={getattr(runtime, field)}")
+
+
+def compute_elastic_config(ds_config, target_deepspeed_version=__version__,
+                           world_size=0):
+    """Compute (final_batch_size, valid_chip_counts[, micro_batch]) from an
+    elastic config — reference elasticity/elasticity.py:240.
+
+    Deterministic for a given ``ds_config`` so both the scheduler and the
+    runtime independently agree. With ``world_size`` > 0, also validates the
+    world size and returns the largest compatible micro-batch size.
+    """
+    if not isinstance(ds_config, dict):
+        raise ValueError(
+            f"Expected ds_config dict, got {type(ds_config)}: {ds_config}")
+    if EC.ELASTICITY not in ds_config:
+        raise ElasticityConfigError(
+            f"'{EC.ELASTICITY}' is missing from the config; add it when "
+            "running an elastic training job.")
+    section = ds_config[EC.ELASTICITY]
+    if not section.get(EC.ENABLED, EC.ENABLED_DEFAULT):
+        raise ElasticityConfigError(
+            "Elasticity is disabled; set 'enabled': true to run elastic.")
+
+    elastic_config = ElasticityConfig(section)
+
+    if float(elastic_config.version) > EC.LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            f"Elasticity version {elastic_config.version} requested but the "
+            f"runtime supports up to {EC.LATEST_ELASTICITY_VERSION}")
+    _compatible_version_check(target_deepspeed_version)
+
+    if float(elastic_config.version) != 0.1:
+        raise NotImplementedError(
+            f"No elastic logic for version {elastic_config.version}")
+
+    final_batch_size, valid_chips = _get_compatible_chips(
+        micro_batches=elastic_config.micro_batches,
+        max_acceptable_batch_size=elastic_config.max_acceptable_batch_size,
+        min_chips=elastic_config.min_chips,
+        max_chips=elastic_config.max_chips,
+        prefer_larger=elastic_config.prefer_larger_batch_size)
+
+    if world_size > 0:
+        if world_size not in valid_chips:
+            raise ElasticityIncompatibleWorldSize(
+                f"World size ({world_size}) is not in the valid chip-count "
+                f"list: {valid_chips}")
+        micro_batch = next(
+            (m for m in sorted(set(elastic_config.micro_batches), reverse=True)
+             if (final_batch_size // world_size) % m == 0), None)
+        assert micro_batch is not None, (
+            f"No divisible micro batch for world_size={world_size}, "
+            f"final_batch_size={final_batch_size}, "
+            f"micro_batches={elastic_config.micro_batches}")
+        return final_batch_size, valid_chips, micro_batch
+
+    return final_batch_size, valid_chips

@@ -1,0 +1,158 @@
+"""Elasticity tests — mirrors reference tests/unit/test_elastic.py."""
+
+import copy
+
+import pytest
+
+from deepspeed_tpu import elasticity
+from deepspeed_tpu.config.config import DeepSpeedConfig
+from deepspeed_tpu.elasticity import (
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+    highly_composite_numbers,
+)
+from deepspeed_tpu.version import __version__
+
+base_ds_config = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_chips": 32,
+        "max_chips": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def _config():
+    return copy.deepcopy(base_ds_config)
+
+
+def test_hcn_generation_matches_known_sequence():
+    # The 38 smallest highly composite numbers (OEIS A002182), which the
+    # reference hard-codes (elasticity/elasticity.py:19).
+    expected = [1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840,
+                1260, 1680, 2520, 5040, 7560, 10080, 15120, 20160, 25200,
+                27720, 45360, 50400, 55440, 83160, 110880, 166320, 221760,
+                277200, 332640, 498960, 554400, 665280, 720720]
+    assert highly_composite_numbers(720720) == expected
+
+
+def test_basic_10k():
+    ds_config = _config()
+    final_batch_size, valid_chips = compute_elastic_config(ds_config)
+    for n in valid_chips:
+        assert final_batch_size % n == 0
+        batch_per_chip = final_batch_size // n
+        assert any(batch_per_chip % mb == 0
+                   for mb in ds_config["elasticity"]["micro_batch_sizes"])
+    # same answers as the reference test (tests/unit/test_elastic.py:40-41)
+    assert len(valid_chips) == 23
+    assert final_batch_size == 9792
+
+
+def test_old_version():
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(_config(), target_deepspeed_version="0.0")
+
+
+def test_disabled():
+    ds_config = _config()
+    ds_config["elasticity"]["enabled"] = False
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(ds_config)
+
+
+def test_valid_world_size():
+    final_batch_size, valid_chips, mbsize = compute_elastic_config(
+        _config(), world_size=64)
+    assert mbsize == 17
+
+
+def test_invalid_world_size():
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(_config(), world_size=128)
+
+
+def test_future_elastic_version():
+    ds_config = _config()
+    ds_config["elasticity"]["version"] = "0.2"
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(ds_config)
+
+
+def test_missing_max_batch():
+    ds_config = _config()
+    del ds_config["elasticity"]["max_train_batch_size"]
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(ds_config)
+
+
+def test_missing_micro_batch():
+    ds_config = _config()
+    del ds_config["elasticity"]["micro_batch_sizes"]
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(ds_config)
+
+
+def test_empty_config():
+    with pytest.raises(ElasticityError):
+        compute_elastic_config({"elasticity": {"enabled": True}})
+
+
+@pytest.mark.parametrize("key, value", [
+    ("micro_batch_sizes", [1, 4, -1, 2, -10]),
+    ("min_chips", -1),
+    ("max_chips", -1),
+    ("micro_batch_sizes", 5),
+    ("micro_batch_sizes", ["a", None, 0.5]),
+    ("micro_batch_sizes", [2, 0.5, 4]),
+])
+def test_invalid_config_values(key, value):
+    ds_config = _config()
+    ds_config["elasticity"][key] = value
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(ds_config)
+
+
+def test_proper_mbsz():
+    ds_config = _config()
+    ds_config["elasticity"]["max_train_batch_size"] = 32
+    ds_config["elasticity"]["micro_batch_sizes"] = [1, 2, 3, 7]
+    ds_config["elasticity"]["min_chips"] = 1
+    final_batch_size, valid_chips, mbsize = compute_elastic_config(
+        ds_config, world_size=7)
+    assert mbsize == 3
+
+
+def test_gpu_alias_keys():
+    ds_config = _config()
+    section = ds_config["elasticity"]
+    section["min_gpus"] = section.pop("min_chips")
+    section["max_gpus"] = section.pop("max_chips")
+    final_batch_size, valid_chips = compute_elastic_config(ds_config)
+    assert final_batch_size == 9792
+
+
+def test_elastic_config_changed():
+    """Batch params in the main config + elasticity must raise unless
+    explicitly ignored (reference config.py:693-705)."""
+    ds_config = _config()
+    ds_config["train_batch_size"] = 4
+    with pytest.raises(ElasticityConfigError):
+        DeepSpeedConfig(ds_config, world_size=64)
+
+    ds_config["elasticity"]["ignore_non_elastic_batch_info"] = True
+    cfg = DeepSpeedConfig(ds_config, world_size=64)
+    assert cfg.train_batch_size == 9792
+    assert cfg.train_micro_batch_size_per_gpu == 17
+    assert cfg.gradient_accumulation_steps == 9792 // (17 * 64)
+
+
+def test_elasticity_enabled_helper():
+    assert elasticity.elasticity_enabled(_config())
+    assert not elasticity.elasticity_enabled({})
