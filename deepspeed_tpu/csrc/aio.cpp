@@ -104,7 +104,12 @@ struct Handle {
       }
       if (failed) errors.fetch_add(1);
       completed.fetch_add(1);
-      if (inflight.fetch_sub(1) == 1) cv_done.notify_all();
+      // decrement+notify under mu: a waiter that checked the predicate but
+      // has not yet blocked must not miss this wakeup
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        if (inflight.fetch_sub(1) == 1) cv_done.notify_all();
+      }
     }
   }
 
@@ -145,8 +150,10 @@ void aio_pwrite(void* h, int fd, void* buf, int64_t nbytes, int64_t offset) {
 
 int64_t aio_handle_wait(void* h) { return static_cast<Handle*>(h)->wait(); }
 
+// returns and clears the error count, so one failed batch does not poison
+// later batches on the same handle
 int64_t aio_handle_errors(void* h) {
-  return static_cast<Handle*>(h)->errors.load();
+  return static_cast<Handle*>(h)->errors.exchange(0);
 }
 
 // sync convenience: whole-tensor read/write through the pool

@@ -90,16 +90,24 @@ class AsyncIOHandle:
 
     def wait(self):
         done = self.lib.aio_handle_wait(self._h)
-        if self.lib.aio_handle_errors(self._h):
-            raise IOError("async IO requests failed")
+        self._raise_errors()
         return done
+
+    def _raise_errors(self):
+        # aio_handle_errors returns-and-clears, so a failure is reported once
+        # (to the wait that observed it) and does not poison later batches
+        n = self.lib.aio_handle_errors(self._h)
+        if n:
+            raise IOError(f"{n} async IO request(s) failed")
 
     # -- sync API (reference sync_pread/sync_pwrite) ------------------------
     def sync_pread(self, arr, path_or_fd, offset=0):
         fd, opened = self._fd(path_or_fd, False)
         try:
             ptr, nbytes = self._buf(arr)
-            return self.lib.aio_sync_pread(self._h, fd, ptr, nbytes, offset)
+            done = self.lib.aio_sync_pread(self._h, fd, ptr, nbytes, offset)
+            self._raise_errors()
+            return done
         finally:
             if opened:
                 self.close(fd)
@@ -108,7 +116,9 @@ class AsyncIOHandle:
         fd, opened = self._fd(path_or_fd, True)
         try:
             ptr, nbytes = self._buf(arr)
-            return self.lib.aio_sync_pwrite(self._h, fd, ptr, nbytes, offset)
+            done = self.lib.aio_sync_pwrite(self._h, fd, ptr, nbytes, offset)
+            self._raise_errors()
+            return done
         finally:
             if opened:
                 self.close(fd)

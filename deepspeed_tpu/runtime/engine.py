@@ -203,9 +203,6 @@ class DeepSpeedEngine:
         # ZeRO-Offload: optimizer state + fp32 master on host (cpu) or NVMe
         self._offload_cfg = self._config.zero_config.offload_optimizer
         self._host_runner = None
-        if self._offload_cfg.enabled and self.precision.fp16:
-            logger.warning("fp16 dynamic loss scaling is not supported with "
-                           "optimizer offload; use bf16")
 
         self._rng = rng if rng is not None else jax.random.PRNGKey(self._config.seed)
         self.state: Optional[TrainState] = None
@@ -546,9 +543,13 @@ class DeepSpeedEngine:
             return self._apply_grads(state, grads, loss)
 
         def grads_batch_fn(state, batch, rng):
-            # offload path: grads stay on device; host applies the step
+            # offload path: grads stay on device; host applies the step.
+            # finiteness + norm are computed here so the host only pulls two
+            # scalars instead of re-scanning every leaf
             grads, loss = accumulate_grads(state, batch, rng)
-            return grads, loss, _global_norm(grads)
+            finite = prec.grads_finite(grads) if self.precision.fp16 \
+                else jnp.asarray(True)
+            return grads, loss, finite, _global_norm(grads)
 
         self._jit_grads_batch = jax.jit(grads_batch_fn)
 
@@ -648,35 +649,83 @@ class DeepSpeedEngine:
     def _host_offload_step(self, batch):
         """Device grads → host SIMD Adam (cpu/NVMe state) → device params.
         The ZeRO-Offload step (reference stage2.py:747-925 + cpu_adam)."""
-        grads, loss, grad_norm = self._jit_grads_batch(self.state, batch,
-                                                       self._next_rng())
-        grads_np = [np.ascontiguousarray(np.asarray(jax.device_get(g),
+        grads, loss, finite, scaled_norm = self._jit_grads_batch(
+            self.state, batch, self._next_rng())
+        return self._host_apply_grads(grads, loss, finite=finite,
+                                      scaled_norm=scaled_norm)
+
+    def _host_apply_grads(self, grads, loss, finite=None, scaled_norm=None):
+        """Shared offload update: unscale by loss scale, fp16 overflow-skip,
+        clip, host optimizer step, push params back (reference
+        stage2.py:747-925 + fused_optimizer.py:194-246).
+
+        ``finite``/``scaled_norm`` are device scalars when coming from the
+        fused grads fn; the forward/backward/step path computes them here."""
+        fp16 = self.precision.fp16
+        scale = float(jax.device_get(self.state.scaler["loss_scale"])) \
+            if fp16 else 1.0
+
+        def pull_grads():
+            return [np.ascontiguousarray(np.asarray(jax.device_get(g),
                                                     np.float32))
                     for g in jax.tree_util.tree_leaves(grads)]
-        norm = float(jax.device_get(grad_norm))
-        clip = self._config.gradient_clipping
-        if clip and clip > 0 and norm > clip:
-            coef = clip / (norm + 1e-6)
-            for g in grads_np:
-                g *= coef
+
+        # overflow-skip applies under fp16 only, matching _apply_grads —
+        # bf16/fp32 runs step unconditionally like the device path. Resolve
+        # the device finite scalar BEFORE transferring the gradient tree so
+        # skipped steps don't pull the full model's grads just to drop them.
+        grads_np = None
+        if finite is not None:
+            finite = bool(jax.device_get(finite))
+        else:
+            grads_np = pull_grads()
+            finite = not fp16 or all(np.isfinite(g).all() for g in grads_np)
+        new_scaler = prec.update_scaler(self.state.scaler, self.precision,
+                                        jnp.asarray(finite))
         step_now = int(jax.device_get(self.state.global_step))
         lr = float(jax.device_get(self._lr_fn()(jnp.asarray(step_now))))
+        if not finite:
+            self.state = TrainState(
+                params=self.state.params, opt_state=self.state.opt_state,
+                scaler=new_scaler, global_step=self.state.global_step,
+                skipped_steps=self.state.skipped_steps + 1)
+            return {"loss": loss, "grad_norm": jnp.float32(0.0),
+                    "lr": jnp.float32(lr), "overflow": jnp.asarray(True),
+                    "loss_scale": new_scaler["loss_scale"]}
+
+        if grads_np is None:
+            grads_np = pull_grads()
+        if scaled_norm is not None:
+            norm = float(jax.device_get(scaled_norm)) / scale
+        else:
+            # fp32 BLAS dot per leaf — no float64 temporaries
+            norm = float(np.sqrt(sum(float(np.dot(g.ravel(), g.ravel()))
+                                     for g in grads_np))) / scale
+
+        # fold unscale + clip into one coefficient; copy leaves only when
+        # it actually rescales (device_get views are read-only)
+        coef = 1.0 / scale
+        clip = self._config.gradient_clipping
+        if clip and clip > 0 and norm > clip:
+            coef *= clip / (norm + 1e-6)
+        if coef != 1.0:
+            coef32 = np.float32(coef)
+            grads_np = [np.ascontiguousarray(g * coef32) for g in grads_np]
 
         self._host_runner.step(grads_np, lr)
-        master = self._host_runner.params_tree()
         new_params = jax.tree_util.tree_map(
             lambda p, s: jax.device_put(
                 np.asarray(p, self.precision.compute_dtype), s),
-            master, self.state_shardings.params)
+            self._host_runner.params_tree(), self.state_shardings.params)
         self.state = TrainState(
             params=new_params,
             opt_state=self.state.opt_state,
-            scaler=self.state.scaler,
+            scaler=new_scaler,
             global_step=self.state.global_step + 1,
             skipped_steps=self.state.skipped_steps)
         return {"loss": loss, "grad_norm": jnp.float32(norm),
                 "lr": jnp.float32(lr), "overflow": jnp.asarray(False),
-                "loss_scale": jnp.float32(1.0)}
+                "loss_scale": new_scaler["loss_scale"]}
 
     def forward(self, batch):
         """Parity shim: computes loss+grads for one micro batch and stashes
@@ -725,30 +774,8 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).start()
         if self._host_runner is not None:
-            grads_np = [np.ascontiguousarray(np.asarray(jax.device_get(g),
-                                                        np.float32))
-                        for g in jax.tree_util.tree_leaves(self._pending_grads)]
-            norm = float(np.sqrt(sum(float(np.sum(g.astype(np.float64) ** 2))
-                                     for g in grads_np)))
-            clip = self._config.gradient_clipping
-            if clip and clip > 0 and norm > clip:
-                for g in grads_np:
-                    g *= clip / (norm + 1e-6)
-            step_now = int(jax.device_get(self.state.global_step))
-            lr = float(jax.device_get(self._lr_fn()(jnp.asarray(step_now))))
-            self._host_runner.step(grads_np, lr)
-            new_params = jax.tree_util.tree_map(
-                lambda p, s: jax.device_put(
-                    np.asarray(p, self.precision.compute_dtype), s),
-                self._host_runner.params_tree(), self.state_shardings.params)
-            self.state = TrainState(
-                params=new_params, opt_state=self.state.opt_state,
-                scaler=self.state.scaler,
-                global_step=self.state.global_step + 1,
-                skipped_steps=self.state.skipped_steps)
-            metrics = {"loss": self._accum_loss, "grad_norm": jnp.float32(norm),
-                       "lr": jnp.float32(lr), "overflow": jnp.asarray(False),
-                       "loss_scale": jnp.float32(1.0)}
+            metrics = self._host_apply_grads(self._pending_grads,
+                                             self._accum_loss)
         else:
             self.state, metrics = self._jit_apply_grads(self.state,
                                                         self._pending_grads,

@@ -31,39 +31,52 @@ class TensorSwapper:
             single_submit=getattr(cfg, "single_submit", False),
             overlap_events=getattr(cfg, "overlap_events", True),
             thread_count=getattr(cfg, "thread_count", 2))
-        self._pending_read = None  # (name, buffer)
+        self._pending_read = None  # (name, buffer, fd)
 
     def _path(self, name):
         return os.path.join(self.dir, f"{name}.swp")
 
+    def _drain_pending(self):
+        """Wait for the in-flight prefetch (if any) and close its fd."""
+        if self._pending_read is None:
+            return None, None
+        name, buf, fd = self._pending_read
+        self._pending_read = None
+        try:
+            self.handle.wait()
+        finally:
+            self.handle.close(fd)
+        return name, buf
+
     def swap_out(self, name, array):
         assert array.dtype == np.float32 and array.flags["C_CONTIGUOUS"]
+        # drain first: the handle's wait/error accounting is per-batch, so a
+        # sync op must not share the handle with an in-flight prefetch (it
+        # would absorb the prefetch's completion and error status)
+        self._drain_pending()
         self.handle.sync_pwrite(array, self._path(name))
 
     def swap_in(self, name, out_array):
         if self._pending_read and self._pending_read[0] == name:
-            self.handle.wait()
-            buf = self._pending_read[1]
-            self._pending_read = None
+            _, buf = self._drain_pending()
             if buf is not out_array:
                 np.copyto(out_array, buf)
             return out_array
+        self._drain_pending()
         self.handle.sync_pread(out_array, self._path(name))
         return out_array
 
     def prefetch(self, name, out_array):
         """Start the async read of `name`; a following swap_in(name) waits
         and consumes it (double buffering)."""
-        if self._pending_read is not None:
-            self.handle.wait()
+        self._drain_pending()
         fd = self.handle.open(self._path(name), False)
         self.handle.async_pread(out_array, fd)
-        # fd intentionally kept open until wait(); closed by OS at release
-        self._pending_read = (name, out_array)
+        self._pending_read = (name, out_array, fd)
 
     def release(self):
         try:
-            self.handle.wait()
+            self._drain_pending()
         except Exception:
             pass
         shutil.rmtree(self.dir, ignore_errors=True)

@@ -27,6 +27,14 @@ class HostOffloadOptimizer:
     """Holds fp32 master state on host; applies native Adam per leaf."""
 
     def __init__(self, params_device, optimizer, offload_cfg, aio_cfg=None):
+        # the host step is Adam/AdamW; anything else would silently train
+        # with the wrong algorithm (the reference likewise restricts offload
+        # to DeepSpeedCPUAdam, stage2.py:747)
+        from deepspeed_tpu.ops.adam import FusedAdam
+        if not isinstance(optimizer, FusedAdam):
+            raise ValueError(
+                f"optimizer offload supports Adam/AdamW-family optimizers "
+                f"only, got {type(optimizer).__name__}")
         self.optimizer = optimizer
         self.device_nvme = offload_cfg.device == C.OFFLOAD_NVME_DEVICE
         self.step_count = 0

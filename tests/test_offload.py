@@ -139,3 +139,99 @@ def test_offload_checkpoint_roundtrip(tmp_path):
     la = float(engine.train_batch(batch))
     lb = float(engine2.train_batch(batch))
     assert la == pytest.approx(lb, rel=1e-4)
+
+
+def test_offload_fp16_unscales_gradients():
+    """fp16 loss scaling + offload: host Adam must see unscaled grads —
+    training should match the pure-device fp16 path closely."""
+    cfg_dev = base_config()
+    cfg_dev["fp16"] = {"enabled": True, "initial_scale_power": 8}
+    cfg_off = base_config()
+    cfg_off["fp16"] = {"enabled": True, "initial_scale_power": 8}
+    cfg_off["zero_optimization"] = {"stage": 2,
+                                    "offload_optimizer": {"device": "cpu"}}
+    e_dev, _, _, _ = dstpu.initialize(config=cfg_dev, model=SimpleModel(),
+                                      mesh=one_device_mesh())
+    e_off, _, _, _ = dstpu.initialize(config=cfg_off, model=SimpleModel(),
+                                      mesh=one_device_mesh())
+    batch = random_batch()
+    for _ in range(5):
+        l_dev = float(e_dev.train_batch(batch))
+        l_off = float(e_off.train_batch(batch))
+    # a 256x-scaled update would diverge instantly; equality to the device
+    # fp16 path proves the unscale happened
+    assert l_off == pytest.approx(l_dev, rel=2e-2)
+
+
+def test_offload_fp16_overflow_skips_step():
+    cfg = base_config()
+    cfg["fp16"] = {"enabled": True, "initial_scale_power": 4, "hysteresis": 1}
+    cfg["zero_optimization"] = {"stage": 2,
+                                "offload_optimizer": {"device": "cpu"}}
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                       mesh=one_device_mesh())
+    x, y = random_batch()
+    engine.train_batch((x, y))
+    params_before = jax.device_get(engine.state.params)
+    scale_before = float(jax.device_get(engine.state.scaler["loss_scale"]))
+    x_bad = x.copy()
+    x_bad[0, 0] = np.inf
+    engine.train_batch((x_bad, y))
+    params_after = jax.device_get(engine.state.params)
+    scale_after = float(jax.device_get(engine.state.scaler["loss_scale"]))
+    assert scale_after < scale_before
+    leaves_b = jax.tree_util.tree_leaves(params_before)
+    leaves_a = jax.tree_util.tree_leaves(params_after)
+    for b, a in zip(leaves_b, leaves_a):
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(a))
+
+
+def test_offload_rejects_non_adam_optimizer():
+    cfg = base_config()
+    cfg["optimizer"] = {"type": "SGD", "params": {"lr": 1e-2}}
+    cfg["zero_optimization"] = {"stage": 2,
+                                "offload_optimizer": {"device": "cpu"}}
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                       mesh=one_device_mesh())
+    with pytest.raises(ValueError, match="Adam"):
+        engine.train_batch(random_batch())
+
+
+def test_swapper_prefetch_no_fd_leak(tmp_path):
+    if not has_native():
+        pytest.skip("no C++ toolchain")
+    import resource
+    from deepspeed_tpu.runtime.swap_tensor import TensorSwapper
+    sw = TensorSwapper(str(tmp_path))
+    a = np.arange(256, dtype=np.float32)
+    sw.swap_out("x", a)
+    out = np.zeros_like(a)
+    soft, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+    for _ in range(min(soft + 64, 4096)):
+        sw.prefetch("x", out)
+        sw.swap_in("x", out)
+    np.testing.assert_array_equal(out, a)
+    sw.release()
+
+
+def test_swapper_prefetch_error_attribution(tmp_path):
+    """A failed prefetch raises at its drain point; sync ops sharing the
+    handle neither absorb that error nor deliver garbage silently."""
+    if not has_native():
+        pytest.skip("no C++ toolchain")
+    from deepspeed_tpu.runtime.swap_tensor import TensorSwapper
+    sw = TensorSwapper(str(tmp_path))
+    a = np.arange(64, dtype=np.float32)
+    sw.swap_out("good", a)
+    # hand-craft a truncated swap file
+    with open(sw._path("bad"), "wb") as f:
+        f.write(b"xyz")
+    out = np.zeros_like(a)
+    sw.prefetch("bad", out)
+    # the next op drains the pending prefetch and must surface ITS failure
+    with pytest.raises(IOError):
+        sw.swap_out("good", a)
+    # handle recovered: clean sync ops still work
+    sw.swap_in("good", out)
+    np.testing.assert_array_equal(out, a)
+    sw.release()
