@@ -7,3 +7,13 @@ from deepspeed_tpu.models.gpt2 import (
     gpt2_large,
     gpt2_xl,
 )
+from deepspeed_tpu.models.bert import (
+    BertConfig,
+    BertModel,
+    BertForPreTraining,
+    BertForQuestionAnswering,
+    BertForSequenceClassification,
+    bert_tiny,
+    bert_base,
+    bert_large,
+)

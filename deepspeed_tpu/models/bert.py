@@ -1,0 +1,267 @@
+"""BERT family — the reference's fused-kernel showcase model.
+
+The reference carries two full in-tree BERT implementations for kernel tests
+(tests/unit/modeling.py post-LN, tests/unit/modelingpreln.py pre-LN, ~2.5k LoC)
+and drives BERT pretraining/SQuAD e2e (tests/model/BingBertSquad). Here BERT
+is a first-class model built directly on the fused encoder layer
+(deepspeed_tpu/ops/transformer), with the same TPU idioms as GPT-2:
+bf16 compute / fp32 params, optional nn.scan over layers, remat via the
+transformer config's memory knobs.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.ops.transformer import (
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+    transformer_layer,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.0
+    attention_probs_dropout_prob: float = 0.0
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    pre_layer_norm: bool = False       # modeling.py vs modelingpreln.py
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = False
+    # fused-layer memory knobs (reference DeepSpeedTransformerConfig)
+    normalize_invertible: bool = False
+    gelu_checkpoint: bool = False
+    attn_dropout_checkpoint: bool = False
+
+    def transformer_config(self) -> DeepSpeedTransformerConfig:
+        return DeepSpeedTransformerConfig(
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            heads=self.num_attention_heads,
+            attn_dropout_ratio=self.attention_probs_dropout_prob,
+            hidden_dropout_ratio=self.hidden_dropout_prob,
+            num_hidden_layers=self.num_hidden_layers,
+            initializer_range=self.initializer_range,
+            layer_norm_eps=self.layer_norm_eps,
+            pre_layer_norm=self.pre_layer_norm,
+            normalize_invertible=self.normalize_invertible,
+            gelu_checkpoint=self.gelu_checkpoint,
+            attn_dropout_checkpoint=self.attn_dropout_checkpoint,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+
+    def num_params(self):
+        E, L, F = self.hidden_size, self.num_hidden_layers, self.intermediate_size
+        emb = (self.vocab_size + self.max_position_embeddings
+               + self.type_vocab_size) * E + 2 * E
+        per_layer = 4 * E * E + 2 * E * F + 9 * E + F
+        final_ln = 2 * E if self.pre_layer_norm else 0
+        return emb + L * per_layer + final_ln + E * E + E
+
+
+class BertEmbeddings(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, deterministic=True):
+        cfg = self.config
+        B, S = input_ids.shape
+        init = nn.initializers.normal(cfg.initializer_range)
+        word = self.param("word_embeddings", init,
+                          (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        pos = self.param("position_embeddings", init,
+                         (cfg.max_position_embeddings, cfg.hidden_size),
+                         cfg.param_dtype)
+        tok = self.param("token_type_embeddings", init,
+                         (cfg.type_vocab_size, cfg.hidden_size),
+                         cfg.param_dtype)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = word[input_ids] + pos[None, :S] + tok[token_type_ids]
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="LayerNorm")(
+            x.astype(cfg.dtype))
+        if cfg.hidden_dropout_prob > 0:
+            x = nn.Dropout(cfg.hidden_dropout_prob)(x, deterministic)
+        return x
+
+
+class _ScanLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask, deterministic):
+        layer = transformer_layer(self.config.transformer_config())
+        return layer(x, attention_mask, deterministic), None
+
+
+class BertEncoder(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None, deterministic=True):
+        cfg = self.config
+        if cfg.scan_layers:
+            scanned = nn.scan(_ScanLayer,
+                              variable_axes={"params": 0},
+                              split_rngs={"params": True, "dropout": True},
+                              in_axes=(nn.broadcast, nn.broadcast),
+                              length=cfg.num_hidden_layers)
+            x, _ = scanned(cfg, name="layer")(x, attention_mask, deterministic)
+        else:
+            for i in range(cfg.num_hidden_layers):
+                x = transformer_layer(cfg.transformer_config())(
+                    x, attention_mask, deterministic)
+        if cfg.pre_layer_norm:
+            # pre-LN stacks need a final normalize (modelingpreln.py ditto)
+            x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                             param_dtype=cfg.param_dtype, name="FinalLayerNorm")(x)
+        return x
+
+
+class BertModel(nn.Module):
+    """Backbone: embeddings → fused encoder stack → pooler.
+
+    Returns (sequence_output [B,S,E], pooled_output [B,E])."""
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        x = BertEmbeddings(cfg, name="embeddings")(
+            input_ids, token_type_ids, deterministic)
+        x = BertEncoder(cfg, name="encoder")(x, attention_mask, deterministic)
+        pooled = nn.tanh(nn.Dense(
+            cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(cfg.initializer_range),
+            name="pooler")(x[:, 0]))
+        return x, pooled
+
+
+class BertForPreTraining(nn.Module):
+    """MLM + NSP heads; returns (prediction_logits, seq_relationship_logits).
+    The MLM decoder is tied to the word embeddings (standard BERT; the
+    reference's BertPreTrainingHeads in tests/unit/modeling.py). Weight tying
+    uses the setup-submodule `.variables` idiom so the decoder reads the live
+    embedding table instead of duplicating the [V, E] matrix."""
+    config: BertConfig
+
+    def setup(self):
+        cfg = self.config
+        self.bert = BertModel(cfg)
+        self.transform = nn.Dense(
+            cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(cfg.initializer_range))
+        self.transform_ln = nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype)
+        self.seq_relationship = nn.Dense(
+            2, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        self.mlm_bias = self.param("mlm_bias", nn.initializers.zeros,
+                                   (cfg.vocab_size,), cfg.param_dtype)
+
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        seq_out, pooled = self.bert(input_ids, attention_mask, token_type_ids,
+                                    deterministic)
+        h = self.transform(seq_out)
+        h = nn.gelu(h, approximate=False)
+        h = self.transform_ln(h)
+        word_emb = self.bert.variables["params"]["embeddings"][
+            "word_embeddings"]
+        mlm_logits = jnp.einsum("bse,ve->bsv", h,
+                                word_emb.astype(cfg.dtype)) \
+            + self.mlm_bias.astype(cfg.dtype)
+        nsp_logits = self.seq_relationship(pooled)
+        return mlm_logits, nsp_logits
+
+
+class BertForQuestionAnswering(nn.Module):
+    """SQuAD head (reference e2e: tests/model/BingBertSquad).
+    Returns (start_logits, end_logits)."""
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        seq_out, _ = BertModel(self.config, name="bert")(
+            input_ids, attention_mask, token_type_ids, deterministic)
+        logits = nn.Dense(2, dtype=jnp.float32,
+                          param_dtype=self.config.param_dtype,
+                          name="qa_outputs")(seq_out.astype(jnp.float32))
+        start, end = jnp.split(logits, 2, axis=-1)
+        return start[..., 0], end[..., 0]
+
+
+class BertForSequenceClassification(nn.Module):
+    config: BertConfig
+    num_labels: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        _, pooled = BertModel(self.config, name="bert")(
+            input_ids, attention_mask, token_type_ids, deterministic)
+        return nn.Dense(self.num_labels, dtype=jnp.float32,
+                        param_dtype=self.config.param_dtype,
+                        name="classifier")(pooled.astype(jnp.float32))
+
+
+def mlm_loss(mlm_logits, labels, ignore_index=-100):
+    """Masked-LM cross entropy in fp32 over positions where labels != ignore."""
+    logits = mlm_logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    targets = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    ll = jnp.where(valid, ll, 0.0)
+    return -ll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def pretraining_loss(outputs, batch):
+    """Combined MLM + NSP loss from a batch dict with keys
+    input_ids/attention_mask/token_type_ids/mlm_labels[/nsp_labels]."""
+    mlm_logits, nsp_logits = outputs
+    loss = mlm_loss(mlm_logits, batch["mlm_labels"])
+    if "nsp_labels" in batch:
+        nsp = jax.nn.log_softmax(nsp_logits.astype(jnp.float32), axis=-1)
+        nsp_ll = jnp.take_along_axis(
+            nsp, batch["nsp_labels"][:, None], axis=-1)[:, 0]
+        loss = loss - nsp_ll.mean()
+    return loss
+
+
+# -- presets ---------------------------------------------------------------
+
+def bert_tiny(**kw):
+    base = dict(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                num_attention_heads=2, intermediate_size=128,
+                max_position_embeddings=128)
+    base.update(kw)
+    return BertConfig(**base)
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def bert_large(**kw):
+    base = dict(hidden_size=1024, num_hidden_layers=24,
+                num_attention_heads=16, intermediate_size=4096)
+    base.update(kw)
+    return BertConfig(**base)

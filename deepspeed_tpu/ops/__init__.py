@@ -2,3 +2,8 @@ from deepspeed_tpu.ops.adam import FusedAdam, DeepSpeedCPUAdam
 from deepspeed_tpu.ops.lamb import FusedLamb
 from deepspeed_tpu.ops.sgd import SGD
 from deepspeed_tpu.ops import sparse_attention  # noqa: F401
+from deepspeed_tpu.ops import transformer  # noqa: F401
+from deepspeed_tpu.ops.transformer import (
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+)

@@ -1,0 +1,13 @@
+"""Fused transformer layer (reference deepspeed/ops/transformer/__init__.py)."""
+
+from deepspeed_tpu.ops.transformer.transformer import (
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+    transformer_layer,
+)
+
+__all__ = [
+    "DeepSpeedTransformerConfig",
+    "DeepSpeedTransformerLayer",
+    "transformer_layer",
+]
