@@ -32,6 +32,7 @@ from deepspeed_tpu.runtime.pipe.module import PipelineModule, LayerSpec, TiedLay
 from deepspeed_tpu.utils import logging as _logging
 
 from deepspeed_tpu import elasticity  # noqa: F401
+from deepspeed_tpu import module_inject  # noqa: F401
 from deepspeed_tpu import ops  # noqa: F401
 from deepspeed_tpu import models  # noqa: F401
 from deepspeed_tpu.runtime import zero  # noqa: F401  (deepspeed.zero parity)

@@ -1,0 +1,27 @@
+"""Module injection (reference deepspeed/module_inject)."""
+
+from deepspeed_tpu.module_inject.replace_policy import (
+    DSPolicy,
+    HFBertLayerPolicy,
+    MegatronLayerPolicy,
+    DSTransformerLayerPolicy,
+)
+from deepspeed_tpu.module_inject.replace_module import (
+    replace_transformer_layer,
+    revert_layer_params,
+    inject_layer_params,
+    quantize_transformer_layer,
+    convert_hf_bert,
+)
+
+__all__ = [
+    "DSPolicy",
+    "HFBertLayerPolicy",
+    "MegatronLayerPolicy",
+    "DSTransformerLayerPolicy",
+    "replace_transformer_layer",
+    "revert_layer_params",
+    "inject_layer_params",
+    "quantize_transformer_layer",
+    "convert_hf_bert",
+]

@@ -1,0 +1,215 @@
+"""Module injection — reference module_inject/replace_module.py:8
+`replace_transformer_layer` and module_inject/inject.py.
+
+In torch the reference walks a live model and swaps nn.Module objects for
+fused-kernel layers, copying weights tensor-by-tensor. In flax the module
+tree is a pure definition and the state is a pytree, so injection is a pytree
+transformation: a policy reads each source layer subtree, emits the fused
+layer's params, and the caller runs the fused model definition
+(DeepSpeedTransformerLayer for training, DeepSpeedTransformerInference for
+serving) over the converted params.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.transformer import DeepSpeedTransformerConfig
+from deepspeed_tpu.ops.transformer.inference import DeepSpeedInferenceConfig
+from deepspeed_tpu.module_inject.replace_policy import (
+    DSPolicy, HFBertLayerPolicy, MegatronLayerPolicy)
+
+
+def inject_layer_params(policy: DSPolicy, layer_params) -> dict:
+    """One source layer subtree → fused-layer params (the weight-copy loop of
+    reference replace_module.py:24-79, as a pure function)."""
+    qkv_k, qkv_b, ow_k, ow_b = policy.attention(layer_params)
+    in_k, in_b, out_k, out_b = policy.mlp(layer_params)
+    attn_s, attn_b, ffn_s, ffn_b = policy.layernorm(layer_params)
+    return {
+        "attn_qkvw": {"kernel": qkv_k, "bias": qkv_b},
+        "attn_ow": {"kernel": ow_k, "bias": ow_b},
+        "inter_w": {"kernel": in_k, "bias": in_b},
+        "output_w": {"kernel": out_k, "bias": out_b},
+        "attn_nw": {"scale": attn_s, "bias": attn_b},
+        "norm_w": {"scale": ffn_s, "bias": ffn_b},
+    }
+
+
+def revert_layer_params(fused_params, policy: DSPolicy) -> dict:
+    """Inverse of inject_layer_params for HF BERT layout (reference
+    revert_transformer_layer, replace_module.py:81-120)."""
+    if not isinstance(policy, HFBertLayerPolicy):
+        raise NotImplementedError("revert supports the HF BERT layout")
+    qkv_k = fused_params["attn_qkvw"]["kernel"]
+    qkv_b = fused_params["attn_qkvw"]["bias"]
+    E = qkv_k.shape[0]
+    qk, kk, vk = jnp.split(qkv_k, 3, axis=1)
+    qb, kb, vb = jnp.split(qkv_b, 3)
+    return {
+        "attention": {
+            "self": {"query": {"kernel": qk, "bias": qb},
+                     "key": {"kernel": kk, "bias": kb},
+                     "value": {"kernel": vk, "bias": vb}},
+            "output": {"dense": {"kernel": fused_params["attn_ow"]["kernel"],
+                                 "bias": fused_params["attn_ow"]["bias"]},
+                       "LayerNorm": {"scale": fused_params["attn_nw"]["scale"],
+                                     "bias": fused_params["attn_nw"]["bias"]}},
+        },
+        "intermediate": {"dense": {"kernel": fused_params["inter_w"]["kernel"],
+                                   "bias": fused_params["inter_w"]["bias"]}},
+        "output": {"dense": {"kernel": fused_params["output_w"]["kernel"],
+                             "bias": fused_params["output_w"]["bias"]},
+                   "LayerNorm": {"scale": fused_params["norm_w"]["scale"],
+                                 "bias": fused_params["norm_w"]["bias"]}},
+    }
+
+
+def _quantize_dequantize(w, bits=8, groups=1):
+    """Symmetric group-wise fake quantization applied to injected weights
+    when quantize=True — the role of module_inject/module_quantize.py (the
+    reference quantizes weights through the quantizer kernel at injection;
+    storage-dtype int8 serving comes with the quantizer op)."""
+    orig_shape = w.shape
+    flat = w.reshape(groups, -1)
+    qmax = 2.0 ** (bits - 1) - 1
+    scale = jnp.max(jnp.abs(flat), axis=1, keepdims=True) / qmax
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(flat / scale), -qmax - 1, qmax)
+    return (q * scale).reshape(orig_shape).astype(w.dtype)
+
+
+def quantize_transformer_layer(fused_params, bits=8, groups=1):
+    """Quantize the four weight matrices of a fused layer subtree."""
+    out = jax.tree_util.tree_map(lambda x: x, fused_params)
+    for name in ("attn_qkvw", "attn_ow", "inter_w", "output_w"):
+        out[name] = dict(out[name])
+        out[name]["kernel"] = _quantize_dequantize(
+            out[name]["kernel"], bits=bits, groups=groups)
+    return out
+
+
+def _find_layer_container(params):
+    """Locate the HF-style encoder layer dict {'0': subtree, '1': ...}."""
+    if "encoder" in params and "layer" in params["encoder"]:
+        return params["encoder"]["layer"]
+    if "layer" in params:
+        return params["layer"]
+    raise ValueError("could not find encoder.layer container in params; "
+                     "pass layer_params explicitly")
+
+
+def replace_transformer_layer(policy_cls,
+                              model_params,
+                              config: Optional[Any] = None,
+                              fp16: bool = False,
+                              training: bool = True,
+                              quantize: bool = False,
+                              quantize_bits: int = 8,
+                              quantize_groups: int = 1,
+                              mp_size: int = 1,
+                              max_out_tokens: int = 1024,
+                              preln: Optional[bool] = None):
+    """Convert a client model's params for the fused layer — reference
+    replace_transformer_layer (module_inject/replace_module.py:8).
+
+    Arguments:
+        policy_cls: a DSPolicy subclass (or instance) describing the source
+            layer layout.
+        model_params: the client model's full param pytree (HF flax style,
+            with encoder.layer.<i> children) or a list of layer subtrees.
+        config: the client model config (HF BertConfig-like) used to build
+            the fused config; optional if you only need the params.
+        training/fp16/quantize/mp_size: reference knobs; training selects
+            DeepSpeedTransformerConfig vs DeepSpeedInferenceConfig.
+
+    Returns:
+        (fused_config, layer_params_list) — fused params for layer i under
+        the returned config's layer module.
+    """
+    policy = policy_cls() if isinstance(policy_cls, type) else policy_cls
+    if isinstance(model_params, (list, tuple)):
+        layers = list(model_params)
+    else:
+        container = _find_layer_container(model_params)
+        layers = [container[k] for k in
+                  sorted(container.keys(), key=lambda s: int(s))]
+
+    converted = [inject_layer_params(policy, l) for l in layers]
+    if quantize:
+        converted = [quantize_transformer_layer(c, quantize_bits,
+                                                quantize_groups)
+                     for c in converted]
+
+    pre_ln = policy.pre_attn_norm if preln is None else preln
+    hidden = int(converted[0]["attn_qkvw"]["kernel"].shape[0])
+    inter = int(converted[0]["inter_w"]["kernel"].shape[1])
+    heads = getattr(config, "num_attention_heads", None) or \
+        getattr(config, "heads", None) or max(1, hidden // 64)
+    eps = getattr(config, "layer_norm_eps", 1e-12)
+
+    if training:
+        fused_cfg = DeepSpeedTransformerConfig(
+            hidden_size=hidden, intermediate_size=inter, heads=heads,
+            num_hidden_layers=len(converted), layer_norm_eps=eps,
+            pre_layer_norm=pre_ln, fp16=fp16)
+    else:
+        fused_cfg = DeepSpeedInferenceConfig(
+            hidden_size=hidden, intermediate_size=inter, heads=heads,
+            layer_norm_eps=eps, pre_layer_norm=pre_ln, fp16=fp16,
+            mp_size=mp_size, triangular_masking=False,
+            max_out_tokens=max_out_tokens)
+    return fused_cfg, converted
+
+
+def convert_hf_bert(hf_params, hf_config, fp16: bool = False,
+                    scan_layers: bool = False):
+    """Whole-model conversion: HF flax BERT params → this repo's BertModel
+    (models/bert.py) definition + params. Returns (BertConfig, params).
+
+    This is the end-to-end injection path a reference user gets from
+    replace_transformer_layer(HFBertLayerPolicy, model, ...): afterwards the
+    model runs entirely on fused layers.
+    """
+    from deepspeed_tpu.models.bert import BertConfig
+
+    cfg = BertConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_hidden_layers=hf_config.num_hidden_layers,
+        num_attention_heads=hf_config.num_attention_heads,
+        intermediate_size=hf_config.intermediate_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        type_vocab_size=hf_config.type_vocab_size,
+        hidden_dropout_prob=getattr(hf_config, "hidden_dropout_prob", 0.0),
+        attention_probs_dropout_prob=getattr(
+            hf_config, "attention_probs_dropout_prob", 0.0),
+        layer_norm_eps=getattr(hf_config, "layer_norm_eps", 1e-12),
+        pre_layer_norm=False,
+        dtype=jnp.bfloat16 if fp16 else jnp.float32,
+        scan_layers=scan_layers,
+    )
+    _, layers = replace_transformer_layer(
+        HFBertLayerPolicy, hf_params, config=hf_config, fp16=fp16)
+
+    emb = hf_params["embeddings"]
+    params = {
+        "embeddings": {
+            "word_embeddings": emb["word_embeddings"]["embedding"],
+            "position_embeddings": emb["position_embeddings"]["embedding"],
+            "token_type_embeddings": emb["token_type_embeddings"]["embedding"],
+            "LayerNorm": {"scale": emb["LayerNorm"]["scale"],
+                          "bias": emb["LayerNorm"]["bias"]},
+        },
+        "encoder": {},
+        "pooler": {"kernel": hf_params["pooler"]["dense"]["kernel"],
+                   "bias": hf_params["pooler"]["dense"]["bias"]},
+    }
+    if scan_layers:
+        stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+        params["encoder"] = {"layer": {"DeepSpeedTransformerLayer_0": stacked}}
+    else:
+        for i, l in enumerate(layers):
+            params["encoder"][f"DeepSpeedTransformerLayer_{i}"] = l
+    return cfg, params

@@ -1,0 +1,100 @@
+"""Injection policies — reference module_inject/replace_policy.py.
+
+A policy knows how to read the parameters out of a client transformer layer
+(HF flax BERT, Megatron-style fused-QKV layers, or this repo's own fused
+layer) and hand them to replace_module in the canonical fused-layer order.
+The reference's policies return torch tensors off live nn.Modules
+(replace_policy.py:32 HFBertLayerPolicy, :103 MegatronLayerPolicy); here a
+policy maps a param *subtree* (flax pytrees are the module state) to the
+fused layer's param names.
+"""
+
+import jax.numpy as jnp
+
+
+class DSPolicy:
+    """Base policy: subclasses define how to extract (qkv, attn out, mlp,
+    layernorms) from one source layer's param subtree."""
+    # does the source architecture normalize before (True) or after (False)
+    # each sublayer
+    pre_attn_norm = False
+
+    def attention(self, layer):
+        """→ (qkv_kernel [E,3E], qkv_bias [3E], out_kernel [E,E], out_bias)"""
+        raise NotImplementedError
+
+    def mlp(self, layer):
+        """→ (inter_kernel, inter_bias, out_kernel, out_bias)"""
+        raise NotImplementedError
+
+    def layernorm(self, layer):
+        """→ (attn_ln_scale, attn_ln_bias, ffn_ln_scale, ffn_ln_bias)"""
+        raise NotImplementedError
+
+
+class HFBertLayerPolicy(DSPolicy):
+    """HF flax BERT layer subtree (encoder/layer/<i>): separate q/k/v denses,
+    post-LN (reference replace_policy.py:32-100)."""
+    pre_attn_norm = False
+
+    def attention(self, layer):
+        a = layer["attention"]["self"]
+        qkv_kernel = jnp.concatenate(
+            [a["query"]["kernel"], a["key"]["kernel"], a["value"]["kernel"]],
+            axis=1)
+        qkv_bias = jnp.concatenate(
+            [a["query"]["bias"], a["key"]["bias"], a["value"]["bias"]])
+        o = layer["attention"]["output"]["dense"]
+        return qkv_kernel, qkv_bias, o["kernel"], o["bias"]
+
+    def mlp(self, layer):
+        i = layer["intermediate"]["dense"]
+        o = layer["output"]["dense"]
+        return i["kernel"], i["bias"], o["kernel"], o["bias"]
+
+    def layernorm(self, layer):
+        attn_ln = layer["attention"]["output"]["LayerNorm"]
+        ffn_ln = layer["output"]["LayerNorm"]
+        return attn_ln["scale"], attn_ln["bias"], ffn_ln["scale"], \
+            ffn_ln["bias"]
+
+
+class MegatronLayerPolicy(DSPolicy):
+    """Megatron-style layer subtree: fused query_key_value dense, pre-LN
+    (reference replace_policy.py:103-144)."""
+    pre_attn_norm = True
+
+    def attention(self, layer):
+        qkv = layer["attention"]["query_key_value"]
+        o = layer["attention"]["dense"]
+        return qkv["kernel"], qkv["bias"], o["kernel"], o["bias"]
+
+    def mlp(self, layer):
+        i = layer["mlp"]["dense_h_to_4h"]
+        o = layer["mlp"]["dense_4h_to_h"]
+        return i["kernel"], i["bias"], o["kernel"], o["bias"]
+
+    def layernorm(self, layer):
+        attn_ln = layer["input_layernorm"]
+        ffn_ln = layer["post_attention_layernorm"]
+        return attn_ln["scale"], attn_ln["bias"], ffn_ln["scale"], \
+            ffn_ln["bias"]
+
+
+class DSTransformerLayerPolicy(DSPolicy):
+    """Identity policy over this repo's own fused layer params (useful for
+    training→inference injection and for revert)."""
+    def __init__(self, pre_layer_norm=True):
+        self.pre_attn_norm = pre_layer_norm
+
+    def attention(self, layer):
+        return layer["attn_qkvw"]["kernel"], layer["attn_qkvw"]["bias"], \
+            layer["attn_ow"]["kernel"], layer["attn_ow"]["bias"]
+
+    def mlp(self, layer):
+        return layer["inter_w"]["kernel"], layer["inter_w"]["bias"], \
+            layer["output_w"]["kernel"], layer["output_w"]["bias"]
+
+    def layernorm(self, layer):
+        return layer["attn_nw"]["scale"], layer["attn_nw"]["bias"], \
+            layer["norm_w"]["scale"], layer["norm_w"]["bias"]

@@ -1,0 +1,205 @@
+"""Fused inference transformer layer — TPU rebuild of the reference's
+inference kernels (csrc/transformer/inference/csrc/pt_binding.cpp, Python
+wrapper ops/transformer/inference/transformer_inference.py:102-473).
+
+TPU design:
+
+- One flax module serves both phases the CUDA path special-cases: full-context
+  ("prompt") processing and incremental single-token decode with a KV cache.
+  The cache is flax's standard ``cache`` variable collection — static shapes
+  ([B, max_out_tokens, H, D]) so the decode step compiles once and XLA keeps
+  it resident in HBM.
+- The CUDA custom GEMM + fused softmax (custom_gemm.cu, softmax.cu) become
+  MXU matmuls with XLA-fused masking; decode attention is one [B,H,1,L]
+  score row against the cache — bandwidth-bound, which HBM handles natively.
+- Tensor-parallel inference (module_inject's mp_size sharding,
+  replace_module.py:16-17) is PartitionSpecs over the mesh 'model' axis
+  (`inference_tp_specs`): qkv/intermediate column-parallel, output
+  projections row-parallel; XLA inserts the psum.
+- Parameter names match the training layer (attn_qkvw/attn_ow/inter_w/
+  output_w/attn_nw/norm_w) so one injection policy feeds both.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.mesh import MODEL_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class DeepSpeedInferenceConfig:
+    """Parity surface of transformer_inference.py's DeepSpeedInferenceConfig
+    (hidden_size/heads/fp16/pre_layer_norm/mp_size/triangular_masking...)."""
+    hidden_size: int = -1
+    intermediate_size: int = -1          # -1 → 4*hidden
+    heads: int = -1
+    layer_norm_eps: float = 1e-12
+    pre_layer_norm: bool = True
+    fp16: bool = False                   # → bf16 compute
+    mp_size: int = 1
+    triangular_masking: bool = True      # causal (decoder) vs encoder
+    max_out_tokens: int = 1024           # KV cache length
+    dtype: Any = None
+    param_dtype: Any = jnp.float32
+
+    @property
+    def compute_dtype(self):
+        if self.dtype is not None:
+            return self.dtype
+        return jnp.bfloat16 if self.fp16 else jnp.float32
+
+    @property
+    def ffn_size(self):
+        return self.intermediate_size if self.intermediate_size > 0 \
+            else 4 * self.hidden_size
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.heads
+
+
+class DeepSpeedTransformerInference(nn.Module):
+    """Inference encoder/decoder layer with optional KV cache.
+
+    Modes:
+      - encoder (``triangular_masking=False``): plain bidirectional layer.
+      - decoder prompt pass: ``mutable=["cache"]`` with S>1 fills the cache.
+      - decode step: S==1 with an initialized cache appends and attends to
+        the prefix.
+    """
+    config: DeepSpeedInferenceConfig
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask=None):
+        cfg = self.config
+        B, S, E = hidden_states.shape
+        dt = cfg.compute_dtype
+        H, D = cfg.heads, cfg.head_dim
+        x = hidden_states.astype(dt)
+
+        ln_kw = dict(epsilon=cfg.layer_norm_eps, dtype=dt,
+                     param_dtype=cfg.param_dtype)
+        dense_kw = dict(dtype=dt, param_dtype=cfg.param_dtype)
+
+        def attn(h):
+            qkv = nn.Dense(3 * E, **dense_kw, name="attn_qkvw")(h)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, S, H, D)
+            k = k.reshape(B, S, H, D)
+            v = v.reshape(B, S, H, D)
+            ctx = self._attend(q, k, v, attention_mask)
+            ctx = ctx.reshape(B, S, E)
+            return nn.Dense(E, **dense_kw, name="attn_ow")(ctx)
+
+        def ffn(h):
+            inter = nn.Dense(cfg.ffn_size, **dense_kw, name="inter_w")(h)
+            inter = nn.gelu(inter, approximate=False)
+            return nn.Dense(E, **dense_kw, name="output_w")(inter)
+
+        if cfg.pre_layer_norm:
+            x = x + attn(nn.LayerNorm(**ln_kw, name="attn_nw")(x))
+            x = x + ffn(nn.LayerNorm(**ln_kw, name="norm_w")(x))
+        else:
+            x = nn.LayerNorm(**ln_kw, name="attn_nw")(x + attn(x))
+            x = nn.LayerNorm(**ln_kw, name="norm_w")(x + ffn(x))
+        return x
+
+    def _attend(self, q, k, v, attention_mask):
+        """[B,S,H,D] q/k/v → [B,S,H,D] context; routes through the KV cache
+        when one exists (decoder use)."""
+        cfg = self.config
+        B, S, H, D = q.shape
+        scale = 1.0 / np.sqrt(D)
+
+        use_cache = cfg.triangular_masking and \
+            (self.has_variable("cache", "cached_key") or
+             self.is_mutable_collection("cache"))
+        if use_cache:
+            L = cfg.max_out_tokens
+            ck = self.variable("cache", "cached_key",
+                               jnp.zeros, (B, L, H, D), k.dtype)
+            cv = self.variable("cache", "cached_value",
+                               jnp.zeros, (B, L, H, D), v.dtype)
+            idx = self.variable("cache", "cache_index",
+                                lambda: jnp.zeros((), jnp.int32))
+            start = idx.value
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k, (0, start, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v, (0, start, 0, 0))
+            idx.value = start + S
+            k_all, v_all = ck.value, cv.value
+            # overflow guard: dynamic_update_slice clamps the write offset,
+            # which would silently return stale context past max_out_tokens.
+            # Shapes are static under jit so we can't raise — poison the
+            # output with NaN instead so overflow is loud and detectable.
+            overflow = (start + S) > L
+            q = jnp.where(overflow, jnp.float32(jnp.nan).astype(q.dtype), q)
+            # position j visible to query i (absolute i = start + i_local)
+            q_pos = start + jnp.arange(S)[:, None]
+            k_pos = jnp.arange(L)[None, :]
+            visible = k_pos <= q_pos                       # [S, L]
+            scores = jnp.einsum("bshd,blhd->bhsl", q, k_all).astype(
+                jnp.float32) * scale
+            scores = jnp.where(visible[None, None], scores,
+                               jnp.float32(-1e30))
+            if attention_mask is not None:
+                scores = scores + _as_bias(attention_mask, L)
+            probs = jax.nn.softmax(scores, axis=-1)
+            return jnp.einsum("bhsl,blhd->bshd", probs.astype(q.dtype), v_all)
+
+        # no cache: route through the shared attention dispatch so encoder
+        # inference gets the Pallas flash kernel on TPU when unmasked
+        from deepspeed_tpu.ops.attention import dot_product_attention
+        bias = _as_bias(attention_mask, S) if attention_mask is not None \
+            else None
+        ctx = dot_product_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), causal=cfg.triangular_masking,
+            bias=bias, scale=scale)
+        return ctx.transpose(0, 2, 1, 3)
+
+
+def _as_bias(attention_mask, L):
+    """[B,S_k] validity mask or [B,1,1,S_k]/[B,1,S_q,S_k] additive bias →
+    additive fp32 bias padded/cropped to key length L."""
+    m = jnp.asarray(attention_mask)
+    if m.ndim == 2:
+        m = (1.0 - (m > 0.5).astype(jnp.float32))[:, None, None, :] * -1e30
+    elif m.ndim == 3:
+        m = m[:, None].astype(jnp.float32)
+    else:
+        m = m.astype(jnp.float32)
+    k_len = m.shape[-1]
+    if k_len < L:
+        m = jnp.pad(m, [(0, 0)] * (m.ndim - 1) + [(0, L - k_len)])
+    elif k_len > L:
+        m = m[..., :L]
+    return m
+
+
+def inference_tp_specs(params):
+    """PartitionSpec tree for TP-sharded inference over the 'model' mesh axis
+    (the mp_size sharding module_inject applies, replace_module.py:16-17):
+    qkv + intermediate column-parallel, output projections row-parallel,
+    everything else replicated."""
+    def leaf_spec(path, leaf):
+        names = [str(getattr(k, "key", k)) for k in path]
+        shape = getattr(leaf, "shape", ())
+        col = any(n in ("attn_qkvw", "inter_w") for n in names)
+        row = any(n in ("attn_ow", "output_w") for n in names)
+        last = names[-1] if names else ""
+        if col and last == "kernel" and len(shape) == 2:
+            return P(None, MODEL_AXIS)
+        if col and last == "bias" and len(shape) == 1:
+            return P(MODEL_AXIS)
+        if row and last == "kernel" and len(shape) == 2:
+            return P(MODEL_AXIS, None)
+        return P()
+    return jax.tree_util.tree_map_with_path(leaf_spec, params)

@@ -24,7 +24,7 @@ TPU design (not a port):
 - `batch_size`/`max_seq_length` preallocation arguments are unnecessary
   (XLA specializes on shapes at trace time); accepted for API parity.
 - fp16 → bf16: the MXU-native dtype needs no loss scaling; `fp16=True`
-  selects bf16 compute unless `strict_fp16` is set.
+  selects bf16 compute (pass `dtype=jnp.float16` explicitly for true fp16).
 """
 
 import dataclasses
@@ -122,8 +122,7 @@ class DeepSpeedTransformerLayer(nn.Module):
     config: DeepSpeedTransformerConfig
 
     @nn.compact
-    def __call__(self, hidden_states, attention_mask=None, deterministic=True,
-                 grads=None):
+    def __call__(self, hidden_states, attention_mask=None, deterministic=True):
         cfg = self.config
         B, S, E = hidden_states.shape
         dt = cfg.compute_dtype
@@ -136,7 +135,7 @@ class DeepSpeedTransformerLayer(nn.Module):
         out_init = nn.initializers.normal(cfg.initializer_range * out_scale)
 
         x = hidden_states.astype(dt)
-        bias, segment_ids = _canonical_mask(attention_mask, B, S, dt)
+        bias, segment_ids = _canonical_mask(attention_mask)
 
         ln_kw = dict(epsilon=cfg.layer_norm_eps, dtype=dt,
                      param_dtype=cfg.param_dtype)
@@ -210,7 +209,7 @@ class DeepSpeedTransformerLayer(nn.Module):
         return x
 
 
-def _canonical_mask(attention_mask, B, S, dt):
+def _canonical_mask(attention_mask):
     """Normalize the two mask conventions the reference supports
     (huggingface additive bias vs raw kernel mask, transformer.py:133-136)
     into (bias, segment_ids) for dot_product_attention.
