@@ -158,6 +158,62 @@ class FlopsProfilerConfig:
                                    C.FLOPS_PROFILER_DETAILED_DEFAULT))
 
 
+class QuantizeTrainingConfig:
+    """MoQ section (reference runtime/config.py:184-215
+    get_quantize_training): progressive bit reduction + optional eigenvalue
+    modulation."""
+
+    def __init__(self, param_dict):
+        d = param_dict.get(C.QUANTIZE_TRAINING, {})
+        self.enabled = bool(d.get(C.QUANTIZE_TRAINING_ENABLED,
+                                  C.QUANTIZE_TRAINING_ENABLED_DEFAULT))
+        bits = d.get(C.QUANTIZE_BITS, {})
+        self.start_bits = int(bits.get(C.QUANTIZE_START_BITS,
+                                       C.QUANTIZE_START_BITS_DEFAULT))
+        self.target_bits = int(bits.get(C.QUANTIZE_TARGET_BITS,
+                                        C.QUANTIZE_TARGET_BITS_DEFAULT))
+        sched = d.get(C.QUANTIZE_SCHEDULE, {})
+        self.quantize_period = int(sched.get(C.QUANTIZE_PERIOD,
+                                             C.QUANTIZE_PERIOD_DEFAULT))
+        self.schedule_offset = int(sched.get(C.QUANTIZE_SCHEDULE_OFFSET,
+                                             C.QUANTIZE_OFFSET_DEFAULT))
+        self.groups = int(d.get(C.QUANTIZE_GROUPS, C.QUANTIZE_GROUPS_DEFAULT))
+        algo = d.get(C.QUANTIZE_ALGO, {})
+        self.q_type = 1 if algo.get(C.QUANTIZE_TYPE) == \
+            C.QUANTIZE_ASYMMETRIC else 0
+        self.q_rounding = 1 if algo.get(C.QUANTIZE_ROUNDING) == \
+            C.QUANTIZE_STOCHASTIC_ROUNDING else 0
+        mixed = d.get(C.FP16_MIXED_QUANTIZE, {})
+        self.fp16_mixed_quantize = bool(mixed.get(
+            C.FP16_MIXED_QUANTIZE_ENABLED,
+            C.FP16_MIXED_QUANTIZE_ENABLED_DEFAULT))
+        self.quantize_change_ratio = float(mixed.get(
+            C.QUANTIZE_CHANGE_RATIO, C.QUANTIZE_CHANGE_RATIO_DEFAULT))
+        self.verbose = bool(d.get(C.QUANTIZE_VERBOSE,
+                                  C.QUANTIZE_VERBOSE_DEFAULT))
+        self.quantizer_kernel = bool(d.get(C.QUANTIZER_KERNEL,
+                                           C.QUANTIZER_KERNEL_DEFAULT))
+        ev = d.get(C.QUANTIZE_EIGENVALUE, {})
+        self.eigenvalue_enabled = bool(ev.get(
+            C.QUANTIZE_EIGENVALUE_ENABLED,
+            C.QUANTIZE_EIGENVALUE_ENABLED_DEFAULT))
+        self.eigenvalue_verbose = bool(ev.get(C.EIGENVALUE_VERBOSE,
+                                              C.EIGENVALUE_VERBOSE_DEFAULT))
+        self.eigenvalue_max_iter = int(ev.get(C.EIGENVALUE_MAX_ITER,
+                                              C.EIGENVALUE_MAX_ITER_DEFAULT))
+        self.eigenvalue_tol = float(ev.get(C.EIGENVALUE_TOL,
+                                           C.EIGENVALUE_TOL_DEFAULT))
+        self.eigenvalue_stability = float(ev.get(
+            C.EIGENVALUE_STABILITY, C.EIGENVALUE_STABILITY_DEFAULT))
+        self.eigenvalue_gas_boundary_resolution = int(ev.get(
+            C.EIGENVALUE_GAS_BOUNDARY_RESOLUTION,
+            C.EIGENVALUE_GAS_BOUNDARY_RESOLUTION_DEFAULT))
+        self.eigenvalue_layer_name = str(ev.get(
+            C.EIGENVALUE_LAYER_NAME, C.EIGENVALUE_LAYER_NAME_DEFAULT))
+        self.eigenvalue_layer_num = int(ev.get(
+            C.EIGENVALUE_LAYER_NUM, C.EIGENVALUE_LAYER_NUM_DEFAULT))
+
+
 class PLDConfig:
     def __init__(self, param_dict):
         d = param_dict.get(C.PROGRESSIVE_LAYER_DROP, {})
@@ -355,6 +411,7 @@ class DeepSpeedConfig:
         self.activation_checkpointing_config = ActivationCheckpointingConfig(pd)
         self.flops_profiler_config = FlopsProfilerConfig(pd)
         self.pld_config = PLDConfig(pd)
+        self.quantize_training_config = QuantizeTrainingConfig(pd)
         self.aio_config = AioConfig(pd)
         self.tensorboard_config = TensorboardConfig(pd)
         self.sparse_attention_config = SparseAttentionConfig(pd)

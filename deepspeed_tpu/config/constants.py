@@ -289,6 +289,57 @@ FLOPS_PROFILER_DETAILED_DEFAULT = True
 #############################################
 # Progressive layer drop (reference constants.py)
 #############################################
+# MoQ quantize-aware training (reference runtime/constants.py
+# QUANTIZE_TRAINING section)
+QUANTIZE_TRAINING = "quantize_training"
+QUANTIZE_TRAINING_ENABLED = "enabled"
+QUANTIZE_TRAINING_ENABLED_DEFAULT = False
+QUANTIZE_BITS = "quantize_bits"
+QUANTIZE_START_BITS = "start_bits"
+QUANTIZE_START_BITS_DEFAULT = 16
+QUANTIZE_TARGET_BITS = "target_bits"
+QUANTIZE_TARGET_BITS_DEFAULT = 8
+QUANTIZE_SCHEDULE = "quantize_schedule"
+QUANTIZE_PERIOD = "quantize_period"
+QUANTIZE_PERIOD_DEFAULT = 1000
+QUANTIZE_SCHEDULE_OFFSET = "schedule_offset"
+QUANTIZE_OFFSET_DEFAULT = 1000
+QUANTIZE_GROUPS = "quantize_groups"
+QUANTIZE_GROUPS_DEFAULT = 1
+QUANTIZE_ALGO = "quantize_algo"
+QUANTIZE_TYPE = "q_type"
+QUANTIZE_SYMMETRIC = "symmetric"
+QUANTIZE_ASYMMETRIC = "asymmetric"
+QUANTIZE_ROUNDING = "rounding"
+QUANTIZE_NEAREST_ROUNDING = "nearest"
+QUANTIZE_STOCHASTIC_ROUNDING = "stochastic"
+FP16_MIXED_QUANTIZE = "fp16_mixed_quantize"
+FP16_MIXED_QUANTIZE_ENABLED = "enabled"
+FP16_MIXED_QUANTIZE_ENABLED_DEFAULT = False
+QUANTIZE_CHANGE_RATIO = "quantize_change_ratio"
+QUANTIZE_CHANGE_RATIO_DEFAULT = 0.001
+QUANTIZE_VERBOSE = "quantize_verbose"
+QUANTIZE_VERBOSE_DEFAULT = False
+QUANTIZER_KERNEL = "quantizer_kernel"
+QUANTIZER_KERNEL_DEFAULT = True
+QUANTIZE_EIGENVALUE = "eigenvalue"
+QUANTIZE_EIGENVALUE_ENABLED = "enabled"
+QUANTIZE_EIGENVALUE_ENABLED_DEFAULT = False
+EIGENVALUE_VERBOSE = "verbose"
+EIGENVALUE_VERBOSE_DEFAULT = False
+EIGENVALUE_MAX_ITER = "max_iter"
+EIGENVALUE_MAX_ITER_DEFAULT = 100
+EIGENVALUE_TOL = "tol"
+EIGENVALUE_TOL_DEFAULT = 1e-2
+EIGENVALUE_STABILITY = "stability"
+EIGENVALUE_STABILITY_DEFAULT = 1e-6
+EIGENVALUE_GAS_BOUNDARY_RESOLUTION = "gas_boundary_resolution"
+EIGENVALUE_GAS_BOUNDARY_RESOLUTION_DEFAULT = 1
+EIGENVALUE_LAYER_NAME = "layer_name"
+EIGENVALUE_LAYER_NAME_DEFAULT = "bert.encoder.layer"
+EIGENVALUE_LAYER_NUM = "layer_num"
+EIGENVALUE_LAYER_NUM_DEFAULT = 0
+
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
 PLD_ENABLED = "enabled"
 PLD_ENABLED_DEFAULT = False

@@ -66,27 +66,18 @@ def revert_layer_params(fused_params, policy: DSPolicy) -> dict:
     }
 
 
-def _quantize_dequantize(w, bits=8, groups=1):
-    """Symmetric group-wise fake quantization applied to injected weights
-    when quantize=True — the role of module_inject/module_quantize.py (the
-    reference quantizes weights through the quantizer kernel at injection;
-    storage-dtype int8 serving comes with the quantizer op)."""
-    orig_shape = w.shape
-    flat = w.reshape(groups, -1)
-    qmax = 2.0 ** (bits - 1) - 1
-    scale = jnp.max(jnp.abs(flat), axis=1, keepdims=True) / qmax
-    scale = jnp.where(scale == 0, 1.0, scale)
-    q = jnp.clip(jnp.round(flat / scale), -qmax - 1, qmax)
-    return (q * scale).reshape(orig_shape).astype(w.dtype)
-
-
 def quantize_transformer_layer(fused_params, bits=8, groups=1):
-    """Quantize the four weight matrices of a fused layer subtree."""
+    """Fake-quantize the four weight matrices of a fused layer subtree — the
+    role of module_inject/module_quantize.py (the reference quantizes
+    injected weights through the quantizer kernel; int8-storage serving uses
+    ops.quantizer.quantize_packed). Shares the grouped-quantization math
+    with MoQ/serving via ops.quantizer."""
+    from deepspeed_tpu.ops.quantizer import quantize_jnp
     out = jax.tree_util.tree_map(lambda x: x, fused_params)
     for name in ("attn_qkvw", "attn_ow", "inter_w", "output_w"):
         out[name] = dict(out[name])
-        out[name]["kernel"] = _quantize_dequantize(
-            out[name]["kernel"], bits=bits, groups=groups)
+        out[name]["kernel"] = quantize_jnp(
+            out[name]["kernel"], bits=bits, groups=groups, sym=True)
     return out
 
 

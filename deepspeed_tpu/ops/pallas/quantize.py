@@ -1,0 +1,167 @@
+"""Grouped quantization kernels — TPU replacement for the reference's CUDA
+quantizer (csrc/quantization/quantizer.cu: ds_quantize_fp16,
+ds_sr_quantize_fp16 and the asym variants, bound in quantizer.cpp:63-73).
+
+Design: per-group scale/offset from a row-max reduction, then an elementwise
+round (nearest or stochastic via the TPU per-core PRNG) — one Pallas program
+per group row, data staged through VMEM so the whole quantize-dequantize is
+one HBM round-trip. Non-TPU backends run the same kernel in interpreter mode
+(conftest CPU tests), and `quantize_jnp` is the pure-XLA reference the kernel
+is tested against.
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only resolves on TPU builds; interpret mode works without it
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+
+def _interpret_default():
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+def _qparams(flat, bits, sym):
+    """Per-group (scale, zero) in fp32. flat: [G, N]."""
+    qmax = 2.0 ** (bits - 1) - 1
+    if sym:
+        scale = jnp.max(jnp.abs(flat), axis=-1, keepdims=True) / qmax
+        scale = jnp.where(scale == 0, 1.0, scale)
+        zero = jnp.zeros_like(scale)
+    else:
+        lo = jnp.min(flat, axis=-1, keepdims=True)
+        hi = jnp.max(flat, axis=-1, keepdims=True)
+        scale = (hi - lo) / (2.0 ** bits - 1)
+        scale = jnp.where(scale == 0, 1.0, scale)
+        zero = lo
+    return scale, zero
+
+
+def quantize_jnp(x, bits=8, groups=1, sym=True, stochastic=False, key=None):
+    """Pure-XLA grouped fake quantization (quantize→dequantize), the numeric
+    ground truth for the Pallas kernel."""
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.reshape(groups, -1).astype(jnp.float32)
+    scale, zero = _qparams(flat, bits, sym)
+    if sym:
+        qmax = 2.0 ** (bits - 1) - 1
+        t = flat / scale
+        if stochastic:
+            u = jax.random.uniform(key, t.shape)
+            q = jnp.floor(t + u)
+        else:
+            q = jnp.round(t)
+        q = jnp.clip(q, -qmax - 1, qmax)
+        out = q * scale
+    else:
+        levels = 2.0 ** bits - 1
+        t = (flat - zero) / scale
+        if stochastic:
+            u = jax.random.uniform(key, t.shape)
+            q = jnp.floor(t + u)
+        else:
+            q = jnp.round(t)
+        q = jnp.clip(q, 0, levels)
+        out = q * scale + zero
+    return out.reshape(orig_shape).astype(orig_dtype)
+
+
+def _quant_kernel(seed_ref, x_ref, o_ref, *, bits, sym, stochastic):
+    if stochastic and pltpu is not None:
+        pltpu.prng_seed(seed_ref[0, 0] + pl.program_id(0))
+    x = x_ref[...].astype(jnp.float32)
+    qmax = 2.0 ** (bits - 1) - 1
+    if sym:
+        scale = jnp.max(jnp.abs(x)) / qmax
+        scale = jnp.where(scale == 0, 1.0, scale)
+        t = x / scale
+        if stochastic:
+            rbits = pltpu.prng_random_bits(t.shape).astype(jnp.uint32)
+            u = (rbits >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+            q = jnp.floor(t + u)
+        else:
+            q = jnp.round(t)
+        q = jnp.clip(q, -qmax - 1, qmax)
+        o_ref[...] = (q * scale).astype(o_ref.dtype)
+    else:
+        levels = 2.0 ** bits - 1
+        lo, hi = jnp.min(x), jnp.max(x)
+        scale = (hi - lo) / levels
+        scale = jnp.where(scale == 0, 1.0, scale)
+        t = (x - lo) / scale
+        if stochastic:
+            rbits = pltpu.prng_random_bits(t.shape).astype(jnp.uint32)
+            u = (rbits >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+            q = jnp.floor(t + u)
+        else:
+            q = jnp.round(t)
+        q = jnp.clip(q, 0, levels)
+        o_ref[...] = (q * scale + lo).astype(o_ref.dtype)
+
+
+def quantize(x, bits=8, groups=1, sym=True, stochastic=False, key=None,
+             interpret=None):
+    """Grouped fake quantization via the Pallas kernel (grid = one program
+    per group). Matches quantize_jnp bit-for-bit with nearest rounding."""
+    if interpret is None:
+        interpret = _interpret_default()
+    if stochastic and key is None:
+        key = jax.random.PRNGKey(0)   # ds_quantizer API parity: key optional
+    if stochastic and (pltpu is None or interpret):
+        # interpreter mode has no TPU PRNG — use the jnp path
+        return quantize_jnp(x, bits, groups, sym, stochastic=True, key=key)
+    orig_shape = x.shape
+    numel = int(np.prod(orig_shape))
+    if numel % groups != 0:
+        raise ValueError(f"numel {numel} not divisible by groups {groups}")
+    n = numel // groups
+    flat = x.reshape(groups, n)
+    if key is None:
+        seed = jnp.zeros((1, 1), jnp.int32)
+    else:
+        seed = jax.random.key_data(key).reshape(-1)[:1].astype(
+            jnp.int32).reshape(1, 1)
+    kernel = functools.partial(_quant_kernel, bits=bits, sym=sym,
+                               stochastic=stochastic)
+    out = pl.pallas_call(
+        kernel,
+        grid=(groups,),
+        in_specs=[pl.BlockSpec((1, 1), lambda g: (0, 0)),
+                  pl.BlockSpec((1, n), lambda g: (g, 0))],
+        out_specs=pl.BlockSpec((1, n), lambda g: (g, 0)),
+        out_shape=jax.ShapeDtypeStruct((groups, n), x.dtype),
+        interpret=interpret,
+    )(seed, flat)
+    return out.reshape(orig_shape)
+
+
+def quantize_packed(x, bits=8, groups=1, sym=True):
+    """Storage quantization: → (int8 codes, fp32 scales[, fp32 zeros]) for
+    int8 serving (the inference-kernel weight format)."""
+    assert bits <= 8
+    flat = x.reshape(groups, -1).astype(jnp.float32)
+    scale, zero = _qparams(flat, bits, sym)
+    if sym:
+        qmax = 2.0 ** (bits - 1) - 1
+        q = jnp.clip(jnp.round(flat / scale), -qmax - 1, qmax)
+        return q.astype(jnp.int8), scale, None
+    # asymmetric codes span [0, 2^bits-1] — unsigned storage
+    levels = 2.0 ** bits - 1
+    q = jnp.clip(jnp.round((flat - zero) / scale), 0, levels)
+    return q.astype(jnp.uint8), scale, zero
+
+
+def dequantize_packed(q, scale, zero, shape, dtype=jnp.float32):
+    flat = q.astype(jnp.float32) * scale
+    if zero is not None:
+        flat = flat + zero
+    return flat.reshape(shape).astype(dtype)

@@ -1,0 +1,20 @@
+"""Quantizer op (reference deepspeed/ops/quantizer/quantizer.py
+`ds_quantizer`)."""
+
+from deepspeed_tpu.ops.pallas.quantize import (
+    quantize,
+    quantize_jnp,
+    quantize_packed,
+    dequantize_packed,
+)
+
+
+def ds_quantizer(input, groups=1, bit_num=8, sr=False, asym=False, key=None):
+    """API-parity entry (ops/quantizer/quantizer.py:10-30): dispatches to the
+    grouped Pallas kernel; `sr` = stochastic rounding, `asym` = asymmetric."""
+    return quantize(input, bits=bit_num, groups=groups, sym=not asym,
+                    stochastic=sr, key=key)
+
+
+__all__ = ["ds_quantizer", "quantize", "quantize_jnp", "quantize_packed",
+           "dequantize_packed"]

@@ -1,0 +1,168 @@
+"""Hessian max-eigenvalue estimation by power iteration.
+
+Reference: runtime/eigenvalue.py:7 `Eigenvalue` — per-layer power iteration
+on the loss curvature, used to modulate MoQ quantization periods
+(engine.py:1250-1257: layers with small curvature quantize earlier).
+
+The torch version does a double-backward through retained graphs; in JAX a
+Hessian-vector product is just `jvp` of `grad` — no graph bookkeeping, and
+the whole iteration jits. Eigenvalues are computed per top-level param block
+(the "layer" granularity the reference gets from module traversal).
+"""
+
+from typing import Callable, Dict, List, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _normalize(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    norm = jnp.sqrt(sum(jnp.vdot(l, l).real for l in leaves))
+    norm = jnp.maximum(norm, 1e-12)
+    return jax.tree_util.tree_map(lambda l: l / norm, tree), norm
+
+
+class Eigenvalue:
+    def __init__(self,
+                 verbose=False,
+                 max_iter=100,
+                 tol=1e-2,
+                 stability=1e-6,
+                 gas_boundary_resolution=1,
+                 layer_name="",
+                 layer_num=0):
+        self.verbose = verbose
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.gas_boundary_resolution = gas_boundary_resolution
+        self.layer_name = layer_name
+        self.layer_num = layer_num
+
+    def hvp(self, loss_fn: Callable, params, vec):
+        """Hessian-vector product: d/dε grad(params + ε·vec) — jvp of grad."""
+        grad_fn = jax.grad(loss_fn)
+        _, hv = jax.jvp(grad_fn, (params,), (vec,))
+        return hv
+
+    def _power_iterate(self, hvp_fn, params, v):
+        """Shared power-iteration loop (reference eigenvalue.py:45-110:
+        random init, normalize, iterate until |Δλ|/λ < tol or max_iter).
+        `hvp_fn(params, v)` must already be jitted by the caller so the
+        compile happens once for all blocks and iterations."""
+        v, _ = _normalize(v)
+        eig = 0.0
+        for _ in range(self.max_iter):
+            hv = hvp_fn(params, v)
+            hv = jax.tree_util.tree_map(
+                lambda l: jnp.nan_to_num(l, nan=0.0, posinf=0.0, neginf=0.0),
+                hv)
+            v, norm = _normalize(hv)
+            new_eig = float(norm)
+            if eig > 0 and abs(new_eig - eig) / max(eig, 1e-12) < self.tol:
+                eig = new_eig
+                break
+            eig = new_eig
+        return eig + self.stability
+
+    def compute_eigenvalue(self, loss_fn: Callable, params,
+                           rng=None) -> float:
+        """Dominant Hessian eigenvalue of loss_fn at params."""
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        keys = jax.random.split(rng, len(leaves))
+        v = jax.tree_util.tree_unflatten(
+            treedef,
+            [jax.random.normal(k, l.shape, jnp.float32)
+             for k, l in zip(keys, leaves)])
+        hvp_fn = jax.jit(lambda p, vv: self.hvp(loss_fn, p, vv))
+        return self._power_iterate(hvp_fn, params, v)
+
+    def find_layer_blocks(self, params) -> List[Tuple[str, list]]:
+        """Locate per-transformer-layer param subtrees, numerically ordered —
+        the role the reference's `layer_name` module lookup plays
+        (eigenvalue.py:112-130). Walks the tree for the dict with the most
+        children whose names end in a layer index (encoder layers in this
+        repo's models: 'DeepSpeedTransformerLayer_3', HF: '3', GPT-2:
+        'h_3'). Returns [(name, key_path)] sorted by index."""
+        def layer_idx(name):
+            tail = name.rsplit("_", 1)[-1] if "_" in name else name
+            return int(tail) if tail.isdigit() else None
+
+        best: Tuple[list, Dict[int, str]] = ([], {})
+        stack = [(params, [])]
+        while stack:
+            node, path = stack.pop()
+            if not isinstance(node, dict):
+                continue
+            idxmap = {}
+            for k in node.keys():
+                i = layer_idx(str(k))
+                if i is not None:
+                    idxmap[i] = k
+            if len(idxmap) > len(best[1]):
+                best = (path, idxmap)
+            for k, v in node.items():
+                stack.append((v, path + [k]))
+        path, idxmap = best
+        return [(idxmap[i], path + [idxmap[i]]) for i in sorted(idxmap)]
+
+    def compute_layer_eigenvalues(self, loss_fn: Callable, params,
+                                  rng=None) -> List[float]:
+        """Per-transformer-layer eigenvalues, index-aligned with the MoQ
+        quantizer's per-layer schedules (Quantizer.eigenvalue_adjust).
+
+        One jitted HVP over the FULL params is compiled once and reused for
+        every block and iteration; restricting the probe vector's support to
+        one layer block power-iterates that block of the Hessian."""
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        blocks = self.find_layer_blocks(params)
+        hvp_fn = jax.jit(lambda p, vv: self.hvp(loss_fn, p, vv))
+
+        def get(tree, key_path):
+            for k in key_path:
+                tree = tree[k]
+            return tree
+
+        zeros = jax.tree_util.tree_map(
+            lambda l: jnp.zeros(l.shape, jnp.float32), params)
+
+        if not blocks:
+            return [self.compute_eigenvalue(loss_fn, params, rng)]
+
+        results = []
+        for i, (name, key_path) in enumerate(blocks):
+            sub = get(params, key_path)
+            krng = jax.random.fold_in(rng, i)
+            leaves, treedef = jax.tree_util.tree_flatten(sub)
+            keys = jax.random.split(krng, len(leaves))
+            v_blk = jax.tree_util.tree_unflatten(
+                treedef,
+                [jax.random.normal(k, l.shape, jnp.float32)
+                 for k, l in zip(keys, leaves)])
+
+            def embed(blk):
+                def swap(path, z):
+                    names = [str(getattr(k, "key", k)) for k in path]
+                    if names[:len(key_path)] == [str(k) for k in key_path]:
+                        b = blk
+                        for k in path[len(key_path):]:
+                            b = b[getattr(k, "key", k)]
+                        return b
+                    return z
+                return jax.tree_util.tree_map_with_path(swap, zeros)
+
+            restrict = lambda tree: get(tree, key_path)  # noqa: E731
+            hvp_blk = lambda p, vb: restrict(hvp_fn(p, embed(vb)))  # noqa
+            results.append(self._power_iterate(hvp_blk, params, v_blk))
+        return results
+
+    # reference API aliases ------------------------------------------------
+    def nan_to_num(self, x):
+        return jnp.nan_to_num(jnp.asarray(x), nan=0.0, posinf=0.0,
+                              neginf=0.0)
+
+    def normalize(self, v):
+        return _normalize(v)[0]

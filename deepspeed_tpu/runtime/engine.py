@@ -183,6 +183,39 @@ class DeepSpeedEngine:
                 theta=self._config.pld_config.theta,
                 gamma=self._config.pld_config.gamma)
 
+        # -- MoQ quantize-aware training + eigenvalue (reference
+        # engine.py:761-791 _configure_quantization)
+        self.quantizer = None
+        self.eigenvalue = None
+        qcfg = self._config.quantize_training_config
+        if qcfg.enabled:
+            from deepspeed_tpu.runtime.quantize import Quantizer
+            self.quantizer = Quantizer(
+                q_target_bits=qcfg.target_bits,
+                q_start_bits=qcfg.start_bits,
+                q_period=qcfg.quantize_period,
+                q_offset=qcfg.schedule_offset,
+                q_groups=qcfg.groups,
+                q_mixed_fp16=qcfg.fp16_mixed_quantize,
+                q_change_ratio=qcfg.quantize_change_ratio,
+                q_type=qcfg.q_type,
+                q_rounding=qcfg.q_rounding,
+                q_verbose=qcfg.verbose,
+                q_eigenvalue=qcfg.eigenvalue_enabled,
+                use_quantizer_kernel=qcfg.quantizer_kernel,
+                layer_num=qcfg.eigenvalue_layer_num)
+            if qcfg.eigenvalue_enabled:
+                from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+                self.eigenvalue = Eigenvalue(
+                    verbose=qcfg.eigenvalue_verbose,
+                    max_iter=qcfg.eigenvalue_max_iter,
+                    tol=qcfg.eigenvalue_tol,
+                    stability=qcfg.eigenvalue_stability,
+                    gas_boundary_resolution=(
+                        qcfg.eigenvalue_gas_boundary_resolution),
+                    layer_name=qcfg.eigenvalue_layer_name,
+                    layer_num=max(qcfg.eigenvalue_layer_num, 1))
+
         # -- dataloader (reference deepspeed_io engine.py:928)
         self.training_dataloader = None
         if training_data is not None:
@@ -641,6 +674,7 @@ class DeepSpeedEngine:
         self._record_metrics(metrics)
         if hasattr(self.lr_scheduler, "step"):
             self.lr_scheduler.step()
+        self._moq_boundary(batch, metrics)
         loss = metrics["loss"]
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(loss)
@@ -740,6 +774,7 @@ class DeepSpeedEngine:
             self.timers(FORWARD_MICRO_TIMER).stop()
         self._pending_loss = loss
         self._pending_micro = (loss, grads)
+        self._moq_batch = batch   # last micro batch, for eigenvalue at step()
         return loss
 
     __call__ = forward
@@ -789,8 +824,44 @@ class DeepSpeedEngine:
             self.lr_scheduler.step()
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).stop()
+        self._moq_boundary(getattr(self, "_moq_batch", None), metrics)
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(metrics["loss"])
+
+    def _moq_boundary(self, batch, metrics):
+        """MoQ hook at every optimizer-step boundary (reference
+        engine.py:1199-1206 quantizer call in _take_model_step +
+        eigenvalue computation at :1250-1257)."""
+        q = self.quantizer
+        if q is None:
+            return
+        if self.global_steps < self._config.quantize_training_config.\
+                schedule_offset:
+            return
+        eigenvalues = None
+        if self.eigenvalue is not None and batch is not None and \
+                q.any_precision_switch() and \
+                self.global_steps % self.eigenvalue.gas_boundary_resolution \
+                == 0:
+            loss_fn = self._resolve_loss_fn()
+
+            def params_loss(p):
+                return loss_fn(p, batch, jax.random.PRNGKey(0),
+                               jnp.float32(1.0))
+            try:
+                eigenvalues = self.eigenvalue.compute_layer_eigenvalues(
+                    params_loss, self.state.params, self._next_rng())
+            except Exception as e:  # curvature is advisory, never fatal
+                logger.warning(f"eigenvalue computation failed: {e}")
+        overflow = bool(jax.device_get(metrics.get("overflow", False)))
+        new_params = q.quantize_tree(self.state.params, overflow=overflow,
+                                     eigenvalues=eigenvalues,
+                                     key=self._next_rng())
+        self.state = TrainState(params=new_params,
+                                opt_state=self.state.opt_state,
+                                scaler=self.state.scaler,
+                                global_step=self.state.global_step,
+                                skipped_steps=self.state.skipped_steps)
 
     def eval_batch(self, batch):
         batch = jax.tree_util.tree_map(jnp.asarray, batch)

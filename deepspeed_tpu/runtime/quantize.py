@@ -1,0 +1,154 @@
+"""MoQ — quantize-aware training with progressive bit reduction.
+
+Reference: runtime/quantize.py:12 `Quantizer` — every `q_period` optimizer
+steps the precision of eligible (2-D) weights is reduced toward
+`q_target_bits`, the period doubling after each reduction; optionally blended
+with the fp32 weights (`fp16_mixed_quantize`) and with per-layer periods
+modulated by Hessian eigenvalues (runtime/eigenvalue.py, engine hooks
+engine.py:761-791,1199-1206,1250-1257).
+
+TPU shape: quantization itself is the grouped Pallas kernel
+(ops/pallas/quantize.py); the schedule runs at the host level between jitted
+train steps — one jitted quantize-tree apply per boundary, so the hot step
+function stays unchanged.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quantizer import quantize
+from deepspeed_tpu.utils.logging import logger
+
+# number of 2-D parameters per transformer layer (reference quantize.py:9)
+TWO_D_PARAMS = 6
+
+
+class Quantizer:
+    def __init__(self,
+                 q_target_bits=8,
+                 q_start_bits=16,
+                 q_period=100,
+                 q_offset=100,
+                 q_groups=1,
+                 q_mixed_fp16=False,
+                 q_change_ratio=0.01,
+                 q_type=0,                 # 0 symmetric / 1 asymmetric
+                 q_rounding=0,             # 0 nearest / 1 stochastic
+                 q_verbose=False,
+                 q_eigenvalue=False,
+                 use_quantizer_kernel=True,
+                 layer_num=0):
+        self.q_target_bits = q_target_bits
+        self.layer_num = layer_num
+        n = layer_num if layer_num != 0 else 1
+        self.q_start_bits = [q_start_bits] * n
+        self.q_period = [q_period] * n
+        self.q_offset = q_offset
+        self.q_groups = q_groups
+        self.q_mixed_fp16 = q_mixed_fp16
+        self.q_change_ratio = q_change_ratio
+        self.q_type = q_type
+        self.q_rounding = q_rounding
+        self.q_verbose = q_verbose
+        self.q_eigenvalue = q_eigenvalue
+        self.use_quantizer_kernel = use_quantizer_kernel
+        self.qsteps = 0
+        self.quantize_real_ratio = 1.0
+
+    # -- schedule ---------------------------------------------------------
+
+    def any_precision_switch(self):
+        """Will the next update change any layer's precision?
+        (reference quantize.py:46-56)"""
+        return any(b != self.q_target_bits for b in self.q_start_bits)
+
+    def _maybe_reduce_bits(self, index):
+        """Advance layer `index`'s schedule; returns True if bits changed."""
+        if self.q_start_bits[index] <= self.q_target_bits:
+            return False
+        if self.qsteps >= self.q_period[index]:
+            self.q_start_bits[index] -= 1
+            # period doubles after each reduction (reference quantize.py:118)
+            self.q_period[index] = int(self.q_period[index] * 2)
+            if self.q_verbose:
+                logger.info(
+                    f"MoQ: layer {index} → {self.q_start_bits[index]} bits "
+                    f"at step {self.qsteps}, next period "
+                    f"{self.q_period[index]}")
+            return True
+        return False
+
+    def update_fp16_ratio(self):
+        """Decay the fp32-blend toward pure quantized weights
+        (reference quantize.py:236-241)."""
+        if self.q_mixed_fp16 and self.quantize_real_ratio > 0:
+            self.quantize_real_ratio = max(
+                0.0, self.quantize_real_ratio - self.q_change_ratio)
+
+    def eigenvalue_adjust(self, eigenvalues):
+        """Scale per-layer periods by normalized eigenvalues: flatter layers
+        (small curvature) quantize sooner (reference quantize.py engine hook
+        engine.py:1250-1257)."""
+        if not eigenvalues:
+            return
+        ev = [max(float(e), 1e-12) for e in eigenvalues]
+        mean = sum(ev) / len(ev)
+        for i in range(min(self.layer_num or 1, len(ev))):
+            factor = ev[i] / mean
+            self.q_period[i] = max(1, int(self.q_period[i] * factor))
+
+    # -- application ------------------------------------------------------
+
+    def _layer_index(self, path_names):
+        """Map a param path to a layer index for per-layer schedules."""
+        if self.layer_num == 0:
+            return 0
+        for name in path_names:
+            for tok in name.replace("_", ".").split("."):
+                if tok.isdigit():
+                    return min(int(tok), self.layer_num - 1)
+        return 0
+
+    def quantize_tree(self, params, overflow=False, eigenvalues=None,
+                      key: Optional[jax.Array] = None):
+        """One MoQ boundary: advance the schedule and return the params tree
+        with every 2-D weight fake-quantized at its layer's current bits.
+        Mirrors reference quantize.py:58-135 `quantize`."""
+        if overflow and not self.q_mixed_fp16:
+            # overflow steps consume no schedule budget (reference
+            # quantize.py:64-66 returns before stepping the counter)
+            return params
+        self.qsteps += TWO_D_PARAMS * (self.layer_num if self.layer_num else 1)
+        if self.q_eigenvalue and eigenvalues:
+            self.eigenvalue_adjust(eigenvalues)
+        for i in range(len(self.q_start_bits)):
+            self._maybe_reduce_bits(i)
+        self.update_fp16_ratio()
+
+        stochastic = self.q_rounding == 1
+        sym = self.q_type == 0
+        keys = {}
+
+        def quant_leaf(path, leaf):
+            arr = jnp.asarray(leaf)
+            if arr.ndim != 2 or not jnp.issubdtype(arr.dtype, jnp.floating):
+                return leaf
+            idx = self._layer_index(
+                [str(getattr(k, "key", k)) for k in path])
+            bits = self.q_start_bits[idx]
+            if bits >= 16:
+                return leaf
+            groups = self.q_groups if arr.size % self.q_groups == 0 else 1
+            sub = jax.random.fold_in(key, len(keys)) if key is not None \
+                else None
+            keys[len(keys)] = True
+            q = quantize(arr, bits=bits, groups=groups, sym=sym,
+                         stochastic=stochastic, key=sub)
+            if self.q_mixed_fp16 and self.quantize_real_ratio > 0:
+                r = self.quantize_real_ratio
+                q = r * arr + (1.0 - r) * q
+            return q.astype(arr.dtype)
+
+        return jax.tree_util.tree_map_with_path(quant_leaf, params)

@@ -1,0 +1,225 @@
+"""Quantizer kernel + MoQ + eigenvalue tests (reference: test_moq_*,
+csrc/quantization kernel tests, runtime/quantize.py semantics)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.ops.quantizer import (
+    ds_quantizer, quantize, quantize_jnp, quantize_packed, dequantize_packed)
+from deepspeed_tpu.runtime.quantize import Quantizer
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+
+# -- kernel ----------------------------------------------------------------
+
+@pytest.mark.parametrize("bits", [4, 8])
+@pytest.mark.parametrize("groups", [1, 4])
+@pytest.mark.parametrize("sym", [True, False])
+def test_pallas_kernel_matches_jnp(bits, groups, sym):
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 64), jnp.float32)
+    a = quantize(x, bits=bits, groups=groups, sym=sym)
+    b = quantize_jnp(x, bits=bits, groups=groups, sym=sym)
+    # reduction ordering of the scale max differs → 1-ULP wiggle allowed
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("sym", [True, False])
+def test_quantization_error_shrinks_with_bits(sym):
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, 32), jnp.float32)
+    errs = []
+    for bits in (2, 4, 8):
+        q = quantize_jnp(x, bits=bits, groups=4, sym=sym)
+        errs.append(float(jnp.abs(q - x).max()))
+    assert errs[0] > errs[1] > errs[2]
+    assert errs[2] < 0.05
+
+
+def test_quantize_idempotent():
+    """Quantizing an already-quantized tensor is a fixed point (nearest)."""
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, 8), jnp.float32)
+    q1 = quantize_jnp(x, bits=8, groups=2)
+    q2 = quantize_jnp(q1, bits=8, groups=2)
+    np.testing.assert_allclose(np.asarray(q1), np.asarray(q2), atol=1e-7)
+
+
+def test_stochastic_rounding_unbiased():
+    """E[sr_quantize(x)] ≈ x — the property the reference's SR kernels exist
+    for (csrc/quantization ds_sr_quantize)."""
+    # anchor 1.0 fixes the 2-bit scale at 1.0 (levels -2,-1,0,1); then the
+    # 0.3 entries stochastically round to 0 or 1 with E[q]=0.3
+    x = np.full((4, 128), 0.3, np.float32)
+    x[:, 0] = 1.0
+    x = jnp.asarray(x)
+    acc = np.zeros((4, 128), np.float64)
+    n = 200
+    for i in range(n):
+        q = quantize(x, bits=2, groups=4, stochastic=True,
+                     key=jax.random.PRNGKey(i))
+        acc += np.asarray(q, np.float64)
+    mean = acc[:, 1:] / n
+    assert abs(mean.mean() - 0.3) < 0.02
+    # nearest rounding deterministically gives 0 for those entries
+    nearest = float(quantize_jnp(x, bits=2, groups=4)[0, 1])
+    assert nearest == 0.0
+
+
+def test_packed_roundtrip():
+    x = jax.random.normal(jax.random.PRNGKey(3), (16, 16), jnp.float32)
+    for sym in (True, False):
+        qdata, scale, zero = quantize_packed(x, bits=8, groups=4, sym=sym)
+        assert qdata.dtype == (jnp.int8 if sym else jnp.uint8)
+        back = dequantize_packed(qdata, scale, zero, x.shape)
+        assert float(jnp.abs(back - x).max()) < 0.05
+
+
+def test_ds_quantizer_api():
+    x = jax.random.normal(jax.random.PRNGKey(4), (8, 32), jnp.float32)
+    q = ds_quantizer(x, groups=2, bit_num=8)
+    assert q.shape == x.shape and q.dtype == x.dtype
+
+
+# -- MoQ schedule ----------------------------------------------------------
+
+def test_moq_progressive_bit_reduction():
+    q = Quantizer(q_start_bits=6, q_target_bits=4, q_period=10, q_groups=2,
+                  layer_num=0)
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (16, 16))}
+    bits_seen = []
+    for step in range(12):
+        params = q.quantize_tree(params)
+        bits_seen.append(q.q_start_bits[0])
+    assert bits_seen[0] == 6
+    assert bits_seen[-1] == 4                      # reached target
+    assert sorted(set(bits_seen), reverse=True) == [6, 5, 4]
+    # period doubled twice
+    assert q.q_period[0] == 40
+    assert not q.any_precision_switch()
+
+
+def test_moq_quantizes_only_2d_floats():
+    q = Quantizer(q_start_bits=4, q_target_bits=4, q_period=1)
+    w = jax.random.normal(jax.random.PRNGKey(0), (4, 4), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(1), (4,), jnp.float32)
+    params = {"w": w, "b": b, "step": jnp.zeros((), jnp.int32)}
+    out = q.quantize_tree(params)
+    assert not np.allclose(np.asarray(out["w"]), np.asarray(w))  # quantized
+    assert len(np.unique(np.asarray(out["w"]))) <= 16
+    np.testing.assert_array_equal(np.asarray(out["b"]),
+                                  np.asarray(b))                 # untouched
+    assert out["step"].dtype == jnp.int32
+
+
+def test_moq_overflow_skips():
+    q = Quantizer(q_start_bits=4, q_target_bits=4, q_period=1)
+    w = jax.random.normal(jax.random.PRNGKey(0), (4, 4), jnp.float32)
+    out = q.quantize_tree({"w": w}, overflow=True)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(w))
+
+
+def test_moq_mixed_fp16_blend():
+    q = Quantizer(q_start_bits=2, q_target_bits=2, q_period=1000,
+                  q_mixed_fp16=True, q_change_ratio=0.5)
+    params = {"w": jnp.ones((4, 4)) * 0.3}
+    full_q = float(quantize_jnp(params["w"], bits=2, groups=1)[0, 0])
+    out1 = float(q.quantize_tree(params)["w"][0, 0])       # ratio 0.5 blend
+    out2 = float(q.quantize_tree(params)["w"][0, 0])       # ratio 0.0 → full
+    assert abs(out1 - (0.5 * 0.3 + 0.5 * full_q)) < 1e-6
+    assert abs(out2 - full_q) < 1e-6
+
+
+def test_moq_eigenvalue_adjusts_period():
+    q = Quantizer(q_start_bits=8, q_target_bits=4, q_period=100,
+                  q_eigenvalue=True, layer_num=2)
+    q.eigenvalue_adjust([2.0, 0.5])   # layer0 sharp, layer1 flat
+    assert q.q_period[0] > q.q_period[1]
+
+
+# -- eigenvalue ------------------------------------------------------------
+
+def test_power_iteration_quadratic():
+    """For loss = 0.5 xᵀ A x the Hessian is A; power iteration must find
+    max |eig|."""
+    A = np.diag([5.0, 2.0, 1.0]).astype(np.float32)
+
+    def loss(x):
+        return 0.5 * x @ jnp.asarray(A) @ x
+
+    ev = Eigenvalue(max_iter=200, tol=1e-5, stability=0.0,
+                    layer_name="x", layer_num=1)
+    x0 = jnp.ones((3,), jnp.float32)
+    lam = ev.compute_eigenvalue(loss, x0)
+    assert abs(lam - 5.0) < 1e-2
+
+
+def test_layerwise_eigenvalues():
+    """Per-layer curvature must align with layer indices (layer_1's block
+    has the sharper Hessian here) even with interleaved non-layer blocks."""
+    def loss(params):
+        enc = params["encoder"]
+        return 0.5 * (1.0 * jnp.sum(enc["layer_0"]["w"] ** 2)
+                      + 3.0 * jnp.sum(enc["layer_1"]["w"] ** 2)
+                      + 7.0 * jnp.sum(params["embeddings"]["e"] ** 2))
+
+    ev = Eigenvalue(max_iter=100, tol=1e-5, stability=0.0,
+                    layer_name="encoder.layer", layer_num=2)
+    params = {"embeddings": {"e": jnp.ones((4,))},
+              "encoder": {"layer_0": {"w": jnp.ones((4,))},
+                          "layer_1": {"w": jnp.ones((4,))}}}
+    blocks = ev.find_layer_blocks(params)
+    assert [b[0] for b in blocks] == ["layer_0", "layer_1"]
+    lams = ev.compute_layer_eigenvalues(loss, params)
+    # layer blocks only — embeddings' 7.0 curvature must NOT leak in
+    assert abs(lams[0] - 1.0) < 1e-2 and abs(lams[1] - 3.0) < 1e-2
+
+
+def test_find_layer_blocks_on_bert():
+    from deepspeed_tpu.models.bert import bert_tiny, BertModel
+    cfg = bert_tiny(dtype=jnp.float32, num_hidden_layers=3)
+    model = BertModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    ev = Eigenvalue(layer_name="encoder.layer", layer_num=3)
+    blocks = ev.find_layer_blocks(params)
+    assert len(blocks) == 3
+    assert all("TransformerLayer" in b[0] for b in blocks)
+
+
+def test_moq_overflow_consumes_no_budget():
+    """Overflow steps must not advance the MoQ schedule (regression)."""
+    q = Quantizer(q_start_bits=8, q_target_bits=4, q_period=10)
+    w = jax.random.normal(jax.random.PRNGKey(0), (4, 4), jnp.float32)
+    for _ in range(5):
+        q.quantize_tree({"w": w}, overflow=True)
+    assert q.qsteps == 0 and q.q_start_bits[0] == 8
+
+
+# -- engine integration ----------------------------------------------------
+
+def test_moq_through_engine():
+    """quantize_training config quantizes weights after schedule_offset."""
+    import deepspeed_tpu as dstpu
+    from tests.simple_model import SimpleModel, random_batch, base_config
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+
+    cfg = base_config()
+    cfg["quantize_training"] = {
+        "enabled": True,
+        "quantize_bits": {"start_bits": 5, "target_bits": 4},
+        "quantize_schedule": {"quantize_period": 1, "schedule_offset": 2},
+        "quantize_groups": 1,
+    }
+    mesh = make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                       mesh=mesh)
+    batch = random_batch(batch_size=8)
+    for _ in range(4):
+        engine.train_batch(batch)
+    assert engine.quantizer is not None
+    assert engine.quantizer.qsteps > 0
+    # weights now land on a small quantized grid: few distinct values
+    w = np.asarray(jax.device_get(
+        jax.tree_util.tree_leaves(engine.state.params)[0]), np.float32)
+    if w.ndim == 2:
+        assert len(np.unique(np.round(w, 6))) <= 2 ** 6
