@@ -84,6 +84,15 @@ def save_checkpoint(save_dir, tag, state, extra, save_latest=True, zero_stage=0)
         if save_latest:
             with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
                 f.write(str(tag))
+        # ship the recovery script with every checkpoint (reference
+        # engine.py:1873-1881 copies utils/zero_to_fp32.py alongside)
+        try:
+            import shutil
+            from deepspeed_tpu.utils import zero_to_fp32 as _z2f
+            shutil.copyfile(_z2f.__file__,
+                            os.path.join(save_dir, "zero_to_fp32.py"))
+        except Exception:
+            pass
 
 
 def read_latest_tag(load_dir):

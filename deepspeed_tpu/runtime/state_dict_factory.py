@@ -1,0 +1,243 @@
+"""State-dict factory — reference runtime/state_dict_factory.py:14
+(`SDLoaderFactory`, `MegatronSDLoader`, `WeightQuantization`): loading
+checkpoints across a CHANGED tensor-parallel degree by merging or splitting
+per-mp-rank shard files, with optional weight quantization on load.
+
+TPU context: this repo's own checkpoints store the full logical tree
+(runtime/checkpointing.py) because GSPMD re-shards on restore — TP resize is
+free. The factory exists for Megatron-STYLE checkpoints: one file per
+mp_rank, each holding that rank's slice of every TP-sharded weight (the
+format produced by torch Megatron exports and by `save_tp_sharded` below).
+Merge/split axes come from a PartitionSpec tree (models/sharding.py /
+ops/transformer/inference.py conventions) or name heuristics, with the
+fused-QKV block layout handled specially like the reference's
+merge_query_key_value/split_query_key_value (state_dict_factory.py:331-420).
+"""
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.parallel.mesh import MODEL_AXIS
+from deepspeed_tpu.runtime.checkpointing import load_tree, save_tree
+from deepspeed_tpu.utils.logging import logger
+
+AUTO_TP_SIZE = 0
+
+
+def _leaf_tp_axis(path_names, shape):
+    """Which dim of this leaf is TP-sharded, or None. Mirrors the spec rules
+    of models/sharding.py / inference_tp_specs: column-parallel producers
+    shard the last dim, row-parallel consumers shard the first, embeddings
+    shard the vocab dim."""
+    names = [n.lower() for n in path_names]
+    last = names[-1] if names else ""
+    joined = "/".join(names)
+    if last in ("bias", "scale") and len(shape) == 1:
+        col = any(t in joined for t in
+                  ("attn_qkvw", "c_attn", "query_key_value", "inter_w",
+                   "c_fc", "dense_h_to_4h"))
+        return 0 if col else None
+    if len(shape) < 2:
+        return None
+    if any(t in joined for t in ("attn_qkvw", "c_attn", "query_key_value",
+                                 "inter_w", "c_fc", "dense_h_to_4h")):
+        return len(shape) - 1               # column parallel
+    if any(t in joined for t in ("attn_ow", "c_proj", "output_w",
+                                 "dense_4h_to_h")):
+        return len(shape) - 2               # row parallel
+    if any(t in joined for t in ("wte", "word_embeddings", "lm_head")):
+        return 0                            # vocab parallel
+    return None
+
+
+def _is_qkv(path_names):
+    joined = "/".join(n.lower() for n in path_names)
+    return any(t in joined for t in ("attn_qkvw", "c_attn",
+                                     "query_key_value"))
+
+
+def _spec_tp_axis(spec):
+    if spec is None:
+        return None
+    for i, ax in enumerate(spec):
+        axes = ax if isinstance(ax, (tuple, list)) else (ax,)
+        if MODEL_AXIS in axes:
+            return i
+    return None
+
+
+def _merge_qkv(shards, axis):
+    """Fused-QKV merge: each shard's qkv dim is [q_i; k_i; v_i] — concat
+    per-component then re-fuse (reference merge_query_key_value)."""
+    parts = [np.split(s, 3, axis=axis) for s in shards]
+    return np.concatenate(
+        [np.concatenate([p[c] for p in parts], axis=axis)
+         for c in range(3)], axis=axis)
+
+
+def _split_qkv(full, ratio, rank_in_group, axis):
+    q, k, v = np.split(full, 3, axis=axis)
+    picks = [np.array_split(c, ratio, axis=axis)[rank_in_group]
+             for c in (q, k, v)]
+    return np.concatenate(picks, axis=axis)
+
+
+class WeightQuantization:
+    """Quantize weights at load time (reference state_dict_factory.py:32 /
+    module WeightQuantization): group-wise symmetric fake quant of 2-D
+    weights; `quantize_packed` via ops.quantizer for int8 storage."""
+
+    def __init__(self, bits=8, groups=64, mlp_extra_grouping=False):
+        self.bits = bits
+        self.groups = groups
+        self.mlp_extra_grouping = mlp_extra_grouping
+
+    def _groups_for(self, path_names):
+        joined = "/".join(n.lower() for n in path_names)
+        if self.mlp_extra_grouping and any(
+                t in joined for t in ("inter_w", "output_w", "c_fc",
+                                      "c_proj", "dense_h_to_4h",
+                                      "dense_4h_to_h")):
+            return self.groups * 2     # reference doubles MLP groups
+        return self.groups
+
+    def quantize_tree(self, params):
+        from deepspeed_tpu.ops.quantizer import quantize_jnp
+
+        def leaf(path, x):
+            arr = np.asarray(x)
+            if arr.ndim != 2 or not np.issubdtype(arr.dtype, np.floating):
+                return x
+            g = self._groups_for([str(getattr(k, "key", k)) for k in path])
+            if arr.size % g != 0:
+                g = 1
+            return np.asarray(quantize_jnp(jnp.asarray(arr), bits=self.bits,
+                                           groups=g, sym=True))
+        return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+class SDLoaderBase:
+    def __init__(self, ckpt_list: Sequence[str], specs=None):
+        self.ckpt_list = list(ckpt_list)
+        self.specs = specs
+
+    def _tp_axis(self, path_names, leaf_shape, spec):
+        ax = _spec_tp_axis(spec)
+        if ax is not None:
+            return ax
+        return _leaf_tp_axis(path_names, leaf_shape)
+
+    def load(self, mp_world_size: int, mp_rank: int,
+             quantize: bool = False, quantize_bits: int = 8,
+             quantize_groups: int = 64, mlp_extra_grouping: bool = False):
+        """Return this mp_rank's param tree at the NEW mp_world_size
+        (reference SDLoaderBase.load, state_dict_factory.py:73-130:
+        same-degree passthrough, merge when shrinking, split when growing)."""
+        src = len(self.ckpt_list)
+        if mp_world_size == src:
+            params = self._load_shard(self.ckpt_list[mp_rank])
+        elif mp_world_size < src:
+            assert src % mp_world_size == 0, (src, mp_world_size)
+            ratio = src // mp_world_size
+            group = self.ckpt_list[mp_rank * ratio:(mp_rank + 1) * ratio]
+            params = self._merge_shards([self._load_shard(p) for p in group])
+        else:
+            assert mp_world_size % src == 0, (src, mp_world_size)
+            ratio = mp_world_size // src
+            params = self._split_shard(
+                self._load_shard(self.ckpt_list[mp_rank // ratio]),
+                ratio, mp_rank % ratio)
+        if quantize:
+            wq = WeightQuantization(quantize_bits, quantize_groups,
+                                    mlp_extra_grouping)
+            params = wq.quantize_tree(params)
+        return params
+
+    def _load_shard(self, path):
+        tree = load_tree(path)
+        return tree.get("params", tree)
+
+    def _map2(self, fn, trees):
+        """tree_map_with_path over parallel trees."""
+        spec_tree = self.specs
+
+        def walk(path, *leaves):
+            names = [str(getattr(k, "key", k)) for k in path]
+            spec = None
+            if spec_tree is not None:
+                node = spec_tree
+                try:
+                    for n in names:
+                        node = node[n]
+                    spec = node
+                except (KeyError, TypeError):
+                    spec = None
+            return fn(names, spec, *leaves)
+        return jax.tree_util.tree_map_with_path(walk, *trees)
+
+    def _merge_shards(self, shards):
+        def merge(names, spec, *leaves):
+            arrs = [np.asarray(l) for l in leaves]
+            ax = self._tp_axis(names, arrs[0].shape, spec)
+            if ax is None:
+                return arrs[0]
+            if _is_qkv(names):
+                return _merge_qkv(arrs, ax)
+            return np.concatenate(arrs, axis=ax)
+        return self._map2(merge, shards)
+
+    def _split_shard(self, full, ratio, rank_in_group):
+        def split(names, spec, leaf):
+            arr = np.asarray(leaf)
+            ax = self._tp_axis(names, arr.shape, spec)
+            if ax is None:
+                return arr
+            if _is_qkv(names):
+                return _split_qkv(arr, ratio, rank_in_group, ax)
+            return np.array_split(arr, ratio, axis=ax)[rank_in_group]
+        return self._map2(split, [full])
+
+
+class MegatronSDLoader(SDLoaderBase):
+    """Megatron layout loader (reference state_dict_factory.py:272): the
+    name heuristics above already encode Megatron's column/row/vocab
+    parallel split and fused-QKV layout."""
+
+
+class SDLoaderFactory:
+    @staticmethod
+    def get_sd_loader_json(json_file):
+        import json
+        with open(json_file) as f:
+            data = json.load(f)
+        return SDLoaderFactory.get_sd_loader(
+            data["checkpoints"], data.get("type", "Megatron"))
+
+    @staticmethod
+    def get_sd_loader(ckpt_list, sd_type="Megatron", specs=None):
+        if sd_type.lower() == "megatron":
+            return MegatronSDLoader(ckpt_list, specs=specs)
+        return SDLoaderBase(ckpt_list, specs=specs)
+
+
+def save_tp_sharded(params, out_dir: str, mp_world_size: int, specs=None,
+                    prefix="mp_rank"):
+    """Export a full logical tree as Megatron-style per-mp-rank shard files
+    — the inverse of SDLoaderBase.load, used for interop and tested as the
+    roundtrip (reference pipeline writes these via engine.py:1524-1551
+    naming)."""
+    os.makedirs(out_dir, exist_ok=True)
+    loader = SDLoaderBase([None], specs=specs)
+    paths = []
+    for r in range(mp_world_size):
+        shard = loader._split_shard(params, mp_world_size, r) \
+            if mp_world_size > 1 else jax.tree_util.tree_map(np.asarray,
+                                                             params)
+        path = os.path.join(out_dir, f"{prefix}_{r:02d}_model_states.npz")
+        save_tree(path, {"params": shard})
+        paths.append(path)
+    return paths

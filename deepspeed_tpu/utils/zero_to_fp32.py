@@ -1,0 +1,66 @@
+"""Offline ZeRO-checkpoint → consolidated fp32 weights converter.
+
+Reference: utils/zero_to_fp32.py:70 — the script DeepSpeed copies into every
+checkpoint directory so users can extract a plain fp32 state dict without
+the training stack.
+
+Here checkpoints store the full logical fp32 master tree per tag
+(runtime/checkpointing.py docstring), so consolidation = load + strip
+non-param state + write one npz. Multi-host shard merging goes through
+`merge_zero_shards`. Usable as a module or CLI:
+
+    python -m deepspeed_tpu.utils.zero_to_fp32 <checkpoint_dir> <output_file>
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None):
+    """Return {path: np.ndarray(fp32)} of consolidated weights (reference
+    zero_to_fp32.py get_fp32_state_dict_from_zero_checkpoint)."""
+    from deepspeed_tpu.runtime.checkpointing import (
+        read_latest_tag, merge_zero_shards, _flatten)
+    if tag is None:
+        tag = read_latest_tag(checkpoint_dir)
+        if tag is None:
+            raise FileNotFoundError(
+                f"no 'latest' file in {checkpoint_dir}; pass an explicit tag")
+    ckpt_dir = os.path.join(checkpoint_dir, str(tag))
+    if not os.path.isdir(ckpt_dir):
+        raise FileNotFoundError(f"checkpoint tag dir not found: {ckpt_dir}")
+    params = merge_zero_shards(ckpt_dir)
+    return {k: np.asarray(v, np.float32)
+            for k, v in _flatten(params).items()}
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir, output_file,
+                                               tag=None):
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    np.savez(output_file, **sd)
+    total = sum(int(np.prod(v.shape)) for v in sd.values())
+    print(f"saved {len(sd)} tensors / {total:,} params to {output_file}")
+    return output_file
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Merge a deepspeed_tpu ZeRO checkpoint into a single "
+                    "fp32 weights file")
+    parser.add_argument("checkpoint_dir",
+                        help="directory containing the 'latest' file and "
+                             "tag subdirectories")
+    parser.add_argument("output_file",
+                        help="path for the consolidated fp32 .npz")
+    parser.add_argument("-t", "--tag", default=None,
+                        help="checkpoint tag (default: read 'latest')")
+    args = parser.parse_args(argv)
+    convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir,
+                                               args.output_file, args.tag)
+
+
+if __name__ == "__main__":
+    main()
