@@ -1,0 +1,49 @@
+"""`dstpu_elastic` — rebuild of the reference's bin/ds_elastic CLI: given a
+config with an `elasticity` block, print the computed final batch size,
+valid chip counts, and (with --world-size) the micro-batch per chip."""
+
+import argparse
+import json
+
+from deepspeed_tpu.elasticity import compute_elastic_config
+from deepspeed_tpu.version import __version__
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-c", "--config", type=str, required=True,
+                        help="deepspeed_tpu config json")
+    parser.add_argument("-w", "--world-size", type=int, default=0,
+                        help="Intended/current number of chips")
+    args = parser.parse_args(argv)
+
+    with open(args.config) as fd:
+        ds_config = json.load(fd)
+
+    print("-" * 42)
+    print("Elasticity config:")
+    print("-" * 42)
+    print(json.dumps(ds_config["elasticity"], indent=4, sort_keys=True))
+
+    if args.world_size > 0:
+        final_batch, valid_chips, micro_batch = compute_elastic_config(
+            ds_config=ds_config, target_deepspeed_version=__version__,
+            world_size=args.world_size)
+        print("-" * 42)
+        print(f"Calculated results for world size {args.world_size}:")
+        print("-" * 42)
+        print(f"final_batch_size .... {final_batch}")
+        print(f"valid_chips ......... {valid_chips}")
+        print(f"micro_batch_size .... {micro_batch}")
+    else:
+        final_batch, valid_chips = compute_elastic_config(
+            ds_config=ds_config, target_deepspeed_version=__version__)
+        print("-" * 42)
+        print("Calculated results:")
+        print("-" * 42)
+        print(f"final_batch_size .... {final_batch}")
+        print(f"valid_chips ......... {valid_chips}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+from deepspeed_tpu.launcher.runner import main as runner_main  # noqa: F401

@@ -1,0 +1,23 @@
+"""Launcher constants — rebuild of deepspeed/launcher/constants.py.
+
+On TPU pods the transport between hosts for *launching* is still ssh (or an
+MPI runner); the training-time transport is ICI/DCN managed by the JAX
+runtime, so there is no NCCL_* env surface — the propagated prefixes are the
+JAX/libtpu ones instead (reference launcher/runner.py:27 EXPORT_ENVS).
+"""
+
+SSH_LAUNCHER = "ssh"
+PDSH_LAUNCHER = "pdsh"
+OPENMPI_LAUNCHER = "openmpi"
+
+PDSH_MAX_FAN_OUT = 1024
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+# Env-var prefixes forwarded from the operator's shell to every worker
+# (reference EXPORT_ENVS = NCCL/PYTHON/MV2/UCX → TPU equivalents).
+EXPORT_ENV_PREFIXES = ["JAX", "XLA", "LIBTPU", "TPU", "PYTHON", "DSTPU"]
+
+# Optional per-job env file, one KEY=VALUE per line, shipped to all workers
+# (reference DEEPSPEED_ENVIRONMENT_NAME ".deepspeed_env").
+ENVIRONMENT_FILE_NAME = ".dstpu_env"

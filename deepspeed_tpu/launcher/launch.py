@@ -1,0 +1,115 @@
+"""Per-host launcher — rebuild of deepspeed/launcher/launch.py.
+
+The reference spawns one process per GPU with RANK/LOCAL_RANK/WORLD_SIZE.
+The JAX process model is one process per host owning every local chip, so
+here each host runs ONE worker whose environment carries the coordinator
+address and its process id; `deepspeed_tpu.init_distributed` (utils/
+distributed.py) picks these up and calls `jax.distributed.initialize`.
+
+Kept from the reference: base64 world-info decoding, SIGINT/SIGTERM
+propagation to children, non-zero-exit fail-fast monitoring
+(launch.py:128-168).
+"""
+
+import base64
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from argparse import ArgumentParser, REMAINDER
+
+from deepspeed_tpu.launcher.constants import DEFAULT_COORDINATOR_PORT
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = ArgumentParser(
+        description="per-host deepspeed_tpu launcher (spawned by the "
+        "runner on every host)")
+    parser.add_argument("--node_rank", type=str, default="0",
+                        help="This host's index in the world-info dict, or "
+                        "'ompi' to read it from OMPI_COMM_WORLD_RANK.")
+    parser.add_argument("--coordinator_addr", type=str, default="127.0.0.1")
+    parser.add_argument("--coordinator_port", type=int,
+                        default=DEFAULT_COORDINATOR_PORT)
+    parser.add_argument("--world_info", type=str, default="None",
+                        help="base64-encoded {host: [chip ids]} dict")
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def build_child_env(args, environ=None):
+    """Worker env: coordinator rendezvous + chip visibility for this host."""
+    env = dict(os.environ if environ is None else environ)
+    assert args.world_info != "None", "must provide world info dict"
+    world_info = json.loads(base64.urlsafe_b64decode(args.world_info))
+
+    node_list = list(world_info.keys())
+    if args.node_rank == "ompi":
+        node_rank = int(env["OMPI_COMM_WORLD_RANK"])
+    else:
+        node_rank = int(args.node_rank)
+    local_node = node_list[node_rank]
+    local_chip_ids = world_info[local_node]
+
+    env["DSTPU_COORDINATOR_ADDR"] = args.coordinator_addr
+    env["DSTPU_COORDINATOR_PORT"] = str(args.coordinator_port)
+    env["DSTPU_NUM_PROCESSES"] = str(len(node_list))
+    env["DSTPU_PROCESS_ID"] = str(node_rank)
+    env["DSTPU_LOCAL_DEVICE_IDS"] = ",".join(map(str, local_chip_ids))
+    # visibility narrowing for partial-host runs (the TPU runtime reads
+    # TPU_VISIBLE_CHIPS; harmless elsewhere)
+    if local_chip_ids:
+        env["TPU_VISIBLE_CHIPS"] = ",".join(map(str, local_chip_ids))
+    return env, node_rank, len(node_list)
+
+
+def main(args=None):
+    args = parse_args(args)
+    env, node_rank, nnodes = build_child_env(args)
+    logger.info(f"node_rank={node_rank} nnodes={nnodes} "
+                f"coordinator={args.coordinator_addr}:"
+                f"{args.coordinator_port}")
+
+    cmd = [sys.executable, "-u", args.training_script] \
+        + args.training_script_args
+    processes = []
+    last_return_code = None
+
+    def sigkill_handler(signum, frame):
+        for p in processes:
+            logger.info(f"Killing subprocess {p.pid}")
+            try:
+                p.kill()
+            except Exception:
+                pass
+        if last_return_code is not None:
+            raise subprocess.CalledProcessError(
+                returncode=last_return_code, cmd=cmd)
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, sigkill_handler)
+    signal.signal(signal.SIGTERM, sigkill_handler)
+
+    processes.append(subprocess.Popen(cmd, env=env))
+
+    alive = set(processes)
+    while alive:
+        finished = set()
+        for p in alive:
+            if p.poll() is None:
+                continue
+            if p.returncode != 0:
+                last_return_code = p.returncode
+                sigkill_handler(signal.SIGTERM, None)
+            finished.add(p)
+        alive -= finished
+        if alive:
+            time.sleep(1)
+
+
+if __name__ == "__main__":
+    main()

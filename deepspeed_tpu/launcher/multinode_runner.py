@@ -1,0 +1,121 @@
+"""Multi-node launch backends — rebuild of deepspeed/launcher/multinode_runner.py.
+
+Each runner turns (world_info, per-host launch module, exports) into one
+command the front-end execs. The reference ships pdsh/mpirun/mvapich; TPU
+pods are plain ssh-reachable VMs so the default here is a portable ssh
+fan-out, with pdsh and OpenMPI kept for parity on clusters that have them.
+"""
+
+import os
+import shlex
+import shutil
+import sys
+from abc import ABC, abstractmethod
+
+from deepspeed_tpu.launcher.constants import PDSH_MAX_FAN_OUT
+from deepspeed_tpu.utils.logging import logger
+
+
+class MultiNodeRunner(ABC):
+    def __init__(self, args, world_info_base64):
+        self.args = args
+        self.user_arguments = self.parse_user_args()
+        self.user_script = args.user_script
+        self.world_info_base64 = world_info_base64
+        self.exports = {}
+
+    @abstractmethod
+    def backend_exists(self):
+        ...
+
+    @abstractmethod
+    def get_cmd(self, environment, active_resources):
+        ...
+
+    def add_export(self, key, var):
+        self.exports[key.strip()] = var.strip()
+
+    def parse_user_args(self):
+        return self.args.user_args
+
+    def _launch_cmd(self, node_rank_token):
+        """The per-host `python -m deepspeed_tpu.launcher.launch …` tail."""
+        return [
+            sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+            f"--world_info={self.world_info_base64}",
+            f"--node_rank={node_rank_token}",
+            f"--coordinator_addr={self.args.coordinator_addr}",
+            f"--coordinator_port={self.args.coordinator_port}",
+        ]
+
+
+class SSHRunner(MultiNodeRunner):
+    """Portable fan-out: one `ssh host 'exports; cd; launch …'` per host,
+    wrapped in a single local shell that waits on all of them and returns
+    the first non-zero status."""
+
+    def backend_exists(self):
+        return shutil.which("ssh")
+
+    def get_cmd(self, environment, active_resources):
+        exports = "".join(f"export {k}={shlex.quote(v)}; "
+                          for k, v in self.exports.items())
+        workdir = os.path.abspath(".")
+        per_host = []
+        for rank, host in enumerate(active_resources):
+            tail = " ".join(
+                self._launch_cmd(rank) + [self.user_script]
+                + list(self.user_arguments))
+            remote = shlex.quote(f"{exports}cd {workdir}; {tail}")
+            per_host.append(
+                f"ssh -o StrictHostKeyChecking=no {host} {remote} &")
+        script = ("set -m; pids=(); "
+                  + " ".join(f"{c} pids+=($!);" for c in per_host)
+                  + " rc=0; for p in ${pids[@]}; do wait $p || rc=$?; done; "
+                  "exit $rc")
+        logger.info("Running on: %s", ",".join(active_resources))
+        return ["bash", "-c", script]
+
+
+class PDSHRunner(MultiNodeRunner):
+    def backend_exists(self):
+        return shutil.which("pdsh")
+
+    def parse_user_args(self):
+        # quote non-flag args so pdsh's remote shell keeps them whole
+        return [x if x.startswith("-") else f"'{x}'"
+                for x in self.args.user_args]
+
+    def get_cmd(self, environment, active_resources):
+        environment["PDSH_RCMD_TYPE"] = "ssh"
+        active_workers = ",".join(active_resources.keys())
+        logger.info("Running on: %s", active_workers)
+        exports = "".join(f"export {k}={shlex.quote(v)}; "
+                          for k, v in self.exports.items())
+        # %n is pdsh's per-host index → node_rank
+        return (["pdsh", "-f", str(PDSH_MAX_FAN_OUT), "-w", active_workers,
+                 exports, f"cd {os.path.abspath('.')};"]
+                + self._launch_cmd("%n")
+                + [self.user_script] + self.user_arguments)
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    """mpirun with one rank per host; each rank discovers its node_rank from
+    OMPI env vars, so the launch module is invoked with --node_rank=ompi."""
+
+    def backend_exists(self):
+        return shutil.which("ompi_info")
+
+    def get_cmd(self, environment, active_resources):
+        total_hosts = len(active_resources)
+        hosts = ",".join(f"{h}:1" for h in active_resources)
+        export_args = []
+        for k, v in self.exports.items():
+            export_args += ["-x", f"{k}={v}"]
+        extra = self.args.launcher_args.split() if \
+            self.args.launcher_args else []
+        return (["mpirun", "-n", str(total_hosts), "--host", hosts,
+                 "--mca", "btl_tcp_if_include", "eth0"]
+                + export_args + extra
+                + self._launch_cmd("ompi")
+                + [self.user_script] + list(self.user_arguments))

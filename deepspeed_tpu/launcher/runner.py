@@ -1,0 +1,278 @@
+"""Multi-host job front-end — rebuild of deepspeed/launcher/runner.py.
+
+Parses an MPI-style hostfile (``worker-0 slots=4``), applies include/exclude
+filters (reference runner.py:151-241), b64-encodes the resulting world info,
+then either launches locally (single host) or hands the per-host command to a
+multinode runner (ssh / pdsh / mpirun — reference multinode_runner.py).
+
+TPU-first deltas from the reference:
+ - "slots" are TPU chips. One *process per host* owns all of its chips (the
+   JAX process model), so the per-host launcher spawns one worker by default
+   instead of one per slot; chip visibility is narrowed per the slot filter
+   via ``TPU_VISIBLE_CHIPS``-style env (``DSTPU_LOCAL_DEVICE_IDS``).
+ - rendezvous is ``jax.distributed.initialize`` against a coordinator
+   address, not a torch MASTER_ADDR store.
+ - forwarded env prefixes are JAX/XLA/LIBTPU/TPU (constants.py), not NCCL/UCX.
+"""
+
+import argparse
+import base64
+import collections
+import json
+import os
+import subprocess
+import sys
+from copy import deepcopy
+
+from deepspeed_tpu.launcher.constants import (
+    DEFAULT_COORDINATOR_PORT,
+    ENVIRONMENT_FILE_NAME,
+    EXPORT_ENV_PREFIXES,
+    OPENMPI_LAUNCHER,
+    PDSH_LAUNCHER,
+    SSH_LAUNCHER,
+)
+from deepspeed_tpu.launcher.multinode_runner import (
+    OpenMPIRunner,
+    PDSHRunner,
+    SSHRunner,
+)
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_HOSTFILE = "/job/hostfile"
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu runner: launch a training job across "
+        "one or more TPU-VM hosts (reference: the `deepspeed` CLI).")
+    parser.add_argument("-H", "--hostfile", type=str, default=DEFAULT_HOSTFILE,
+                        help="MPI-style hostfile: lines of 'host slots=N' "
+                        "where N is the chip count on that host.")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="NODE_SPEC[@NODE_SPEC ...] with "
+                        "NODE_SPEC=NAME[:SLOT[,SLOT ...]] — hosts/chips to "
+                        "use. Omitting :SLOT takes the whole host.")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="Same syntax as --include; resources to skip. "
+                        "Mutually exclusive with --include.")
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="Use the top N hosts of the hostfile.")
+    parser.add_argument("--num_chips", type=int, default=-1,
+                        help="Max chips to use per host ([0:N)).")
+    parser.add_argument("--coordinator_port", type=int,
+                        default=DEFAULT_COORDINATOR_PORT,
+                        help="Port for the JAX distributed coordinator.")
+    parser.add_argument("--coordinator_addr", type=str, default="",
+                        help="Address of the coordinator (host 0); inferred "
+                        "from the hostfile if unset.")
+    parser.add_argument("--launcher", type=str, default=SSH_LAUNCHER,
+                        help="Multi-node backend: ssh (default), pdsh, "
+                        "openmpi.")
+    parser.add_argument("--launcher_args", type=str, default="",
+                        help="Extra args passed through to the backend.")
+    parser.add_argument("--force_multi", action="store_true",
+                        help="Force multi-node code path for a single "
+                        "remote host.")
+    parser.add_argument("user_script", type=str,
+                        help="Training script to launch.")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path):
+    """'host slots=N' lines → OrderedDict host→slot-count; None if absent."""
+    if not os.path.isfile(hostfile_path):
+        logger.warning(f"No hostfile at {hostfile_path}; using local "
+                       "resources only.")
+        return None
+    resource_pool = collections.OrderedDict()
+    with open(hostfile_path) as fd:
+        for line in fd:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                _, slot_count = slots.split("=")
+                slot_count = int(slot_count)
+            except ValueError:
+                raise ValueError(
+                    f"Hostfile line not 'host slots=N': {line!r}")
+            if hostname in resource_pool:
+                raise ValueError(f"host {hostname} is already defined")
+            resource_pool[hostname] = slot_count
+    return resource_pool
+
+
+def parse_resource_filter(host_info, include_str="", exclude_str=""):
+    """Filter {host: [slot ids]} by an include or exclude NODE_SPEC string.
+
+    Semantics of reference runner.py:151-241: the two are mutually
+    exclusive; include builds the set from scratch, exclude removes from the
+    full set; hosts left with zero slots drop out; hostfile order is kept.
+    """
+    if include_str and exclude_str:
+        raise ValueError("include_str and exclude_str are mutually exclusive")
+    if not include_str and not exclude_str:
+        return host_info
+
+    filtered = {}
+    parse_str = include_str
+    if exclude_str:
+        filtered = deepcopy(host_info)
+        parse_str = exclude_str
+
+    for node_config in parse_str.split("@"):
+        if ":" in node_config:
+            hostname, slot_str = node_config.split(":")
+            slots = [int(x) for x in slot_str.split(",")]
+            if hostname not in host_info:
+                raise ValueError(f"Hostname '{hostname}' not in hostfile")
+            for s in slots:
+                if s not in host_info[hostname]:
+                    raise ValueError(
+                        f"No slot '{s}' on host '{hostname}'")
+            if include_str:
+                filtered[hostname] = slots
+            else:
+                for s in slots:
+                    filtered[hostname].remove(s)
+        else:
+            hostname = node_config
+            if hostname not in host_info:
+                raise ValueError(f"Hostname '{hostname}' not in hostfile")
+            if include_str:
+                filtered[hostname] = host_info[hostname]
+            else:
+                filtered[hostname] = []
+
+    for hostname in list(filtered):
+        filtered[hostname] = sorted(set(filtered[hostname]))
+        if not filtered[hostname]:
+            del filtered[hostname]
+
+    ordered = collections.OrderedDict()
+    for host in host_info:
+        if host in filtered:
+            ordered[host] = filtered[host]
+    return ordered
+
+
+def parse_inclusion_exclusion(resource_pool, inclusion, exclusion):
+    active = collections.OrderedDict(
+        (host, list(range(slots))) for host, slots in resource_pool.items())
+    return parse_resource_filter(active, include_str=inclusion,
+                                 exclude_str=exclusion)
+
+
+def encode_world_info(world_info):
+    return base64.urlsafe_b64encode(
+        json.dumps(world_info).encode()).decode()
+
+
+def decode_world_info(encoded):
+    return json.loads(base64.urlsafe_b64decode(encoded))
+
+
+def _local_chip_count():
+    try:
+        import jax
+        return jax.local_device_count()
+    except Exception:
+        return 1
+
+
+def collect_exports(environ=None):
+    """Env vars to forward to workers, by prefix + per-job env file."""
+    environ = os.environ if environ is None else environ
+    exports = {}
+    for key, val in environ.items():
+        if any(key.startswith(p) for p in EXPORT_ENV_PREFIXES):
+            exports[key] = val
+    for path in (os.path.expanduser("~"), "."):
+        env_file = os.path.join(path, ENVIRONMENT_FILE_NAME)
+        if os.path.isfile(env_file):
+            with open(env_file) as fd:
+                for line in fd:
+                    line = line.strip()
+                    if line and not line.startswith("#") and "=" in line:
+                        key, val = line.split("=", 1)
+                        exports[key.strip()] = val.strip()
+    return exports
+
+
+def main(args=None):
+    args = parse_args(args)
+
+    if (args.num_nodes >= 0 or args.num_chips >= 0) and \
+            (args.include or args.exclude):
+        raise ValueError(
+            "Cannot specify num_nodes/num_chips with include/exclude")
+
+    multi_node = True
+    resource_pool = fetch_hostfile(args.hostfile)
+    if not resource_pool:
+        resource_pool = collections.OrderedDict(
+            localhost=_local_chip_count())
+        args.coordinator_addr = "127.0.0.1"
+        multi_node = False
+    if not multi_node and args.num_nodes > 1:
+        raise ValueError("num_nodes > 1 but hostfile provides one host")
+
+    active_resources = parse_inclusion_exclusion(resource_pool,
+                                                 args.include, args.exclude)
+    if args.num_nodes > 0:
+        keep = list(active_resources.keys())[:args.num_nodes]
+        active_resources = collections.OrderedDict(
+            (k, active_resources[k]) for k in keep)
+    if args.num_chips > 0:
+        for host in active_resources:
+            active_resources[host] = \
+                active_resources[host][:args.num_chips]
+
+    if not args.coordinator_addr:
+        args.coordinator_addr = next(iter(active_resources))
+
+    world_info = encode_world_info(
+        {h: s for h, s in active_resources.items()})
+
+    # A hostfile naming only this machine still runs locally (no sshd
+    # needed) unless --force_multi asks for the remote path.
+    if multi_node and len(active_resources) == 1 and \
+            next(iter(active_resources)) in ("localhost", "127.0.0.1"):
+        multi_node = False
+    multi_node = multi_node or args.force_multi
+    env = os.environ.copy()
+    if not multi_node:
+        # Single host: exec the per-host launcher directly.
+        cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+               f"--world_info={world_info}", "--node_rank=0",
+               f"--coordinator_addr={args.coordinator_addr}",
+               f"--coordinator_port={args.coordinator_port}",
+               args.user_script] + args.user_args
+    else:
+        runner_cls = {SSH_LAUNCHER: SSHRunner, PDSH_LAUNCHER: PDSHRunner,
+                      OPENMPI_LAUNCHER: OpenMPIRunner}.get(
+                          args.launcher.lower())
+        if runner_cls is None:
+            raise ValueError(f"Unknown launcher {args.launcher}")
+        runner = runner_cls(args, world_info)
+        if not runner.backend_exists():
+            raise RuntimeError(
+                f"launcher backend '{args.launcher}' not installed")
+        for key, val in collect_exports().items():
+            runner.add_export(key, val)
+        # get_cmd may mutate env (e.g. PDSH_RCMD_TYPE); the same dict goes
+        # to Popen below.
+        cmd = runner.get_cmd(env, active_resources)
+
+    logger.info(f"cmd = {' '.join(map(str, cmd))}")
+    result = subprocess.Popen(cmd, env=env)
+    result.wait()
+    if result.returncode != 0:
+        sys.exit(result.returncode)
+
+
+if __name__ == "__main__":
+    main()

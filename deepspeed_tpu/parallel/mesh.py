@@ -14,14 +14,11 @@ reference's topology axis order ['pipe','data','model']
 """
 
 import dataclasses
-import os
 from typing import Optional, Sequence
 
 import numpy as np
 import jax
 from jax.sharding import Mesh, PartitionSpec, NamedSharding
-
-from deepspeed_tpu.utils.logging import logger, log_dist
 
 # Mesh axis names. ZeRO shards over DATA_AXIS; tensor parallelism over
 # MODEL_AXIS; pipeline stages over PIPE_AXIS; ring-attention/sequence
@@ -34,7 +31,6 @@ EXPERT_AXIS = "expert"
 
 AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
 
-_initialized = False
 _current_mesh: Optional[Mesh] = None
 
 
@@ -55,35 +51,14 @@ def init_distributed(coordinator_address: Optional[str] = None,
                      process_id: Optional[int] = None,
                      auto_mpi_discovery: bool = True):
     """Multi-host initialization — parity with reference
-    deepspeed/utils/distributed.py:12 (init_distributed + mpi_discovery).
-
-    Single-process (the common TPU-VM single-host case and all unit tests) is
-    a no-op. Multi-host: uses explicit args, else env vars
-    (``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID``), else OpenMPI
-    env discovery (OMPI_COMM_WORLD_*), mirroring the reference's fallbacks.
-    """
-    global _initialized
-    if _initialized:
-        return
-    if coordinator_address is None:
-        coordinator_address = os.environ.get("COORDINATOR_ADDRESS")
-    if num_processes is None and os.environ.get("NUM_PROCESSES"):
-        num_processes = int(os.environ["NUM_PROCESSES"])
-    if process_id is None and os.environ.get("PROCESS_ID"):
-        process_id = int(os.environ["PROCESS_ID"])
-
-    # MPI discovery fallback (reference utils/distributed.py:54-142)
-    if auto_mpi_discovery and num_processes is None and "OMPI_COMM_WORLD_SIZE" in os.environ:
-        num_processes = int(os.environ["OMPI_COMM_WORLD_SIZE"])
-        process_id = int(os.environ["OMPI_COMM_WORLD_RANK"])
-
-    if coordinator_address and num_processes and num_processes > 1:
-        log_dist(f"jax.distributed.initialize({coordinator_address}, "
-                 f"n={num_processes}, id={process_id})", ranks=[0])
-        jax.distributed.initialize(coordinator_address=coordinator_address,
-                                   num_processes=num_processes,
-                                   process_id=process_id)
-    _initialized = True
+    deepspeed/utils/distributed.py:12. Full resolution order (launcher env
+    contract, generic env, MPI discovery) lives in utils/distributed.py;
+    single-process is a no-op."""
+    from deepspeed_tpu.utils.distributed import init_distributed as _init
+    _init(coordinator_address=coordinator_address,
+          num_processes=num_processes,
+          process_id=process_id,
+          auto_mpi_discovery=auto_mpi_discovery)
 
 
 @dataclasses.dataclass

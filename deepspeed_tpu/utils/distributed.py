@@ -1,0 +1,94 @@
+"""Distributed rendezvous — rebuild of deepspeed/utils/distributed.py:12-142.
+
+The reference resolves RANK/WORLD_SIZE/MASTER_ADDR from the environment
+(with OpenMPI / Azure-ML discovery fallbacks) and calls
+``torch.distributed.init_process_group``. Here the rendezvous target is
+``jax.distributed.initialize``; sources, in priority order:
+
+1. explicit arguments;
+2. the launcher contract (``DSTPU_COORDINATOR_ADDR/PORT``,
+   ``DSTPU_NUM_PROCESSES``, ``DSTPU_PROCESS_ID``,
+   ``DSTPU_LOCAL_DEVICE_IDS`` — set by launcher/launch.py);
+3. generic env (``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID``);
+4. OpenMPI discovery (``OMPI_COMM_WORLD_*`` — reference mpi_discovery
+   utils/distributed.py:54) — requires an explicit ``MASTER_ADDR`` for the
+   coordinator; mpirun gives ranks but no rendezvous host;
+5. single-process no-op (TPU-VM single host, unit tests).
+"""
+
+import os
+from typing import Optional, Sequence
+
+from deepspeed_tpu.utils.logging import log_dist
+
+_initialized = False
+
+
+def discover_rendezvous(environ=None, auto_mpi_discovery=True):
+    """Resolve (coordinator_address, num_processes, process_id,
+    local_device_ids) from the environment without side effects. Fields that
+    cannot be resolved come back None."""
+    env = os.environ if environ is None else environ
+
+    def geti(name):
+        val = env.get(name)
+        return int(val) if val not in (None, "") else None
+
+    addr = num = pid = None
+    if env.get("DSTPU_COORDINATOR_ADDR"):
+        port = env.get("DSTPU_COORDINATOR_PORT", "8476")
+        addr = f"{env['DSTPU_COORDINATOR_ADDR']}:{port}"
+        num = geti("DSTPU_NUM_PROCESSES")
+        pid = geti("DSTPU_PROCESS_ID")
+    elif env.get("COORDINATOR_ADDRESS"):
+        addr = env["COORDINATOR_ADDRESS"]
+        num = geti("NUM_PROCESSES")
+        pid = geti("PROCESS_ID")
+    elif auto_mpi_discovery and env.get("OMPI_COMM_WORLD_SIZE"):
+        num = geti("OMPI_COMM_WORLD_SIZE")
+        pid = geti("OMPI_COMM_WORLD_RANK")
+        # mpirun provides ranks but no rendezvous host: require MASTER_ADDR
+        # rather than guessing localhost (every rank dialing its own
+        # loopback would hang, not fail).
+        if env.get("MASTER_ADDR"):
+            port = env.get("MASTER_PORT", "8476")
+            addr = f"{env['MASTER_ADDR']}:{port}"
+
+    ids = env.get("DSTPU_LOCAL_DEVICE_IDS", "")
+    local_device_ids = [int(x) for x in ids.split(",") if x != ""] or None
+    return addr, num, pid, local_device_ids
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     local_device_ids: Optional[Sequence[int]] = None,
+                     auto_mpi_discovery: bool = True):
+    """Idempotent multi-host init; single-process is a no-op. Explicit
+    arguments always win; env discovery fills in only the missing fields."""
+    global _initialized
+    if _initialized:
+        return
+    addr, num, pid, ids = discover_rendezvous(
+        auto_mpi_discovery=auto_mpi_discovery)
+    coordinator_address = coordinator_address if coordinator_address \
+        else addr
+    num_processes = num_processes if num_processes is not None else num
+    process_id = process_id if process_id is not None else pid
+    local_device_ids = local_device_ids if local_device_ids is not None \
+        else ids
+
+    if coordinator_address and num_processes and num_processes > 1:
+        import jax
+        log_dist(f"jax.distributed.initialize({coordinator_address}, "
+                 f"n={num_processes}, id={process_id}, "
+                 f"local_device_ids={local_device_ids})", ranks=[0])
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id,
+                                   local_device_ids=local_device_ids)
+    _initialized = True
+
+
+def is_initialized():
+    return _initialized

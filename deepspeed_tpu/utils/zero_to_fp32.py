@@ -2,39 +2,53 @@
 
 Reference: utils/zero_to_fp32.py:70 — the script DeepSpeed copies into every
 checkpoint directory so users can extract a plain fp32 state dict without
-the training stack.
+the training stack. This file is therefore fully standalone: stdlib + numpy
+only, no deepspeed_tpu imports (it is shipped by copyfile at save time,
+runtime/checkpointing.py).
 
-Here checkpoints store the full logical fp32 master tree per tag
-(runtime/checkpointing.py docstring), so consolidation = load + strip
-non-param state + write one npz. Multi-host shard merging goes through
-`merge_zero_shards`. Usable as a module or CLI:
+Checkpoint layout (runtime/checkpointing.py docstring): a ``latest`` pointer
+file, tag subdirectories holding ``mp_rank_00_model_states.npz`` with
+'/'-joined tree paths as npz keys; fp32 master weights live in the params
+tree itself, so consolidation = load + strip the 'params/' prefix.
 
-    python -m deepspeed_tpu.utils.zero_to_fp32 <checkpoint_dir> <output_file>
+    python zero_to_fp32.py <checkpoint_dir> <output_file>
 """
 
 import argparse
 import os
-import sys
 
 import numpy as np
+
+LATEST_FILE = "latest"
+MODEL_STATES_FILE = "mp_rank_00_model_states.npz"
+
+
+def read_latest_tag(checkpoint_dir):
+    latest_path = os.path.join(checkpoint_dir, LATEST_FILE)
+    if os.path.isfile(latest_path):
+        with open(latest_path) as f:
+            return f.read().strip()
+    return None
 
 
 def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None):
     """Return {path: np.ndarray(fp32)} of consolidated weights (reference
     zero_to_fp32.py get_fp32_state_dict_from_zero_checkpoint)."""
-    from deepspeed_tpu.runtime.checkpointing import (
-        read_latest_tag, merge_zero_shards, _flatten)
     if tag is None:
         tag = read_latest_tag(checkpoint_dir)
         if tag is None:
             raise FileNotFoundError(
                 f"no 'latest' file in {checkpoint_dir}; pass an explicit tag")
     ckpt_dir = os.path.join(checkpoint_dir, str(tag))
-    if not os.path.isdir(ckpt_dir):
-        raise FileNotFoundError(f"checkpoint tag dir not found: {ckpt_dir}")
-    params = merge_zero_shards(ckpt_dir)
-    return {k: np.asarray(v, np.float32)
-            for k, v in _flatten(params).items()}
+    model_path = os.path.join(ckpt_dir, MODEL_STATES_FILE)
+    if not os.path.isfile(model_path):
+        raise FileNotFoundError(f"model states not found: {model_path}")
+    out = {}
+    with np.load(model_path, allow_pickle=False) as data:
+        for key in data.files:
+            if key.startswith("params/"):
+                out[key[len("params/"):]] = np.asarray(data[key], np.float32)
+    return out
 
 
 def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir, output_file,

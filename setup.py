@@ -15,7 +15,10 @@ setup(
     install_requires=["jax", "flax", "numpy"],
     entry_points={
         "console_scripts": [
+            "dstpu=deepspeed_tpu.launcher.runner:main",
+            "dstpu_launch=deepspeed_tpu.launcher.launch:main",
             "dstpu_report=deepspeed_tpu.env_report:main",
+            "dstpu_elastic=deepspeed_tpu.elasticity.cli:main",
         ],
     },
 )
