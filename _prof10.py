@@ -1,0 +1,34 @@
+import time, cProfile, pstats, io
+import numpy as np
+import jax, jax.numpy as jnp
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+
+dev = jax.devices()[0]
+mesh = make_mesh(MeshConfig(data=1), devices=[dev])
+seq, B = 1024, 8
+model_cfg = GPT2Config(vocab_size=50304, n_positions=seq, n_embd=1024,
+                       n_layer=24, n_head=16, dtype=jnp.bfloat16,
+                       scan_layers=True, remat=True)
+cfg = {"train_batch_size": B, "zero_optimization": {"stage": 3},
+       "bf16": {"enabled": True}, "gradient_clipping": 1.0,
+       "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+       "steps_per_print": 1000}
+model = GPT2LMHeadModel(model_cfg)
+engine, _, _, _ = dstpu.initialize(config=cfg, model=model, mesh=mesh)
+rng = np.random.RandomState(0)
+batch = {"input_ids": rng.randint(0, 50304, size=(B, seq)).astype(np.int32)}
+for _ in range(3):
+    engine.train_batch(batch)
+jax.block_until_ready(engine.state.params)
+
+pr = cProfile.Profile()
+pr.enable()
+for _ in range(5):
+    engine.train_batch(batch)
+jax.block_until_ready(engine.state.params)
+pr.disable()
+s = io.StringIO()
+pstats.Stats(pr, stream=s).sort_stats("cumulative").print_stats(25)
+print(s.getvalue())

@@ -1,0 +1,51 @@
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+
+dev = jax.devices()[0]
+mesh = make_mesh(MeshConfig(data=1), devices=[dev])
+seq, B = 1024, 8
+model_cfg = GPT2Config(vocab_size=50304, n_positions=seq, n_embd=1024,
+                       n_layer=24, n_head=16, dtype=jnp.bfloat16,
+                       scan_layers=True, remat=True)
+cfg = {"train_batch_size": B, "zero_optimization": {"stage": 3},
+       "bf16": {"enabled": True}, "gradient_clipping": 1.0,
+       "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+       "steps_per_print": 1000}
+model = GPT2LMHeadModel(model_cfg)
+engine, _, _, _ = dstpu.initialize(config=cfg, model=model, mesh=mesh)
+rng = np.random.RandomState(0)
+batch = {"input_ids": rng.randint(0, 50304, size=(B, seq)).astype(np.int32)}
+batch_j = jax.tree_util.tree_map(jnp.asarray, batch)
+engine._ensure_ready(batch_j)
+
+r = jax.random.PRNGKey(1)
+
+# time grads-only compiled fn
+g = engine._jit_grads_batch(engine.state, batch_j, r)
+float(g[1])
+t0 = time.perf_counter()
+for _ in range(5):
+    g = engine._jit_grads_batch(engine.state, batch_j, r)
+float(g[1])
+print(f"grads_batch: {(time.perf_counter()-t0)/5*1000:.1f}ms", flush=True)
+
+# time full train step compiled fn (donating copies of state)
+st, m = engine._jit_train_batch(engine.state, batch_j, r)
+float(m["loss"])
+t0 = time.perf_counter()
+for _ in range(5):
+    st, m = engine._jit_train_batch(st, batch_j, r)
+float(m["loss"])
+print(f"train_batch jit: {(time.perf_counter()-t0)/5*1000:.1f}ms", flush=True)
+
+engine.state = st
+# full wrapper
+t0 = time.perf_counter()
+for _ in range(5):
+    engine.train_batch(batch)
+jax.block_until_ready(engine.state.params)
+print(f"train_batch wrapper: {(time.perf_counter()-t0)/5*1000:.1f}ms", flush=True)

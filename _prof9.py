@@ -1,0 +1,30 @@
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+
+# h2d upload cost through tunnel
+b = np.random.randint(0, 50304, (8, 1024)).astype(np.int32)
+for _ in range(2):
+    x = jnp.asarray(b); jax.block_until_ready(x)
+t0 = time.perf_counter()
+for _ in range(10):
+    x = jnp.asarray(b); jax.block_until_ready(x)
+print(f"h2d 32KB: {(time.perf_counter()-t0)/10*1000:.1f}ms", flush=True)
+
+# rng split cost
+key = jax.random.PRNGKey(0)
+for _ in range(2):
+    key, s = jax.random.split(key)
+jax.block_until_ready(key)
+t0 = time.perf_counter()
+for _ in range(10):
+    key, s = jax.random.split(key)
+jax.block_until_ready(key)
+print(f"rng split: {(time.perf_counter()-t0)/10*1000:.1f}ms", flush=True)
+
+from deepspeed_tpu.utils.timer import ThroughputTimer
+tt = ThroughputTimer(batch_size=8)
+t0 = time.perf_counter()
+for _ in range(10):
+    tt.start(); tt.stop()
+print(f"tput timer: {(time.perf_counter()-t0)/10*1000:.1f}ms", flush=True)

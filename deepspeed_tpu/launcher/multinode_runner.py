@@ -61,6 +61,7 @@ class SSHRunner(MultiNodeRunner):
         exports = "".join(f"export {k}={shlex.quote(v)}; "
                           for k, v in self.exports.items())
         workdir = os.path.abspath(".")
+        ssh_opts = self.args.launcher_args or ""
         per_host = []
         for rank, host in enumerate(active_resources):
             tail = " ".join(
@@ -68,8 +69,13 @@ class SSHRunner(MultiNodeRunner):
                 + list(self.user_arguments))
             remote = shlex.quote(f"{exports}cd {workdir}; {tail}")
             per_host.append(
-                f"ssh -o StrictHostKeyChecking=no {host} {remote} &")
-        script = ("set -m; pids=(); "
+                f"ssh -o StrictHostKeyChecking=no {ssh_opts} {host} "
+                f"{remote} &")
+        # no `set -m`: the backgrounded ssh children must stay in the
+        # front-end's process group so Ctrl-C/SIGTERM reaches them (job
+        # control would re-parent them into their own groups and orphan
+        # the remote jobs)
+        script = ("pids=(); "
                   + " ".join(f"{c} pids+=($!);" for c in per_host)
                   + " rc=0; for p in ${pids[@]}; do wait $p || rc=$?; done; "
                   "exit $rc")
@@ -92,9 +98,12 @@ class PDSHRunner(MultiNodeRunner):
         logger.info("Running on: %s", active_workers)
         exports = "".join(f"export {k}={shlex.quote(v)}; "
                           for k, v in self.exports.items())
+        extra = self.args.launcher_args.split() if \
+            self.args.launcher_args else []
         # %n is pdsh's per-host index → node_rank
-        return (["pdsh", "-f", str(PDSH_MAX_FAN_OUT), "-w", active_workers,
-                 exports, f"cd {os.path.abspath('.')};"]
+        return (["pdsh", "-f", str(PDSH_MAX_FAN_OUT)] + extra
+                + ["-w", active_workers,
+                   exports, f"cd {os.path.abspath('.')};"]
                 + self._launch_cmd("%n")
                 + [self.user_script] + self.user_arguments)
 

@@ -176,11 +176,16 @@ def decode_world_info(encoded):
 
 
 def _local_chip_count():
-    try:
-        import jax
-        return jax.local_device_count()
-    except Exception:
-        return 1
+    """Count local TPU chips WITHOUT initializing a JAX backend: libtpu
+    takes an exclusive per-process lock, so touching jax here would leave
+    the launcher holding the TPU and the spawned training process unable
+    to acquire it. Device files are authoritative on TPU-VMs."""
+    import glob
+    for pattern in ("/dev/accel*", "/dev/vfio/[0-9]*"):
+        chips = glob.glob(pattern)
+        if chips:
+            return len(chips)
+    return 1
 
 
 def collect_exports(environ=None):
@@ -245,7 +250,9 @@ def main(args=None):
     multi_node = multi_node or args.force_multi
     env = os.environ.copy()
     if not multi_node:
-        # Single host: exec the per-host launcher directly.
+        # Single host: exec the per-host launcher directly. The per-job env
+        # file applies here too (same contract as the multi-node path).
+        env.update(collect_exports())
         cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
                f"--world_info={world_info}", "--node_rank=0",
                f"--coordinator_addr={args.coordinator_addr}",

@@ -38,6 +38,7 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16          # activation/compute dtype (MXU-friendly)
     param_dtype: Any = jnp.float32     # master params
     remat: bool = False
+    remat_policy: Optional[str] = None  # None=full remat | "dots" | "offload"
     scan_layers: bool = True
     use_flash: Optional[bool] = None   # None = auto (TPU yes)
     tie_word_embeddings: bool = True
@@ -126,15 +127,35 @@ class Block(nn.Module):
         return x
 
 
+def _remat_policy(name):
+    """Named remat policies (the memory/compute knobs of the reference's
+    activation_checkpointing config, SURVEY §5.7): full remat (None), keep
+    matmul outputs on-chip ("dots"), or offload saved residuals to host
+    memory ("offload" — the cpu_checkpointing analog)."""
+    if name is None:
+        return None
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if name == "offload":
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
+    raise ValueError(f"unknown remat_policy {name!r}")
+
+
+def _maybe_remat(cfg):
+    if not cfg.remat:
+        return Block
+    return nn.remat(Block, prevent_cse=False, static_argnums=(2,),
+                    policy=_remat_policy(cfg.remat_policy))
+
+
 class ScanBody(nn.Module):
     """One scanned layer step: returns (carry, None) as nn.scan requires."""
     config: GPT2Config
 
     @nn.compact
     def __call__(self, x, deterministic, keep_prob):
-        block = Block
-        if self.config.remat:
-            block = nn.remat(Block, prevent_cse=False, static_argnums=(2,))
+        block = _maybe_remat(self.config)
         return block(self.config, name="blk")(x, deterministic, keep_prob), None
 
 
@@ -159,9 +180,7 @@ class GPT2LMHeadModel(nn.Module):
                               length=cfg.n_layer)
             x, _ = scanned(cfg, name="h")(x, deterministic, keep_prob)
         else:
-            block = Block
-            if cfg.remat:
-                block = nn.remat(Block, prevent_cse=False, static_argnums=(2,))
+            block = _maybe_remat(cfg)
             for i in range(cfg.n_layer):
                 x = block(cfg, name=f"h_{i}")(x, deterministic, keep_prob)
 
@@ -183,10 +202,13 @@ def lm_loss(logits, labels, ignore_index=-100):
     targets = labels[:, 1:]
     valid = targets != ignore_index
     targets = jnp.where(valid, targets, 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    ll = jnp.where(valid, ll, 0.0)
-    return -ll.sum() / jnp.maximum(valid.sum(), 1)
+    # -log p(target) = logsumexp(logits) - logits[target]; this form never
+    # materializes a [B, S, V] fp32 log-softmax in HBM (the lse and the
+    # gathered target logit are both [B, S])
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, lse - tgt, 0.0)
+    return nll.sum() / jnp.maximum(valid.sum(), 1)
 
 
 # -- presets ---------------------------------------------------------------

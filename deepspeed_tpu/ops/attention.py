@@ -34,12 +34,9 @@ def reference_attention(q, k, v, causal=False, bias=None, scale=None,
     return jnp.einsum("bhst,bhtd->bhsd", probs.astype(q.dtype), v)
 
 
-@functools.lru_cache(maxsize=1)
 def _on_tpu():
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
+    from deepspeed_tpu.utils.platform import is_tpu_backend
+    return is_tpu_backend()
 
 
 def dot_product_attention(q, k, v, causal=False, bias=None, scale=None,

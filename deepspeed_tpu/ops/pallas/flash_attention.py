@@ -25,10 +25,8 @@ NEG_INF = -1e30
 
 
 def _interpret_default():
-    try:
-        return jax.default_backend() != "tpu"
-    except Exception:
-        return True
+    from deepspeed_tpu.utils.platform import is_tpu_backend
+    return not is_tpu_backend()
 
 
 # ---------------------------------------------------------------- forward

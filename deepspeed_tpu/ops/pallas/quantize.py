@@ -24,10 +24,8 @@ except Exception:  # pragma: no cover
 
 
 def _interpret_default():
-    try:
-        return jax.default_backend() != "tpu"
-    except Exception:
-        return True
+    from deepspeed_tpu.utils.platform import is_tpu_backend
+    return not is_tpu_backend()
 
 
 def _qparams(flat, bits, sym):

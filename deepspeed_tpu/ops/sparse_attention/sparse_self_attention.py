@@ -36,11 +36,8 @@ def sparse_attention(q, k, v, layout, block, key_padding_mask=None,
     scale = scale if scale is not None else 1.0 / np.sqrt(D)
     mask = _expand_layout_mask(layout, block, S)  # [H, S, S]
 
-    use_pallas = False
-    try:
-        use_pallas = jax.default_backend() == "tpu"
-    except Exception:
-        pass
+    from deepspeed_tpu.utils.platform import is_tpu_backend
+    use_pallas = is_tpu_backend()
     if use_pallas:
         try:
             from deepspeed_tpu.ops.pallas.blocksparse import blocksparse_attention

@@ -1,0 +1,24 @@
+"""Backend detection.
+
+Pallas kernels must compile (not interpret) whenever the execution target is
+a real TPU. That is *not* the same as ``jax.default_backend() == "tpu"``:
+tunneled/proxied PJRT plugins (e.g. an `axon` terminal fronting a TPU chip)
+register under their own platform name while still executing TPU programs.
+Detect TPU by the device kind, which the plugin reports faithfully
+("TPU v4", "TPU v5 lite", ...).
+"""
+
+def is_tpu_backend() -> bool:
+    # evaluated per call (no cache): a process may legitimately switch
+    # backends, e.g. run on the TPU then move to a forced-CPU device mesh
+    # (jax.config.update("jax_platforms", "cpu") + clear_backends)
+    try:
+        import jax
+        if jax.default_backend() == "tpu":
+            return True
+        dev = jax.devices()[0]
+        kind = getattr(dev, "device_kind", "") or ""
+        platform = getattr(dev, "platform", "") or ""
+        return kind.upper().startswith("TPU") or platform == "tpu"
+    except Exception:
+        return False

@@ -11,12 +11,21 @@ import time
 from deepspeed_tpu.utils.logging import logger
 
 
+_sync_token = None
+
+
 def _sync_device():
+    """Block until previously dispatched work is done — the TPU analog of
+    torch.cuda.synchronize(). Enqueues one cached tiny computation behind the
+    in-flight work and waits on it (a fresh device_put per call costs a full
+    host→device transfer round trip on tunneled backends)."""
+    global _sync_token
     try:
         import jax
-        # Blocks until all dispatched computations on the default backend are
-        # done — the TPU analog of torch.cuda.synchronize().
-        (jax.device_put(0) + 0).block_until_ready()
+        if _sync_token is None:
+            import jax.numpy as jnp
+            _sync_token = jax.jit(lambda: jnp.zeros((), jnp.int32))
+        _sync_token().block_until_ready()
     except Exception:
         pass
 
@@ -120,9 +129,25 @@ class ThroughputTimer:
     def start(self):
         self._init_timer()
         self.started = True
-        if self.total_step_count >= self.start_step:
+        if self.total_step_count == self.start_step:
+            # timeline accounting: sync once at the start of the measured
+            # region, then measure contiguous wall time window-by-window.
+            # Syncing every step would serialize dispatch against execution;
+            # skipping sync but summing per-step gaps would silently drop
+            # device work that runs during host-side gaps. Wall-clock windows
+            # bounded by syncs count everything exactly once.
             _sync_device()
-            self.start_time = time.time()
+            self._window_start = time.time()
+            self._steps_in_windows = 0
+
+    def _fold_window(self):
+        """Close the current window: sync, add its wall time, start a new
+        window."""
+        _sync_device()
+        now = time.time()
+        self.total_elapsed_time += now - self._window_start
+        self._steps_in_windows = self.total_step_count - self.start_step
+        self._window_start = now
 
     def stop(self, report_speed=True):
         if not self.started:
@@ -131,20 +156,21 @@ class ThroughputTimer:
         self.total_step_count += 1
         self.local_step_count += 1
         if self.total_step_count > self.start_step:
-            _sync_device()
             self.end_time = time.time()
-            duration = self.end_time - self.start_time
-            self.total_elapsed_time += duration
-            if report_speed and self.local_step_count % self.steps_per_output == 0:
+            if report_speed and \
+                    self.local_step_count % self.steps_per_output == 0:
+                self._fold_window()
                 self.logging(
                     "{}/{}, SamplesPerSec={}".format(self.epoch_count,
                                                      self.local_step_count,
                                                      self.avg_samples_per_sec()))
 
-    def avg_samples_per_sec(self):
+    def avg_samples_per_sec(self, fold=False):
         if self.total_step_count > self.start_step:
+            if fold or not getattr(self, "_steps_in_windows", 0):
+                self._fold_window()
+            steps = max(getattr(self, "_steps_in_windows", 0), 1)
             samples_per_step = self.batch_size * self.num_workers
-            total_step_offset = self.total_step_count - self.start_step
-            avg_time_per_step = self.total_elapsed_time / max(total_step_offset, 1)
+            avg_time_per_step = self.total_elapsed_time / steps
             return samples_per_step / max(avg_time_per_step, 1e-12)
         return float("-inf")

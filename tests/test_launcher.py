@@ -20,6 +20,8 @@ from deepspeed_tpu.launcher.multinode_runner import (
 )
 from deepspeed_tpu.utils.distributed import discover_rendezvous
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def _write_hostfile(tmp_path, text):
     path = tmp_path / "hostfile"
@@ -205,7 +207,7 @@ def test_localhost_hostfile_stays_local(tmp_path):
         [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
          "--hostfile", path, str(script)],
         capture_output=True, text=True, env=env, timeout=120,
-        cwd="/root/repo")
+        cwd=REPO_ROOT)
     assert out.returncode == 0, out.stderr
     assert "LOCAL_OK" in out.stdout
 
@@ -226,7 +228,7 @@ def test_single_host_end_to_end(tmp_path):
         [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
          "--hostfile", str(tmp_path / "none"), str(script)],
         capture_output=True, text=True, env=env, timeout=120,
-        cwd="/root/repo")
+        cwd=REPO_ROOT)
     assert out.returncode == 0, out.stderr
     payload = json.loads(out.stdout.strip().splitlines()[-1])
     assert payload["DSTPU_COORDINATOR_ADDR"] == "127.0.0.1"
@@ -244,5 +246,5 @@ def test_launch_propagates_child_failure(tmp_path):
         [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
          f"--world_info={info}", "--node_rank=0", str(script)],
         capture_output=True, text=True, env=env, timeout=120,
-        cwd="/root/repo")
+        cwd=REPO_ROOT)
     assert out.returncode != 0
