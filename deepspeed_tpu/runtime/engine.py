@@ -17,6 +17,7 @@ functional core, same call pattern as the reference loop, engine.py:1005,
 `is_gradient_accumulation_boundary` (engine.py:975).
 """
 
+import functools
 import inspect
 import os
 from typing import Any, Callable, Optional
@@ -316,6 +317,38 @@ class DeepSpeedEngine:
     def is_gradient_accumulation_boundary(self):
         return (self.micro_steps + 1) % self.gradient_accumulation_steps() == 0
 
+    def _compressed_comm_active(self):
+        """True when the train step should use the 1-bit compressed
+        collective path (reference onebit wiring: engine's own allreduce is
+        disabled and the optimizer communicates compressed momentum,
+        onebit/adam.py:92-104). Requires a pure-DP layout: the momentum
+        collective assumes replicated params (ZeRO stage 0, no tp/sp/pp)."""
+        cached = getattr(self, "_compressed_comm_cached", None)
+        if cached is not None:
+            return cached
+        self._compressed_comm_cached = self._compute_compressed_comm()
+        return self._compressed_comm_cached
+
+    def _compute_compressed_comm(self):
+        if not getattr(self.optimizer, "supports_compressed_comm", False):
+            return False
+        if self._offload_cfg.enabled:
+            return False
+        dp = mesh_lib.mesh_axis_size(self.mesh, mesh_lib.DATA_AXIS)
+        if dp <= 1:
+            return False
+        pure_dp = (self.zero_optimization_stage() == 0 and all(
+            mesh_lib.mesh_axis_size(self.mesh, a) == 1
+            for a in (mesh_lib.PIPE_AXIS, mesh_lib.SEQ_AXIS,
+                      mesh_lib.MODEL_AXIS)))
+        if not pure_dp:
+            logger.warning(
+                "1-bit optimizer requested with ZeRO stage "
+                f"{self.zero_optimization_stage()} or a non-data mesh axis; "
+                "compressed communication disabled (exact-comm fallback)")
+            return False
+        return True
+
     # ------------------------------------------------------------------
     # state init
     # ------------------------------------------------------------------
@@ -384,6 +417,9 @@ class DeepSpeedEngine:
                 if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else
                 jnp.asarray(p), params)
             opt_state = {}
+        elif self._compressed_comm_active():
+            opt_state = self.optimizer.init_compressed(
+                params, mesh_lib.mesh_axis_size(self.mesh, mesh_lib.DATA_AXIS))
         else:
             opt_state = self.optimizer.init(params)
         scaler = prec.init_scaler_state(self.precision)
@@ -395,6 +431,14 @@ class DeepSpeedEngine:
         param_sh = self.zero.param_shardings(params)
         opt_sh = self.zero.opt_state_shardings(
             opt_state, params, getattr(self.optimizer, "param_like_state_fields", ()))
+        if self._compressed_comm_active():
+            # per-device error-feedback state: leading [dp] axis sharded
+            # over data so every worker keeps exactly its own error tensors
+            err_sh = NamedSharding(self.mesh, PartitionSpec(mesh_lib.DATA_AXIS))
+            for key in ("worker_error", "server_error"):
+                if key in opt_state:
+                    opt_sh[key] = jax.tree_util.tree_map(
+                        lambda _: err_sh, opt_state[key])
         repl = NamedSharding(self.mesh, PartitionSpec())
         scaler_sh = jax.tree_util.tree_map(lambda _: repl, scaler)
         self.state_shardings = TrainState(
@@ -542,6 +586,16 @@ class DeepSpeedEngine:
         repl = NamedSharding(self.mesh, PartitionSpec())
 
         def accumulate_grads(state, batch, rng):
+            if gas == 1:
+                # no accumulation: skip the scan and the fp32 zero-buffer
+                # init+add pass entirely (one full extra read/write of the
+                # gradient tree per step otherwise)
+                batch = jax.tree_util.tree_map(
+                    lambda x: jax.lax.with_sharding_constraint(x, batch_sh),
+                    batch)
+                loss, grads = self._micro_loss_and_grads(state, batch, rng,
+                                                         loss_fn=loss_fn)
+                return grads, loss
             # batch leading dim = gas * micro_global; scan over gas chunks
             def to_chunks(x):
                 assert x.shape[0] % gas == 0, (
@@ -599,6 +653,8 @@ class DeepSpeedEngine:
         self._jit_train_batch = jax.jit(train_batch_fn, donate_argnums=(0,))
         self._jit_micro_grads = jax.jit(micro_grads_fn)
         self._jit_apply_grads = jax.jit(apply_grads_fn, donate_argnums=(0, 1))
+        if self._compressed_comm_active():
+            self._jit_train_batch = self._build_compressed_train_fn(loss_fn)
 
         try:
             accepts_det = "deterministic" in inspect.signature(
@@ -614,6 +670,124 @@ class DeepSpeedEngine:
             return self.module.apply({"params": state.params}, x)
         self._jit_eval = jax.jit(eval_fn)
         self._last_lr = None
+
+    def _build_compressed_train_fn(self, loss_fn):
+        """shard_map train step for 1-bit optimizers: grads stay LOCAL to
+        each data shard (no GSPMD psum), the optimizer's step_local runs the
+        warmup pmean / compressed momentum collective itself (the
+        reference's compressed_allreduce replacing the engine allreduce,
+        comm/nccl.py:47). Params replicated; error-feedback state per-device
+        with a leading [dp] axis."""
+        mesh = self.mesh
+        axis = mesh_lib.DATA_AXIS
+        gas = self.gradient_accumulation_steps()
+        cfg = self._config
+        state = self.state
+        keep_fn = self._keep_prob_fn()
+        lr_fn = self._lr_fn()
+        opt = self.optimizer
+        precision = self.precision
+        spec_like = lambda tree, s: jax.tree_util.tree_map(  # noqa: E731
+            lambda _: s, tree)
+
+        opt_specs = {
+            k: spec_like(v, PartitionSpec(axis))
+            if k in ("worker_error", "server_error") else
+            spec_like(v, PartitionSpec())
+            for k, v in state.opt_state.items()}
+        state_specs = TrainState(
+            params=spec_like(state.params, PartitionSpec()),
+            opt_state=opt_specs,
+            scaler=spec_like(state.scaler, PartitionSpec()),
+            global_step=PartitionSpec(),
+            skipped_steps=PartitionSpec())
+
+        def train_fn(state, batch, rng):
+            batch_specs = spec_like(batch, PartitionSpec(axis))
+
+            @functools.partial(
+                jax.shard_map, mesh=mesh,
+                in_specs=(state_specs, batch_specs, PartitionSpec()),
+                out_specs=(state_specs, spec_like(
+                    {"loss": 0, "grad_norm": 0, "lr": 0, "overflow": 0,
+                     "loss_scale": 0}, PartitionSpec())),
+                check_vma=False)
+            def inner(state, batch, rng):
+                tm = jax.tree_util.tree_map
+                # per-device dropout streams over distinct data shards
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+                scale = state.scaler["loss_scale"]
+                keep_prob = keep_fn(state.global_step)
+
+                def micro_grads(micro, r):
+                    def scaled(p):
+                        loss = loss_fn(p, micro, r, keep_prob)
+                        return (loss * scale).astype(jnp.float32), loss
+                    return jax.grad(scaled, has_aux=True)(state.params)
+
+                if gas == 1:
+                    grads, loss = micro_grads(batch, rng)
+                    grads = tm(lambda g: g.astype(jnp.float32), grads)
+                else:
+                    chunked = tm(lambda x: x.reshape(
+                        (gas, x.shape[0] // gas) + x.shape[1:]), batch)
+                    rngs = jax.random.split(rng, gas)
+
+                    def body(acc, inp):
+                        micro, r = inp
+                        g, l = micro_grads(micro, r)
+                        acc_g, acc_l = acc
+                        return (tm(lambda a, gg: a + gg.astype(jnp.float32)
+                                   / gas, acc_g, g), acc_l + l / gas), None
+                    zero_g = tm(lambda p: jnp.zeros(p.shape, jnp.float32),
+                                state.params)
+                    (grads, loss), _ = jax.lax.scan(
+                        body, (zero_g, jnp.float32(0.0)), (chunked, rngs))
+
+                inv = 1.0 / scale
+                grads = tm(lambda g: g * inv, grads)
+                loss = jax.lax.pmean(loss, axis)
+                local_finite = prec.grads_finite(grads) if precision.fp16 \
+                    else jnp.asarray(True)
+                finite = jax.lax.pmin(
+                    local_finite.astype(jnp.int32), axis) > 0
+                # metrics-only norm: mean of the local-shard grad norms
+                # (the exact global norm would need an uncompressed
+                # collective, defeating the compression)
+                grad_norm = jax.lax.pmean(_global_norm(grads), axis)
+
+                opt_local = dict(state.opt_state)
+                for key in ("worker_error", "server_error"):
+                    opt_local[key] = tm(lambda x: x[0], opt_local[key])
+
+                lr = lr_fn(state.global_step)
+                clip = cfg.gradient_clipping or None
+                new_params, new_opt = opt.step_local(
+                    state.params, grads, opt_local, lr, axis, clip=clip)
+
+                for key in ("worker_error", "server_error"):
+                    new_opt[key] = tm(lambda x: x[None], new_opt[key])
+
+                new_params = _tree_where(finite, new_params, state.params)
+                new_opt = _tree_where(finite, new_opt, state.opt_state)
+                new_scaler = prec.update_scaler(state.scaler, precision,
+                                                finite)
+                new_state = TrainState(
+                    params=new_params,
+                    opt_state=new_opt,
+                    scaler=new_scaler,
+                    global_step=state.global_step
+                    + finite.astype(jnp.int32),
+                    skipped_steps=state.skipped_steps
+                    + (~finite).astype(jnp.int32))
+                return new_state, {
+                    "loss": loss, "grad_norm": grad_norm, "lr": lr,
+                    "overflow": ~finite,
+                    "loss_scale": new_scaler["loss_scale"]}
+
+            return inner(state, batch, rng)
+
+        return jax.jit(train_fn, donate_argnums=(0,))
 
     def _micro_loss_and_grads(self, state, micro_batch, rng, loss_fn=None):
         if loss_fn is None:

@@ -37,6 +37,7 @@ class OnebitLamb(TpuOptimizer):
     coeff_beta: float = 0.9
 
     param_like_state_fields = ("exp_avg", "exp_avg_sq", "worker_error")
+    supports_compressed_comm = True
 
     def init(self, params):
         return {
@@ -46,6 +47,84 @@ class OnebitLamb(TpuOptimizer):
             "worker_error": tree_zeros_like(params, jnp.float32),
             "lamb_coeff": _tree_scalar_like(params, 1.0),
         }
+
+    def init_compressed(self, params, dp_size):
+        """State for the distributed compressed path (see OnebitAdam
+        .init_compressed): error-feedback trees per-device with a leading
+        [dp] axis; moments and coefficients replicated."""
+        from deepspeed_tpu.parallel import compression as comp
+        we, se = comp.init_error_states(params, dp_size)
+        bump = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: jnp.zeros((dp_size,) + x.shape, x.dtype), t)
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "exp_avg": tree_zeros_like(params, jnp.float32),
+            "exp_avg_sq": tree_zeros_like(params, jnp.float32),
+            "worker_error": bump(we),
+            "server_error": bump(se),
+            "lamb_coeff": _tree_scalar_like(params, 1.0),
+        }
+
+    def step_local(self, params, grads, state, lr, axis_name, clip=None):
+        """Distributed step inside shard_map over ``axis_name`` (unreduced
+        per-device grads). Warmup = exact LAMB on pmean'd grads, recording
+        the running scaling coefficient; compressed = 1-bit momentum
+        collective + frozen coefficient (the reference's two-phase design,
+        arXiv:2104.06069)."""
+        from deepspeed_tpu.parallel.compression import tree_compressed_allreduce
+        lr = self.lr if lr is None else lr
+        beta1, beta2 = self.betas
+        count = state["step"] + 1
+        frozen = count > self.freeze_step
+        tm = jax.tree_util.tree_map
+
+        def warmup(grads, m, v, we, se):
+            g = tm(lambda x: jax.lax.pmean(x.astype(jnp.float32), axis_name),
+                   grads)
+            if clip:
+                sq = sum(jnp.sum(jnp.square(l))
+                         for l in jax.tree_util.tree_leaves(g))
+                coef = jnp.minimum(1.0, clip / (jnp.sqrt(sq) + 1e-6))
+                g = tm(lambda x: x * coef, g)
+            m_new = tm(lambda mm, gg: beta1 * mm + (1 - beta1) * gg, m, g)
+            v_new = tm(lambda vv, gg: beta2 * vv + (1 - beta2) * gg * gg, v, g)
+            return m_new, m_new, v_new, we, se
+
+        def compressed(grads, m, v, we, se):
+            m_loc = tm(lambda mm, gg: beta1 * mm
+                       + (1 - beta1) * gg.astype(jnp.float32), m, grads)
+            m_sync, we2, se2 = tree_compressed_allreduce(
+                m_loc, we, se, axis_name)
+            return m_sync, m_sync, v, we2, se2
+
+        m_eff, m_new, v_new, we2, se2 = jax.lax.cond(
+            frozen, compressed, warmup,
+            grads, state["exp_avg"], state["exp_avg_sq"],
+            state["worker_error"], state["server_error"])
+
+        def apply_leaf(p, m, v, coeff):
+            p32 = p.astype(jnp.float32)
+            update = m / (jnp.sqrt(v) + self.eps)
+            if self.weight_decay != 0.0:
+                update = update + self.weight_decay * p32
+            p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+            u_norm = jnp.sqrt(jnp.sum(update * update))
+            fresh = jnp.where((p_norm > 0) & (u_norm > 0),
+                              p_norm / jnp.maximum(u_norm, 1e-12),
+                              jnp.float32(1.0))
+            fresh = jnp.clip(fresh, self.min_coeff, self.max_coeff)
+            coeff_new = jnp.where(
+                frozen, coeff,
+                self.coeff_beta * coeff + (1.0 - self.coeff_beta) * fresh)
+            trust = jnp.where(frozen, coeff_new, fresh)
+            return (p32 - lr * trust * update).astype(p.dtype), coeff_new
+
+        applied = tm(apply_leaf, params, m_eff, v_new, state["lamb_coeff"])
+        pick = lambda i: tm(  # noqa: E731
+            lambda t: t[i], applied, is_leaf=lambda x: isinstance(x, tuple))
+        return pick(0), {"step": count, "exp_avg": m_new,
+                         "exp_avg_sq": v_new, "worker_error": we2,
+                         "server_error": se2, "lamb_coeff": pick(1)}
 
     def step(self, params, grads, state, lr=None):
         lr = self.lr if lr is None else lr
