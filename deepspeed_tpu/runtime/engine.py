@@ -148,12 +148,30 @@ class DeepSpeedEngine:
                                        world_size=self.dp_world_size)
 
         self.precision = prec.PrecisionConfig.from_ds_config(self._config)
+        param_offload = self._config.zero_config.offload_param
+        self._param_offload_host = bool(param_offload.enabled)
+        if self._param_offload_host:
+            from deepspeed_tpu.utils.platform import is_tpu_backend
+            if param_offload.device == C.OFFLOAD_NVME_DEVICE:
+                logger.warning(
+                    "offload_param device=nvme: params rest in host DRAM "
+                    "(pinned_host) on TPU; the NVMe tier backs optimizer "
+                    "state via offload_optimizer")
+            if not is_tpu_backend():
+                # the CPU PJRT backend advertises pinned_host but aborts
+                # executing programs that move between memory spaces — the
+                # tier is a no-op off-TPU (host RAM is already "host")
+                logger.warning("offload_param: non-TPU backend, params "
+                               "stay in default memory")
+                self._param_offload_host = False
         self.zero = ZeroPartitioner(
             mesh, self._config.zero_optimization_stage,
             tp_specs=param_tp_specs,
             param_persistence_threshold=(
                 self._config.zero_config.param_persistence_threshold
-                if self._config.zero_optimization_stage >= 3 else 0))
+                if self._config.zero_optimization_stage >= 3 else 0),
+            param_memory_kind="pinned_host" if self._param_offload_host
+            else None)
 
         # -- optimizer (reference _configure_optimizer engine.py:647)
         if optimizer is not None:
@@ -332,7 +350,7 @@ class DeepSpeedEngine:
     def _compute_compressed_comm(self):
         if not getattr(self.optimizer, "supports_compressed_comm", False):
             return False
-        if self._offload_cfg.enabled:
+        if self._offload_cfg.enabled or self._param_offload_host:
             return False
         dp = mesh_lib.mesh_axis_size(self.mesh, mesh_lib.DATA_AXIS)
         if dp <= 1:
@@ -558,17 +576,27 @@ class DeepSpeedEngine:
             grads = jax.tree_util.tree_map(lambda g: g * clip_coef, grads)
 
         lr = self._lr_fn()(state.global_step)
-        new_params, new_opt = self.optimizer.step(state.params, grads,
+        params = state.params
+        if self._param_offload_host:
+            # param offload tier: stream host-resident params to HBM for
+            # the update (compute ops cannot mix memory spaces)
+            params = jax.device_put(
+                params, self.zero.device_param_shardings(params))
+        new_params, new_opt = self.optimizer.step(params, grads,
                                                   state.opt_state, lr)
-        # constrain updated params back to their resting sharding (the
-        # stage-1/2 all-gather of updated partitions, stage2.py:~1470)
-        new_params = jax.tree_util.tree_map(
-            lambda p, s: jax.lax.with_sharding_constraint(p, s),
-            new_params, self.zero.param_shardings(new_params))
-
-        # skip-on-overflow (reference fused_optimizer.py:194-246)
-        new_params = _tree_where(finite, new_params, state.params)
+        # skip-on-overflow (reference fused_optimizer.py:194-246); done
+        # before moving back so both branches live in device memory
+        new_params = _tree_where(finite, new_params, params)
         new_opt = _tree_where(finite, new_opt, state.opt_state)
+        if self._param_offload_host:
+            new_params = jax.device_put(
+                new_params, self.zero.param_shardings(new_params))
+        else:
+            # constrain updated params back to their resting sharding (the
+            # stage-1/2 all-gather of updated partitions, stage2.py:~1470)
+            new_params = jax.tree_util.tree_map(
+                lambda p, s: jax.lax.with_sharding_constraint(p, s),
+                new_params, self.zero.param_shardings(new_params))
         new_scaler = prec.update_scaler(state.scaler, self.precision, finite)
         return TrainState(
             params=new_params,
@@ -664,10 +692,14 @@ class DeepSpeedEngine:
 
         def eval_fn(state, x):
             x = jax.lax.with_sharding_constraint(x, batch_sh)
+            params = state.params
+            if self._param_offload_host:
+                params = jax.device_put(
+                    params, self.zero.device_param_shardings(params))
             if accepts_det:
-                return self.module.apply({"params": state.params}, x,
+                return self.module.apply({"params": params}, x,
                                          deterministic=True)
-            return self.module.apply({"params": state.params}, x)
+            return self.module.apply({"params": params}, x)
         self._jit_eval = jax.jit(eval_fn)
         self._last_lr = None
 
@@ -799,7 +831,14 @@ class DeepSpeedEngine:
             loss = loss_fn(p, micro_batch, rng, keep_prob)
             return (loss * scale).astype(jnp.float32), loss
 
-        grads, loss = jax.grad(scaled_loss, has_aux=True)(state.params)
+        params = state.params
+        if self._param_offload_host:
+            # stream the host-resident params into HBM for compute; grads
+            # come out device-resident (the swap-in of the reference's
+            # partitioned_param_swapper, done by XLA's h2d DMA)
+            params = jax.device_put(
+                params, self.zero.device_param_shardings(params))
+        grads, loss = jax.grad(scaled_loss, has_aux=True)(params)
         grads = self.zero.constrain_grads(grads)
         return loss, grads
 
@@ -1054,6 +1093,31 @@ class DeepSpeedEngine:
         if self._config.tensorboard_config.enabled:
             host = {k: float(jax.device_get(v)) for k, v in metrics.items()}
             self.scalar_history.append((self.global_steps, host))
+            writer = self._summary_writer()
+            if writer is not None:
+                # reference tags, engine.py:1095-1105 / :1272-1298
+                writer.add_scalar("Train/Samples/train_loss", host["loss"],
+                                  self.global_samples)
+                writer.add_scalar("Train/Samples/lr", host["lr"],
+                                  self.global_samples)
+                writer.add_scalar("Train/Samples/loss_scale",
+                                  host["loss_scale"], self.global_samples)
+                writer.add_scalar("Train/Samples/grad_norm",
+                                  host["grad_norm"], self.global_samples)
+                if self.global_steps % self.steps_per_print() == 0:
+                    writer.flush()
+
+    def _summary_writer(self):
+        if getattr(self, "_summary_writer_obj", None) is None:
+            try:
+                from deepspeed_tpu.utils.monitor import SummaryEventWriter
+                tb = self._config.tensorboard_config
+                self._summary_writer_obj = SummaryEventWriter(
+                    tb.output_path, tb.job_name)
+            except Exception as e:
+                logger.warning(f"summary writer unavailable: {e}")
+                self._summary_writer_obj = False
+        return self._summary_writer_obj or None
 
     def _sync_skipped_steps(self):
         if self.state is not None:

@@ -1,0 +1,133 @@
+"""Defragmenting tensor arena — rebuild of the reference's
+ContiguousMemoryAllocator (zero/contiguous_memory_allocator.py:9).
+
+One contiguous host buffer serves many tensor-sized sub-allocations; when
+free space is sufficient but fragmented, ``allocate`` compacts live tensors
+to the front of the buffer (preserving contents) and retries — the
+reference's memory-defragmentation move (:112-160). On TPU this arena backs
+host-side staging: pinned swap buffers for the NVMe optimizer/param tiers
+and contiguous activation staging, where allocation churn and fragmentation
+otherwise fight the aio path's alignment requirements.
+
+Tensors are numpy views into the arena; a move during defragmentation
+preserves values but REPLACES the view object — callers access live
+tensors through ``get_tensor(tensor_id)`` after any allocate() (the
+reference instead re-points module params, :14-18 comment block).
+"""
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class ContiguousMemoryAllocator:
+    def __init__(self, size, dtype=np.float32):
+        self.buffer = np.zeros(int(size), dtype)
+        self.size = int(size)
+        self.dtype = np.dtype(dtype)
+
+        # offset → length of free block (reference self.contiguous_sizes)
+        self.free_blocks = {0: self.size}
+        # tensor_id → (offset, numel); views live in self.tensor_map
+        self.tensor_addresses = {}
+        self.tensor_sizes = {}
+        self.tensor_map = {}
+
+        self.total_free = self.size
+        self.max_allocated = 0
+        self.count = 0
+
+    # -- public API (reference :25-110) ---------------------------------
+    def allocate_tensor(self, numel):
+        """Returns (tensor_id, view). Asserts there is enough total free
+        space; defragments when no single free block fits."""
+        numel = int(numel)
+        assert numel <= self.total_free, (
+            f"arena exhausted: need {numel}, free {self.total_free}")
+        if self._largest_free() < numel:
+            logger.info(
+                f"arena defragment: need {numel} contiguous, largest free "
+                f"{self._largest_free()} of {self.total_free} total")
+            self._defragment()
+        offset = self._find_block(numel)
+        assert offset is not None
+        self._carve(offset, numel)
+        self.count += 1
+        tid = self.count
+        view = self.buffer[offset:offset + numel]
+        self.tensor_addresses[tid] = offset
+        self.tensor_sizes[tid] = numel
+        self.tensor_map[tid] = view
+        self.total_free -= numel
+        self.max_allocated = max(self.max_allocated,
+                                 self.size - self.total_free)
+        return tid, view
+
+    def get_tensor(self, tensor_id):
+        """Current live view (revalidate after any allocate/defragment)."""
+        return self.tensor_map[tensor_id]
+
+    def release_tensor(self, tensor_id):
+        offset = self.tensor_addresses.pop(tensor_id)
+        numel = self.tensor_sizes.pop(tensor_id)
+        del self.tensor_map[tensor_id]
+        self.total_free += numel
+        self._free(offset, numel)
+
+    def allocated_ids(self):
+        return sorted(self.tensor_addresses)
+
+    def print_allocation(self):
+        logger.info(
+            f"arena: size={self.size} free={self.total_free} "
+            f"live={len(self.tensor_addresses)} "
+            f"largest_free={self._largest_free()}")
+
+    # -- internals -------------------------------------------------------
+    def _largest_free(self):
+        return max(self.free_blocks.values(), default=0)
+
+    def _find_block(self, numel):
+        best = None
+        for off, length in self.free_blocks.items():
+            if length >= numel and (best is None or length < best[1]):
+                best = (off, length)
+        return best[0] if best else None
+
+    def _carve(self, offset, numel):
+        length = self.free_blocks.pop(offset)
+        if length > numel:
+            self.free_blocks[offset + numel] = length - numel
+
+    def _free(self, offset, numel):
+        # merge with adjacent free blocks (reference :162-199)
+        end = offset + numel
+        nxt = self.free_blocks.pop(end, None)
+        if nxt is not None:
+            numel += nxt
+        for off in list(self.free_blocks):
+            if off + self.free_blocks[off] == offset:
+                offset = off
+                numel += self.free_blocks.pop(off)
+                break
+        self.free_blocks[offset] = numel
+
+    def _defragment(self):
+        """Compact live tensors to the front in address order, copying
+        contents and re-pointing views (reference :112-160)."""
+        cursor = 0
+        for tid in sorted(self.tensor_addresses,
+                          key=lambda t: self.tensor_addresses[t]):
+            offset = self.tensor_addresses[tid]
+            numel = self.tensor_sizes[tid]
+            if offset != cursor:
+                # regions may overlap when sliding left; numpy handles
+                # overlapping same-buffer copies for a leftward move via
+                # an explicit copy of the source
+                self.buffer[cursor:cursor + numel] = \
+                    self.buffer[offset:offset + numel].copy()
+                self.tensor_addresses[tid] = cursor
+                self.tensor_map[tid] = self.buffer[cursor:cursor + numel]
+            cursor += numel
+        self.free_blocks = {cursor: self.size - cursor} \
+            if cursor < self.size else {}

@@ -99,11 +99,17 @@ class HostOffloadOptimizer:
         self.step_count += 1
         hyper = self._hyper()
         n = len(self.master)
+        if self.swapper is not None and n > 0:
+            self.swapper.prefetch(0)
         for i in range(n):
             g = np.asarray(grads_np[i], np.float32)
             p = self.master[i]
             if self.swapper is not None:
                 m, v = self.swapper.fetch(i)
+                if i + 1 < n:
+                    # double buffering: next leaf's moments stream from NVMe
+                    # while this leaf runs the SIMD Adam step
+                    self.swapper.prefetch(i + 1)
             else:
                 m, v = self.m[i], self.v[i]
             self._apply_leaf(p, g, m, v, lr, hyper)

@@ -68,13 +68,18 @@ class ZeroPartitioner:
     PartitionSpec matching the params tree carrying tensor-parallel axes."""
 
     def __init__(self, mesh: Mesh, stage: int, tp_specs=None,
-                 param_persistence_threshold: int = 0):
+                 param_persistence_threshold: int = 0,
+                 param_memory_kind=None):
         assert 0 <= stage <= 3
         self.mesh = mesh
         self.stage = stage
         self.tp_specs = tp_specs
         self.dp = mesh_lib.mesh_axis_size(mesh, mesh_lib.DATA_AXIS)
         self.min_size = int(param_persistence_threshold)
+        # "pinned_host" = ZeRO-Offload/Infinity param tier: params rest in
+        # host DRAM (reference offload_param, partitioned_param_swapper.py:36)
+        # and stream to HBM inside the step via device_put
+        self.param_memory_kind = param_memory_kind
 
     # -- spec trees --------------------------------------------------------
     def _base_spec(self, path, leaf):
@@ -128,6 +133,16 @@ class ZeroPartitioner:
             is_leaf=lambda x: isinstance(x, PartitionSpec))
 
     def param_shardings(self, params):
+        """Resting shardings (host-memory-kind when the param offload tier
+        is on)."""
+        sh = self._named(self.param_specs(params))
+        if self.param_memory_kind:
+            sh = jax.tree_util.tree_map(
+                lambda s: s.with_memory_kind(self.param_memory_kind), sh)
+        return sh
+
+    def device_param_shardings(self, params):
+        """Compute-time shardings: always default (HBM) memory."""
         return self._named(self.param_specs(params))
 
     def grad_shardings(self, params):

@@ -1,0 +1,49 @@
+"""Training monitor — the engine's TensorBoard scalar stream (reference
+engine.py:162-163 SummaryWriter construction and the scalar writes at
+:291-316, :1095-1105, :1272-1298).
+
+Uses torch.utils.tensorboard when importable (tensorboard is in the base
+image); otherwise falls back to a JSONL event log with the same tags, so
+monitoring never becomes a hard dependency.
+"""
+
+import json
+import os
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class SummaryEventWriter:
+    """add_scalar/flush/close facade over SummaryWriter or a JSONL file."""
+
+    def __init__(self, output_path="runs/", job_name="DeepSpeedJobName"):
+        self.log_dir = os.path.join(output_path, job_name)
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._tb = None
+        self._fh = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            self._tb = SummaryWriter(log_dir=self.log_dir)
+        except Exception as e:
+            logger.warning(f"tensorboard unavailable ({e}); "
+                           f"writing JSONL events to {self.log_dir}")
+            self._fh = open(os.path.join(self.log_dir, "events.jsonl"), "a")
+
+    def add_scalar(self, tag, value, step):
+        if self._tb is not None:
+            self._tb.add_scalar(tag, float(value), int(step))
+        else:
+            self._fh.write(json.dumps(
+                {"tag": tag, "value": float(value), "step": int(step)}) + "\n")
+
+    def flush(self):
+        if self._tb is not None:
+            self._tb.flush()
+        elif self._fh is not None:
+            self._fh.flush()
+
+    def close(self):
+        if self._tb is not None:
+            self._tb.close()
+        elif self._fh is not None:
+            self._fh.close()

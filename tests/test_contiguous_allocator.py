@@ -1,0 +1,67 @@
+"""ContiguousMemoryAllocator tests — alloc/release/merge/defragment
+semantics of the reference arena (zero/contiguous_memory_allocator.py:9)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.zero.contiguous_memory_allocator import (
+    ContiguousMemoryAllocator,
+)
+
+
+def test_alloc_and_release_roundtrip():
+    a = ContiguousMemoryAllocator(100)
+    t1, v1 = a.allocate_tensor(40)
+    t2, v2 = a.allocate_tensor(40)
+    assert a.total_free == 20
+    v1[:] = 1.0
+    v2[:] = 2.0
+    a.release_tensor(t1)
+    assert a.total_free == 60
+    np.testing.assert_array_equal(a.get_tensor(t2), np.full(40, 2.0))
+
+
+def test_free_block_merging():
+    a = ContiguousMemoryAllocator(100)
+    t1, _ = a.allocate_tensor(30)
+    t2, _ = a.allocate_tensor(30)
+    t3, _ = a.allocate_tensor(30)
+    a.release_tensor(t1)
+    a.release_tensor(t3)       # tail merge with the trailing 10
+    a.release_tensor(t2)       # middle release merges everything
+    assert a.free_blocks == {0: 100}
+
+
+def test_defragment_preserves_contents():
+    a = ContiguousMemoryAllocator(100)
+    ids = []
+    for i in range(5):
+        tid, v = a.allocate_tensor(20)
+        v[:] = float(i)
+        ids.append(tid)
+    # free alternating tensors → fragmentation: free total 40, largest 20
+    a.release_tensor(ids[1])
+    a.release_tensor(ids[3])
+    assert a._largest_free() == 20
+    # needs 40 contiguous → triggers defragment
+    tid, v = a.allocate_tensor(40)
+    v[:] = 9.0
+    for i in (0, 2, 4):
+        np.testing.assert_array_equal(a.get_tensor(ids[i]),
+                                      np.full(20, float(i)))
+    np.testing.assert_array_equal(a.get_tensor(tid), np.full(40, 9.0))
+    assert a.total_free == 0
+
+
+def test_exhaustion_asserts():
+    a = ContiguousMemoryAllocator(10)
+    a.allocate_tensor(8)
+    with pytest.raises(AssertionError):
+        a.allocate_tensor(4)
+
+
+def test_views_alias_arena():
+    a = ContiguousMemoryAllocator(16)
+    tid, v = a.allocate_tensor(16)
+    v[:] = 7.0
+    assert a.buffer[0] == 7.0

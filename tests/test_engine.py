@@ -206,3 +206,23 @@ def test_sgd_optimizer():
     for _ in range(20):
         l1 = float(engine.train_batch(batch))
     assert l1 < l0
+
+
+def test_tensorboard_monitor_writes_scalars(tmp_path):
+    """Monitor subsystem: scalar stream lands in TB event files (or the
+    JSONL fallback) under output_path/job_name (reference engine.py:162,
+    1095-1105)."""
+    import os
+    cfg = base_config()
+    cfg["tensorboard"] = {"enabled": True,
+                          "output_path": str(tmp_path),
+                          "job_name": "job1"}
+    engine = make_engine(cfg)
+    batch = random_batch()
+    for _ in range(3):
+        engine.train_batch(batch)
+    log_dir = os.path.join(str(tmp_path), "job1")
+    assert os.path.isdir(log_dir) and os.listdir(log_dir)
+    assert len(engine.scalar_history) == 3
+    assert {"loss", "lr", "loss_scale", "grad_norm"} <= \
+        set(engine.scalar_history[0][1].keys())

@@ -235,3 +235,58 @@ def test_swapper_prefetch_error_attribution(tmp_path):
     sw.swap_in("good", out)
     np.testing.assert_array_equal(out, a)
     sw.release()
+
+
+def test_param_offload_host_trains():
+    """offload_param: params rest in pinned_host memory between steps and
+    stream to HBM inside the step (the TPU form of the reference's
+    ZeRO-3/Infinity param tier, partitioned_param_swapper.py:36)."""
+    import jax
+    import numpy as np
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+    from tests.simple_model import SimpleModel, random_batch, base_config
+
+    cfg = base_config()
+    cfg["zero_optimization"] = {"stage": 3,
+                                "offload_param": {"device": "cpu"}}
+    mesh = make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                       mesh=mesh)
+    from deepspeed_tpu.utils.platform import is_tpu_backend
+    # on non-TPU backends the tier downgrades to default memory (the CPU
+    # PJRT backend cannot execute cross-memory-space programs)
+    assert engine._param_offload_host == is_tpu_backend()
+    batch = random_batch()
+    l0 = float(engine.train_batch(batch))
+    for _ in range(10):
+        l1 = float(engine.train_batch(batch))
+    assert l1 < l0
+    if is_tpu_backend():
+        leaf = jax.tree_util.tree_leaves(engine.state.params)[0]
+        assert leaf.sharding.memory_kind == "pinned_host"
+    # eval path streams too
+    out = engine.eval_batch(batch)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_param_offload_multidevice_zero3():
+    import jax
+    import pytest
+    import numpy as np
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+    from tests.simple_model import SimpleModel, random_batch, base_config
+    if len(jax.devices()) < 4:
+        pytest.skip("need 4 devices")
+    cfg = base_config()
+    cfg["zero_optimization"] = {"stage": 3,
+                                "offload_param": {"device": "cpu"}}
+    mesh = make_mesh(MeshConfig(data=4), devices=jax.devices()[:4])
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                       mesh=mesh)
+    batch = random_batch()
+    l0 = float(engine.train_batch(batch))
+    for _ in range(8):
+        l1 = float(engine.train_batch(batch))
+    assert l1 < l0
