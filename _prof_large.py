@@ -1,0 +1,35 @@
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel, lm_loss
+
+def timeit(f, *a, n=6):
+    float(f(*a)[0]); float(f(*a)[0])
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = f(*a)
+    float(out[0])
+    return (time.perf_counter() - t0) / n * 1000
+
+S = 1024
+for name, E, L, H, B in (("medium", 1024, 24, 16, 8),
+                          ("large", 1280, 36, 20, 8),
+                          ("xl-ish", 1600, 24, 25, 4)):
+    ids = np.random.randint(0, 50304, (B, S)).astype(np.int32)
+    cfg = GPT2Config(vocab_size=50304, n_positions=S, n_embd=E, n_layer=L,
+                     n_head=H, dtype=jnp.bfloat16, scan_layers=True, remat=True)
+    model = GPT2LMHeadModel(cfg)
+    try:
+        params = jax.jit(lambda: model.init(jax.random.PRNGKey(0), ids[:1])["params"])()
+        jax.block_until_ready(params)
+        @jax.jit
+        def fwdbwd(p, x):
+            def loss_fn(p):
+                return lm_loss(model.apply({"params": p}, x), x)
+            return jax.value_and_grad(loss_fn)(p)
+        tb = timeit(fwdbwd, params, ids)
+        fl = 6 * cfg.num_params() * B * S + 12 * L * S * E * B * S
+        print(f"{name} (E{E} L{L} B{B}): {tb:.0f}ms mfu {fl/(tb/1e3)/197e12*100:.1f}%", flush=True)
+    except Exception as e:
+        print(f"{name}: FAILED {str(e)[:80]}", flush=True)
+    del model
