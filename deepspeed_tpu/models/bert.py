@@ -44,9 +44,12 @@ class BertConfig:
     normalize_invertible: bool = False
     gelu_checkpoint: bool = False
     attn_dropout_checkpoint: bool = False
+    # block-sparse attention layout (SparseAttentionUtils.sparse_config_for)
+    sparsity_config: Any = None
 
     def transformer_config(self) -> DeepSpeedTransformerConfig:
         return DeepSpeedTransformerConfig(
+            sparsity_config=self.sparsity_config,
             hidden_size=self.hidden_size,
             intermediate_size=self.intermediate_size,
             heads=self.num_attention_heads,

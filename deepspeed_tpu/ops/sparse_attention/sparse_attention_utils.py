@@ -1,0 +1,148 @@
+"""Sparse-attention model integration — rebuild of the reference's
+ops/sparse_attention/sparse_attention_utils.py (SparseAttentionUtils) and
+bert_sparse_self_attention.py (BertSparseSelfAttention).
+
+The reference surgically swaps `nn.Module` attention objects inside a live
+HF BERT/RoBERTa model (replace_model_self_attention_with_sparse_self_attention)
+and patches position-embedding tensors in place. Flax models are config-
+driven and parameters are explicit pytrees, so the TPU equivalents are:
+
+  * a `BertSparseSelfAttention` flax module usable as the attention block
+    of an encoder layer;
+  * config rewriting (`sparse_config_for`) instead of object surgery;
+  * pure-function helpers over parameter pytrees / batch arrays for the
+    position-embedding extension and block-size padding.
+"""
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+    SparseSelfAttention,
+    sparse_attention,
+)
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    SparsityConfig,
+    FixedSparsityConfig,
+)
+
+
+class BertSparseSelfAttention(nn.Module):
+    """BERT self-attention block computing QKV then block-sparse attention
+    (reference bert_sparse_self_attention.py:9). Drop-in for the dense
+    attention inside a BERT encoder layer: [B, S, E] → [B, S, E] context
+    (before the output projection)."""
+    hidden_size: int
+    num_attention_heads: int
+    sparsity_config: SparsityConfig
+    dtype: any = jnp.bfloat16
+    param_dtype: any = jnp.float32
+    initializer_range: float = 0.02
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask=None):
+        E = self.hidden_size
+        H = self.num_attention_heads
+        assert E % H == 0
+        B, S, _ = hidden_states.shape
+        init = nn.initializers.normal(self.initializer_range)
+        qkv = nn.Dense(3 * E, dtype=self.dtype, param_dtype=self.param_dtype,
+                       kernel_init=init, name="qkv")(hidden_states)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, S, H, E // H).transpose(0, 2, 1, 3)
+
+        op = SparseSelfAttention(self.sparsity_config)
+        ctx = op(heads(q), heads(k), heads(v),
+                 key_padding_mask=attention_mask)
+        return ctx.transpose(0, 2, 1, 3).reshape(B, S, E)
+
+
+class SparseAttentionUtils:
+    """Helpers mirroring the reference SparseAttentionUtils API."""
+
+    @staticmethod
+    def extend_position_embedding(params, max_position):
+        """Return a params pytree whose position-embedding table is extended
+        to ``max_position`` rows by tiling the learned table (the reference
+        repeats the original weights, sparse_attention_utils.py:52-80:
+        'this is a temporary hack'; it keeps the embedding distribution).
+
+        Works on any pytree containing a leaf whose path ends in
+        'position_embeddings' (our BertModel) or 'wpe' (our GPT-2)."""
+        def maybe_extend(path, leaf):
+            names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+            if not names:
+                return leaf
+            if names[-1] not in ("position_embeddings", "wpe"):
+                return leaf
+            orig, width = leaf.shape
+            if max_position <= orig:
+                return leaf
+            reps = int(np.ceil(max_position / orig))
+            return jnp.tile(leaf, (reps, 1))[:max_position]
+
+        return jax.tree_util.tree_map_with_path(maybe_extend, params)
+
+    @staticmethod
+    def update_tokenizer_model_max_length(tokenizer, max_position):
+        """Parity helper (reference :82-96): bump a HF-style tokenizer's
+        max length so it can emit extended sequences."""
+        tokenizer.model_max_length = max_position
+        if hasattr(tokenizer, "init_kwargs"):
+            tokenizer.init_kwargs["model_max_length"] = max_position
+        return tokenizer
+
+    @staticmethod
+    def sparse_config_for(bert_config, sparsity_config=None):
+        """Config rewriting in place of the reference's module surgery
+        (replace_model_self_attention_with_sparse_self_attention, :98-153):
+        returns a copy of our BertConfig with the sparse layout attached
+        (the encoder layer reads it and routes attention through the
+        block-sparse kernel)."""
+        import dataclasses
+        sparsity_config = sparsity_config or FixedSparsityConfig(
+            num_heads=bert_config.num_attention_heads)
+        return dataclasses.replace(bert_config,
+                                   sparsity_config=sparsity_config)
+
+    @staticmethod
+    def pad_to_block_size(block_size, input_ids=None, attention_mask=None,
+                          token_type_ids=None, position_ids=None,
+                          inputs_embeds=None, pad_token_id=0,
+                          model_embeddings=None):
+        """Pad sequence-dim inputs up to a multiple of the sparsity block
+        (reference :155-211). Returns (pad_len, padded tensors in the same
+        order). ``model_embeddings`` is accepted for signature parity and
+        unused (flax embeds inside the model)."""
+        seqs = [t for t in (input_ids, attention_mask, token_type_ids,
+                            position_ids, inputs_embeds) if t is not None]
+        assert seqs, "nothing to pad"
+        S = seqs[0].shape[1]
+        pad_len = (block_size - S % block_size) % block_size
+
+        def pad(t, value=0):
+            if t is None or pad_len == 0:
+                return t
+            widths = [(0, 0), (0, pad_len)] + [(0, 0)] * (t.ndim - 2)
+            return jnp.pad(t, widths, constant_values=value)
+
+        return (pad_len,
+                pad(input_ids, pad_token_id),
+                pad(attention_mask, 0),       # padded keys masked out
+                pad(token_type_ids, 0),
+                pad(position_ids, 0),
+                pad(inputs_embeds, 0))
+
+    @staticmethod
+    def unpad_sequence_output(pad_len, sequence_output):
+        """Strip the block padding from the model output (reference
+        :213-222)."""
+        if pad_len:
+            return sequence_output[:, :-pad_len]
+        return sequence_output

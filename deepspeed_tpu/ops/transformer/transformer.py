@@ -66,6 +66,10 @@ class DeepSpeedTransformerConfig:
     training: bool = True
     dtype: Any = None               # explicit compute dtype override
     param_dtype: Any = jnp.float32
+    # block-sparse attention: a SparsityConfig routes the attention through
+    # the Pallas block-sparse kernel (the reference integrates sparse
+    # attention into BERT via module surgery; here it is a config knob)
+    sparsity_config: Any = None
 
     @property
     def compute_dtype(self):
@@ -149,7 +153,18 @@ class DeepSpeedTransformerLayer(nn.Module):
                 return t.reshape(B, S, cfg.heads, cfg.head_dim) \
                         .transpose(0, 2, 1, 3)
 
-            if cfg.attn_dropout_ratio > 0 and not deterministic:
+            if cfg.sparsity_config is not None:
+                from deepspeed_tpu.ops.sparse_attention.sparse_self_attention \
+                    import sparse_attention
+                layout = cfg.sparsity_config.make_layout(S)
+                kpm = None
+                if segment_ids is not None:
+                    kpm = segment_ids != 0
+                ctx = sparse_attention(heads(q), heads(k), heads(v),
+                                       layout, cfg.sparsity_config.block,
+                                       key_padding_mask=kpm,
+                                       attn_mask=None)
+            elif cfg.attn_dropout_ratio > 0 and not deterministic:
                 # reference semantics: dropout on the softmax PROBABILITIES
                 # (csrc/transformer attn_prob dropout), not the context —
                 # needs materialized probs, so this training-with-attn-dropout

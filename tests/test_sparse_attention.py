@@ -132,3 +132,102 @@ def test_sparse_self_attention_module():
     assert out.shape == q.shape
     assert np.isfinite(np.asarray(out)).all()
     assert 64 in mod._layout_cache
+
+
+# -- integration utils (reference sparse_attention_utils.py role) -----------
+
+def test_bert_sparse_config_swap_forward():
+    """Config-level sparse swap: a BERT encoder with a sparsity_config runs
+    block-sparse attention end to end (reference
+    replace_model_self_attention_with_sparse_self_attention)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.bert import bert_tiny, BertModel
+    from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import (
+        SparseAttentionUtils,
+    )
+    from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+
+    dense_cfg = bert_tiny(max_position_embeddings=128)
+    sparse_cfg = SparseAttentionUtils.sparse_config_for(
+        dense_cfg, FixedSparsityConfig(num_heads=2, block=16,
+                                       num_local_blocks=2,
+                                       num_global_blocks=1))
+    assert sparse_cfg.sparsity_config is not None
+
+    ids = np.random.RandomState(0).randint(0, 512, (2, 64)).astype(np.int32)
+    model = BertModel(sparse_cfg)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    seq_out, pooled = model.apply({"params": params}, ids)
+    assert seq_out.shape == (2, 64, 64)
+    assert np.isfinite(np.asarray(seq_out, np.float32)).all()
+
+    # dense model with the same params differs (sparse layout masks scores)
+    dense_out, _ = BertModel(dense_cfg).apply({"params": params}, ids)
+    assert not np.allclose(np.asarray(seq_out, np.float32),
+                           np.asarray(dense_out, np.float32), atol=1e-3)
+
+
+def test_pad_unpad_to_block_size():
+    import numpy as np
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import (
+        SparseAttentionUtils,
+    )
+    ids = jnp.ones((2, 50), jnp.int32)
+    mask = jnp.ones((2, 50), jnp.int32)
+    pad_len, pids, pmask, ptok, ppos, pemb = \
+        SparseAttentionUtils.pad_to_block_size(
+            16, input_ids=ids, attention_mask=mask, pad_token_id=7)
+    assert pad_len == 14
+    assert pids.shape == (2, 64) and int(pids[0, -1]) == 7
+    assert pmask.shape == (2, 64) and int(pmask[0, -1]) == 0
+    out = jnp.zeros((2, 64, 8))
+    assert SparseAttentionUtils.unpad_sequence_output(pad_len, out).shape \
+        == (2, 50, 8)
+    # already aligned → no-op
+    pad_len, pids, *_ = SparseAttentionUtils.pad_to_block_size(
+        16, input_ids=jnp.ones((2, 64), jnp.int32))
+    assert pad_len == 0 and pids.shape == (2, 64)
+
+
+def test_extend_position_embedding():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.bert import bert_tiny, BertModel
+    from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import (
+        SparseAttentionUtils,
+    )
+    cfg = bert_tiny(max_position_embeddings=64)
+    ids = np.zeros((1, 16), np.int32)
+    params = BertModel(cfg).init(jax.random.PRNGKey(0), ids)["params"]
+    ext = SparseAttentionUtils.extend_position_embedding(params, 150)
+    tbl = ext["embeddings"]["position_embeddings"]
+    assert tbl.shape[0] == 150
+    orig = params["embeddings"]["position_embeddings"]
+    np.testing.assert_array_equal(np.asarray(tbl[:64]), np.asarray(orig))
+    np.testing.assert_array_equal(np.asarray(tbl[64:128]), np.asarray(orig))
+    # other leaves untouched
+    np.testing.assert_array_equal(
+        np.asarray(ext["embeddings"]["word_embeddings"]),
+        np.asarray(params["embeddings"]["word_embeddings"]))
+
+
+def test_bert_sparse_self_attention_module():
+    import numpy as np
+    import jax
+    from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import (
+        BertSparseSelfAttention,
+    )
+    from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+    mod = BertSparseSelfAttention(
+        hidden_size=64, num_attention_heads=2,
+        sparsity_config=FixedSparsityConfig(num_heads=2, block=16,
+                                            num_local_blocks=2))
+    x = np.random.RandomState(0).randn(2, 64, 64).astype(np.float32)
+    params = mod.init(jax.random.PRNGKey(0), x)["params"]
+    out = mod.apply({"params": params}, x)
+    assert out.shape == (2, 64, 64)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
