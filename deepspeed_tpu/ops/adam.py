@@ -51,7 +51,11 @@ class FusedAdam(TpuOptimizer):
             "exp_avg_sq": tree_zeros_like(params, jnp.float32),
         }
 
-    def step(self, params, grads, state, lr=None):
+    def step(self, params, grads, state, lr=None, grad_scale=None):
+        """``grad_scale`` folds loss-scale inverse and clip coefficient into
+        the Adam gradient read — the engine passes it instead of
+        materializing unscaled/clipped copies of the full gradient tree
+        (two saved read+write passes per step)."""
         lr = self.lr if lr is None else lr
         beta1, beta2 = self.betas
         count = state["step"] + 1
@@ -64,6 +68,8 @@ class FusedAdam(TpuOptimizer):
 
         def update_leaf(p, g, m, v):
             g32 = g.astype(jnp.float32)
+            if grad_scale is not None:
+                g32 = g32 * grad_scale
             p32 = p.astype(jnp.float32)
             if self.weight_decay != 0.0 and not self.adam_w_mode:
                 g32 = g32 + self.weight_decay * p32

@@ -35,7 +35,7 @@ class FusedLamb(TpuOptimizer):
             "exp_avg_sq": tree_zeros_like(params, jnp.float32),
         }
 
-    def step(self, params, grads, state, lr=None):
+    def step(self, params, grads, state, lr=None, grad_scale=None):
         lr = self.lr if lr is None else lr
         beta1, beta2 = self.betas
         count = state["step"] + 1
@@ -48,6 +48,8 @@ class FusedLamb(TpuOptimizer):
 
         def update_leaf(p, g, m, v):
             g32 = g.astype(jnp.float32)
+            if grad_scale is not None:
+                g32 = g32 * grad_scale
             p32 = p.astype(jnp.float32)
             m_new = beta1 * m + (1.0 - beta1) * g32
             v_new = beta2 * v + (1.0 - beta2) * (g32 * g32)

@@ -560,20 +560,25 @@ class DeepSpeedEngine:
         return lambda step: pld.theta_at(step)
 
     def _apply_grads(self, state, grads, loss):
-        """Unscale, clip, step, scaler update — one fused update."""
+        """Unscale, clip, step, scaler update — one fused update.
+
+        The loss-scale inverse and clip coefficient are folded into ONE
+        scalar passed to the optimizer's gradient read (`grad_scale`), so
+        the full gradient tree is never re-materialized for unscaling or
+        clipping (the reference does both as separate tensor passes,
+        fused_optimizer.py:194-246)."""
         cfg = self._config
         scale = state.scaler["loss_scale"]
         inv = 1.0 / scale
-        grads = jax.tree_util.tree_map(
-            lambda g: (g.astype(jnp.float32) * inv), grads)
         finite = prec.grads_finite(grads) if self.precision.fp16 \
             else jnp.asarray(True)
 
-        grad_norm = _global_norm(grads)
+        # one read-only pass: norm of the RAW (still loss-scaled) grads
+        grad_norm = _global_norm(grads) * inv
+        gscale = inv
         if cfg.gradient_clipping and cfg.gradient_clipping > 0:
-            clip_coef = jnp.minimum(
+            gscale = inv * jnp.minimum(
                 1.0, cfg.gradient_clipping / (grad_norm + 1e-6))
-            grads = jax.tree_util.tree_map(lambda g: g * clip_coef, grads)
 
         lr = self._lr_fn()(state.global_step)
         params = state.params
@@ -582,8 +587,15 @@ class DeepSpeedEngine:
             # the update (compute ops cannot mix memory spaces)
             params = jax.device_put(
                 params, self.zero.device_param_shardings(params))
-        new_params, new_opt = self.optimizer.step(params, grads,
-                                                  state.opt_state, lr)
+        if "grad_scale" in inspect.signature(
+                self.optimizer.step).parameters:
+            new_params, new_opt = self.optimizer.step(
+                params, grads, state.opt_state, lr, grad_scale=gscale)
+        else:
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32) * gscale, grads)
+            new_params, new_opt = self.optimizer.step(params, grads,
+                                                      state.opt_state, lr)
         # skip-on-overflow (reference fused_optimizer.py:194-246); done
         # before moving back so both branches live in device memory
         new_params = _tree_where(finite, new_params, params)
