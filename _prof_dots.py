@@ -1,0 +1,33 @@
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel, lm_loss
+
+def timeit(f, *a, n=6):
+    float(f(*a)[0]); float(f(*a)[0])
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = f(*a)
+    float(out[0])
+    return (time.perf_counter() - t0) / n * 1000
+
+S, B = 1024, 8
+ids = np.random.randint(0, 50304, (B, S)).astype(np.int32)
+for policy in ("dots", None):
+    cfg = GPT2Config(vocab_size=50304, n_positions=S, n_embd=1280, n_layer=36,
+                     n_head=20, dtype=jnp.bfloat16, scan_layers=True,
+                     remat=True, remat_policy=policy)
+    model = GPT2LMHeadModel(cfg)
+    try:
+        params = jax.jit(lambda: model.init(jax.random.PRNGKey(0), ids[:1])["params"])()
+        jax.block_until_ready(params)
+        @jax.jit
+        def fwdbwd(p, x):
+            def loss_fn(p):
+                return lm_loss(model.apply({"params": p}, x), x)
+            return jax.value_and_grad(loss_fn)(p)
+        tb = timeit(fwdbwd, params, ids)
+        fl = 6 * cfg.num_params() * B * S + 12 * 36 * S * 1280 * B * S
+        print(f"large policy={policy}: {tb:.0f}ms mfu {fl/(tb/1e3)/197e12*100:.1f}%", flush=True)
+    except Exception as e:
+        print(f"large policy={policy}: FAILED {str(e)[:80]}", flush=True)

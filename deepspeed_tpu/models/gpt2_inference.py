@@ -1,0 +1,161 @@
+"""GPT-2 serving path — the fused inference stack + KV-cache generation.
+
+The reference serves GPT-2/Megatron by injecting fused inference kernels
+into a live torch model (module_inject/replace_module.py:8 with
+`MegatronLayerPolicy`, kernels in csrc/transformer/inference/). Here the
+same role is a pure pytree conversion: training `GPT2LMHeadModel` params →
+`GPT2InferenceModel` (a stack of `DeepSpeedTransformerInference` layers with
+flax cache collections) + a jitted incremental `generate` loop.
+
+Decode step cost is one [B,1,E] pass over cached K/V — bandwidth-bound,
+static shapes, compiled once.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.models.gpt2 import GPT2Config
+from deepspeed_tpu.ops.transformer.inference import (
+    DeepSpeedInferenceConfig,
+    DeepSpeedTransformerInference,
+)
+
+
+def inference_config(cfg: GPT2Config, max_out_tokens: int = 0,
+                     dtype=None) -> DeepSpeedInferenceConfig:
+    return DeepSpeedInferenceConfig(
+        hidden_size=cfg.n_embd,
+        heads=cfg.n_head,
+        layer_norm_eps=cfg.layer_norm_epsilon,
+        pre_layer_norm=True,
+        triangular_masking=True,
+        max_out_tokens=max_out_tokens or cfg.n_positions,
+        dtype=dtype or cfg.dtype,
+        param_dtype=cfg.param_dtype,
+    )
+
+
+class _ScanInferenceLayer(nn.Module):
+    config: DeepSpeedInferenceConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask):
+        layer = DeepSpeedTransformerInference(self.config, name="blk")
+        return layer(x, attention_mask), None
+
+
+class GPT2InferenceModel(nn.Module):
+    """GPT-2 LM built on the fused inference layer. Param layout mirrors the
+    training model's embeddings (`wte`/`wpe`/`ln_f`) with injected fused
+    blocks under `h/blk` (scan) — produced by `convert_gpt2_params`."""
+    config: GPT2Config
+    max_out_tokens: int = 0
+
+    @nn.compact
+    def __call__(self, input_ids, position_offset=0):
+        cfg = self.config
+        icfg = inference_config(cfg, self.max_out_tokens)
+        B, S = input_ids.shape
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (cfg.n_positions, cfg.n_embd), cfg.param_dtype)
+        pos = position_offset + jnp.arange(S)
+        x = wte[input_ids].astype(cfg.dtype) \
+            + wpe[pos][None].astype(cfg.dtype)
+
+        scanned = nn.scan(_ScanInferenceLayer,
+                          variable_axes={"params": 0, "cache": 0},
+                          split_rngs={"params": True},
+                          in_axes=(nn.broadcast,),
+                          length=cfg.n_layer)
+        x, _ = scanned(icfg, name="h")(x, None)
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="ln_f")(x)
+        return jnp.einsum("bse,ve->bsv", x, wte.astype(cfg.dtype))
+
+
+def _convert_block(blk):
+    """Training Block subtree → fused inference layer subtree (the weight
+    copy of replace_module.py:24-79; orientations are identical since both
+    sides are flax Dense kernels [in, out])."""
+    return {
+        "attn_nw": dict(blk["ln_1"]),
+        "attn_qkvw": dict(blk["attn"]["c_attn"]),
+        "attn_ow": dict(blk["attn"]["c_proj"]),
+        "norm_w": dict(blk["ln_2"]),
+        "inter_w": dict(blk["mlp"]["c_fc"]),
+        "output_w": dict(blk["mlp"]["c_proj"]),
+    }
+
+
+def convert_gpt2_params(params, cfg: GPT2Config):
+    """Training `GPT2LMHeadModel` params → `GPT2InferenceModel` params.
+
+    Handles both layouts: scan-stacked (`h/blk/...` leaves with a leading
+    [L] axis — converted wholesale, the stacking carries over) and unrolled
+    (`h_0`..`h_{L-1}` — re-stacked onto a leading layer axis)."""
+    out = {"wte": params["wte"], "wpe": params["wpe"],
+           "ln_f": dict(params["ln_f"])}
+    if "h" in params:
+        out["h"] = {"blk": _convert_block(params["h"]["blk"])}
+    else:
+        blocks = [_convert_block(params[f"h_{i}"])
+                  for i in range(cfg.n_layer)]
+        out["h"] = {"blk": jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *blocks)}
+    return out
+
+
+def generate(cfg: GPT2Config, params, input_ids, max_new_tokens=20,
+             temperature: float = 0.0, rng=None, max_out_tokens: int = 0):
+    """KV-cache generation. ``temperature == 0`` → greedy. Returns
+    [B, S + max_new_tokens] token ids.
+
+    Prompt processing fills the cache in one pass; each new token is one
+    jitted single-position step (compiled once, static shapes)."""
+    input_ids = jnp.asarray(input_ids)
+    B, S = input_ids.shape
+    total = S + max_new_tokens
+    max_out = max_out_tokens or max(total, cfg.n_positions)
+    assert total <= max_out, (total, max_out)
+    model = GPT2InferenceModel(cfg, max_out_tokens=max_out)
+    iparams = params if "h" in params and "blk" in params.get("h", {}) \
+        and "attn_qkvw" in params["h"]["blk"] else \
+        convert_gpt2_params(params, cfg)
+
+    @jax.jit
+    def prompt_pass(p, ids):
+        logits, vars_ = model.apply({"params": p}, ids, mutable=["cache"])
+        return logits[:, -1], vars_["cache"]
+
+    @jax.jit
+    def decode_step(p, cache, tok, offset):
+        logits, vars_ = model.apply(
+            {"params": p, "cache": cache}, tok[:, None],
+            position_offset=offset, mutable=["cache"])
+        return logits[:, -1], vars_["cache"]
+
+    def pick(logits, r):
+        if temperature and temperature > 0:
+            return jax.random.categorical(r, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    logits, cache = prompt_pass(iparams, input_ids)
+    toks = [input_ids]
+    for i in range(max_new_tokens):
+        rng, sub = jax.random.split(rng)
+        nxt = pick(logits, sub)
+        toks.append(nxt[:, None])
+        if i + 1 < max_new_tokens:
+            # offset as a device scalar so the step compiles exactly once
+            logits, cache = decode_step(iparams, cache, nxt,
+                                        jnp.asarray(S + i, jnp.int32))
+    return jnp.concatenate(toks, axis=1)
