@@ -35,6 +35,7 @@ def inference_config(cfg: GPT2Config, max_out_tokens: int = 0,
         pre_layer_norm=True,
         triangular_masking=True,
         max_out_tokens=max_out_tokens or cfg.n_positions,
+        gelu_approximate=True,   # GPT-2 trains with tanh-approx GELU
         dtype=dtype or cfg.dtype,
         param_dtype=cfg.param_dtype,
     )
@@ -78,7 +79,10 @@ class GPT2InferenceModel(nn.Module):
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="ln_f")(x)
-        return jnp.einsum("bse,ve->bsv", x, wte.astype(cfg.dtype))
+        if cfg.tie_word_embeddings:
+            return jnp.einsum("bse,ve->bsv", x, wte.astype(cfg.dtype))
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="lm_head")(x)
 
 
 def _convert_block(blk):
@@ -103,6 +107,8 @@ def convert_gpt2_params(params, cfg: GPT2Config):
     (`h_0`..`h_{L-1}` — re-stacked onto a leading layer axis)."""
     out = {"wte": params["wte"], "wpe": params["wpe"],
            "ln_f": dict(params["ln_f"])}
+    if not cfg.tie_word_embeddings:
+        out["lm_head"] = dict(params["lm_head"])
     if "h" in params:
         out["h"] = {"blk": _convert_block(params["h"]["blk"])}
     else:
@@ -113,34 +119,55 @@ def convert_gpt2_params(params, cfg: GPT2Config):
     return out
 
 
+_STEP_CACHE = {}
+
+
+def _compiled_steps(cfg: GPT2Config, max_out: int):
+    """(prompt_pass, decode_step) jitted once per (config, cache length) —
+    repeated generate() calls hit jit's cache instead of retracing the
+    whole model per request."""
+    key = (cfg, max_out)
+    if key not in _STEP_CACHE:
+        model = GPT2InferenceModel(cfg, max_out_tokens=max_out)
+
+        @jax.jit
+        def prompt_pass(p, ids):
+            logits, vars_ = model.apply({"params": p}, ids,
+                                        mutable=["cache"])
+            return logits[:, -1], vars_["cache"]
+
+        @jax.jit
+        def decode_step(p, cache, tok, offset):
+            logits, vars_ = model.apply(
+                {"params": p, "cache": cache}, tok[:, None],
+                position_offset=offset, mutable=["cache"])
+            return logits[:, -1], vars_["cache"]
+
+        _STEP_CACHE[key] = (prompt_pass, decode_step)
+    return _STEP_CACHE[key]
+
+
 def generate(cfg: GPT2Config, params, input_ids, max_new_tokens=20,
              temperature: float = 0.0, rng=None, max_out_tokens: int = 0):
     """KV-cache generation. ``temperature == 0`` → greedy. Returns
     [B, S + max_new_tokens] token ids.
 
     Prompt processing fills the cache in one pass; each new token is one
-    jitted single-position step (compiled once, static shapes)."""
+    jitted single-position step (compiled once per config, static shapes)."""
     input_ids = jnp.asarray(input_ids)
     B, S = input_ids.shape
     total = S + max_new_tokens
-    max_out = max_out_tokens or max(total, cfg.n_positions)
+    # every emitted position needs a real learned position embedding —
+    # beyond n_positions the wpe gather would clamp and silently corrupt
+    assert total <= cfg.n_positions, (
+        f"prompt {S} + max_new_tokens {max_new_tokens} exceeds "
+        f"n_positions {cfg.n_positions}")
+    max_out = max_out_tokens or cfg.n_positions
     assert total <= max_out, (total, max_out)
-    model = GPT2InferenceModel(cfg, max_out_tokens=max_out)
+    prompt_pass, decode_step = _compiled_steps(cfg, max_out)
     iparams = params if "h" in params and "blk" in params.get("h", {}) \
         and "attn_qkvw" in params["h"]["blk"] else \
         convert_gpt2_params(params, cfg)
-
-    @jax.jit
-    def prompt_pass(p, ids):
-        logits, vars_ = model.apply({"params": p}, ids, mutable=["cache"])
-        return logits[:, -1], vars_["cache"]
-
-    @jax.jit
-    def decode_step(p, cache, tok, offset):
-        logits, vars_ = model.apply(
-            {"params": p, "cache": cache}, tok[:, None],
-            position_offset=offset, mutable=["cache"])
-        return logits[:, -1], vars_["cache"]
 
     def pick(logits, r):
         if temperature and temperature > 0:

@@ -45,6 +45,7 @@ class DeepSpeedInferenceConfig:
     mp_size: int = 1
     triangular_masking: bool = True      # causal (decoder) vs encoder
     max_out_tokens: int = 1024           # KV cache length
+    gelu_approximate: bool = False       # tanh-approx GELU (GPT-2) vs exact
     dtype: Any = None
     param_dtype: Any = jnp.float32
 
@@ -99,7 +100,10 @@ class DeepSpeedTransformerInference(nn.Module):
 
         def ffn(h):
             inter = nn.Dense(cfg.ffn_size, **dense_kw, name="inter_w")(h)
-            inter = nn.gelu(inter, approximate=False)
+            # must match the training model's GELU variant bit-for-bit or
+            # injected params serve shifted logits (GPT-2 trains with the
+            # tanh approximation; BERT with exact GELU)
+            inter = nn.gelu(inter, approximate=cfg.gelu_approximate)
             return nn.Dense(E, **dense_kw, name="output_w")(inter)
 
         if cfg.pre_layer_norm:

@@ -68,3 +68,24 @@ def test_generate_sampling_shape_and_determinism():
     assert out1.shape == (2, 17)
     np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
     assert (np.asarray(out1[:, :12]) == ids).all()
+
+
+def test_untied_embeddings_served_correctly():
+    from deepspeed_tpu.models.gpt2 import gpt2_tiny, GPT2LMHeadModel
+    cfg = gpt2_tiny(dtype=jnp.float32, tie_word_embeddings=False)
+    model = GPT2LMHeadModel(cfg)
+    ids = np.random.RandomState(1).randint(0, 512, (2, 10)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    ref = model.apply({"params": params}, ids)
+    iparams = convert_gpt2_params(params, cfg)
+    got, _ = GPT2InferenceModel(cfg, max_out_tokens=32).apply(
+        {"params": iparams}, ids, mutable=["cache"])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_generate_rejects_overlong_request():
+    import pytest
+    cfg, _, params, ids = _setup()   # n_positions = 128, prompt 12
+    with pytest.raises(AssertionError):
+        generate(cfg, params, ids, max_new_tokens=120)
