@@ -1,0 +1,83 @@
+"""HuggingFace interop — load HF GPT-2 checkpoints into the TPU framework.
+
+The reference consumes HF/Megatron models by module surgery
+(module_inject/replace_module.py) and by Megatron checkpoint resharding
+(runtime/state_dict_factory.py:272). The flax equivalents here are pure
+pytree converters: HF Flax GPT-2 params → `GPT2LMHeadModel` params (either
+unrolled or scan-stacked layout), plus config translation — so a user can
+bring an HF GPT-2 and train it under ZeRO/offload/1-bit or serve it through
+the fused inference stack (`models/gpt2_inference.py`).
+"""
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.gpt2 import GPT2Config
+
+
+def config_from_hf_gpt2(hf_config, **overrides) -> GPT2Config:
+    """transformers.GPT2Config → GPT2Config. GPT-2's activation is the tanh
+    GELU in both stacks; dtype/remat/scan knobs come from ``overrides``."""
+    base = dict(
+        vocab_size=hf_config.vocab_size,
+        n_positions=hf_config.n_positions,
+        n_embd=hf_config.n_embd,
+        n_layer=hf_config.n_layer,
+        n_head=hf_config.n_head,
+        dropout=getattr(hf_config, "resid_pdrop", 0.0),
+        layer_norm_epsilon=hf_config.layer_norm_epsilon,
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", True),
+    )
+    base.update(overrides)
+    return GPT2Config(**base)
+
+
+def _dense(conv1d):
+    """HF flax GPT-2 keeps torch Conv1D orientation: kernel [out, in].
+    nn.Dense wants [in, out]."""
+    return {"kernel": jnp.asarray(conv1d["kernel"]).T,
+            "bias": jnp.asarray(conv1d["bias"])}
+
+
+def _hf_layer(block):
+    """One HF flax GPT-2 block subtree → our Block subtree."""
+    return {
+        "ln_1": dict(block["ln_1"]),
+        "attn": {"c_attn": _dense(block["attn"]["c_attn"]),
+                 "c_proj": _dense(block["attn"]["c_proj"])},
+        "ln_2": dict(block["ln_2"]),
+        "mlp": {"c_fc": _dense(block["mlp"]["c_fc"]),
+                "c_proj": _dense(block["mlp"]["c_proj"])},
+    }
+
+
+def convert_hf_gpt2_params(hf_params, cfg: GPT2Config):
+    """HF FlaxGPT2LMHeadModel params → our GPT2LMHeadModel params.
+
+    Accepts the params dict with or without the top-level "transformer"
+    wrapper. Produces the layout matching ``cfg.scan_layers`` (scan-stacked
+    leaves under h/blk, or h_0..h_{L-1})."""
+    p = hf_params.get("transformer", hf_params)
+    out = {
+        "wte": jnp.asarray(p["wte"]["embedding"]),
+        "wpe": jnp.asarray(p["wpe"]["embedding"]),
+        "ln_f": dict(p["ln_f"]),
+    }
+    blocks = [_hf_layer(p["h"][str(i)]) for i in range(cfg.n_layer)]
+    if cfg.scan_layers:
+        out["h"] = {"blk": jax.tree_util.tree_map(
+            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *blocks)}
+    else:
+        for i, blk in enumerate(blocks):
+            out[f"h_{i}"] = blk
+    if not cfg.tie_word_embeddings and "lm_head" in hf_params:
+        out["lm_head"] = dict(hf_params["lm_head"])
+    return out
+
+
+def from_hf_gpt2(hf_model, **config_overrides):
+    """(our_config, our_params) from a transformers FlaxGPT2LMHeadModel."""
+    cfg = config_from_hf_gpt2(hf_model.config, **config_overrides)
+    return cfg, convert_hf_gpt2_params(hf_model.params, cfg)

@@ -1,0 +1,74 @@
+"""HF GPT-2 → deepspeed_tpu conversion tests: a randomly initialized
+transformers FlaxGPT2LMHeadModel must produce (near-)identical logits
+through our model after param conversion, and train under the engine."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+transformers = pytest.importorskip("transformers")
+
+
+def _hf_tiny():
+    from transformers import GPT2Config as HFConfig, FlaxGPT2LMHeadModel
+    hf_cfg = HFConfig(vocab_size=512, n_positions=128, n_embd=64,
+                      n_layer=2, n_head=2, resid_pdrop=0.0,
+                      embd_pdrop=0.0, attn_pdrop=0.0)
+    return FlaxGPT2LMHeadModel(hf_cfg, seed=0)
+
+
+def test_converted_logits_match_hf():
+    from deepspeed_tpu.models.hf_interop import from_hf_gpt2
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+
+    hf_model = _hf_tiny()
+    ids = np.random.RandomState(0).randint(0, 512, (2, 16)).astype(np.int32)
+    ref = np.asarray(hf_model(ids).logits)
+
+    for scan in (True, False):
+        cfg, params = from_hf_gpt2(hf_model, dtype=jnp.float32,
+                                   scan_layers=scan)
+        got = GPT2LMHeadModel(cfg).apply({"params": params},
+                                         jnp.asarray(ids))
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4,
+                                   atol=2e-4, err_msg=f"scan={scan}")
+
+
+def test_hf_model_trains_under_engine():
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models.hf_interop import from_hf_gpt2
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+
+    hf_model = _hf_tiny()
+    cfg, params = from_hf_gpt2(hf_model, dtype=jnp.float32,
+                               scan_layers=True)
+    engine, _, _, _ = dstpu.initialize(
+        config={"train_batch_size": 4,
+                "zero_optimization": {"stage": 2},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+        model=GPT2LMHeadModel(cfg),
+        model_parameters=params,
+        mesh=make_mesh(MeshConfig(data=1), devices=jax.devices()[:1]))
+    batch = {"input_ids": np.random.RandomState(0)
+             .randint(0, 512, (4, 32)).astype(np.int32)}
+    l0 = float(engine.train_batch(batch))
+    for _ in range(8):
+        l1 = float(engine.train_batch(batch))
+    assert l1 < l0
+
+
+def test_converted_params_serve_through_inference_stack():
+    from deepspeed_tpu.models.hf_interop import from_hf_gpt2
+    from deepspeed_tpu.models.gpt2_inference import generate
+
+    hf_model = _hf_tiny()
+    cfg, params = from_hf_gpt2(hf_model, dtype=jnp.float32,
+                               scan_layers=True)
+    ids = np.random.RandomState(0).randint(0, 512, (1, 8)).astype(np.int32)
+    out = generate(cfg, params, ids, max_new_tokens=4)
+    assert out.shape == (1, 12)
+    # greedy continuation must match HF's own greedy pick for the 1st token
+    hf_logits = np.asarray(_hf_tiny()(ids).logits)
+    assert int(out[0, 8]) == int(hf_logits[0, -1].argmax())
