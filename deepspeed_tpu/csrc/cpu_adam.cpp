@@ -86,6 +86,147 @@ void ds_adam_step_plus_copy(float* params,
   }
 }
 
+// Multi-tensor apply (reference csrc/adam/multi_tensor_adam.cu:163 /
+// multi_tensor_apply.cuh): one call steps a whole parameter list. The
+// OpenMP region spans all tensors so small leaves don't serialize on
+// per-call fork/join.
+void ds_adam_step_multi(float** params,
+                        const float** grads,
+                        float** exp_avg,
+                        float** exp_avg_sq,
+                        const int64_t* sizes,
+                        int64_t n_tensors,
+                        int64_t step,
+                        float lr,
+                        float beta1,
+                        float beta2,
+                        float eps,
+                        float weight_decay,
+                        int adamw_mode,
+                        int bias_correction) {
+  float bc1 = 1.0f, bc2 = 1.0f;
+  if (bias_correction) {
+    bc1 = 1.0f - std::pow(beta1, (float)step);
+    bc2 = 1.0f - std::pow(beta2, (float)step);
+  }
+  const float omb1 = 1.0f - beta1;
+  const float omb2 = 1.0f - beta2;
+  const float inv_bc1 = 1.0f / bc1;
+  const float inv_bc2_sqrt = 1.0f / std::sqrt(bc2);
+
+#pragma omp parallel
+  for (int64_t t = 0; t < n_tensors; ++t) {
+    float* p_ = params[t];
+    const float* g_ = grads[t];
+    float* m_ = exp_avg[t];
+    float* v_ = exp_avg_sq[t];
+    const int64_t n = sizes[t];
+#pragma omp for schedule(static) nowait
+    for (int64_t i = 0; i < n; ++i) {
+      float g = g_[i];
+      float p = p_[i];
+      if (weight_decay != 0.0f && !adamw_mode) g += weight_decay * p;
+      float m = beta1 * m_[i] + omb1 * g;
+      float v = beta2 * v_[i] + omb2 * g * g;
+      m_[i] = m;
+      v_[i] = v;
+      float denom = std::sqrt(v) * inv_bc2_sqrt + eps;
+      float update = (m * inv_bc1) / denom;
+      if (weight_decay != 0.0f && adamw_mode) update += weight_decay * p;
+      p_[i] = p - lr * update;
+    }
+  }
+}
+
+// Host LAMB step over one flat tensor (reference
+// csrc/lamb/fused_lamb_cuda_kernel.cu:469): Adam-style update, then a
+// per-tensor trust ratio ||p|| / ||update|| clamped to
+// [min_coeff, max_coeff]. Two-pass: the norms need the full update before
+// any element of p moves.
+void ds_lamb_step(float* params,
+                  const float* grads,
+                  float* exp_avg,
+                  float* exp_avg_sq,
+                  float* update_buf,   // scratch, n floats
+                  int64_t n,
+                  int64_t step,
+                  float lr,
+                  float beta1,
+                  float beta2,
+                  float eps,
+                  float weight_decay,
+                  float max_coeff,
+                  float min_coeff,
+                  int bias_correction) {
+  float bc1 = 1.0f, bc2 = 1.0f;
+  if (bias_correction) {
+    bc1 = 1.0f - std::pow(beta1, (float)step);
+    bc2 = 1.0f - std::pow(beta2, (float)step);
+  }
+  const float omb1 = 1.0f - beta1;
+  const float omb2 = 1.0f - beta2;
+  const float inv_bc1 = 1.0f / bc1;
+  const float inv_bc2_sqrt = 1.0f / std::sqrt(bc2);
+
+  double p_sq = 0.0, u_sq = 0.0;
+#pragma omp parallel for schedule(static) reduction(+ : p_sq, u_sq)
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grads[i];
+    float p = params[i];
+    float m = beta1 * exp_avg[i] + omb1 * g;
+    float v = beta2 * exp_avg_sq[i] + omb2 * g * g;
+    exp_avg[i] = m;
+    exp_avg_sq[i] = v;
+    float denom = std::sqrt(v) * inv_bc2_sqrt + eps;
+    float u = (m * inv_bc1) / denom;
+    if (weight_decay != 0.0f) u += weight_decay * p;
+    update_buf[i] = u;
+    p_sq += (double)p * p;
+    u_sq += (double)u * u;
+  }
+  float trust = 1.0f;
+  if (p_sq > 0.0 && u_sq > 0.0) {
+    trust = (float)(std::sqrt(p_sq) / std::sqrt(u_sq));
+    if (trust > max_coeff) trust = max_coeff;
+    if (trust < min_coeff) trust = min_coeff;
+  }
+  const float step_size = lr * trust;
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    params[i] -= step_size * update_buf[i];
+  }
+}
+
+// Staging conversions for the offload tiers (the reference's overlapped
+// fp16 copy tiles, cpu_adam.cpp:67): round-to-nearest-even fp32→bf16 and
+// the exact widening bf16→fp32.
+void ds_fp32_to_bf16(const float* src, uint16_t* dst, int64_t n) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t bits;
+    __builtin_memcpy(&bits, &src[i], 4);
+    uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
+    dst[i] = (uint16_t)((bits + rounding) >> 16);
+  }
+}
+
+void ds_bf16_to_fp32(const uint16_t* src, float* dst, int64_t n) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t bits = ((uint32_t)src[i]) << 16;
+    __builtin_memcpy(&dst[i], &bits, 4);
+  }
+}
+
+// L2 norm over a flat tensor (fp64 accumulation) — host-side grad-norm for
+// the offload clip path.
+double ds_l2_norm_sq(const float* x, int64_t n) {
+  double acc = 0.0;
+#pragma omp parallel for schedule(static) reduction(+ : acc)
+  for (int64_t i = 0; i < n; ++i) acc += (double)x[i] * x[i];
+  return acc;
+}
+
 int ds_adam_num_threads() {
 #ifdef _OPENMP
   return omp_get_max_threads();

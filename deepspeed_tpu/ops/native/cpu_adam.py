@@ -10,32 +10,112 @@ from deepspeed_tpu.ops.native.builder import CPUAdamBuilder
 _lib = None
 
 
+_F32P = ctypes.POINTER(ctypes.c_float)
+_U16P = ctypes.POINTER(ctypes.c_uint16)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+
+
+def _check(*arrays, dtype=np.float32):
+    for arr in arrays:
+        assert isinstance(arr, np.ndarray) and arr.dtype == dtype \
+            and arr.flags["C_CONTIGUOUS"], f"need contiguous {dtype} arrays"
+
+
 class _NativeCpuAdam:
     def __init__(self, lib):
         self.lib = lib
-        f32p = ctypes.POINTER(ctypes.c_float)
         lib.ds_adam_step.argtypes = [
-            f32p, f32p, f32p, f32p,
+            _F32P, _F32P, _F32P, _F32P,
             ctypes.c_int64, ctypes.c_int64,
             ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
             ctypes.c_float, ctypes.c_int, ctypes.c_int]
         lib.ds_adam_step.restype = None
+        lib.ds_adam_step_multi.argtypes = [
+            ctypes.POINTER(_F32P), ctypes.POINTER(_F32P),
+            ctypes.POINTER(_F32P), ctypes.POINTER(_F32P), _I64P,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_int, ctypes.c_int]
+        lib.ds_adam_step_multi.restype = None
+        lib.ds_lamb_step.argtypes = [
+            _F32P, _F32P, _F32P, _F32P, _F32P,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int]
+        lib.ds_lamb_step.restype = None
+        lib.ds_fp32_to_bf16.argtypes = [_F32P, _U16P, ctypes.c_int64]
+        lib.ds_bf16_to_fp32.argtypes = [_U16P, _F32P, ctypes.c_int64]
+        lib.ds_l2_norm_sq.argtypes = [_F32P, ctypes.c_int64]
+        lib.ds_l2_norm_sq.restype = ctypes.c_double
         lib.ds_adam_num_threads.restype = ctypes.c_int
 
     def adam_step(self, params, grads, exp_avg, exp_avg_sq, step, lr,
                   beta1, beta2, eps, weight_decay, adamw_mode,
                   bias_correction=True):
-        for arr in (params, grads, exp_avg, exp_avg_sq):
-            assert isinstance(arr, np.ndarray) and arr.dtype == np.float32 \
-                and arr.flags["C_CONTIGUOUS"], "need contiguous fp32 arrays"
-        n = params.size
-        f32p = ctypes.POINTER(ctypes.c_float)
+        _check(params, grads, exp_avg, exp_avg_sq)
         self.lib.ds_adam_step(
-            params.ctypes.data_as(f32p), grads.ctypes.data_as(f32p),
-            exp_avg.ctypes.data_as(f32p), exp_avg_sq.ctypes.data_as(f32p),
-            n, int(step), float(lr), float(beta1), float(beta2), float(eps),
-            float(weight_decay), int(bool(adamw_mode)),
+            params.ctypes.data_as(_F32P), grads.ctypes.data_as(_F32P),
+            exp_avg.ctypes.data_as(_F32P), exp_avg_sq.ctypes.data_as(_F32P),
+            params.size, int(step), float(lr), float(beta1), float(beta2),
+            float(eps), float(weight_decay), int(bool(adamw_mode)),
             int(bool(bias_correction)))
+
+    def adam_step_multi(self, params, grads, exp_avg, exp_avg_sq, step, lr,
+                        beta1, beta2, eps, weight_decay, adamw_mode,
+                        bias_correction=True):
+        """One call for a whole leaf list (reference multi-tensor apply)."""
+        n = len(params)
+        assert n == len(grads) == len(exp_avg) == len(exp_avg_sq)
+        for group in (params, grads, exp_avg, exp_avg_sq):
+            _check(*group)
+
+        def ptr_array(group):
+            return (_F32P * n)(*(a.ctypes.data_as(_F32P) for a in group))
+
+        sizes = (ctypes.c_int64 * n)(*(a.size for a in params))
+        self.lib.ds_adam_step_multi(
+            ptr_array(params), ptr_array(grads), ptr_array(exp_avg),
+            ptr_array(exp_avg_sq), sizes, n, int(step), float(lr),
+            float(beta1), float(beta2), float(eps), float(weight_decay),
+            int(bool(adamw_mode)), int(bool(bias_correction)))
+
+    def lamb_step(self, params, grads, exp_avg, exp_avg_sq, step, lr,
+                  beta1, beta2, eps, weight_decay, max_coeff, min_coeff,
+                  bias_correction=True, update_buf=None):
+        _check(params, grads, exp_avg, exp_avg_sq)
+        if update_buf is None:
+            update_buf = np.empty_like(params)
+        self.lib.ds_lamb_step(
+            params.ctypes.data_as(_F32P), grads.ctypes.data_as(_F32P),
+            exp_avg.ctypes.data_as(_F32P), exp_avg_sq.ctypes.data_as(_F32P),
+            update_buf.ctypes.data_as(_F32P),
+            params.size, int(step), float(lr), float(beta1), float(beta2),
+            float(eps), float(weight_decay), float(max_coeff),
+            float(min_coeff), int(bool(bias_correction)))
+
+    def fp32_to_bf16(self, src, dst=None):
+        _check(src)
+        if dst is None:
+            dst = np.empty(src.shape, np.uint16)
+        _check(dst, dtype=np.uint16)
+        self.lib.ds_fp32_to_bf16(src.ctypes.data_as(_F32P),
+                                 dst.ctypes.data_as(_U16P), src.size)
+        return dst
+
+    def bf16_to_fp32(self, src, dst=None):
+        _check(src, dtype=np.uint16)
+        if dst is None:
+            dst = np.empty(src.shape, np.float32)
+        _check(dst)
+        self.lib.ds_bf16_to_fp32(src.ctypes.data_as(_U16P),
+                                 dst.ctypes.data_as(_F32P), src.size)
+        return dst
+
+    def l2_norm(self, arr):
+        _check(arr)
+        import math
+        return math.sqrt(self.lib.ds_l2_norm_sq(
+            arr.ctypes.data_as(_F32P), arr.size))
 
     def num_threads(self):
         return self.lib.ds_adam_num_threads()

@@ -27,14 +27,17 @@ class HostOffloadOptimizer:
     """Holds fp32 master state on host; applies native Adam per leaf."""
 
     def __init__(self, params_device, optimizer, offload_cfg, aio_cfg=None):
-        # the host step is Adam/AdamW; anything else would silently train
-        # with the wrong algorithm (the reference likewise restricts offload
-        # to DeepSpeedCPUAdam, stage2.py:747)
+        # host steps exist for Adam/AdamW (SIMD ds_adam_step) and LAMB
+        # (ds_lamb_step); anything else would silently train with the wrong
+        # algorithm (the reference restricts offload to DeepSpeedCPUAdam,
+        # stage2.py:747 — LAMB offload is a TPU-side extension)
         from deepspeed_tpu.ops.adam import FusedAdam
-        if not isinstance(optimizer, FusedAdam):
+        from deepspeed_tpu.ops.lamb import FusedLamb
+        if not isinstance(optimizer, (FusedAdam, FusedLamb)):
             raise ValueError(
-                f"optimizer offload supports Adam/AdamW-family optimizers "
+                f"optimizer offload supports Adam/AdamW/LAMB optimizers "
                 f"only, got {type(optimizer).__name__}")
+        self.is_lamb = isinstance(optimizer, FusedLamb)
         self.optimizer = optimizer
         self.device_nvme = offload_cfg.device == C.OFFLOAD_NVME_DEVICE
         self.step_count = 0
@@ -71,9 +74,13 @@ class HostOffloadOptimizer:
                     eps=getattr(opt, "eps", 1e-8),
                     weight_decay=getattr(opt, "weight_decay", 0.0),
                     adamw_mode=getattr(opt, "adam_w_mode", True),
-                    bias_correction=getattr(opt, "bias_correction", True))
+                    bias_correction=getattr(opt, "bias_correction", True),
+                    max_coeff=getattr(opt, "max_coeff", 10.0),
+                    min_coeff=getattr(opt, "min_coeff", 0.01))
 
     def _apply_leaf(self, p, g, m, v, lr, hyper):
+        if self.is_lamb:
+            return self._apply_leaf_lamb(p, g, m, v, lr, hyper)
         if self._native is not None:
             self._native.adam_step(p.reshape(-1), np.ascontiguousarray(
                 g.reshape(-1)), m.reshape(-1), v.reshape(-1),
@@ -95,10 +102,52 @@ class HostOffloadOptimizer:
             update = update + hyper["weight_decay"] * p
         p -= lr * update
 
+    def _apply_leaf_lamb(self, p, g, m, v, lr, hyper):
+        g = np.ascontiguousarray(g.reshape(-1), dtype=np.float32)
+        pf, mf, vf = p.reshape(-1), m.reshape(-1), v.reshape(-1)
+        if self._native is not None:
+            self._native.lamb_step(
+                pf, g, mf, vf, self.step_count, lr, hyper["beta1"],
+                hyper["beta2"], hyper["eps"], hyper["weight_decay"],
+                hyper["max_coeff"], hyper["min_coeff"],
+                hyper["bias_correction"])
+            return
+        beta1, beta2 = hyper["beta1"], hyper["beta2"]
+        bc1 = 1 - beta1 ** self.step_count if hyper["bias_correction"] else 1.0
+        bc2 = 1 - beta2 ** self.step_count if hyper["bias_correction"] else 1.0
+        mf *= beta1
+        mf += (1 - beta1) * g
+        vf *= beta2
+        vf += (1 - beta2) * g * g
+        update = (mf / bc1) / (np.sqrt(vf / bc2) + hyper["eps"])
+        if hyper["weight_decay"]:
+            update += hyper["weight_decay"] * pf
+        p_norm = float(np.linalg.norm(pf))
+        u_norm = float(np.linalg.norm(update))
+        trust = 1.0
+        if p_norm > 0 and u_norm > 0:
+            trust = np.clip(p_norm / max(u_norm, 1e-12),
+                            hyper["min_coeff"], hyper["max_coeff"])
+        pf -= lr * trust * update
+
     def step(self, grads_np: List[np.ndarray], lr: float):
         self.step_count += 1
         hyper = self._hyper()
         n = len(self.master)
+        if self.swapper is None and self._native is not None \
+                and not self.is_lamb:
+            # CPU tier, Adam: one multi-tensor native call (OpenMP spans the
+            # whole leaf list — reference multi_tensor_apply)
+            grads = [np.ascontiguousarray(np.asarray(g, np.float32)
+                                          .reshape(-1)) for g in grads_np]
+            self._native.adam_step_multi(
+                [p.reshape(-1) for p in self.master], grads,
+                [m.reshape(-1) for m in self.m],
+                [v.reshape(-1) for v in self.v],
+                self.step_count, lr, hyper["beta1"], hyper["beta2"],
+                hyper["eps"], hyper["weight_decay"], hyper["adamw_mode"],
+                hyper["bias_correction"])
+            return self.master
         if self.swapper is not None and n > 0:
             self.swapper.prefetch(0)
         for i in range(n):

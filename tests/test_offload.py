@@ -290,3 +290,100 @@ def test_param_offload_multidevice_zero3():
     for _ in range(8):
         l1 = float(engine.train_batch(batch))
     assert l1 < l0
+
+
+# -- native kernel extensions ------------------------------------------------
+
+def _native():
+    import pytest
+    try:
+        from deepspeed_tpu.ops.native import cpu_adam
+        return cpu_adam.load()
+    except Exception as e:
+        pytest.skip(f"native lib unavailable: {e}")
+
+
+def test_native_multi_tensor_adam_matches_single():
+    import numpy as np
+    lib = _native()
+    rng = np.random.RandomState(0)
+    shapes = [(1000,), (33,), (257,)]
+    ps = [rng.randn(*s).astype(np.float32) for s in shapes]
+    gs = [rng.randn(*s).astype(np.float32) for s in shapes]
+    ms = [np.zeros(s, np.float32) for s in shapes]
+    vs = [np.zeros(s, np.float32) for s in shapes]
+    ps2 = [p.copy() for p in ps]
+    ms2 = [m.copy() for m in ms]
+    vs2 = [v.copy() for v in vs]
+    args = dict(step=3, lr=1e-2, beta1=0.9, beta2=0.999, eps=1e-8,
+                weight_decay=0.01, adamw_mode=True)
+    for p, g, m, v in zip(ps, gs, ms, vs):
+        lib.adam_step(p, g, m, v, **args)
+    lib.adam_step_multi(ps2, gs, ms2, vs2, **args)
+    for a, b in zip(ps, ps2):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_native_lamb_matches_jit_lamb():
+    import numpy as np
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.lamb import FusedLamb
+    lib = _native()
+    rng = np.random.RandomState(1)
+    p = rng.randn(512).astype(np.float32)
+    g = rng.randn(512).astype(np.float32)
+    opt = FusedLamb(lr=1e-2, weight_decay=0.01)
+    state = opt.init({"w": jnp.asarray(p)})
+    jp, jstate = {"w": jnp.asarray(p)}, state
+    np_p, np_m, np_v = p.copy(), np.zeros(512, np.float32), \
+        np.zeros(512, np.float32)
+    for step in range(1, 4):
+        jp, jstate = opt.step(jp, {"w": jnp.asarray(g)}, jstate)
+        lib.lamb_step(np_p, g, np_m, np_v, step, 1e-2, 0.9, 0.999, 1e-8,
+                      0.01, 10.0, 0.01)
+    np.testing.assert_allclose(np_p, np.asarray(jp["w"]), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_native_bf16_conversions_roundtrip():
+    import numpy as np
+    import jax.numpy as jnp
+    lib = _native()
+    x = np.random.RandomState(2).randn(4096).astype(np.float32)
+    bf = lib.fp32_to_bf16(x)
+    # match jax's RNE fp32->bf16
+    ref = np.asarray(jnp.asarray(x).astype(jnp.bfloat16)).view(np.uint16)
+    np.testing.assert_array_equal(bf, ref)
+    back = lib.bf16_to_fp32(bf)
+    np.testing.assert_array_equal(
+        back, np.asarray(jnp.asarray(x).astype(jnp.bfloat16)
+                         .astype(jnp.float32)))
+
+
+def test_native_l2_norm():
+    import numpy as np
+    lib = _native()
+    x = np.random.RandomState(3).randn(10000).astype(np.float32)
+    np.testing.assert_allclose(lib.l2_norm(x), np.linalg.norm(x), rtol=1e-6)
+
+
+def test_lamb_offload_trains():
+    """LAMB under the host-offload tier (TPU-side extension of the
+    reference's Adam-only offload)."""
+    import numpy as np
+    import jax
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+    from tests.simple_model import SimpleModel, random_batch, base_config
+    cfg = base_config()
+    cfg["optimizer"] = {"type": "Lamb", "params": {"lr": 1e-2}}
+    cfg["zero_optimization"] = {"stage": 2,
+                                "offload_optimizer": {"device": "cpu"}}
+    mesh = make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                       mesh=mesh)
+    batch = random_batch()
+    l0 = float(engine.train_batch(batch))
+    for _ in range(10):
+        l1 = float(engine.train_batch(batch))
+    assert l1 < l0
