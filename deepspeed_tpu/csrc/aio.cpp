@@ -18,6 +18,7 @@
 #include <cstdio>
 #include <cstring>
 #include <deque>
+#include <memory>
 #include <mutex>
 #include <thread>
 #include <vector>
@@ -33,6 +34,9 @@ struct Request {
   int64_t nbytes;
   int64_t offset;
   bool write;
+  // pieces of one user-submitted transfer share a countdown so `completed`
+  // counts USER requests, not internal split chunks
+  std::shared_ptr<std::atomic<int64_t>> remaining;
 };
 
 struct Handle {
@@ -69,13 +73,45 @@ struct Handle {
     for (auto& t : workers) t.join();
   }
 
-  void submit(const Request& r) {
+  void submit(Request r) {
+    r.remaining = std::make_shared<std::atomic<int64_t>>(1);
     {
       std::lock_guard<std::mutex> lk(mu);
-      queue.push_back(r);
+      queue.push_back(std::move(r));
       inflight.fetch_add(1);
     }
     cv_work.notify_one();
+  }
+
+  // Fan one large transfer across the worker pool (the reference slices a
+  // tensor across its thread pool, deepspeed_aio_thread.cpp:84): split into
+  // block_size pieces, capped at queue_depth*thread_count pieces so tiny
+  // blocks don't drown the queue in bookkeeping.
+  void submit_split(const Request& r) {
+    const int64_t max_pieces =
+        (int64_t)queue_depth * (thread_count > 0 ? thread_count : 1);
+    int64_t pieces = (r.nbytes + block_size - 1) / block_size;
+    if (pieces > max_pieces) pieces = max_pieces;
+    if (pieces <= 1 || thread_count <= 1) {
+      submit(r);
+      return;
+    }
+    const int64_t piece = (r.nbytes + pieces - 1) / pieces;
+    auto remaining = std::make_shared<std::atomic<int64_t>>(
+        (r.nbytes + piece - 1) / piece);
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      for (int64_t off = 0; off < r.nbytes; off += piece) {
+        Request sub = r;
+        sub.buf = static_cast<char*>(r.buf) + off;
+        sub.offset = r.offset + off;
+        sub.nbytes = std::min(piece, r.nbytes - off);
+        sub.remaining = remaining;
+        queue.push_back(std::move(sub));
+        inflight.fetch_add(1);
+      }
+    }
+    cv_work.notify_all();
   }
 
   void run() {
@@ -103,7 +139,7 @@ struct Handle {
         done += rc;
       }
       if (failed) errors.fetch_add(1);
-      completed.fetch_add(1);
+      if (r.remaining->fetch_sub(1) == 1) completed.fetch_add(1);
       // decrement+notify under mu: a waiter that checked the predicate but
       // has not yet blocked must not miss this wakeup
       {
@@ -139,13 +175,14 @@ int aio_open(const char* path, int for_write) {
 
 void aio_close(int fd) { close(fd); }
 
-// async: enqueue and return immediately; pair with aio_handle_wait
+// async: enqueue and return immediately; pair with aio_handle_wait.
+// Large transfers split across the worker pool.
 void aio_pread(void* h, int fd, void* buf, int64_t nbytes, int64_t offset) {
-  static_cast<Handle*>(h)->submit({fd, buf, nbytes, offset, false});
+  static_cast<Handle*>(h)->submit_split({fd, buf, nbytes, offset, false});
 }
 
 void aio_pwrite(void* h, int fd, void* buf, int64_t nbytes, int64_t offset) {
-  static_cast<Handle*>(h)->submit({fd, buf, nbytes, offset, true});
+  static_cast<Handle*>(h)->submit_split({fd, buf, nbytes, offset, true});
 }
 
 int64_t aio_handle_wait(void* h) { return static_cast<Handle*>(h)->wait(); }
@@ -160,14 +197,14 @@ int64_t aio_handle_errors(void* h) {
 int64_t aio_sync_pread(void* h, int fd, void* buf, int64_t nbytes,
                        int64_t offset) {
   auto* handle = static_cast<Handle*>(h);
-  handle->submit({fd, buf, nbytes, offset, false});
+  handle->submit_split({fd, buf, nbytes, offset, false});
   return handle->wait();
 }
 
 int64_t aio_sync_pwrite(void* h, int fd, void* buf, int64_t nbytes,
                         int64_t offset) {
   auto* handle = static_cast<Handle*>(h);
-  handle->submit({fd, buf, nbytes, offset, true});
+  handle->submit_split({fd, buf, nbytes, offset, true});
   return handle->wait();
 }
 
