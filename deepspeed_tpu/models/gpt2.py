@@ -39,6 +39,7 @@ class GPT2Config:
     param_dtype: Any = jnp.float32     # master params
     remat: bool = False
     remat_policy: Optional[str] = None  # None=full remat | "dots" | "offload"
+    sp_backend: str = "ring"            # "ring" | "ulysses" (seq-axis attn)
     scan_layers: bool = True
     use_flash: Optional[bool] = None   # None = auto (TPU yes)
     tie_word_embeddings: bool = True
@@ -68,14 +69,20 @@ class SelfAttention(nn.Module):
             return t.reshape(B, S, cfg.n_head, cfg.head_dim).transpose(0, 2, 1, 3)
 
         # sequence parallelism: when the active mesh has a seq axis, run
-        # ring attention over it instead of letting GSPMD gather full K/V
+        # ring or Ulysses attention over it instead of letting GSPMD gather
+        # full K/V
         from deepspeed_tpu.parallel import mesh as mesh_lib
         mesh = mesh_lib.current_mesh()
         if mesh is not None and mesh.shape.get(mesh_lib.SEQ_AXIS, 1) > 1 \
                 and S % mesh.shape[mesh_lib.SEQ_AXIS] == 0:
-            from deepspeed_tpu.parallel.ring_attention import ring_attention
-            out = ring_attention(heads(q), heads(k), heads(v), mesh,
-                                 causal=True)
+            if cfg.sp_backend == "ulysses":
+                from deepspeed_tpu.parallel.ulysses import ulysses_attention
+                out = ulysses_attention(heads(q), heads(k), heads(v), mesh,
+                                        causal=True)
+            else:
+                from deepspeed_tpu.parallel.ring_attention import ring_attention
+                out = ring_attention(heads(q), heads(k), heads(v), mesh,
+                                     causal=True)
         else:
             out = dot_product_attention(heads(q), heads(k), heads(v),
                                         causal=True, use_flash=cfg.use_flash)
