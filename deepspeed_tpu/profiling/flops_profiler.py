@@ -64,6 +64,14 @@ class FlopsProfiler:
         }
         logger.info(f"[flops_profiler] params={n_params/1e6:.2f}M "
                     f"flops/step={flops/1e9:.2f} GFLOPs")
+        cfg = eng._config.flops_profiler_config
+        if getattr(cfg, "detailed", False):
+            table = module_breakdown(
+                eng.module, eng._model_inputs(batch),
+                depth=getattr(cfg, "module_depth", 2))
+            if table:
+                self.last_profile["module_breakdown"] = table
+                logger.info("\n" + table)
         return self.last_profile
 
     def _measure(self, state, batch, rng):
@@ -90,9 +98,25 @@ def duration_of(fn, *args, warmup=1, iters=3):
     return (time.perf_counter() - t0) / iters
 
 
+def module_breakdown(model, example_input, depth=2, rng=None):
+    """Per-module flops/params table (the reference's annotated model tree,
+    profiler.py:print_model_profile) via flax tabulate over the module
+    hierarchy; depth mirrors the `module_depth` config knob."""
+    try:
+        import flax.linen as nn
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        depth = None if depth is None or depth < 0 else int(depth)
+        tab = nn.tabulate(model, rng, compute_flops=True, depth=depth)
+        return tab(example_input)
+    except Exception as e:  # tabulate needs a traceable example input
+        logger.warning(f"module breakdown unavailable: {e}")
+        return ""
+
+
 def get_model_profile(model, input_shape, rng=None, detailed=False):
     """Standalone entry mirroring the reference's get_model_profile: returns
-    (flops, macs_estimate, params) for a flax model's forward pass."""
+    (flops, macs_estimate, params) for a flax model's forward pass; with
+    ``detailed`` also logs the per-module table."""
     import jax.numpy as jnp
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     x = jnp.zeros(input_shape, jnp.int32)
@@ -103,4 +127,8 @@ def get_model_profile(model, input_shape, rng=None, detailed=False):
         return model.apply({"params": p}, xx)
 
     flops, cost = flops_of_jitted(fwd, params, x)
+    if detailed:
+        table = module_breakdown(model, x)
+        if table:
+            logger.info("\n" + table)
     return flops, flops / 2.0, params_count(params)

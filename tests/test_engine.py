@@ -226,3 +226,17 @@ def test_tensorboard_monitor_writes_scalars(tmp_path):
     assert len(engine.scalar_history) == 3
     assert {"loss", "lr", "loss_scale", "grad_norm"} <= \
         set(engine.scalar_history[0][1].keys())
+
+
+def test_flops_profiler_detailed_breakdown():
+    """detailed mode emits the per-module table (reference
+    print_model_profile role)."""
+    from deepspeed_tpu.profiling.flops_profiler import (
+        module_breakdown, get_model_profile)
+    from deepspeed_tpu.models.gpt2 import gpt2_tiny, GPT2LMHeadModel
+    import numpy as np
+    model = GPT2LMHeadModel(gpt2_tiny())
+    table = module_breakdown(model, np.zeros((1, 8), np.int32), depth=2)
+    assert "GPT2LMHeadModel" in table and "flops" in table
+    flops, macs, n_params = get_model_profile(model, (1, 8))
+    assert flops > 0 and n_params > 0
