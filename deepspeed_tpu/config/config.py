@@ -17,7 +17,7 @@ from deepspeed_tpu.config import constants as C
 from deepspeed_tpu.utils.logging import logger
 
 
-class DeepSpeedConfigError(Exception):
+class DeepSpeedConfigError(ValueError):
     pass
 
 

@@ -255,6 +255,19 @@ class DeepSpeedEngine:
         # ZeRO-Offload: optimizer state + fp32 master on host (cpu) or NVMe
         self._offload_cfg = self._config.zero_config.offload_optimizer
         self._host_runner = None
+        if self._offload_cfg.enabled:
+            # fail at construction, not at the first train_batch: the host
+            # tier only has SIMD steps for the Adam/LAMB families, and the
+            # NVMe tier needs somewhere to put the moments
+            from deepspeed_tpu.ops.lamb import FusedLamb
+            if not isinstance(self.optimizer, (FusedAdam, FusedLamb)):
+                raise ValueError(
+                    "optimizer offload supports Adam/AdamW/LAMB optimizers "
+                    f"only, got {type(self.optimizer).__name__}")
+            if self._offload_cfg.device == C.OFFLOAD_NVME_DEVICE and \
+                    not self._offload_cfg.nvme_path:
+                raise ValueError(
+                    "offload_optimizer device=nvme requires nvme_path")
 
         self._rng = rng if rng is not None else jax.random.PRNGKey(self._config.seed)
         self.state: Optional[TrainState] = None

@@ -1,0 +1,76 @@
+"""Error-path behavior: bad configs and misuse must fail with clear
+messages (the verify-probe tier)."""
+
+import numpy as np
+import pytest
+import jax
+
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+from tests.simple_model import SimpleModel, random_batch, base_config
+
+
+def _mesh1():
+    return make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+
+
+def test_missing_config_raises():
+    with pytest.raises(ValueError, match="deepspeed_config"):
+        dstpu.initialize(model=SimpleModel())
+
+
+def test_unknown_optimizer_raises():
+    cfg = base_config()
+    cfg["optimizer"] = {"type": "AdaGoober", "params": {}}
+    with pytest.raises(ValueError, match="[Uu]nknown optimizer"):
+        dstpu.initialize(config=cfg, model=SimpleModel(), mesh=_mesh1())
+
+
+def test_bad_config_path_raises():
+    with pytest.raises((FileNotFoundError, ValueError)):
+        dstpu.initialize(config="/nonexistent/ds_config.json",
+                         model=SimpleModel(), mesh=_mesh1())
+
+
+def test_batch_not_divisible_by_gas_raises():
+    cfg = base_config()
+    cfg["train_batch_size"] = 8
+    cfg["gradient_accumulation_steps"] = 4
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                       mesh=_mesh1())
+    x, y = random_batch(batch_size=6)   # 6 not divisible by gas=4
+    with pytest.raises(Exception, match="divisible|gradient_accumulation"):
+        engine.train_batch((x, y))
+
+
+def test_invalid_zero_stage_raises():
+    cfg = base_config()
+    cfg["zero_optimization"] = {"stage": 7}
+    with pytest.raises(Exception):
+        dstpu.initialize(config=cfg, model=SimpleModel(), mesh=_mesh1())
+
+
+def test_batch_triangle_conflict_raises():
+    cfg = base_config()
+    cfg["train_batch_size"] = 8
+    cfg["train_micro_batch_size_per_gpu"] = 3
+    cfg["gradient_accumulation_steps"] = 2   # 3*2 != 8
+    with pytest.raises(Exception, match="batch"):
+        dstpu.initialize(config=cfg, model=SimpleModel(), mesh=_mesh1())
+
+
+def test_offload_rejects_sgd():
+    cfg = base_config()
+    cfg["optimizer"] = {"type": "SGD", "params": {"lr": 0.1}}
+    cfg["zero_optimization"] = {"stage": 2,
+                                "offload_optimizer": {"device": "cpu"}}
+    with pytest.raises(ValueError, match="Adam|LAMB"):
+        dstpu.initialize(config=cfg, model=SimpleModel(), mesh=_mesh1())
+
+
+def test_nvme_offload_requires_path():
+    cfg = base_config()
+    cfg["zero_optimization"] = {"stage": 2,
+                                "offload_optimizer": {"device": "nvme"}}
+    with pytest.raises(Exception, match="nvme_path"):
+        dstpu.initialize(config=cfg, model=SimpleModel(), mesh=_mesh1())

@@ -191,10 +191,10 @@ def test_offload_rejects_non_adam_optimizer():
     cfg["optimizer"] = {"type": "SGD", "params": {"lr": 1e-2}}
     cfg["zero_optimization"] = {"stage": 2,
                                 "offload_optimizer": {"device": "cpu"}}
-    engine, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
-                                       mesh=one_device_mesh())
+    # rejection now happens at construction, not at the first step
     with pytest.raises(ValueError, match="Adam"):
-        engine.train_batch(random_batch())
+        dstpu.initialize(config=cfg, model=SimpleModel(),
+                         mesh=one_device_mesh())
 
 
 def test_swapper_prefetch_no_fd_leak(tmp_path):
