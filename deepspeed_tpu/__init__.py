@@ -53,7 +53,8 @@ def initialize(args=None,
                collate_fn=None,
                config=None,
                config_params=None,
-               rng=None):
+               rng=None,
+               loss_fn=None):
     """Initialize the engine — mirrors ``deepspeed.initialize``
     (reference deepspeed/__init__.py:54).
 
@@ -103,7 +104,8 @@ def initialize(args=None,
                         mpu=mpu,
                         collate_fn=collate_fn,
                         config=config,
-                        rng=rng)
+                        rng=rng,
+                        loss_fn=loss_fn)
 
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 

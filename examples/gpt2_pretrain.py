@@ -1,0 +1,63 @@
+"""GPT-2 pretraining example — the Megatron-GPT2 configs of the reference
+perf harness (BASELINE.json config 3): GPT-2 under ZeRO-2/3 with optional
+tensor/sequence parallel axes, on synthetic token streams.
+
+Run:  python examples/gpt2_pretrain.py --model medium --zero 3 --steps 20
+Multi-host: dstpu --hostfile hf examples/gpt2_pretrain.py --zero 3
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax.numpy as jnp
+
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.models import gpt2 as gpt2_lib
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="small",
+                    choices=["tiny", "small", "medium", "large", "xl"])
+    ap.add_argument("--zero", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--sp", type=int, default=1)
+    dstpu.add_config_arguments(ap)
+    args = ap.parse_args()
+
+    cfg_fn = {"tiny": gpt2_lib.gpt2_tiny, "small": gpt2_lib.gpt2_small,
+              "medium": gpt2_lib.gpt2_medium, "large": gpt2_lib.gpt2_large,
+              "xl": gpt2_lib.gpt2_xl}[args.model]
+    model_cfg = cfg_fn(dtype=jnp.bfloat16, remat=True,
+                       n_positions=max(args.seq, 128))
+    config = {
+        "train_batch_size": args.batch,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": args.zero},
+        "gradient_clipping": 1.0,
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "mesh": {"data": -1, "model": args.tp, "seq": args.sp},
+        "steps_per_print": 5,
+    }
+    engine, _, _, _ = dstpu.initialize(
+        config=config, model=gpt2_lib.GPT2LMHeadModel(model_cfg))
+
+    rng = np.random.RandomState(0)
+    for step in range(args.steps):
+        batch = {"input_ids": rng.randint(
+            0, model_cfg.vocab_size,
+            size=(args.batch, args.seq)).astype(np.int32)}
+        loss = engine.train_batch(batch)
+    print(f"final loss: {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()
