@@ -36,17 +36,34 @@ class OpBuilder:
         return [os.path.join(CSRC, s) for s in self.sources]
 
     def so_path(self):
-        return os.path.join(BUILD_DIR, f"lib{self.name}.so")
+        suffix = "_tsan" if self._tsan() else ""
+        return os.path.join(BUILD_DIR, f"lib{self.name}{suffix}.so")
+
+    @staticmethod
+    def _tsan():
+        """DS_BUILD_TSAN=1 builds the host libraries under ThreadSanitizer —
+        the concurrency guard rail SURVEY §5.2 calls for on the swap/aio
+        thread pools (the reference has no sanitizer story at all). TSAN
+        builds cache separately so switching modes doesn't thrash.
+
+        Running requires the runtime preloaded (dlopen'ing a TSAN .so into
+        a plain python hits the static-TLS limit):
+
+            LD_PRELOAD=$(g++ -print-file-name=libtsan.so) \\
+                DS_BUILD_TSAN=1 python -m pytest tests/test_offload.py
+        """
+        return os.environ.get("DS_BUILD_TSAN", "") == "1"
 
     def is_compatible(self):
         from shutil import which
         return which("g++") is not None
 
     def command(self):
-        return (["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                 "-march=native", "-fopenmp"]
-                + self.extra_flags
-                + self.absolute_sources()
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+               "-march=native", "-fopenmp"]
+        if self._tsan():
+            cmd += ["-fsanitize=thread", "-g", "-O1"]
+        return (cmd + self.extra_flags + self.absolute_sources()
                 + ["-o", self.so_path()])
 
     def needs_build(self):
