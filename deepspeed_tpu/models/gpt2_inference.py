@@ -27,7 +27,8 @@ from deepspeed_tpu.ops.transformer.inference import (
 
 
 def inference_config(cfg: GPT2Config, max_out_tokens: int = 0,
-                     dtype=None) -> DeepSpeedInferenceConfig:
+                     dtype=None, quantize_bits: int = 0,
+                     quantize_groups: int = 1) -> DeepSpeedInferenceConfig:
     return DeepSpeedInferenceConfig(
         hidden_size=cfg.n_embd,
         heads=cfg.n_head,
@@ -36,6 +37,8 @@ def inference_config(cfg: GPT2Config, max_out_tokens: int = 0,
         triangular_masking=True,
         max_out_tokens=max_out_tokens or cfg.n_positions,
         gelu_approximate=True,   # GPT-2 trains with tanh-approx GELU
+        quantize_bits=quantize_bits,
+        quantize_groups=quantize_groups,
         dtype=dtype or cfg.dtype,
         param_dtype=cfg.param_dtype,
     )
@@ -56,11 +59,15 @@ class GPT2InferenceModel(nn.Module):
     blocks under `h/blk` (scan) — produced by `convert_gpt2_params`."""
     config: GPT2Config
     max_out_tokens: int = 0
+    quantize_bits: int = 0      # int8-storage serving (4x weight memory)
+    quantize_groups: int = 1
 
     @nn.compact
     def __call__(self, input_ids, position_offset=0):
         cfg = self.config
-        icfg = inference_config(cfg, self.max_out_tokens)
+        icfg = inference_config(cfg, self.max_out_tokens,
+                                quantize_bits=self.quantize_bits,
+                                quantize_groups=self.quantize_groups)
         B, S = input_ids.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
@@ -122,13 +129,16 @@ def convert_gpt2_params(params, cfg: GPT2Config):
 _STEP_CACHE = {}
 
 
-def _compiled_steps(cfg: GPT2Config, max_out: int):
+def _compiled_steps(cfg: GPT2Config, max_out: int, quantize_bits: int = 0,
+                    quantize_groups: int = 1):
     """(prompt_pass, decode_step) jitted once per (config, cache length) —
     repeated generate() calls hit jit's cache instead of retracing the
     whole model per request."""
-    key = (cfg, max_out)
+    key = (cfg, max_out, quantize_bits, quantize_groups)
     if key not in _STEP_CACHE:
-        model = GPT2InferenceModel(cfg, max_out_tokens=max_out)
+        model = GPT2InferenceModel(cfg, max_out_tokens=max_out,
+                                   quantize_bits=quantize_bits,
+                                   quantize_groups=quantize_groups)
 
         @jax.jit
         def prompt_pass(p, ids):
@@ -147,13 +157,24 @@ def _compiled_steps(cfg: GPT2Config, max_out: int):
     return _STEP_CACHE[key]
 
 
+def quantize_gpt2_inference_params(iparams, groups: int = 1):
+    """Injected inference params → int8-storage params (serve with
+    `generate(..., quantize_bits=8)`): ~4x less HBM for the layer weights."""
+    from deepspeed_tpu.ops.transformer.inference import \
+        quantize_inference_params
+    return quantize_inference_params(iparams, bits=8, groups=groups)
+
+
 def generate(cfg: GPT2Config, params, input_ids, max_new_tokens=20,
-             temperature: float = 0.0, rng=None, max_out_tokens: int = 0):
+             temperature: float = 0.0, rng=None, max_out_tokens: int = 0,
+             quantize_bits: int = 0, quantize_groups: int = 1):
     """KV-cache generation. ``temperature == 0`` → greedy. Returns
     [B, S + max_new_tokens] token ids.
 
     Prompt processing fills the cache in one pass; each new token is one
-    jitted single-position step (compiled once per config, static shapes)."""
+    jitted single-position step (compiled once per config, static shapes).
+    ``quantize_bits=8`` serves int8-stored weights (params must come from
+    `quantize_gpt2_inference_params`)."""
     input_ids = jnp.asarray(input_ids)
     B, S = input_ids.shape
     total = S + max_new_tokens
@@ -164,10 +185,11 @@ def generate(cfg: GPT2Config, params, input_ids, max_new_tokens=20,
         f"n_positions {cfg.n_positions}")
     max_out = max_out_tokens or cfg.n_positions
     assert total <= max_out, (total, max_out)
-    prompt_pass, decode_step = _compiled_steps(cfg, max_out)
-    iparams = params if "h" in params and "blk" in params.get("h", {}) \
-        and "attn_qkvw" in params["h"]["blk"] else \
-        convert_gpt2_params(params, cfg)
+    prompt_pass, decode_step = _compiled_steps(cfg, max_out, quantize_bits,
+                                               quantize_groups)
+    converted = "h" in params and "blk" in params.get("h", {}) and \
+        any(k in params["h"]["blk"] for k in ("attn_qkvw",))
+    iparams = params if converted else convert_gpt2_params(params, cfg)
 
     def pick(logits, r):
         if temperature and temperature > 0:

@@ -46,6 +46,12 @@ class DeepSpeedInferenceConfig:
     triangular_masking: bool = True      # causal (decoder) vs encoder
     max_out_tokens: int = 1024           # KV cache length
     gelu_approximate: bool = False       # tanh-approx GELU (GPT-2) vs exact
+    # int8-storage serving (the reference's quantized inference kernels,
+    # module_inject/module_quantize.py + inference int8 GEMMs): weight
+    # matrices live in HBM as int8 codes + per-group fp32 scales and
+    # dequantize at the matmul read — 4x weight-memory reduction
+    quantize_bits: int = 0               # 0 = off; 8 = int8 storage
+    quantize_groups: int = 1
     dtype: Any = None
     param_dtype: Any = jnp.float32
 
@@ -63,6 +69,30 @@ class DeepSpeedInferenceConfig:
     @property
     def head_dim(self):
         return self.hidden_size // self.heads
+
+
+class QuantDense(nn.Module):
+    """Dense layer over int8-stored weights: params are `kernel_q`
+    (int8 [in, out]) + `kernel_scale` (fp32 [groups, 1]) + `bias`; the
+    dequantize fuses into the matmul's weight read under XLA."""
+    features: int
+    groups: int = 1
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        kq = self.param("kernel_q", nn.initializers.zeros,
+                        (in_features, self.features), jnp.int8)
+        scale = self.param("kernel_scale", nn.initializers.ones,
+                           (self.groups, 1), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), self.param_dtype)
+        w = (kq.astype(jnp.float32).reshape(self.groups, -1)
+             * scale).reshape(in_features, self.features)
+        y = x @ w.astype(self.dtype)
+        return y + bias.astype(self.dtype)
 
 
 class DeepSpeedTransformerInference(nn.Module):
@@ -88,23 +118,30 @@ class DeepSpeedTransformerInference(nn.Module):
                      param_dtype=cfg.param_dtype)
         dense_kw = dict(dtype=dt, param_dtype=cfg.param_dtype)
 
+        def make_dense(features, name):
+            if cfg.quantize_bits:
+                return QuantDense(features, groups=cfg.quantize_groups,
+                                  dtype=dt, param_dtype=cfg.param_dtype,
+                                  name=name)
+            return nn.Dense(features, **dense_kw, name=name)
+
         def attn(h):
-            qkv = nn.Dense(3 * E, **dense_kw, name="attn_qkvw")(h)
+            qkv = make_dense(3 * E, "attn_qkvw")(h)
             q, k, v = jnp.split(qkv, 3, axis=-1)
             q = q.reshape(B, S, H, D)
             k = k.reshape(B, S, H, D)
             v = v.reshape(B, S, H, D)
             ctx = self._attend(q, k, v, attention_mask)
             ctx = ctx.reshape(B, S, E)
-            return nn.Dense(E, **dense_kw, name="attn_ow")(ctx)
+            return make_dense(E, "attn_ow")(ctx)
 
         def ffn(h):
-            inter = nn.Dense(cfg.ffn_size, **dense_kw, name="inter_w")(h)
+            inter = make_dense(cfg.ffn_size, "inter_w")(h)
             # must match the training model's GELU variant bit-for-bit or
             # injected params serve shifted logits (GPT-2 trains with the
             # tanh approximation; BERT with exact GELU)
             inter = nn.gelu(inter, approximate=cfg.gelu_approximate)
-            return nn.Dense(E, **dense_kw, name="output_w")(inter)
+            return make_dense(E, "output_w")(inter)
 
         if cfg.pre_layer_norm:
             x = x + attn(nn.LayerNorm(**ln_kw, name="attn_nw")(x))
@@ -186,6 +223,51 @@ def _as_bias(attention_mask, L):
     elif k_len > L:
         m = m[..., :L]
     return m
+
+
+def quantize_inference_params(params, bits=8, groups=1):
+    """Fused-layer params → int8-storage params for `quantize_bits` serving:
+    every `kernel` under the four weight names becomes `kernel_q` (int8,
+    same shape) + `kernel_scale` ([groups, 1] fp32, per leading layer-stack
+    entry when the tree is scan-stacked). Biases and layernorms stay fp32.
+    Symmetric per-group quantization (ops.quantizer)."""
+    assert bits == 8, "int8 storage only"
+    weight_names = ("attn_qkvw", "attn_ow", "inter_w", "output_w")
+
+    def convert(tree):
+        if not isinstance(tree, dict):
+            return tree
+        out = {}
+        for key, sub in tree.items():
+            if key in weight_names and isinstance(sub, dict) \
+                    and "kernel" in sub:
+                w = jnp.asarray(sub["kernel"])
+                if w.ndim == 3:      # scan-stacked [L, in, out]
+                    L = w.shape[0]
+                    flat = w.reshape(L * groups, -1).astype(jnp.float32)
+                    amax = jnp.max(jnp.abs(flat), axis=1, keepdims=True)
+                    scale = jnp.maximum(amax / 127.0, 1e-12)
+                    q = jnp.clip(jnp.round(flat / scale), -128, 127)
+                    out[key] = {
+                        "kernel_q": q.astype(jnp.int8).reshape(w.shape),
+                        "kernel_scale": scale.reshape(L, groups, 1),
+                        "bias": sub["bias"],
+                    }
+                else:
+                    flat = w.reshape(groups, -1).astype(jnp.float32)
+                    amax = jnp.max(jnp.abs(flat), axis=1, keepdims=True)
+                    scale = jnp.maximum(amax / 127.0, 1e-12)
+                    q = jnp.clip(jnp.round(flat / scale), -128, 127)
+                    out[key] = {
+                        "kernel_q": q.astype(jnp.int8).reshape(w.shape),
+                        "kernel_scale": scale,
+                        "bias": sub["bias"],
+                    }
+            else:
+                out[key] = convert(sub)
+        return out
+
+    return convert(params)
 
 
 def inference_tp_specs(params):

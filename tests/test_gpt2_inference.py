@@ -89,3 +89,32 @@ def test_generate_rejects_overlong_request():
     cfg, _, params, ids = _setup()   # n_positions = 128, prompt 12
     with pytest.raises(AssertionError):
         generate(cfg, params, ids, max_new_tokens=120)
+
+
+def test_int8_storage_serving():
+    """int8 weight storage: params shrink to int8 codes, logits stay close
+    to the fp path, generation runs (reference quantized inference)."""
+    from deepspeed_tpu.models.gpt2_inference import (
+        quantize_gpt2_inference_params,
+    )
+    cfg, model, params, ids = _setup()
+    ref = model.apply({"params": params}, ids)
+    iparams = convert_gpt2_params(params, cfg)
+    qparams = quantize_gpt2_inference_params(iparams, groups=4)
+    blk = qparams["h"]["blk"]
+    assert blk["attn_qkvw"]["kernel_q"].dtype == jnp.int8
+    assert "kernel" not in blk["attn_qkvw"]
+
+    inf = GPT2InferenceModel(cfg, max_out_tokens=32, quantize_bits=8,
+                             quantize_groups=4)
+    got, _ = inf.apply({"params": qparams}, ids, mutable=["cache"])
+    ref_n = np.asarray(ref, np.float32)
+    got_n = np.asarray(got, np.float32)
+    # int8 weights shift logits but must stay within quantization noise
+    err = np.abs(got_n - ref_n).mean() / (np.abs(ref_n).mean() + 1e-9)
+    assert err < 0.12, err
+
+    out = generate(cfg, qparams, ids, max_new_tokens=4, quantize_bits=8,
+                   quantize_groups=4)
+    assert out.shape == (2, 16)
+    assert np.isfinite(np.asarray(out, np.float64)).all()
