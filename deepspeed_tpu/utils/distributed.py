@@ -80,13 +80,15 @@ def init_distributed(coordinator_address: Optional[str] = None,
 
     if coordinator_address and num_processes and num_processes > 1:
         import jax
-        log_dist(f"jax.distributed.initialize({coordinator_address}, "
-                 f"n={num_processes}, id={process_id}, "
-                 f"local_device_ids={local_device_ids})", ranks=[0])
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id,
                                    local_device_ids=local_device_ids)
+        # log only AFTER initialize: rank-aware logging touches the backend,
+        # and jax.distributed.initialize must precede any backend init
+        log_dist(f"jax.distributed.initialize({coordinator_address}, "
+                 f"n={num_processes}, id={process_id}, "
+                 f"local_device_ids={local_device_ids})", ranks=[0])
     _initialized = True
 
 

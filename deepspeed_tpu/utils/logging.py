@@ -5,7 +5,6 @@ On TPU-VM there is one process per host; "rank" here is ``jax.process_index``.
 
 import logging
 import sys
-import functools
 
 LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"
 
@@ -29,10 +28,16 @@ class LoggerFactory:
 logger = LoggerFactory.create_logger(name="deepspeed_tpu", level=logging.INFO)
 
 
-@functools.lru_cache(maxsize=None)
 def _process_index():
+    """Current process rank WITHOUT forcing backend initialization: calling
+    jax.process_index() before jax.distributed.initialize would both break
+    the multi-host rendezvous (backend init must come after) and pin the
+    rank to 0. Uncached — the rank changes when distributed init runs."""
     try:
         import jax
+        from jax._src import xla_bridge as xb
+        if not xb._backends:
+            return 0
         return jax.process_index()
     except Exception:
         return 0
