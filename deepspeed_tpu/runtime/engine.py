@@ -867,6 +867,17 @@ class DeepSpeedEngine:
         grads = self.zero.constrain_grads(grads)
         return loss, grads
 
+    def _globalize_batch(self, batch):
+        """Multi-host: every process feeds the FULL global batch (the
+        reference gives each rank a per-rank loader instead); jax extracts
+        each process's addressable shards. Single-process: plain upload."""
+        if jax.process_count() == 1:
+            return jax.tree_util.tree_map(jnp.asarray, batch)
+        sh = mesh_lib.batch_sharding(self.mesh)
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(
+                sh, np.asarray(x)), batch)
+
     def _ensure_ready(self, batch):
         if self.state is None:
             self._init_state(example_batch=self._example_from_batch(batch))
@@ -892,8 +903,9 @@ class DeepSpeedEngine:
             micro = [next(data_iter) for _ in range(self.gradient_accumulation_steps())]
             batch = jax.tree_util.tree_map(
                 lambda *xs: np.concatenate([np.asarray(x) for x in xs]), *micro)
-        batch = jax.tree_util.tree_map(jnp.asarray, batch)
+        # state init inspects host-side shapes; globalize only after
         self._ensure_ready(batch)
+        batch = self._globalize_batch(batch)
         if self.flops_profiler is not None:
             self.flops_profiler.maybe_profile(batch)
 
@@ -1003,8 +1015,9 @@ class DeepSpeedEngine:
         """Parity shim: computes loss+grads for one micro batch and stashes
         them for `backward`/`step` (the reference runs fwd here and autograd
         later; under XLA fwd+bwd are one fused program)."""
-        batch = jax.tree_util.tree_map(jnp.asarray, batch)
+        # state init inspects host-side shapes; globalize only after
         self._ensure_ready(batch)
+        batch = self._globalize_batch(batch)
         if self.wall_clock_breakdown():
             self.timers(FORWARD_MICRO_TIMER).start()
         loss, grads = self._jit_micro_grads(self.state, batch, self._next_rng())
@@ -1102,8 +1115,9 @@ class DeepSpeedEngine:
                                 skipped_steps=self.state.skipped_steps)
 
     def eval_batch(self, batch):
-        batch = jax.tree_util.tree_map(jnp.asarray, batch)
+        # state init inspects host-side shapes; globalize only after
         self._ensure_ready(batch)
+        batch = self._globalize_batch(batch)
         return self._jit_eval(self.state, self._model_inputs(batch))
 
     def zero_grad(self):

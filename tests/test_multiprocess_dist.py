@@ -94,3 +94,92 @@ def test_two_process_psum_over_launcher_contract(tmp_path, world):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
     for rank, out in enumerate(outs):
         assert f"RANK{rank}_OK" in out
+
+
+_ENGINE_WORKER = textwrap.dedent("""
+    import os
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from deepspeed_tpu.utils.distributed import init_distributed
+    init_distributed()
+
+    import numpy as np
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+    from tests.simple_model import SimpleModel, random_batch, base_config
+
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8            # 4 local x 2 processes
+    mesh = make_mesh(MeshConfig(data=8))      # dp over the GLOBAL mesh
+    cfg = base_config()
+    cfg["zero_optimization"] = {"stage": 2}
+    cfg["seed"] = 3
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                       mesh=mesh)
+    batch = random_batch()                    # identical on every process
+    losses = [float(engine.train_batch(batch)) for _ in range(3)]
+    print("LOSSES", jax.process_index(), ",".join(f"{l:.6f}" for l in losses),
+          flush=True)
+""")
+
+
+def test_engine_trains_across_two_processes(tmp_path):
+    """Full engine training over a 2-process global mesh (dp=8, ZeRO-2):
+    the true multi-host path — rendezvous, global batch feeding, GSPMD
+    collectives over DCN-style process boundaries."""
+    script = tmp_path / "engine_worker.py"
+    script.write_text(_ENGINE_WORKER)
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "DSTPU_COORDINATOR_ADDR": "127.0.0.1",
+            "DSTPU_COORDINATOR_PORT": str(port),
+            "DSTPU_NUM_PROCESSES": "2",
+            "DSTPU_PROCESS_ID": str(rank),
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "PYTHONPATH": REPO_ROOT + os.pathsep
+            + os.environ.get("PYTHONPATH", ""),
+        })
+        env.pop("DSTPU_LOCAL_DEVICE_IDS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env, cwd=REPO_ROOT,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"rank {rank} hung")
+        outs.append(out)
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+
+    import re
+    curves = {}
+    for out in outs:
+        m = re.search(r"LOSSES (\d+) ([\d.,-]+)", out)
+        assert m, out
+        curves[int(m.group(1))] = [float(x) for x in m.group(2).split(",")]
+    # both processes observe the identical global trajectory
+    assert curves[0] == curves[1]
+
+    # and it matches the same config run in ONE process on 8 local devices
+    import numpy as np
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+    from tests.simple_model import SimpleModel, random_batch, base_config
+    if len(__import__("jax").devices()) >= 8:
+        import jax
+        cfg = base_config()
+        cfg["zero_optimization"] = {"stage": 2}
+        cfg["seed"] = 3
+        engine, _, _, _ = dstpu.initialize(
+            config=cfg, model=SimpleModel(),
+            mesh=make_mesh(MeshConfig(data=8), devices=jax.devices()[:8]))
+        batch = random_batch()
+        ref = [float(engine.train_batch(batch)) for _ in range(3)]
+        np.testing.assert_allclose(curves[0], ref, rtol=1e-4, atol=1e-5)
