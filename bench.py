@@ -80,10 +80,13 @@ def main():
     batch = {"input_ids": rng.randint(0, 50304, size=(batch_size, seq))
              .astype(np.int32)}
 
-    # warmup (compile)
+    # warmup (compile); force with a DATA-dependent readback — on tunneled
+    # backends block_until_ready can return before execution finishes, so
+    # only a device_get of a value produced by the step is a trustworthy
+    # fence
     for _ in range(2):
         loss = engine.train_batch(batch)
-    jax.block_until_ready(engine.state.params)
+    float(jax.device_get(loss))
 
     # two timed windows, best wins: the tunneled chip shows ±5% run-to-run
     # noise and the benchmark should report the machine, not the tunnel
@@ -93,7 +96,7 @@ def main():
         t0 = time.perf_counter()
         for _ in range(iters):
             loss = engine.train_batch(batch)
-        jax.block_until_ready(engine.state.params)
+        float(jax.device_get(loss))
         best = min(best, (time.perf_counter() - t0) / iters)
     dt = best
 
