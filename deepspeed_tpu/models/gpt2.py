@@ -40,6 +40,9 @@ class GPT2Config:
     remat: bool = False
     remat_policy: Optional[str] = None  # None=full remat | "dots" | "offload"
     sp_backend: str = "ring"            # "ring" | "ulysses" (seq-axis attn)
+    moe_experts: int = 0                # >0 → MoE FFN (expert parallel)
+    moe_k: int = 1
+    moe_capacity_factor: float = 1.25
     scan_layers: bool = True
     use_flash: Optional[bool] = None   # None = auto (TPU yes)
     tie_word_embeddings: bool = True
@@ -130,7 +133,16 @@ class Block(nn.Module):
         x = x + keep * SelfAttention(cfg, name="attn")(ln1, deterministic)
         ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                            param_dtype=cfg.param_dtype, name="ln_2")(x)
-        x = x + keep * MLP(cfg, name="mlp")(ln2, deterministic)
+        if cfg.moe_experts:
+            from deepspeed_tpu.moe import MoE
+            ffn_out = MoE(num_experts=cfg.moe_experts,
+                          d_ff=4 * cfg.n_embd, k=cfg.moe_k,
+                          capacity_factor=cfg.moe_capacity_factor,
+                          dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                          name="moe")(ln2)
+        else:
+            ffn_out = MLP(cfg, name="mlp")(ln2, deterministic)
+        x = x + keep * ffn_out
         return x
 
 

@@ -1,0 +1,1 @@
+from deepspeed_tpu.moe.layer import MoE, MoEMLP, TopKGate, load_balance_loss

@@ -1,0 +1,156 @@
+"""Mixture-of-Experts layer with expert parallelism — a beyond-reference
+capability (the 2021 reference snapshot predates deepspeed/moe; SURVEY §2.3
+marks EP "not present"). Built TPU-first:
+
+- experts live stacked on a leading [E] axis sharded over the mesh's
+  `expert` axis (aliased onto `data`, parallel/mesh.py:25), so expert
+  weights are expert-parallel with zero per-expert module objects;
+- top-k gating (Switch/GShard style) with capacity-factor truncation and
+  the standard load-balancing auxiliary loss;
+- dispatch/combine are einsums against a one-hot dispatch mask — under
+  GSPMD the [tokens→experts] regroup lowers to the all_to_all the
+  reference-era MoE implementations issue by hand;
+- everything is dense-shaped and static (capacity fixes the expert batch),
+  so XLA tiles it onto the MXU.
+"""
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
+
+def load_balance_loss(gate_probs, expert_mask):
+    """Switch-transformer aux loss: E * sum_e f_e * P_e, where f_e is the
+    fraction of tokens routed to expert e and P_e the mean gate prob."""
+    E = gate_probs.shape[-1]
+    f = expert_mask.mean(axis=0)          # [E] fraction of tokens
+    p = gate_probs.mean(axis=0)           # [E] mean router prob
+    return E * jnp.sum(f * p)
+
+
+class TopKGate(nn.Module):
+    """Router: logits → top-k expert assignment with capacity truncation.
+
+    Returns (dispatch [T, E, C] one-hot, combine [T, E, C] weights,
+    aux_loss). T = tokens, E = experts, C = capacity per expert.
+    """
+    num_experts: int
+    k: int = 1
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):                 # x: [T, H]
+        T = x.shape[0]
+        E = self.num_experts
+        C = max(1, int(np.ceil(self.capacity_factor * self.k * T / E)))
+        logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                          param_dtype=self.param_dtype,
+                          name="wg")(x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)          # [T, E]
+
+        dispatch = jnp.zeros((T, E, C), jnp.float32)
+        combine = jnp.zeros((T, E, C), jnp.float32)
+        remaining = probs
+        mask_total = jnp.zeros((T, E), jnp.float32)
+        for _ in range(self.k):
+            choice = jnp.argmax(remaining, axis=-1)       # [T]
+            onehot = jax.nn.one_hot(choice, E)            # [T, E]
+            mask_total = mask_total + onehot
+            # position of each token within its chosen expert's buffer
+            pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot   # [T, E]
+            keep = (pos < C).astype(jnp.float32) * onehot
+            pos_c = jax.nn.one_hot(pos.sum(axis=-1).astype(jnp.int32), C)
+            d = keep[:, :, None] * pos_c[:, None, :]      # [T, E, C]
+            gate_w = (probs * onehot).sum(axis=-1)        # [T]
+            dispatch = dispatch + d
+            combine = combine + d * gate_w[:, None, None]
+            remaining = remaining * (1.0 - onehot)        # mask for next k
+
+        aux = load_balance_loss(probs, jnp.clip(mask_total, 0.0, 1.0))
+        return dispatch, combine, aux
+
+
+class MoEMLP(nn.Module):
+    """Expert FFN bank: stacked [E, ...] kernels, expert-sharded over the
+    mesh's expert axis when one exists."""
+    num_experts: int
+    d_model: int
+    d_ff: int
+    activation: Callable = nn.gelu
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, xe):               # [E, C, H]
+        E, C, H = xe.shape
+        init = nn.initializers.normal(0.02)
+        wi = self.param("wi", init, (E, H, self.d_ff), self.param_dtype)
+        wo = self.param("wo", init, (E, self.d_ff, H), self.param_dtype)
+        h = jnp.einsum("ech,ehf->ecf", xe, wi.astype(self.dtype))
+        h = self.activation(h)
+        return jnp.einsum("ecf,efh->ech", h, wo.astype(self.dtype))
+
+
+class MoE(nn.Module):
+    """Drop-in MoE block: [B, S, H] → [B, S, H] (+ aux loss via the
+    'losses' mutable collection or returned when `return_aux`)."""
+    num_experts: int
+    d_ff: int
+    k: int = 1
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    return_aux: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        B, S, H = x.shape
+        T = B * S
+        flat = x.reshape(T, H)
+        dispatch, combine, aux = TopKGate(
+            self.num_experts, k=self.k,
+            capacity_factor=self.capacity_factor,
+            param_dtype=self.param_dtype, name="gate")(flat)
+
+        # [T,H] → [E,C,H]: the token→expert regroup (GSPMD lowers this to
+        # the EP all_to_all when experts are sharded)
+        xe = jnp.einsum("tec,th->ech", dispatch.astype(self.dtype), flat)
+        mesh = mesh_lib.current_mesh()
+        if mesh is not None and \
+                mesh_lib.mesh_axis_size(mesh, mesh_lib.DATA_AXIS) > 1 and \
+                self.num_experts % mesh_lib.mesh_axis_size(
+                    mesh, mesh_lib.DATA_AXIS) == 0:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            xe = jax.lax.with_sharding_constraint(
+                xe, NamedSharding(mesh, P(mesh_lib.DATA_AXIS)))
+        ye = MoEMLP(self.num_experts, H, self.d_ff, dtype=self.dtype,
+                    param_dtype=self.param_dtype, name="experts")(xe)
+        y = jnp.einsum("tec,ech->th", combine.astype(self.dtype), ye)
+        y = y.reshape(B, S, H)
+
+        if self.is_mutable_collection("losses"):
+            self.sow("losses", "moe_aux", aux)
+        if self.return_aux:
+            return y, aux
+        return y
+
+
+def expert_shardings(params, mesh):
+    """PartitionSpec tree sharding the stacked expert kernels over the
+    expert(=data) axis; router + everything else replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    def leaf(path, x):
+        names = [str(getattr(p, "key", p)) for p in path]
+        if "experts" in names and names[-1] in ("wi", "wo"):
+            return P(mesh_lib.DATA_AXIS)
+        return P()
+    return jax.tree_util.tree_map_with_path(leaf, params)
