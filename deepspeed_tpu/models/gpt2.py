@@ -43,6 +43,7 @@ class GPT2Config:
     moe_experts: int = 0                # >0 → MoE FFN (expert parallel)
     moe_k: int = 1
     moe_capacity_factor: float = 1.25
+    moe_aux_coeff: float = 0.01         # load-balance loss weight
     scan_layers: bool = True
     use_flash: Optional[bool] = None   # None = auto (TPU yes)
     tie_word_embeddings: bool = True
@@ -138,8 +139,10 @@ class Block(nn.Module):
             ffn_out = MoE(num_experts=cfg.moe_experts,
                           d_ff=4 * cfg.n_embd, k=cfg.moe_k,
                           capacity_factor=cfg.moe_capacity_factor,
+                          dropout=cfg.dropout,
+                          out_init_std=0.02 / np.sqrt(2 * cfg.n_layer),
                           dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                          name="moe")(ln2)
+                          name="moe")(ln2, deterministic)
         else:
             ffn_out = MLP(cfg, name="mlp")(ln2, deterministic)
         x = x + keep * ffn_out
@@ -193,7 +196,7 @@ class GPT2LMHeadModel(nn.Module):
 
         if cfg.scan_layers:
             scanned = nn.scan(ScanBody,
-                              variable_axes={"params": 0},
+                              variable_axes={"params": 0, "losses": 0},
                               split_rngs={"params": True, "dropout": True},
                               in_axes=(nn.broadcast, nn.broadcast),
                               length=cfg.n_layer)

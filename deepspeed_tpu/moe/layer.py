@@ -5,13 +5,21 @@ marks EP "not present"). Built TPU-first:
 - experts live stacked on a leading [E] axis sharded over the mesh's
   `expert` axis (aliased onto `data`, parallel/mesh.py:25), so expert
   weights are expert-parallel with zero per-expert module objects;
-- top-k gating (Switch/GShard style) with capacity-factor truncation and
-  the standard load-balancing auxiliary loss;
-- dispatch/combine are einsums against a one-hot dispatch mask — under
-  GSPMD the [tokens→experts] regroup lowers to the all_to_all the
-  reference-era MoE implementations issue by hand;
-- everything is dense-shaped and static (capacity fixes the expert batch),
-  so XLA tiles it onto the MXU.
+- top-k gating (Switch/GShard style) with per-group capacity-factor
+  truncation and the standard load-balancing auxiliary loss; slot
+  positions carry across the k rounds so second choices never collide
+  with first choices in an expert's buffer;
+- routing is GROUPED (GShard's group axis = batch row): dispatch/combine
+  masks are [G, S, E, C] with C ∝ S/E, so their memory and the dispatch
+  einsum cost scale with S² per group instead of (B·S)² global;
+- dispatch/combine are einsums against one-hot masks — under GSPMD the
+  [tokens→experts] regroup lowers to the all_to_all reference-era MoE
+  implementations issue by hand;
+- everything is dense-shaped and static (capacity fixes the expert
+  batch), so XLA tiles it onto the MXU.
+
+The aux loss is sown into the "losses" collection; the engine adds it to
+the objective when the model opts in (GPT2Config.moe_experts).
 """
 
 import dataclasses
@@ -27,7 +35,8 @@ from deepspeed_tpu.parallel import mesh as mesh_lib
 
 def load_balance_loss(gate_probs, expert_mask):
     """Switch-transformer aux loss: E * sum_e f_e * P_e, where f_e is the
-    fraction of tokens routed to expert e and P_e the mean gate prob."""
+    fraction of tokens routed to expert e and P_e the mean gate prob.
+    Inputs [T, E]."""
     E = gate_probs.shape[-1]
     f = expert_mask.mean(axis=0)          # [E] fraction of tokens
     p = gate_probs.mean(axis=0)           # [E] mean router prob
@@ -37,8 +46,11 @@ def load_balance_loss(gate_probs, expert_mask):
 class TopKGate(nn.Module):
     """Router: logits → top-k expert assignment with capacity truncation.
 
-    Returns (dispatch [T, E, C] one-hot, combine [T, E, C] weights,
-    aux_loss). T = tokens, E = experts, C = capacity per expert.
+    Input [T, H] → (dispatch [T, E, C] one-hot, combine [T, E, C] weights,
+    aux_loss). T = tokens, E = experts, C = capacity per expert. Slot
+    occupancy accumulates across the k rounds, so a round-2 assignment
+    lands after all round-1 tokens of the same expert and is dropped when
+    the expert is full.
     """
     num_experts: int
     k: int = 1
@@ -60,18 +72,23 @@ class TopKGate(nn.Module):
         combine = jnp.zeros((T, E, C), jnp.float32)
         remaining = probs
         mask_total = jnp.zeros((T, E), jnp.float32)
+        occupancy = jnp.zeros((E,), jnp.float32)          # filled slots
         for _ in range(self.k):
             choice = jnp.argmax(remaining, axis=-1)       # [T]
             onehot = jax.nn.one_hot(choice, E)            # [T, E]
             mask_total = mask_total + onehot
-            # position of each token within its chosen expert's buffer
-            pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot   # [T, E]
+            # slot index = this round's order within the expert, offset by
+            # slots already filled in earlier rounds
+            pos = ((jnp.cumsum(onehot, axis=0) - 1.0)
+                   + occupancy[None, :]) * onehot          # [T, E]
             keep = (pos < C).astype(jnp.float32) * onehot
-            pos_c = jax.nn.one_hot(pos.sum(axis=-1).astype(jnp.int32), C)
+            pos_c = jax.nn.one_hot(
+                jnp.clip(pos.sum(axis=-1), 0, C - 1).astype(jnp.int32), C)
             d = keep[:, :, None] * pos_c[:, None, :]      # [T, E, C]
             gate_w = (probs * onehot).sum(axis=-1)        # [T]
             dispatch = dispatch + d
             combine = combine + d * gate_w[:, None, None]
+            occupancy = occupancy + keep.sum(axis=0)
             remaining = remaining * (1.0 - onehot)        # mask for next k
 
         aux = load_balance_loss(probs, jnp.clip(mask_total, 0.0, 1.0))
@@ -80,61 +97,78 @@ class TopKGate(nn.Module):
 
 class MoEMLP(nn.Module):
     """Expert FFN bank: stacked [E, ...] kernels, expert-sharded over the
-    mesh's expert axis when one exists."""
+    mesh's expert axis when one exists. ``out_init_std`` lets residual
+    stacks scale the output projection like their dense c_proj."""
     num_experts: int
     d_model: int
     d_ff: int
     activation: Callable = nn.gelu
+    dropout: float = 0.0
+    out_init_std: float = 0.02
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, xe):               # [E, C, H]
+    def __call__(self, xe, deterministic=True):   # [E, C, H]
         E, C, H = xe.shape
-        init = nn.initializers.normal(0.02)
-        wi = self.param("wi", init, (E, H, self.d_ff), self.param_dtype)
-        wo = self.param("wo", init, (E, self.d_ff, H), self.param_dtype)
+        wi = self.param("wi", nn.initializers.normal(0.02),
+                        (E, H, self.d_ff), self.param_dtype)
+        wo = self.param("wo", nn.initializers.normal(self.out_init_std),
+                        (E, self.d_ff, H), self.param_dtype)
         h = jnp.einsum("ech,ehf->ecf", xe, wi.astype(self.dtype))
         h = self.activation(h)
+        if self.dropout > 0:
+            h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
         return jnp.einsum("ecf,efh->ech", h, wo.astype(self.dtype))
 
 
 class MoE(nn.Module):
-    """Drop-in MoE block: [B, S, H] → [B, S, H] (+ aux loss via the
-    'losses' mutable collection or returned when `return_aux`)."""
+    """Drop-in MoE block: [B, S, H] → [B, S, H]. The load-balancing aux
+    loss is sown into the 'losses' collection (and returned when
+    ``return_aux``); batch rows are the routing groups."""
     num_experts: int
     d_ff: int
     k: int = 1
     capacity_factor: float = 1.25
+    dropout: float = 0.0
+    out_init_std: float = 0.02
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     return_aux: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, deterministic=True):
         B, S, H = x.shape
-        T = B * S
-        flat = x.reshape(T, H)
-        dispatch, combine, aux = TopKGate(
-            self.num_experts, k=self.k,
-            capacity_factor=self.capacity_factor,
-            param_dtype=self.param_dtype, name="gate")(flat)
+        E = self.num_experts
+        # one router shared across groups; routing per batch row bounds the
+        # one-hot masks at [B, S, E, C] with C ∝ S/E
+        gate = nn.vmap(TopKGate, in_axes=0, out_axes=0,
+                       variable_axes={"params": None},
+                       split_rngs={"params": False})(
+            E, k=self.k, capacity_factor=self.capacity_factor,
+            param_dtype=self.param_dtype, name="gate")
+        dispatch, combine, aux = gate(x)          # [B,S,E,C], aux [B]
+        aux = aux.mean()
 
-        # [T,H] → [E,C,H]: the token→expert regroup (GSPMD lowers this to
-        # the EP all_to_all when experts are sharded)
-        xe = jnp.einsum("tec,th->ech", dispatch.astype(self.dtype), flat)
+        C = dispatch.shape[-1]
+        # [B,S,H] → [E, B*C, H]: the token→expert regroup (GSPMD lowers
+        # this to the EP all_to_all when experts are sharded)
+        xe = jnp.einsum("bsec,bsh->ebch", dispatch.astype(self.dtype), x)
+        xe = xe.reshape(E, B * C, H)
         mesh = mesh_lib.current_mesh()
-        if mesh is not None and \
-                mesh_lib.mesh_axis_size(mesh, mesh_lib.DATA_AXIS) > 1 and \
-                self.num_experts % mesh_lib.mesh_axis_size(
-                    mesh, mesh_lib.DATA_AXIS) == 0:
+        ep = mesh is not None and \
+            mesh_lib.mesh_axis_size(mesh, mesh_lib.DATA_AXIS) > 1 and \
+            E % mesh_lib.mesh_axis_size(mesh, mesh_lib.DATA_AXIS) == 0
+        if ep:
             from jax.sharding import NamedSharding, PartitionSpec as P
             xe = jax.lax.with_sharding_constraint(
                 xe, NamedSharding(mesh, P(mesh_lib.DATA_AXIS)))
-        ye = MoEMLP(self.num_experts, H, self.d_ff, dtype=self.dtype,
-                    param_dtype=self.param_dtype, name="experts")(xe)
-        y = jnp.einsum("tec,ech->th", combine.astype(self.dtype), ye)
-        y = y.reshape(B, S, H)
+        ye = MoEMLP(E, H, self.d_ff, dropout=self.dropout,
+                    out_init_std=self.out_init_std, dtype=self.dtype,
+                    param_dtype=self.param_dtype,
+                    name="experts")(xe, deterministic)
+        ye = ye.reshape(E, B, C, H)
+        y = jnp.einsum("bsec,ebch->bsh", combine.astype(self.dtype), ye)
 
         if self.is_mutable_collection("losses"):
             self.sow("losses", "moe_aux", aux)
@@ -145,12 +179,16 @@ class MoE(nn.Module):
 
 def expert_shardings(params, mesh):
     """PartitionSpec tree sharding the stacked expert kernels over the
-    expert(=data) axis; router + everything else replicated."""
+    expert(=data) axis; router + everything else replicated. Kernels whose
+    expert count does not divide the axis stay replicated (matching the
+    guard MoE.__call__ applies)."""
     from jax.sharding import PartitionSpec as P
+    axis = mesh_lib.mesh_axis_size(mesh, mesh_lib.DATA_AXIS)
 
     def leaf(path, x):
         names = [str(getattr(p, "key", p)) for p in path]
-        if "experts" in names and names[-1] in ("wi", "wo"):
+        if "experts" in names and names[-1] in ("wi", "wo") \
+                and axis > 0 and x.shape[0] % axis == 0:
             return P(mesh_lib.DATA_AXIS)
         return P()
     return jax.tree_util.tree_map_with_path(leaf, params)

@@ -523,6 +523,22 @@ class DeepSpeedEngine:
         except (TypeError, ValueError):
             pass
         has_dropout = getattr(getattr(model, "config", None), "dropout", 0.0) > 0
+        model_cfg = getattr(model, "config", None)
+        uses_moe = getattr(model_cfg, "moe_experts", 0) and \
+            getattr(model_cfg, "moe_experts", 0) > 0
+        moe_aux_coeff = float(getattr(model_cfg, "moe_aux_coeff", 0.01))
+
+        def apply_model(params, inputs, kwargs):
+            """Runs the model; when it carries MoE blocks, collect the sown
+            load-balancing losses so the router actually trains balanced
+            (the aux term of Switch/GShard)."""
+            if uses_moe:
+                out, vs = model.apply({"params": params}, inputs,
+                                      mutable=["losses"], **kwargs)
+                aux = sum(jnp.sum(l) for l in jax.tree_util.tree_leaves(
+                    vs.get("losses", {})))
+                return out, moe_aux_coeff * aux
+            return model.apply({"params": params}, inputs, **kwargs), 0.0
 
         def default_loss(params, batch, rng, keep_prob):
             from deepspeed_tpu.models.gpt2 import lm_loss
@@ -534,22 +550,21 @@ class DeepSpeedEngine:
             if has_dropout:
                 kwargs["rngs"] = {"dropout": rng}
             if isinstance(batch, dict) and "input_ids" in batch:
-                logits = model.apply({"params": params}, batch["input_ids"],
-                                     **kwargs)
+                logits, aux = apply_model(params, batch["input_ids"], kwargs)
                 labels = batch.get("labels", batch["input_ids"])
-                return lm_loss(logits, labels)
+                return lm_loss(logits, labels) + aux
             if isinstance(batch, (tuple, list)) and len(batch) == 2:
                 x, y = batch
-                out = model.apply({"params": params}, x, **kwargs)
+                out, aux = apply_model(params, x, kwargs)
                 if jnp.issubdtype(jnp.asarray(y).dtype, jnp.integer):
                     logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
                     ll = jnp.take_along_axis(logp, y[..., None], axis=-1)
-                    return -ll.mean()
+                    return -ll.mean() + aux
                 return jnp.mean(jnp.square(out.astype(jnp.float32) -
-                                           y.astype(jnp.float32)))
+                                           y.astype(jnp.float32))) + aux
             # bare array → LM on itself
-            logits = model.apply({"params": params}, batch, **kwargs)
-            return lm_loss(logits, batch)
+            logits, aux = apply_model(params, batch, kwargs)
+            return lm_loss(logits, batch) + aux
         return default_loss
 
     # ------------------------------------------------------------------
