@@ -96,6 +96,11 @@ def _convert_block(blk):
     """Training Block subtree → fused inference layer subtree (the weight
     copy of replace_module.py:24-79; orientations are identical since both
     sides are flax Dense kernels [in, out])."""
+    if "moe" in blk:
+        raise NotImplementedError(
+            "MoE GPT-2 serving is not supported by the fused inference "
+            "stack yet — run inference through the training model "
+            "(model.apply) for moe_experts > 0")
     return {
         "attn_nw": dict(blk["ln_1"]),
         "attn_qkvw": dict(blk["attn"]["c_attn"]),
