@@ -35,8 +35,11 @@ struct Request {
   int64_t offset;
   bool write;
   // pieces of one user-submitted transfer share a countdown so `completed`
-  // counts USER requests, not internal split chunks
+  // counts USER requests, not internal split chunks; `failed` is the
+  // request-level flag so `errors` also counts USER requests (one failed
+  // large transfer = one error, however many pieces it was split into)
   std::shared_ptr<std::atomic<int64_t>> remaining;
+  std::shared_ptr<std::atomic<bool>> failed;
 };
 
 struct Handle {
@@ -75,6 +78,7 @@ struct Handle {
 
   void submit(Request r) {
     r.remaining = std::make_shared<std::atomic<int64_t>>(1);
+    r.failed = std::make_shared<std::atomic<bool>>(false);
     {
       std::lock_guard<std::mutex> lk(mu);
       queue.push_back(std::move(r));
@@ -99,6 +103,7 @@ struct Handle {
     const int64_t piece = (r.nbytes + pieces - 1) / pieces;
     auto remaining = std::make_shared<std::atomic<int64_t>>(
         (r.nbytes + piece - 1) / piece);
+    auto failed = std::make_shared<std::atomic<bool>>(false);
     {
       std::lock_guard<std::mutex> lk(mu);
       for (int64_t off = 0; off < r.nbytes; off += piece) {
@@ -107,6 +112,7 @@ struct Handle {
         sub.offset = r.offset + off;
         sub.nbytes = std::min(piece, r.nbytes - off);
         sub.remaining = remaining;
+        sub.failed = failed;
         queue.push_back(std::move(sub));
         inflight.fetch_add(1);
       }
@@ -138,8 +144,11 @@ struct Handle {
         }
         done += rc;
       }
-      if (failed) errors.fetch_add(1);
-      if (r.remaining->fetch_sub(1) == 1) completed.fetch_add(1);
+      if (failed) r.failed->store(true);
+      if (r.remaining->fetch_sub(1) == 1) {
+        completed.fetch_add(1);
+        if (r.failed->load()) errors.fetch_add(1);
+      }
       // decrement+notify under mu: a waiter that checked the predicate but
       // has not yet blocked must not miss this wakeup
       {

@@ -58,6 +58,18 @@ void ds_adam_step(float* params,
   }
 }
 
+// Round-to-nearest-even fp32→bf16 with a NaN guard: the rounding add would
+// otherwise carry a high-mantissa NaN through the exponent into ±0/Inf —
+// and NaNs (fp16-overflow markers) are exactly what the offload staging
+// must preserve for the skip-step logic.
+static inline uint16_t fp32_bits_to_bf16(uint32_t bits) {
+  if ((bits & 0x7F800000u) == 0x7F800000u && (bits & 0x007FFFFFu)) {
+    return (uint16_t)(((bits >> 16) & 0x8000u) | 0x7FC0u);
+  }
+  uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
+  return (uint16_t)((bits + rounding) >> 16);
+}
+
 // Same step but also writes a bf16 copy of the updated params (the tile the
 // reference copies back to GPU overlapped with compute, cpu_adam.cpp:67).
 void ds_adam_step_plus_copy(float* params,
@@ -78,11 +90,9 @@ void ds_adam_step_plus_copy(float* params,
                eps, weight_decay, adamw_mode, bias_correction);
 #pragma omp parallel for schedule(static)
   for (int64_t i = 0; i < n; ++i) {
-    // round-to-nearest-even fp32→bf16
     uint32_t bits;
     __builtin_memcpy(&bits, &params[i], 4);
-    uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
-    params_bf16[i] = (uint16_t)((bits + rounding) >> 16);
+    params_bf16[i] = fp32_bits_to_bf16(bits);
   }
 }
 
@@ -205,8 +215,7 @@ void ds_fp32_to_bf16(const float* src, uint16_t* dst, int64_t n) {
   for (int64_t i = 0; i < n; ++i) {
     uint32_t bits;
     __builtin_memcpy(&bits, &src[i], 4);
-    uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
-    dst[i] = (uint16_t)((bits + rounding) >> 16);
+    dst[i] = fp32_bits_to_bf16(bits);
   }
 }
 

@@ -79,7 +79,18 @@ class SelfAttention(nn.Module):
         mesh = mesh_lib.current_mesh()
         if mesh is not None and mesh.shape.get(mesh_lib.SEQ_AXIS, 1) > 1 \
                 and S % mesh.shape[mesh_lib.SEQ_AXIS] == 0:
-            if cfg.sp_backend == "ulysses":
+            sp = mesh.shape[mesh_lib.SEQ_AXIS]
+            if cfg.sp_backend == "ulysses" and cfg.n_head % sp != 0:
+                # Ulysses scatters heads over the seq axis, so it also needs
+                # n_head % sp == 0; fall back to ring attention (which has no
+                # head constraint) rather than tripping a trace-time assert
+                # inside the a2a — but say so, the user asked for ulysses.
+                from deepspeed_tpu.utils.logging import logger
+                logger.warning(
+                    f"sp_backend='ulysses' needs n_head ({cfg.n_head}) "
+                    f"divisible by the seq axis ({sp}); falling back to "
+                    f"ring attention")
+            if cfg.sp_backend == "ulysses" and cfg.n_head % sp == 0:
                 from deepspeed_tpu.parallel.ulysses import ulysses_attention
                 out = ulysses_attention(heads(q), heads(k), heads(v), mesh,
                                         causal=True)

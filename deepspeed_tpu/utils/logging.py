@@ -35,8 +35,21 @@ def _process_index():
     rank to 0. Uncached — the rank changes when distributed init runs."""
     try:
         import jax
+    except Exception:
+        return 0
+    try:
+        # private API (jax 0.4.x): the only way to ask "is a backend already
+        # initialized" without initializing one. If a jax upgrade moves the
+        # symbol, fall through to jax.process_index() — by then callers are
+        # typically past distributed init, so the cure is worse only in the
+        # narrow pre-init window, and we accept that rather than guessing 0
+        # forever (which re-enables duplicated logging on every process).
         from jax._src import xla_bridge as xb
-        if not xb._backends:
+        backends_initialized = bool(xb._backends)
+    except Exception:
+        backends_initialized = None
+    try:
+        if backends_initialized is False:
             return 0
         return jax.process_index()
     except Exception:

@@ -7,6 +7,7 @@ image); otherwise falls back to a JSONL event log with the same tags, so
 monitoring never becomes a hard dependency.
 """
 
+import atexit
 import json
 import os
 
@@ -28,6 +29,10 @@ class SummaryEventWriter:
             logger.warning(f"tensorboard unavailable ({e}); "
                            f"writing JSONL events to {self.log_dir}")
             self._fh = open(os.path.join(self.log_dir, "events.jsonl"), "a")
+            # the engine has no teardown hook that reliably runs on process
+            # exit; without this, scalars buffered since the last
+            # steps_per_print flush are lost
+            atexit.register(self.close)
 
     def add_scalar(self, tag, value, step):
         if self._tb is not None:
@@ -47,3 +52,4 @@ class SummaryEventWriter:
             self._tb.close()
         elif self._fh is not None:
             self._fh.close()
+            atexit.unregister(self.close)

@@ -237,6 +237,23 @@ def test_swapper_prefetch_error_attribution(tmp_path):
     sw.release()
 
 
+def test_aio_split_transfer_counts_one_error(tmp_path):
+    """One failed user transfer = ONE reported error, even when submit_split
+    fanned it into many pieces across the worker pool."""
+    if not has_native():
+        pytest.skip("no C++ toolchain")
+    from deepspeed_tpu.ops.native.aio import AsyncIOHandle
+    h = AsyncIOHandle(block_size=4096, queue_depth=4, thread_count=4)
+    short = tmp_path / "short.bin"
+    short.write_bytes(b"\0" * 4096)
+    buf = np.zeros(1 << 20, np.uint8)  # 1 MiB read from a 4 KiB file
+    fd = h.open(short, False)
+    h.async_pread(buf, fd, 0)
+    with pytest.raises(IOError, match=r"\b1 async IO request"):
+        h.wait()
+    h.close(fd)
+
+
 def test_param_offload_host_trains():
     """offload_param: params rest in pinned_host memory between steps and
     stream to HBM inside the step (the TPU form of the reference's
@@ -358,6 +375,22 @@ def test_native_bf16_conversions_roundtrip():
     np.testing.assert_array_equal(
         back, np.asarray(jnp.asarray(x).astype(jnp.bfloat16)
                          .astype(jnp.float32)))
+
+
+def test_native_bf16_conversion_preserves_nan_inf():
+    """NaNs must survive fp32→bf16 staging (they drive overflow-skip); the
+    RNE rounding add must not carry a high-mantissa NaN into ±0/Inf."""
+    import numpy as np
+    lib = _native()
+    specials = np.array([0x7FFFFFFF, 0xFFFFFFFF, 0x7F800001, 0x7FC00000,
+                         0xFF800001], np.uint32).view(np.float32)
+    x = np.concatenate([specials, [np.inf, -np.inf, 0.0, -0.0]]).astype(
+        np.float32)
+    bf = lib.fp32_to_bf16(x)
+    back = lib.bf16_to_fp32(bf)
+    assert np.isnan(back[:5]).all(), back[:5]
+    assert back[5] == np.inf and back[6] == -np.inf
+    assert back[7] == 0.0 and back[8] == 0.0
 
 
 def test_native_l2_norm():
