@@ -60,12 +60,16 @@ def main():
     jax.clear_caches()
 
     seq = 1024
-    # GPT-2 large (774M) — the largest dense config whose full fp32 Adam
-    # state fits a single 16G chip; bigger matmuls run closer to the MXU
-    # roofline than the 345M config (35%→41% raw MFU)
+    # GPT-2 large (774M), the largest dense config that trains in 16 GB.
+    # Measured fastest recipe on v5e (see docs/perf_tuning.md): bs8
+    # (8192-row matmuls feed the MXU at its efficiency knee), remat with
+    # the dots_flash_fc policy (keep projections + flash residuals,
+    # recompute only the qkv matmul), fused chunked head+loss (no [B,S,V]
+    # buffer), bf16 gradients + bf16 Adam moments (fp32 update math).
     model_cfg = GPT2Config(vocab_size=50304, n_positions=seq, n_embd=1280,
                            n_layer=36, n_head=20, dtype=jnp.bfloat16,
-                           scan_layers=True, remat=True)
+                           scan_layers=True, remat=True,
+                           remat_policy="dots_flash_fc", loss_chunk=1024)
     batch_size = 8
 
     cfg = {
@@ -73,9 +77,11 @@ def main():
         "gradient_accumulation_steps": 1,
         "zero_optimization": {"stage": 3},
         "bf16": {"enabled": True},
+        "data_types": {"grad_dtype": "bf16"},
         "gradient_clipping": 1.0,
         "optimizer": {"type": "AdamW",
-                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+                      "params": {"lr": 1e-4, "weight_decay": 0.01,
+                                 "moment_dtype": "bf16"}},
         "steps_per_print": 1000,
     }
     model = GPT2LMHeadModel(model_cfg)

@@ -439,6 +439,30 @@ class DeepSpeedConfig:
             self.bf16_enabled = precision in ("bfloat16", "bf16")
             self.fp16_enabled = precision in ("float16", "fp16")
 
+        # gradient-accumulation buffer dtype (modern DeepSpeed's
+        # data_types.grad_accum_dtype; the reference's fp16 engine
+        # accumulated in fp16 implicitly). "fp32" (default) or "bf16" —
+        # bf16 halves the accumulator HBM for long-gas large models.
+        data_types = pd.get("data_types", {})
+        self.grad_accum_dtype = data_types.get(
+            "grad_accum_dtype",
+            bf16.get("grad_accum_dtype", "fp32"))
+        if self.grad_accum_dtype not in ("fp32", "bf16"):
+            raise DeepSpeedConfigError(
+                f"grad_accum_dtype must be 'fp32' or 'bf16', got "
+                f"{self.grad_accum_dtype!r}")
+        # grad_dtype="bf16": cast fp32 params to bf16 ONCE before the model
+        # apply inside the differentiated function, so every parameter
+        # cotangent (including layer-scan stack buffers) materializes in
+        # bf16 — the reference fp16 engine's grads-in-fp16 semantics
+        # (model.half(), engine.py:624), with fp32 master math in the
+        # optimizer read.
+        self.grad_dtype = data_types.get("grad_dtype", "fp32")
+        if self.grad_dtype not in ("fp32", "bf16"):
+            raise DeepSpeedConfigError(
+                f"grad_dtype must be 'fp32' or 'bf16', got "
+                f"{self.grad_dtype!r}")
+
         self.optimizer_name = None
         self.optimizer_params = None
         opt = pd.get(C.OPTIMIZER, None)

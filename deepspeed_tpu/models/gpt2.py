@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
+from jax.ad_checkpoint import checkpoint_name
+
 from deepspeed_tpu.ops.attention import dot_product_attention
 
 
@@ -47,6 +49,11 @@ class GPT2Config:
     scan_layers: bool = True
     use_flash: Optional[bool] = None   # None = auto (TPU yes)
     tie_word_embeddings: bool = True
+    # fused head+loss: when __call__ gets `labels`, compute the LM cross
+    # entropy in chunks of this many tokens instead of materializing the
+    # [B, S, V] logits (f32 lse temporaries are >1 GB at V=50k) — the
+    # memory knob that lets dots-policy remat fit a 16 GB chip. 0 = off.
+    loss_chunk: int = 0
 
     @property
     def head_dim(self):
@@ -67,6 +74,7 @@ class SelfAttention(nn.Module):
         B, S, E = x.shape
         qkv = nn.Dense(3 * E, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                        kernel_init=nn.initializers.normal(0.02), name="c_attn")(x)
+        qkv = checkpoint_name(qkv, "qkv")
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(t):
@@ -106,6 +114,7 @@ class SelfAttention(nn.Module):
                        kernel_init=nn.initializers.normal(
                            0.02 / np.sqrt(2 * cfg.n_layer)),
                        name="c_proj")(out)
+        out = checkpoint_name(out, "attn_proj")
         if cfg.dropout > 0:
             out = nn.Dropout(cfg.dropout)(out, deterministic=deterministic)
         return out
@@ -119,11 +128,13 @@ class MLP(nn.Module):
         cfg = self.config
         h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                      kernel_init=nn.initializers.normal(0.02), name="c_fc")(x)
+        h = checkpoint_name(h, "mlp_fc")
         h = nn.gelu(h, approximate=True)
         h = nn.Dense(cfg.n_embd, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                      kernel_init=nn.initializers.normal(
                          0.02 / np.sqrt(2 * cfg.n_layer)),
                      name="c_proj")(h)
+        h = checkpoint_name(h, "mlp_proj")
         if cfg.dropout > 0:
             h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
         return h
@@ -169,6 +180,41 @@ def _remat_policy(name):
         return None
     if name == "dots":
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if name == "dots_lite":
+        # save qkv (3E) + both residual-branch projections (2E) per layer
+        # but NOT the 4E mlp fc output — 5E/9E of the "dots" footprint for
+        # one extra fc matmul (1/3 of forward flops) recomputed in backward.
+        return jax.checkpoint_policies.save_only_these_names(
+            "qkv", "attn_proj", "mlp_proj")
+    if name == "dots_flash":
+        # dots_lite + the flash-attention kernel's own residuals (output +
+        # logsumexp): backward runs the flash bwd kernels directly instead
+        # of re-executing the forward kernel first. +1E per layer over
+        # dots_lite; the best-measured fit for 16 GB at GPT-2-large/bs8
+        # once optimizer moments are bf16.
+        return jax.checkpoint_policies.save_only_these_names(
+            "qkv", "attn_proj", "mlp_proj", "flash_o", "flash_lse")
+    if name == "dots_flash_fc":
+        # dots_flash but trading qkv (3E, 6-unit recompute) for mlp_fc
+        # (4E, 8-unit recompute): less backward recompute per byte saved.
+        # Needs grad_dtype=bf16's memory headroom at bs8/16 GB.
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_proj", "mlp_fc", "mlp_proj", "flash_o", "flash_lse")
+    if name == "dots_plus":
+        # everything "dots" keeps plus the flash residuals: no matmul or
+        # attention recompute at all in backward. The roomiest policy;
+        # needs bf16 grads to fit 16 GB at GPT-2-large/bs8.
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names(
+                "flash_o", "flash_lse"))
+    if name == "projs":
+        # save only the residual-branch projections (2E per layer): qkv and
+        # fc recompute in backward (~58% of forward flops) but the big-batch
+        # µbatch that feeds the MXU at full tilt fits in 16 GB — measured
+        # faster end-to-end than any fuller policy at a smaller batch.
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_proj", "mlp_proj")
     if name == "offload":
         return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
             "device", "pinned_host")
@@ -196,7 +242,8 @@ class GPT2LMHeadModel(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids, deterministic=True, keep_prob=1.0):
+    def __call__(self, input_ids, deterministic=True, keep_prob=1.0,
+                 labels=None):
         cfg = self.config
         B, S = input_ids.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
@@ -219,12 +266,61 @@ class GPT2LMHeadModel(nn.Module):
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="ln_f")(x)
+        if labels is not None and cfg.loss_chunk > 0 \
+                and cfg.tie_word_embeddings:
+            return chunked_lm_loss(x, wte.astype(cfg.dtype), labels,
+                                   cfg.loss_chunk)
         if cfg.tie_word_embeddings:
             logits = jnp.einsum("bse,ve->bsv", x, wte.astype(cfg.dtype))
         else:
             logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                               param_dtype=cfg.param_dtype, name="lm_head")(x)
+        if labels is not None:
+            return lm_loss(logits, labels)
         return logits
+
+
+def chunked_lm_loss(hidden, wte, labels, chunk, ignore_index=-100):
+    """Fused LM head + next-token cross entropy without a [B, S, V] buffer.
+
+    Scans over chunks of ``chunk`` tokens; each chunk projects [C, E] @
+    [E, V] and reduces to per-token nll immediately. The chunk body is
+    rematerialized, so backward recomputes each chunk's logits instead of
+    saving them — one extra head matmul per step (~1-2% of model flops)
+    buys back >1 GB of f32 logsumexp temporaries at GPT-2 vocab sizes.
+
+    Matches ``lm_loss(logits, labels)`` to fp32 rounding: same shift, same
+    ignore_index masking, same mean normalization.
+    """
+    B, S, E = hidden.shape
+    xs = hidden[:, :-1, :].reshape(-1, E)
+    tgt = labels[:, 1:].reshape(-1)
+    n = xs.shape[0]
+    pad = (-n) % chunk
+    if pad:
+        xs = jnp.pad(xs, ((0, pad), (0, 0)))
+        tgt = jnp.pad(tgt, (0, pad), constant_values=ignore_index)
+    xs = xs.reshape(-1, chunk, E)
+    tgt = tgt.reshape(-1, chunk)
+
+    @jax.checkpoint
+    def chunk_nll(h, t):
+        logits = (h @ wte.T).astype(jnp.float32)       # [C, V]
+        valid = t != ignore_index
+        t0 = jnp.where(valid, t, 0)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        g = jnp.take_along_axis(logits, t0[:, None], axis=-1)[:, 0]
+        return (jnp.sum(jnp.where(valid, lse - g, 0.0)),
+                jnp.sum(valid.astype(jnp.int32)))
+
+    def body(carry, xt):
+        total, count = carry
+        ds, dc = chunk_nll(*xt)
+        return (total + ds, count + dc), None
+
+    (total, count), _ = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.int32(0)), (xs, tgt))
+    return total / jnp.maximum(count, 1)
 
 
 def lm_loss(logits, labels, ignore_index=-100):

@@ -34,6 +34,13 @@ class FusedAdam(TpuOptimizer):
     adam_w_mode: bool = True
     bias_correction: bool = True
     amsgrad: bool = False
+    # storage dtype for exp_avg: "fp32" (default, the reference's
+    # fp32-master semantics) or "bf16" — compute is still fp32 (read →
+    # widen → update → round). exp_avg_sq deliberately stays fp32 either
+    # way: at beta2=0.999 its per-step relative update (~1e-3) is below
+    # bf16 ulp (3.9e-3), so a bf16 EMA freezes (in particular it can never
+    # decay when gradients shrink) — a systematic bias, not noise.
+    moment_dtype: str = "fp32"
 
     param_like_state_fields = ("exp_avg", "exp_avg_sq")
 
@@ -41,13 +48,21 @@ class FusedAdam(TpuOptimizer):
         if self.amsgrad:
             raise ValueError("FusedAdam does not support the AMSGrad variant "
                              "(parity with reference fused_adam.py:40)")
+        if self.moment_dtype not in ("fp32", "bf16"):
+            raise ValueError(f"moment_dtype must be 'fp32' or 'bf16', got "
+                             f"{self.moment_dtype!r}")
+
+    def _mdtype(self):
+        return jnp.bfloat16 if self.moment_dtype == "bf16" else jnp.float32
 
     def init(self, params):
         return {
             "step": jnp.zeros((), jnp.int32),
-            # Optimizer ("master") state stays fp32 even when params are
-            # bf16 — the ZeRO fp32-partition analog (reference stage2.py:~300).
-            "exp_avg": tree_zeros_like(params, jnp.float32),
+            # Optimizer ("master") state stays fp32 by default even when
+            # params are bf16 — the ZeRO fp32-partition analog (reference
+            # stage2.py:~300); moment_dtype="bf16" opts exp_avg into half
+            # storage (exp_avg_sq must stay fp32, see field comment).
+            "exp_avg": tree_zeros_like(params, self._mdtype()),
             "exp_avg_sq": tree_zeros_like(params, jnp.float32),
         }
 
@@ -73,14 +88,15 @@ class FusedAdam(TpuOptimizer):
             p32 = p.astype(jnp.float32)
             if self.weight_decay != 0.0 and not self.adam_w_mode:
                 g32 = g32 + self.weight_decay * p32
-            m_new = beta1 * m + (1.0 - beta1) * g32
-            v_new = beta2 * v + (1.0 - beta2) * (g32 * g32)
+            m_new = beta1 * m.astype(jnp.float32) + (1.0 - beta1) * g32
+            v_new = beta2 * v.astype(jnp.float32) + (1.0 - beta2) * (g32 * g32)
             denom = jnp.sqrt(v_new / bc2) + self.eps
             update = (m_new / bc1) / denom
             if self.weight_decay != 0.0 and self.adam_w_mode:
                 update = update + self.weight_decay * p32
             p_new = p32 - lr * update
-            return p_new.astype(p.dtype), m_new, v_new
+            return (p_new.astype(p.dtype), m_new.astype(m.dtype),
+                    v_new.astype(v.dtype))
 
         flat = jax.tree_util.tree_map(
             update_leaf, params, grads, state["exp_avg"], state["exp_avg_sq"])

