@@ -4,8 +4,8 @@ expresses as PipelineModule(LayerSpec(GPT2Block)...) (pipe/module.py:87).
 Embedding and LM head run outside the pipeline (replicated w.r.t. the pipe
 axis, sharded over data/model as usual); the L transformer blocks are
 stage-stacked [S, L/S, ...], sharded over the 'pipe' mesh axis, and executed
-by the SPMD collective pipeline (parallel/pipeline_spmd.py). Composes with
-ZeRO (data axis) and TP (model axis) since the pipeline shard_maps only the
+by the 1F1B SPMD pipeline (parallel/pipeline_1f1b.py). Composes with ZeRO
+(data axis) and TP (model axis) since the pipeline shard_maps only the
 pipe axis.
 """
 
@@ -17,8 +17,8 @@ import jax.numpy as jnp
 from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel, Block
 from deepspeed_tpu.models.sharding import _gpt2_leaf_spec
 from deepspeed_tpu.parallel import mesh as mesh_lib
-from deepspeed_tpu.parallel.pipeline_spmd import (
-    spmd_pipeline, stack_stage_params)
+from deepspeed_tpu.parallel.pipeline_1f1b import (
+    pipeline_1f1b, stack_stage_params)
 from jax.sharding import PartitionSpec as P
 
 
@@ -78,7 +78,7 @@ class GPT2PipeModel:
             return h
 
         mb = x.reshape((M, B // M) + x.shape[1:])
-        h = spmd_pipeline(stage_fn, params["h_stages"], mb, self.mesh)
+        h = pipeline_1f1b(stage_fn, params["h_stages"], mb, self.mesh)
         x = h.reshape(B, T, cfg.n_embd)
 
         from flax import linen as nn

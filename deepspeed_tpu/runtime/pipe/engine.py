@@ -3,10 +3,11 @@
 Executes a PipelineModule under the instruction schedules in schedule.py.
 Single-stage (pipe axis = 1) runs the module sequentially through the base
 engine — the degenerate DataParallelSchedule case. Multi-stage execution
-lowers the TrainSchedule to the SPMD collective pipeline in
-deepspeed_tpu/parallel/pipeline_spmd.py (stage-stacked params sharded over
-the 'pipe' mesh axis, microbatches rotated with ppermute) rather than the
-reference's per-rank NCCL p2p interpreter (pipe/engine.py:1209).
+lowers the TrainSchedule to the 1F1B SPMD pipeline in
+deepspeed_tpu/parallel/pipeline_1f1b.py (stage-stacked params sharded over
+the 'pipe' mesh axis, microbatches rotated with ppermute, backward replay
+of the even/odd schedule) rather than the reference's per-rank NCCL p2p
+interpreter (pipe/engine.py:1209).
 """
 
 import numpy as np

@@ -1,6 +1,7 @@
-"""SPMD pipeline tests: the collective pipeline matches sequential
-execution exactly (fwd + grads), and GPT2PipeModel trains under the engine
-on a pipe×data mesh."""
+"""SPMD pipeline integration tests: the 1F1B executor behind
+GPT2PipeModel matches sequential execution exactly (fwd + grads), and
+GPT2PipeModel trains under the engine on a pipe×data mesh.
+(Executor-level schedule/numerics tests: test_pipeline_1f1b.py.)"""
 
 import numpy as np
 import jax
@@ -9,8 +10,8 @@ import pytest
 
 import deepspeed_tpu as dstpu
 from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
-from deepspeed_tpu.parallel.pipeline_spmd import (
-    spmd_pipeline, stack_stage_params, unstack_stage_params)
+from deepspeed_tpu.parallel.pipeline_1f1b import (
+    pipeline_1f1b as spmd_pipeline, stack_stage_params, unstack_stage_params)
 from tests.simple_model import base_config
 
 
