@@ -1,0 +1,66 @@
+"""GPT-2 1.5B (gpt2_xl, the BASELINE.md north-star config) on ONE 16 GB
+chip via ZeRO-Offload — the max-params-per-chip evidence run.
+
+Not part of bench.py's driver path: the 48-layer offload program takes
+~25 min to compile through the tunneled backend, and the steady-state step
+is dominated by the host optimizer (on this harness the host has a single
+CPU core and sits behind the tunnel; measured 425 s/step, loss falling
+11.16 -> 10.49 over 4 steps on 2026-07-30. A real TPU-VM host with its
+usual core count and PCIe runs the same host step in seconds).
+
+Prints one JSON line: params, fit evidence, samples/sec.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models.gpt2 import gpt2_xl, GPT2LMHeadModel
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+
+    cfg_m = gpt2_xl(dtype=jnp.bfloat16, scan_layers=True, remat=True,
+                    remat_policy="projs", loss_chunk=1024)
+    cfg = {
+        "train_batch_size": 4,
+        "zero_optimization": {"stage": 3,
+                              "offload_optimizer": {"device": "cpu"}},
+        "bf16": {"enabled": True},
+        "data_types": {"grad_dtype": "bf16"},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "steps_per_print": 1000,
+    }
+    mesh = make_mesh(MeshConfig(data=1), devices=[jax.devices()[0]])
+    engine, _, _, _ = dstpu.initialize(config=cfg,
+                                       model=GPT2LMHeadModel(cfg_m),
+                                       mesh=mesh)
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, 50257, size=(4, 1024))
+             .astype(np.int32)}
+    losses = []
+    t0 = time.perf_counter()
+    losses.append(float(engine.train_batch(batch)))
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(3):
+        losses.append(float(engine.train_batch(batch)))
+    dt = (time.perf_counter() - t0) / 3
+    print(json.dumps({
+        "metric": "gpt2_xl_1p5b_zero_offload_params_per_chip",
+        "value": round(cfg_m.num_params() / 1e9, 3),
+        "unit": "B params on one 16GB chip",
+        "detail": {"first_loss": losses[0], "last_loss": losses[-1],
+                   "compile_s": round(compile_s, 1),
+                   "steady_step_s": round(dt, 1),
+                   "samples_per_sec": round(4 / dt, 4)},
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,7 +115,8 @@ class PipelineModule:
                  base_seed: int = 1234,
                  partition_method: str = "parameters",
                  activation_checkpoint_interval: int = 0,
-                 checkpointable_layers=None):
+                 checkpointable_layers=None,
+                 num_microbatches: Optional[int] = None):
         self._layer_specs = list(layers)
         self._num_layers = len(self._layer_specs)
         self.loss_fn = loss_fn
@@ -124,6 +125,9 @@ class PipelineModule:
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
         self.checkpointable_layers = checkpointable_layers
+        self.num_microbatches = num_microbatches
+        self._spmd_mesh = None        # set by lower_to_spmd
+        self._trunk = None            # (start, stop) homogeneous layer run
 
         if num_stages is None and topology is None:
             num_stages = 1
@@ -187,6 +191,145 @@ class PipelineModule:
                         f"[{self.parts[s]}, {self.parts[s+1]}) "
                         f"params={sum(counts[self.parts[s]:self.parts[s+1]])/1e6:.2f}M")
 
+    # -- SPMD lowering -----------------------------------------------------
+    def _find_homogeneous_trunk(self):
+        """Longest contiguous run of pairwise-identical LayerSpecs (same
+        class, args, kwargs; not tied, flax modules). These are the layers
+        that can be stage-stacked for the 1F1B SPMD executor; layers before/
+        after the run ("prefix"/"suffix" — embeddings, heads, norms) run on
+        every stage, replicated w.r.t. the pipe axis."""
+        def key(i):
+            spec = self._layer_specs[i]
+            if isinstance(spec, TiedLayerSpec) or \
+                    not isinstance(spec, LayerSpec):
+                return None
+            f = self.forward_funcs[i]
+            if not (hasattr(f, "init") and hasattr(f, "apply")):
+                return None
+            try:
+                return (spec.typename, repr(spec.module_args),
+                        repr(sorted(spec.module_kwargs.items())))
+            except Exception:
+                return None
+
+        best, cur_start = (0, 0), 0
+        prev = object()
+        for i in range(self._num_layers + 1):
+            k = key(i) if i < self._num_layers else None
+            if k is None or k != prev:
+                cur_start = i
+            prev = k
+            if k is not None and i + 1 - cur_start > best[1] - best[0]:
+                best = (cur_start, i + 1)
+        return best
+
+    def lower_to_spmd(self, mesh, num_microbatches: Optional[int] = None):
+        """Configure pipelined SPMD execution over ``mesh``'s 'pipe' axis:
+        the homogeneous trunk is stage-stacked and run by the 1F1B executor
+        (parallel/pipeline_1f1b.py); called by PipelineEngine when the mesh
+        has pipe > 1. Raises if the model has no trunk that divides into
+        the pipe stages (the reference would equally fail to balance such
+        a model across stages, module.py:355)."""
+        from deepspeed_tpu.parallel import mesh as mesh_lib
+        S = mesh_lib.mesh_axis_size(mesh, mesh_lib.PIPE_AXIS)
+        start, stop = self._find_homogeneous_trunk()
+        run = stop - start
+        if run < S:
+            raise ValueError(
+                f"PipelineModule: longest homogeneous layer run is {run} "
+                f"(layers [{start}, {stop})) but the mesh has {S} pipeline "
+                f"stages; need at least one layer per stage. Express the "
+                f"repeated block as identical LayerSpecs to pipeline it.")
+        # keep only a multiple of S so stages stack evenly; leftovers join
+        # the suffix (run uniformly on all stages)
+        stop = start + (run // S) * S
+        self._trunk = (start, stop)
+        self._spmd_mesh = mesh
+        self.num_stages = S
+        if num_microbatches is not None:
+            self.num_microbatches = num_microbatches
+        if self.num_microbatches is None:
+            self.num_microbatches = S
+        logger.info(
+            f"PipelineModule lowered to SPMD: trunk layers "
+            f"[{start}, {stop}) over {S} stages "
+            f"({(stop - start) // S}/stage), prefix {start}, "
+            f"suffix {self._num_layers - stop}, "
+            f"micro_batches={self.num_microbatches}")
+        return self
+
+    def _refine_trunk_by_shapes(self, params):
+        """Spec equality can't see data-dependent shapes (the first Dense
+        of a width-W run has an input-width kernel); shrink the trunk to
+        the longest sub-run whose param trees match exactly, then floor to
+        a stage multiple."""
+        start, stop = self._trunk
+        S = self.num_stages
+
+        def sig(i):
+            leaves, treedef = jax.tree_util.tree_flatten(
+                params[f"layer_{i}"])
+            return (treedef, tuple((x.shape, x.dtype) for x in leaves))
+
+        best = (start, start)
+        run_start = start
+        for i in range(start, stop + 1):
+            if i == stop or (i > run_start and sig(i) != sig(run_start)):
+                if i - run_start > best[1] - best[0]:
+                    best = (run_start, i)
+                run_start = i
+        start, stop = best
+        stop = start + ((stop - start) // S) * S
+        if stop - start < S:
+            raise ValueError(
+                f"PipelineModule: after shape matching, the homogeneous "
+                f"trunk is {best[1] - best[0]} layers — fewer than the "
+                f"{S} pipeline stages. Express the repeated block as "
+                f"shape-identical LayerSpecs to pipeline it.")
+        self._trunk = (start, stop)
+        return start, stop
+
+    def _stack_trunk(self, params):
+        """Per-layer params → stage-stacked trunk + the rest untouched."""
+        from deepspeed_tpu.parallel.pipeline_1f1b import stack_stage_params
+        start, stop = self._refine_trunk_by_shapes(params)
+        layer_trees = [params[f"layer_{i}"] for i in range(start, stop)]
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *layer_trees)
+        trunk_keys = {f"layer_{i}" for i in range(start, stop)}
+        out = {k: v for k, v in params.items() if k not in trunk_keys}
+        out["trunk_stages"] = stack_stage_params(stacked, self.num_stages)
+        return out
+
+    def unstack_trunk(self, params):
+        """Inverse of _stack_trunk — for checkpoint interop with the
+        sequential layout (state_dict_factory-style resharding)."""
+        from deepspeed_tpu.parallel.pipeline_1f1b import unstack_stage_params
+        start, stop = self._trunk
+        flat = unstack_stage_params(params["trunk_stages"])
+        out = {k: v for k, v in params.items() if k != "trunk_stages"}
+        for i in range(start, stop):
+            out[f"layer_{i}"] = jax.tree_util.tree_map(
+                lambda x, i=i: x[i - start], flat)
+        return out
+
+    def param_partition_specs(self, params_shapes):
+        """Base GSPMD specs: 'pipe' on the stage dim of trunk_stages,
+        replicated elsewhere (consumed by the engine's ZeroPartitioner)."""
+        from jax.sharding import PartitionSpec as P
+        from deepspeed_tpu.parallel import mesh as mesh_lib
+
+        def walk(tree, under_trunk):
+            if isinstance(tree, dict):
+                return {k: walk(v, under_trunk or k == "trunk_stages")
+                        for k, v in tree.items()}
+            if under_trunk:
+                return P(mesh_lib.PIPE_AXIS)
+            return P()
+        tree = params_shapes.get("params", params_shapes) \
+            if isinstance(params_shapes, dict) else params_shapes
+        return walk(tree, False)
+
     def stage_of_layer(self, layer_idx):
         for s in range(self.num_stages):
             if self.parts[s] <= layer_idx < self.parts[s + 1]:
@@ -234,10 +377,22 @@ class PipelineModule:
         params["tied"] = tied
         if (self.partition_method or "").lower() == "parameters":
             self._partition_layers_by_params(params)
+        if self._spmd_mesh is not None:
+            params = self._stack_trunk(params)
         return {"params": params}
 
     def apply(self, variables, x, **kwargs):
         params = variables["params"]
+        if self._spmd_mesh is not None:
+            if "trunk_stages" not in params:
+                # user-supplied params in the sequential layout: re-layout
+                # (pure reshape/stack — safe under jit) instead of silently
+                # running un-pipelined on a pipe>1 mesh
+                logger.warning(
+                    "PipelineModule: converting sequential-layout params "
+                    "to the stage-stacked layout for pipelined execution")
+                params = self._stack_trunk(dict(params))
+            return self._apply_pipelined(params, x)
         tied = params.get("tied", {})
         h = x
         for i in range(self._num_layers):
@@ -249,6 +404,38 @@ class PipelineModule:
                 )(layer_params, h)
             else:
                 h = self._apply_layer(i, layer_params, h, tied)
+        return h
+
+    def _apply_pipelined(self, params, x):
+        """Prefix layers (replicated w.r.t. pipe) → 1F1B trunk → suffix."""
+        from deepspeed_tpu.parallel.pipeline_1f1b import pipeline_1f1b
+        start, stop = self._trunk
+        tied = params.get("tied", {})
+        trunk_module = self.forward_funcs[start]
+
+        h = x
+        for i in range(start):
+            h = self._apply_layer(i, params.get(f"layer_{i}"), h, tied)
+
+        M = self.num_microbatches
+        B = h.shape[0]
+        assert B % M == 0, (f"batch {B} not divisible by "
+                            f"num_microbatches {M}")
+
+        def stage_fn(stage_params, hh):
+            def one_layer(carry, layer_params):
+                return trunk_module.apply({"params": layer_params},
+                                          carry), None
+            hh, _ = jax.lax.scan(one_layer, hh, stage_params)
+            return hh
+
+        mb = h.reshape((M, B // M) + h.shape[1:])
+        h = pipeline_1f1b(stage_fn, params["trunk_stages"], mb,
+                          self._spmd_mesh)
+        h = h.reshape((B,) + h.shape[2:])
+
+        for i in range(stop, self._num_layers):
+            h = self._apply_layer(i, params.get(f"layer_{i}"), h, tied)
         return h
 
     def __call__(self, x):

@@ -128,3 +128,97 @@ def test_pipeline_engine_single_stage_trains():
     for _ in range(20):
         l1 = float(engine.train_batch((x, y)))
     assert l1 < l0
+
+
+def test_pipeline_module_finds_homogeneous_trunk():
+    layers = [LayerSpec(nn.Dense, 32),            # prefix (different width)
+              LayerSpec(nn.Dense, 16), LayerSpec(nn.Dense, 16),
+              LayerSpec(nn.Dense, 16), LayerSpec(nn.Dense, 16),
+              LayerSpec(nn.Dense, 4)]             # suffix
+    pipe = PipelineModule(layers=layers, num_stages=2)
+    assert pipe._find_homogeneous_trunk() == (1, 5)
+
+
+def test_pipeline_module_lowered_apply_matches_sequential():
+    """The SPMD lowering (stage-stacked trunk + 1F1B executor) computes
+    exactly what the sequential module computes."""
+    import jax
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+
+    def build():
+        layers = [LayerSpec(nn.Dense, 16)] + \
+            [LayerSpec(nn.Dense, 16) for _ in range(4)] + \
+            [LayerSpec(nn.Dense, 4)]
+        return PipelineModule(layers=layers, partition_method="uniform")
+
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 16), jnp.float32)
+    seq = build()
+    seq_vars = seq.init(jax.random.PRNGKey(0), x)
+    ref = seq.apply(seq_vars, x)
+
+    if len(jax.devices()) < 2:
+        import pytest
+        pytest.skip("need 2 devices")
+    mesh = make_mesh(MeshConfig(pipe=2), devices=jax.devices()[:2])
+    low = build().lower_to_spmd(mesh, num_microbatches=2)
+    low_vars = low.init(jax.random.PRNGKey(0), x)
+    assert "trunk_stages" in low_vars["params"]
+    got = low.apply(low_vars, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    # round-trip back to the sequential layout (checkpoint interop)
+    flat = low.unstack_trunk(low_vars["params"])
+    for i in range(1, 5):
+        np.testing.assert_allclose(
+            np.asarray(flat[f"layer_{i}"]["kernel"]),
+            np.asarray(seq_vars["params"][f"layer_{i}"]["kernel"]))
+
+
+def test_pipeline_module_trains_pipe2xdp_matches_pipe1():
+    """VERDICT #3 done-condition: a non-GPT-2 LayerSpec model trains under
+    the engine on pipe=2 x dp=2 with losses matching the pipe=1 run."""
+    import jax
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+    if len(jax.devices()) < 4:
+        import pytest
+        pytest.skip("need 4 devices")
+
+    def loss_fn(out, y):
+        logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, y[..., None], axis=-1).mean()
+
+    def run(mesh_cfg, n_dev):
+        layers = [LayerSpec(nn.Dense, 32)] + \
+            [LayerSpec(nn.Dense, 32) for _ in range(4)] + \
+            [LayerSpec(nn.Dense, 4)]
+        pipe = PipelineModule(layers=layers, loss_fn=loss_fn,
+                              num_microbatches=2)
+        mesh = make_mesh(mesh_cfg, devices=jax.devices()[:n_dev])
+        engine, _, _, _ = dstpu.initialize(
+            config=base_config(), model=pipe, mesh=mesh)
+        x, y = random_batch(batch_size=8)
+        return [float(engine.train_batch((x, y))) for _ in range(8)]
+
+    base = run(MeshConfig(data=1), 1)
+    got = run(MeshConfig(pipe=2, data=2), 4)
+    assert got[-1] < got[0] - 0.1, got
+    np.testing.assert_allclose(got, base, rtol=2e-3, atol=2e-3)
+
+
+def test_pipeline_lowering_triggers_from_config_mesh():
+    """pipe>1 coming from the config's mesh section (no mesh kwarg) must
+    still lower the module — not silently train un-pipelined."""
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("need 4 devices")
+    layers = [LayerSpec(nn.Dense, 32) for _ in range(4)]
+    pipe = PipelineModule(layers=layers, num_microbatches=2)
+    cfg = base_config()
+    cfg["mesh"] = {"pipe": 2, "data": 4}
+    cfg["train_batch_size"] = 8
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=pipe)
+    assert pipe._spmd_mesh is not None
+    x, y = random_batch(batch_size=8)
+    loss = float(engine.train_batch((x, y)))
+    assert np.isfinite(loss)
+    assert "trunk_stages" in engine.state.params
