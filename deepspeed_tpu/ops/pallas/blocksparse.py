@@ -1,11 +1,18 @@
-"""Block-sparse attention Pallas kernel — the TPU replacement for the
+"""Block-sparse attention Pallas kernels — the TPU replacement for the
 reference's Triton SDD/DSD/DDS matmuls + block softmax
-(ops/sparse_attention/matmul.py:16, softmax.py:17).
+(ops/sparse_attention/matmul.py:16, softmax.py:17), used under autograd for
+training exactly like the reference's sparse_self_attention.py:14.
 
 Strategy (splash-attention style): the static layout [H, nb, nb] is
 compiled into, per (head, q-block), the list of active k-blocks; the kernel
 iterates only those, with online softmax — so compute and HBM traffic scale
 with nnz blocks, matching the reference's 6x speedup story (SURVEY §6).
+
+Backward mirrors ops/pallas/flash_attention.py: a dq pass over the layout
+rows and a dk/dv pass over the layout's TRANSPOSE (per k-block, the list of
+q-blocks that attend to it), both rematerializing p from the forward's
+logsumexp. The softmax scale is folded into the q-loads; nothing here is
+autodiff-traced — `blocksparse_attention` carries a custom VJP.
 """
 
 import functools
@@ -14,8 +21,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+POS_INF = 1e30
 
 
 def _interpret_default():
@@ -37,18 +46,24 @@ def _layout_tables(layout):
     return counts, cols, max(max_nnz, 1)
 
 
-def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_ref, v_ref, o_ref,
-                   *, scale, block):
-    q = q_ref[0].astype(jnp.float32)  # [block, D]
-    nnz = counts_ref[0, 0]
+# ---------------------------------------------------------------- forward
+
+def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                   *, scale, block, num_heads):
+    # counts/cols are scalar-prefetched whole into SMEM (Mosaic requires
+    # ≥(8,128) tiles for VMEM blocks; control tables belong in SMEM anyway).
+    # Tables are per-HEAD (identical across the batch) to fit SMEM.
+    h, r = pl.program_id(0) % num_heads, pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [block, D]
+    nnz = counts_ref[h, r]
 
     def body(j, carry):
         o_acc, m_acc, l_acc = carry
-        kb = cols_ref[0, 0, j]
+        kb = cols_ref[h, r, j]
         k = k_ref[0, pl.ds(kb * block, block), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(kb * block, block), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32)
         m_new = jnp.maximum(m_acc, jnp.max(s, axis=1))
         alpha = jnp.exp(m_acc - m_new)
         p = jnp.exp(s - m_new[:, None])
@@ -64,6 +79,163 @@ def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_ref, v_ref, o_ref,
     l_safe = jnp.maximum(l, 1e-30)
     o = jnp.where((l > 0)[:, None], o / l_safe[:, None], 0.0)
     o_ref[0] = o.astype(o_ref.dtype)
+    # rows with no active blocks get +inf so backward's exp(s - lse) is 0
+    lse_ref[0, :, 0] = jnp.where(l > 0, m + jnp.log(l_safe), POS_INF)
+
+
+# ---------------------------------------------------------------- backward
+
+def _bs_dq_kernel(counts_ref, cols_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                  delta_ref, dq_ref, *, scale, block, num_heads):
+    h, r = pl.program_id(0) % num_heads, pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+    nnz = counts_ref[h, r]
+
+    def body(j, dq_acc):
+        kb = cols_ref[h, r, j]
+        k = k_ref[0, pl.ds(kb * block, block), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block, block), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq_acc + jax.lax.dot(ds, k,
+                                    preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nnz, body, jnp.zeros_like(q))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bs_dkv_kernel(countsT_ref, rows_ref, q_ref, k_ref, v_ref, do_ref,
+                   lse_ref, delta_ref, dk_ref, dv_ref, *, scale, block,
+                   num_heads):
+    h, c = pl.program_id(0) % num_heads, pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)   # [block, D]
+    v = v_ref[0].astype(jnp.float32)
+    nnz = countsT_ref[h, c]
+
+    def body(j, carry):
+        dk_acc, dv_acc = carry
+        qb = rows_ref[h, c, j]
+        q = q_ref[0, pl.ds(qb * block, block), :].astype(jnp.float32) * scale
+        do = do_ref[0, pl.ds(qb * block, block), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block, block), 0]
+        delta = delta_ref[0, pl.ds(qb * block, block), 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        p = jnp.exp(s - lse[:, None])
+        dv_new = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        # dk = dsᵀ·(scale·q): q was pre-scaled, so this is exact
+        dk_new = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk, dv = jax.lax.fori_loop(0, nnz, body,
+                               (jnp.zeros_like(k), jnp.zeros_like(v)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------- plumbing
+
+def _bs_fwd(qf, kf, vf, tables, scale, block, interpret):
+    (counts_bh, cols_bh, _, _, _, _, _) = tables
+    BH, S, D = qf.shape
+    nb = S // block
+    kernel = functools.partial(_bs_fwd_kernel, scale=scale, block=block,
+                               num_heads=tables[-1])
+    # index maps under scalar prefetch receive the scalar refs after the
+    # grid indices; the q/k/v blocks don't depend on them
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(BH, nb),
+        in_specs=[
+            pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i, *_: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i, *_: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+            pl.BlockSpec((1, block, 1), lambda b, i, *_: (b, i, 0)),
+        ],
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
+            jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(counts_bh, cols_bh, qf, kf, vf)
+    return o, lse
+
+
+def _bs_bwd(qf, kf, vf, o, lse, do, tables, scale, block, interpret):
+    (counts_bh, cols_bh, max_nnz,
+     countsT_bh, rows_bh, max_nnzT, _) = tables
+    BH, S, D = qf.shape
+    nb = S // block
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)[:, :, None]
+
+    dq = pl.pallas_call(
+        functools.partial(_bs_dq_kernel, scale=scale, block=block,
+                          num_heads=tables[-1]),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(BH, nb),
+            in_specs=[
+                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, S, D), lambda b, i, *_: (b, 0, 0)),
+                pl.BlockSpec((1, S, D), lambda b, i, *_: (b, 0, 0)),
+                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, block, 1), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, block, 1), lambda b, i, *_: (b, i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
+        interpret=interpret,
+    )(counts_bh, cols_bh, qf, kf, vf, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bs_dkv_kernel, scale=scale, block=block,
+                          num_heads=tables[-1]),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(BH, nb),
+            in_specs=[
+                pl.BlockSpec((1, S, D), lambda b, i, *_: (b, 0, 0)),
+                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, S, D), lambda b, i, *_: (b, 0, 0)),
+                pl.BlockSpec((1, S, 1), lambda b, i, *_: (b, 0, 0)),
+                pl.BlockSpec((1, S, 1), lambda b, i, *_: (b, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
+        ],
+        interpret=interpret,
+    )(countsT_bh, rows_bh, qf, kf, vf, do, lse, delta)
+    return dq, dk, dv
 
 
 def blocksparse_attention(q, k, v, layout, block, scale=None,
@@ -71,9 +243,10 @@ def blocksparse_attention(q, k, v, layout, block, scale=None,
                           interpret=None):
     """[B, H, S, D] attention restricted to `layout` [H, S//block, S//block].
 
-    Extra element-level masks are not supported in the kernel path (the
-    reference applied them inside the Triton softmax); callers pass masks via
-    the dense fallback in sparse_self_attention.py.
+    Differentiable (custom VJP; used for training like the reference's
+    Triton path). Extra element-level masks are not supported in the kernel
+    path (the reference applied them inside the Triton softmax); callers
+    pass masks via the dense fallback in sparse_self_attention.py.
     """
     if key_padding_mask is not None or attn_mask is not None:
         raise NotImplementedError("mask args use the dense fallback path")
@@ -87,32 +260,40 @@ def blocksparse_attention(q, k, v, layout, block, scale=None,
         interpret = _interpret_default()
     if S % block or block < 8:
         raise NotImplementedError("layout block too small for kernel tiling")
+    if S * D > 262144:
+        # the bwd kernels keep whole [S, D] q/do rows resident in VMEM
+        # (plus double buffering); measured ceiling on v5e is S·D ≈ 256k
+        # (S=4096 at D=64 fits, S=8192 overflows the 16 MB scoped vmem).
+        # Beyond that the caller's dense fallback handles it; the long-S
+        # regime belongs to ring attention (parallel/ring_attention.py)
+        # which shards S before attention runs.
+        raise NotImplementedError(
+            f"S*D={S * D} exceeds the kernel's VMEM row budget")
 
     counts, cols, max_nnz = _layout_tables(layout)
-    counts = jnp.asarray(counts)  # [H, nb]
-    cols = jnp.asarray(cols)      # [H, nb, max_nnz]
+    countsT, rows, max_nnzT = _layout_tables(layout.transpose(0, 2, 1))
+    # per-head tables (identical across batch); kernels index with
+    # program_id(0) % H — [B*H]-expanded tables overflow the 1 MB SMEM
+    tables = (jnp.asarray(counts), jnp.asarray(cols), max_nnz,
+              jnp.asarray(countsT), jnp.asarray(rows), max_nnzT, H)
 
     qf = q.reshape(B * H, S, D)
     kf = k.reshape(B * H, S, D)
     vf = v.reshape(B * H, S, D)
-    # expand tables to BH by head index
-    head_idx = np.arange(B * H) % H
-    counts_bh = counts[head_idx]          # [BH, nb]
-    cols_bh = cols[head_idx]              # [BH, nb, max_nnz]
 
-    kernel = functools.partial(_bs_fwd_kernel, scale=scale, block=block)
-    o = pl.pallas_call(
-        kernel,
-        grid=(B * H, nb),
-        in_specs=[
-            pl.BlockSpec((1, 1), lambda b, i: (b, i)),
-            pl.BlockSpec((1, 1, max_nnz), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
-        interpret=interpret,
-    )(counts_bh, cols_bh, qf, kf, vf)
-    return o.reshape(B, H, S, D)
+    @jax.custom_vjp
+    def run(qf, kf, vf):
+        o, _ = _bs_fwd(qf, kf, vf, tables, scale, block, bool(interpret))
+        return o
+
+    def run_fwd(qf, kf, vf):
+        o, lse = _bs_fwd(qf, kf, vf, tables, scale, block, bool(interpret))
+        return o, (qf, kf, vf, o, lse)
+
+    def run_bwd(res, do):
+        qf, kf, vf, o, lse = res
+        return _bs_bwd(qf, kf, vf, o, lse, do, tables, scale, block,
+                       bool(interpret))
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(qf, kf, vf).reshape(B, H, S, D)

@@ -26,18 +26,20 @@ def _expand_layout_mask(layout, block, seq_len):
 
 
 def sparse_attention(q, k, v, layout, block, key_padding_mask=None,
-                     attn_mask=None, scale=None):
+                     attn_mask=None, scale=None, use_kernel=None):
     """Masked attention with a static block-sparse layout.
 
     q/k/v: [B, H, S, D]. layout: [H, S//block, S//block] ndarray.
-    Returns [B, H, S, D].
+    Returns [B, H, S, D]. Differentiable on both paths (the Pallas kernel
+    carries a custom VJP — trainable like the reference's Triton op).
+    use_kernel: None = auto (kernel on TPU, dense fallback elsewhere);
+    True forces the kernel (interpret mode off-TPU — how CI exercises it).
     """
     B, H, S, D = q.shape
     scale = scale if scale is not None else 1.0 / np.sqrt(D)
-    mask = _expand_layout_mask(layout, block, S)  # [H, S, S]
 
     from deepspeed_tpu.utils.platform import is_tpu_backend
-    use_pallas = is_tpu_backend()
+    use_pallas = is_tpu_backend() if use_kernel is None else use_kernel
     if use_pallas:
         try:
             from deepspeed_tpu.ops.pallas.blocksparse import blocksparse_attention
@@ -46,7 +48,12 @@ def sparse_attention(q, k, v, layout, block, key_padding_mask=None,
                                          key_padding_mask=key_padding_mask,
                                          attn_mask=attn_mask)
         except NotImplementedError:
-            pass
+            if use_kernel:
+                raise
+
+    # dense fallback only: the [H, S, S] element mask is hundreds of MB at
+    # kernel-scale sequence lengths, so build it after kernel dispatch
+    mask = _expand_layout_mask(layout, block, S)
 
     scores = jnp.einsum("bhsd,bhtd->bhst", q, k) * scale
     neg = jnp.finfo(scores.dtype).min

@@ -145,6 +145,12 @@ class DeepSpeedEngine:
                 data=mc.data, model=mc.model, pipe=mc.pipe, seq=mc.seq))
         self.mesh = mesh
         mesh_lib.set_current_mesh(mesh)
+        # pipeline modules re-layout their params for the 1F1B executor;
+        # this must see the FINAL mesh (after distributed init + config
+        # resolution) and precede any param/state initialization
+        if hasattr(model, "lower_to_spmd") and \
+                mesh_lib.mesh_axis_size(mesh, mesh_lib.PIPE_AXIS) > 1:
+            model.lower_to_spmd(mesh)
         self.dp_world_size = mesh_lib.dp_world_size(mesh)
         self._config = DeepSpeedConfig(config, mpu=mpu,
                                        world_size=self.dp_world_size)

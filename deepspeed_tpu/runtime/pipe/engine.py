@@ -26,31 +26,9 @@ class PipelineEngine(DeepSpeedEngine):
     def __init__(self, *args, model=None, **kwargs):
         assert isinstance(model, PipelineModule), \
             "PipelineEngine requires a PipelineModule"
-        mesh = kwargs.get("mesh")
-        if mesh is None:
-            # resolve the mesh the way the base engine will (config "mesh"
-            # section) — the lowering decision must precede param init
-            from deepspeed_tpu.config.config import (
-                DeepSpeedConfig, MeshConfigSection)
-            config = kwargs.get("config")
-            if config is not None:
-                pd = (config._param_dict
-                      if isinstance(config, DeepSpeedConfig)
-                      else DeepSpeedConfig.load_param_dict(config))
-                mc = MeshConfigSection(pd)
-                mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(
-                    data=mc.data, model=mc.model, pipe=mc.pipe, seq=mc.seq))
-                kwargs["mesh"] = mesh
-        pipe_axis = mesh_lib.mesh_axis_size(mesh, mesh_lib.PIPE_AXIS) \
-            if mesh is not None else 1
-        if pipe_axis > 1:
-            if model.num_stages not in (None, 1, pipe_axis):
-                logger.warning(
-                    f"PipelineModule num_stages={model.num_stages} != mesh "
-                    f"pipe axis {pipe_axis}; using mesh value")
-            # lower BEFORE the base engine initializes params so init()
-            # produces the stage-stacked trunk layout
-            model.lower_to_spmd(mesh)
+        # the base engine lowers the module right after it resolves the
+        # final mesh (kwarg or config section, after distributed init) and
+        # before any param/state initialization — see engine.py mesh setup
         super().__init__(*args, model=model, **kwargs)
         self.num_stages = model.num_stages
         # ZeRO-2/3 + PP restriction, same as reference pipe/engine.py:55

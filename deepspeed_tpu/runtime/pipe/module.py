@@ -245,7 +245,15 @@ class PipelineModule:
         stop = start + (run // S) * S
         self._trunk = (start, stop)
         self._spmd_mesh = mesh
-        self.num_stages = S
+        if self.num_stages != S:
+            if self.num_stages not in (None, 1):
+                logger.warning(
+                    f"PipelineModule num_stages={self.num_stages} != mesh "
+                    f"pipe axis {S}; using mesh value")
+            self.num_stages = S
+            # keep reporting surfaces (parts/stage_of_layer) consistent
+            # with the new stage count
+            self._partition_layers_static()
         if num_microbatches is not None:
             self.num_microbatches = num_microbatches
         if self.num_microbatches is None:
@@ -424,8 +432,14 @@ class PipelineModule:
 
         def stage_fn(stage_params, hh):
             def one_layer(carry, layer_params):
-                return trunk_module.apply({"params": layer_params},
-                                          carry), None
+                def body(p, c):
+                    return trunk_module.apply({"params": p}, c)
+                if self.activation_checkpoint_interval > 0:
+                    # honor the module's remat config inside the stage:
+                    # bounds the vjp residuals of a multi-layer stage body
+                    # to layer boundaries (same knob as the sequential path)
+                    body = jax.checkpoint(body, prevent_cse=False)
+                return body(layer_params, carry), None
             hh, _ = jax.lax.scan(one_layer, hh, stage_params)
             return hh
 
