@@ -1,19 +1,32 @@
 """Sharded checkpoint save/load — rebuild of the reference's checkpoint
 machinery (engine.py:1562-1891): tag directories, a ``latest`` pointer file,
-model-states / optim-states file split, and client-state passthrough.
+model-states / optim-states file split, client-state passthrough, and — the
+ZeRO property that matters at scale — **per-rank shard files** (reference
+``zero_pp_rank_*`` shards, engine.py:1883) so no process ever materializes
+the full optimizer state.
 
-Format: each tag directory holds
-  - ``mp_rank_00_model_states.npz``   — model params (reference engine.py:1837)
-  - ``zero_pp_rank_{r}_mp_rank_00_optim_states.npz`` — optimizer + scaler
-    state for data-parallel rank r (reference engine.py:1883 per-rank ZeRO
-    shards). In the GSPMD world a single process holds all addressable
-    shards, so r is ``jax.process_index()``.
-  - ``meta.json`` — counters, lr-scheduler state, client state.
+Format: each tag directory holds, per process r:
+  - ``model_states_shard_{r}.npz``  — this process's addressable,
+    replica-0 pieces of the param tree
+  - ``optim_states_shard_{r}.npz``  — same for optimizer + scaler +
+    counters
+  - ``shard_index_{r}.json``        — for every piece: its tree path,
+    npz key, global array shape/dtype, and the global index window it
+    covers
+and (rank 0 only) ``meta.json`` + the ``latest`` pointer + a copy of
+``zero_to_fp32.py`` (reference engine.py:1873-1881).
 
-Arrays are stored flat with '/'-joined tree paths as npz keys and re-nested
-on load. fp32 master weights live in the params tree itself, so the
-``zero_to_fp32`` offline merge (reference utils/zero_to_fp32.py:70) reduces
-to `load_tree` + `merge_zero_shards` below.
+Loading reads the union of all index files, so the shard layout at load
+time is independent of the layout at save time: a dp=4 save restores onto
+a dp=2 mesh (or a single host) by assembling exactly the index windows
+each new shard needs — the reference's elastic restore
+(zero/stage1.py:898-1031) expressed as window reads. With target shardings
+supplied, assembly happens through ``jax.make_array_from_callback`` and
+each process touches only the bytes of its own shards.
+
+The r1 single-file format (``mp_rank_00_model_states.npz`` +
+``zero_pp_rank_{r}_mp_rank_00_optim_states.npz``) is still read for
+backward compatibility.
 """
 
 import json
@@ -25,17 +38,21 @@ import jax
 LATEST_FILE = "latest"
 
 
-def _flatten(tree, prefix=""):
-    out = {}
+# ---------------------------------------------------------------- tree walk
+
+def _walk(tree, prefix=""):
     if isinstance(tree, dict):
         for k, v in tree.items():
-            out.update(_flatten(v, f"{prefix}{k}/"))
+            yield from _walk(v, f"{prefix}{k}/")
     elif isinstance(tree, (list, tuple)):
         for i, v in enumerate(tree):
-            out.update(_flatten(v, f"{prefix}{i}/"))
+            yield from _walk(v, f"{prefix}{i}/")
     else:
-        out[prefix[:-1]] = np.asarray(jax.device_get(tree))
-    return out
+        yield prefix[:-1], tree
+
+
+def _flatten(tree, prefix=""):
+    return {p: np.asarray(jax.device_get(v)) for p, v in _walk(tree, prefix)}
 
 
 def _unflatten(flat):
@@ -58,22 +75,182 @@ def load_tree(path):
         return _unflatten({k: data[k] for k in data.files})
 
 
-def save_checkpoint(save_dir, tag, state, extra, save_latest=True, zero_stage=0):
+# ---------------------------------------------------------------- sharded IO
+
+def _local_pieces(leaf):
+    """Yield (piece_array, start, stop) for this process's replica-0 shards
+    of `leaf` (whole-array for plain numpy / single-device values)."""
+    if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+        for sh in leaf.addressable_shards:
+            if sh.replica_id != 0:
+                continue
+            idx = sh.index  # tuple of slices into the global shape
+            start = [0 if s.start is None else int(s.start) for s in idx]
+            stop = [int(leaf.shape[d]) if s.stop is None else int(s.stop)
+                    for d, s in enumerate(idx)]
+            yield np.asarray(sh.data), start, stop
+    else:
+        arr = np.asarray(leaf)
+        if jax.process_index() == 0:
+            yield arr, [0] * arr.ndim, list(arr.shape)
+
+
+def _save_sharded_trees(ckpt_dir, trees):
+    """trees: {file_stem: pytree}. Writes this process's pieces + index."""
+    rank = jax.process_index()
+    index = {}
+    for stem, tree in trees.items():
+        pieces = {}
+        for path, leaf in _walk(tree):
+            entries = []
+            for j, (arr, start, stop) in enumerate(_local_pieces(leaf)):
+                key = f"{path}//{j}"
+                # store raw bytes: npz cannot round-trip ml_dtypes arrays
+                # (bfloat16 comes back as void '|V2'); shape+dtype live in
+                # the index
+                pieces[key] = np.frombuffer(
+                    np.ascontiguousarray(arr).tobytes(), np.uint8)
+                entries.append({"key": key, "start": start, "stop": stop})
+            dt = leaf.dtype if hasattr(leaf, "dtype") \
+                else np.asarray(leaf).dtype
+            index[f"{stem}:{path}"] = {
+                "file": f"{stem}_shard_{rank}.npz",
+                "shape": list(np.shape(leaf)),
+                "dtype": str(np.dtype(dt)),   # 'bfloat16' via ml_dtypes
+                "pieces": entries,
+            }
+        np.savez(os.path.join(ckpt_dir, f"{stem}_shard_{rank}.npz"), **pieces)
+    with open(os.path.join(ckpt_dir, f"shard_index_{rank}.json"), "w") as f:
+        json.dump(index, f)
+
+
+class ShardedCheckpoint:
+    """Reader over the union of all ranks' shard index files."""
+
+    def __init__(self, ckpt_dir):
+        self.ckpt_dir = ckpt_dir
+        self.leaves = {}   # "stem:path" -> {shape, dtype, pieces:[...]}
+        self._files = {}
+        found = False
+        for fname in sorted(os.listdir(ckpt_dir)):
+            if not (fname.startswith("shard_index_") and
+                    fname.endswith(".json")):
+                continue
+            found = True
+            with open(os.path.join(ckpt_dir, fname)) as f:
+                for full, info in json.load(f).items():
+                    entry = self.leaves.setdefault(full, {
+                        "shape": tuple(info["shape"]),
+                        "dtype": np.dtype(info["dtype"]),
+                        "pieces": []})
+                    for p in info["pieces"]:
+                        entry["pieces"].append(
+                            {"file": info["file"], **p})
+        if not found:
+            raise FileNotFoundError(f"no shard_index_*.json in {ckpt_dir}")
+
+    def _piece(self, file, key, dtype, shape):
+        if file not in self._files:
+            self._files[file] = np.load(
+                os.path.join(self.ckpt_dir, file), allow_pickle=False)
+        raw = self._files[file][key]
+        return np.frombuffer(raw.tobytes(), dtype).reshape(shape)
+
+    def close(self):
+        for f in self._files.values():
+            f.close()
+        self._files = {}
+
+    def struct(self, stem):
+        """Nested dict of jax.ShapeDtypeStruct for one tree."""
+        flat = {}
+        pre = f"{stem}:"
+        for full, info in self.leaves.items():
+            if full.startswith(pre):
+                flat[full[len(pre):]] = jax.ShapeDtypeStruct(
+                    info["shape"], info["dtype"])
+        return _unflatten(flat)
+
+    def _read_window(self, info, idx):
+        """Assemble the region `idx` (tuple of slices) of one leaf from
+        whichever pieces overlap it."""
+        shape = info["shape"]
+        start = [0 if s.start is None else int(s.start) for s in idx]
+        stop = [shape[d] if s.stop is None else int(s.stop)
+                for d, s in enumerate(idx)]
+        out = np.empty([b - a for a, b in zip(start, stop)],
+                       info["dtype"])
+        filled = 0
+        for p in info["pieces"]:
+            inter_a = [max(a, pa) for a, pa in zip(start, p["start"])]
+            inter_b = [min(b, pb) for b, pb in zip(stop, p["stop"])]
+            if any(a >= b for a, b in zip(inter_a, inter_b)):
+                continue
+            src = self._piece(p["file"], p["key"], info["dtype"],
+                              [b - a for a, b in zip(p["start"], p["stop"])])
+            src_sl = tuple(slice(a - pa, b - pa) for a, pa, b in
+                           zip(inter_a, p["start"], inter_b))
+            dst_sl = tuple(slice(a - sa, b - sa) for a, sa, b in
+                           zip(inter_a, start, inter_b))
+            out[dst_sl] = src[src_sl]
+            filled += int(np.prod([b - a for a, b in zip(inter_a, inter_b)]))
+        # pieces never overlap (each came from a distinct replica-0 shard
+        # window), so full coverage <=> the element counts add up; anything
+        # less means a rank's shard/index files are missing and resuming
+        # would read uninitialized memory
+        if filled != out.size:
+            raise IOError(
+                f"checkpoint window incomplete: assembled {filled} of "
+                f"{out.size} elements (missing shard files in "
+                f"{self.ckpt_dir}?)")
+        return out
+
+    def assemble(self, stem, shardings=None):
+        """Rebuild one tree. With `shardings` (pytree of jax shardings
+        matching struct(stem)): each process reads only the windows of its
+        own addressable shards via make_array_from_callback. Without:
+        plain full numpy assembly (single-host convenience)."""
+        struct = self.struct(stem)
+        flat_sh = dict(_walk(shardings)) if shardings is not None else {}
+
+        def build(path):
+            info = self.leaves[f"{stem}:{path}"]
+            sh = flat_sh.get(path)
+            if sh is None:
+                return self._read_window(
+                    info, tuple(slice(0, s) for s in info["shape"]))
+            return jax.make_array_from_callback(
+                tuple(info["shape"]), sh,
+                lambda idx, info=info: self._read_window(info, idx))
+
+        flat = {p: build(p) for p, _ in _walk(struct)}
+        return _unflatten(flat)
+
+
+# ---------------------------------------------------------------- public API
+
+def save_checkpoint(save_dir, tag, state, extra, save_latest=True,
+                    zero_stage=0):
     ckpt_dir = os.path.join(save_dir, str(tag))
     os.makedirs(ckpt_dir, exist_ok=True)
     rank = jax.process_index()
 
-    if rank == 0:
-        save_tree(os.path.join(ckpt_dir, "mp_rank_00_model_states.npz"),
-                  {"params": state.params})
-    optim_tree = {
-        "opt_state": state.opt_state,
-        "scaler": state.scaler,
-        "global_step": state.global_step,
-        "skipped_steps": state.skipped_steps,
-    }
-    save_tree(os.path.join(
-        ckpt_dir, f"zero_pp_rank_{rank}_mp_rank_00_optim_states.npz"), optim_tree)
+    _save_sharded_trees(ckpt_dir, {
+        "model_states": {"params": state.params},
+        "optim_states": {
+            "opt_state": state.opt_state,
+            "scaler": state.scaler,
+            "global_step": state.global_step,
+            "skipped_steps": state.skipped_steps,
+        },
+    })
+
+    if jax.process_count() > 1:
+        # loaders need EVERY rank's shard files, so the `latest` pointer
+        # (and meta) must not be published until all ranks finished writing
+        # (the reference's tag-consistency barrier, engine.py:1745-1760)
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"ckpt_save:{tag}")
 
     if rank == 0:
         meta = dict(extra)
@@ -103,15 +280,62 @@ def read_latest_tag(load_dir):
     return None
 
 
-def load_checkpoint(load_dir, tag=None):
+def _load_meta(ckpt_dir):
+    meta_path = os.path.join(ckpt_dir, "meta.json")
+    meta = {}
+    if os.path.isfile(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    for key in ("global_steps", "micro_steps", "global_samples",
+                "skipped_steps"):
+        if key in meta:
+            try:
+                meta[key] = int(meta[key])
+            except (TypeError, ValueError):
+                pass
+    return meta
+
+
+def load_checkpoint(load_dir, tag=None, shardings_fn=None):
     """Returns ({params, opt_state, scaler, global_step, skipped_steps},
     meta) or None if nothing to load (reference engine.py:1600 warns and
-    returns None)."""
+    returns None).
+
+    shardings_fn(struct) -> matching tree of jax shardings (or None): when
+    given and the checkpoint is in the sharded format, each process reads
+    only its own shard windows. `struct` has the same {"params":...,
+    "opt_state":..., ...} layout with ShapeDtypeStruct leaves.
+    """
     if tag is None:
         tag = read_latest_tag(load_dir)
         if tag is None:
             return None
     ckpt_dir = os.path.join(load_dir, str(tag))
+    try:
+        reader = ShardedCheckpoint(ckpt_dir)
+    except (FileNotFoundError, NotADirectoryError):
+        return _load_checkpoint_legacy(ckpt_dir)
+
+    struct = dict(reader.struct("model_states"))
+    struct.update(reader.struct("optim_states"))
+    shardings = shardings_fn(struct) if shardings_fn is not None else None
+
+    def sub(tree, key):
+        return None if tree is None else tree.get(key)
+
+    state = {"params": reader.assemble(
+        "model_states", {"params": sub(shardings, "params")})["params"]}
+    optim_sh = None
+    if shardings is not None:
+        optim_sh = {k: shardings.get(k) for k in
+                    ("opt_state", "scaler", "global_step", "skipped_steps")}
+    state.update(reader.assemble("optim_states", optim_sh))
+    reader.close()
+    return state, _load_meta(ckpt_dir)
+
+
+def _load_checkpoint_legacy(ckpt_dir):
+    """r1 format: full-tree npz per rank."""
     model_path = os.path.join(ckpt_dir, "mp_rank_00_model_states.npz")
     if not os.path.isfile(model_path):
         return None
@@ -120,27 +344,21 @@ def load_checkpoint(load_dir, tag=None):
     optim_path = os.path.join(
         ckpt_dir, f"zero_pp_rank_{rank}_mp_rank_00_optim_states.npz")
     if not os.path.isfile(optim_path):
-        optim_path = os.path.join(ckpt_dir, "zero_pp_rank_0_mp_rank_00_optim_states.npz")
-    optim = load_tree(optim_path)
-    state.update(optim)
-    meta_path = os.path.join(ckpt_dir, "meta.json")
-    meta = {}
-    if os.path.isfile(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
-    for key in ("global_steps", "micro_steps", "global_samples", "skipped_steps"):
-        if key in meta:
-            try:
-                meta[key] = int(meta[key])
-            except (TypeError, ValueError):
-                pass
-    return state, meta
+        optim_path = os.path.join(
+            ckpt_dir, "zero_pp_rank_0_mp_rank_00_optim_states.npz")
+    state.update(load_tree(optim_path))
+    return state, _load_meta(ckpt_dir)
 
 
 def merge_zero_shards(ckpt_dir):
     """Offline ZeRO-shard merge: the `zero_to_fp32.py` analog (reference
-    utils/zero_to_fp32.py:70). With npz full-tree shards per process this
-    concatenates nothing for single-host saves and simply returns the fp32
-    params; kept as the stable entry point for multi-host shard merging."""
-    model_path = os.path.join(ckpt_dir, "mp_rank_00_model_states.npz")
-    return load_tree(model_path)["params"]
+    utils/zero_to_fp32.py:70) — assembles the full fp32 param tree from
+    every rank's shard files."""
+    try:
+        reader = ShardedCheckpoint(ckpt_dir)
+        params = reader.assemble("model_states")["params"]
+        reader.close()
+        return params
+    except FileNotFoundError:
+        model_path = os.path.join(ckpt_dir, "mp_rank_00_model_states.npz")
+        return load_tree(model_path)["params"]

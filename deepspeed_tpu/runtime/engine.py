@@ -1268,16 +1268,41 @@ class DeepSpeedEngine:
                              zero_stage=self.zero_optimization_stage())
         return True
 
+    def _ckpt_shardings(self, struct):
+        """Target shardings for sharded checkpoint loading — derived from
+        the ShapeDtypeStruct trees in the checkpoint index, so each process
+        reads only the windows of its own shards."""
+        try:
+            param_sh = self.zero.param_shardings(struct["params"])
+            opt_sh = self.zero.opt_state_shardings(
+                struct["opt_state"], struct["params"],
+                getattr(self.optimizer, "param_like_state_fields", ()))
+        except Exception as e:
+            logger.warning(f"sharded-load sharding derivation failed ({e}); "
+                           f"assembling full arrays on host")
+            return None
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        out = {"params": param_sh, "opt_state": opt_sh,
+               "scaler": jax.tree_util.tree_map(lambda _: repl,
+                                                struct.get("scaler", {})),
+               "global_step": repl, "skipped_steps": repl}
+        return out
+
     def load_checkpoint(self, load_dir, tag=None, load_module_only=False,
                         load_optimizer_states=True, load_lr_scheduler_states=True):
         from deepspeed_tpu.runtime import checkpointing as ckpt
-        loaded = ckpt.load_checkpoint(load_dir, tag)
+        shardings_fn = None if self._offload_cfg.enabled \
+            else self._ckpt_shardings
+        loaded = ckpt.load_checkpoint(load_dir, tag,
+                                      shardings_fn=shardings_fn)
         if loaded is None:
             logger.warning(f"Unable to find checkpoint in {load_dir}, tag={tag}")
             return None, {}
         state_tree, extra = loaded
         if (load_module_only or not load_optimizer_states) and self.state is not None:
-            state_tree["opt_state"] = jax.device_get(self.state.opt_state)
+            # keep the live (possibly non-addressable) sharded opt_state
+            # as-is — device_get would gather/fail on multi-host shards
+            state_tree["opt_state"] = self.state.opt_state
         template = TrainState(
             params=state_tree["params"],
             opt_state=state_tree["opt_state"],

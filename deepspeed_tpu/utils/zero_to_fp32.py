@@ -7,14 +7,17 @@ only, no deepspeed_tpu imports (it is shipped by copyfile at save time,
 runtime/checkpointing.py).
 
 Checkpoint layout (runtime/checkpointing.py docstring): a ``latest`` pointer
-file, tag subdirectories holding ``mp_rank_00_model_states.npz`` with
-'/'-joined tree paths as npz keys; fp32 master weights live in the params
-tree itself, so consolidation = load + strip the 'params/' prefix.
+file, tag subdirectories holding per-rank ``model_states_shard_{r}.npz``
+piece files plus ``shard_index_{r}.json`` indexes describing the global
+index window each piece covers. Consolidation = union all indexes, paste
+pieces into full arrays, strip the 'params/' prefix. (The older
+single-file ``mp_rank_00_model_states.npz`` layout is also read.)
 
     python zero_to_fp32.py <checkpoint_dir> <output_file>
 """
 
 import argparse
+import json
 import os
 
 import numpy as np
@@ -40,15 +43,70 @@ def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None):
             raise FileNotFoundError(
                 f"no 'latest' file in {checkpoint_dir}; pass an explicit tag")
     ckpt_dir = os.path.join(checkpoint_dir, str(tag))
+    indexes = [f for f in sorted(os.listdir(ckpt_dir))
+               if f.startswith("shard_index_") and f.endswith(".json")]
+    if indexes:
+        return _assemble_sharded(ckpt_dir, indexes)
     model_path = os.path.join(ckpt_dir, MODEL_STATES_FILE)
     if not os.path.isfile(model_path):
-        raise FileNotFoundError(f"model states not found: {model_path}")
+        raise FileNotFoundError(
+            f"no shard_index_*.json and no {MODEL_STATES_FILE} in {ckpt_dir}")
     out = {}
     with np.load(model_path, allow_pickle=False) as data:
         for key in data.files:
             if key.startswith("params/"):
                 out[key[len("params/"):]] = np.asarray(data[key], np.float32)
     return out
+
+
+def _assemble_sharded(ckpt_dir, index_files):
+    """Merge every rank's model-state pieces into full fp32 arrays."""
+    leaves = {}
+    for fname in index_files:
+        with open(os.path.join(ckpt_dir, fname)) as f:
+            for full, info in json.load(f).items():
+                stem, path = full.split(":", 1)
+                if stem != "model_states" or not path.startswith("params/"):
+                    continue
+                entry = leaves.setdefault(
+                    path[len("params/"):],
+                    {"shape": tuple(info["shape"]),
+                     "dtype": info["dtype"], "pieces": []})
+                for p in info["pieces"]:
+                    entry["pieces"].append({"file": info["file"], **p})
+    out = {}
+    files = {}
+    for path, info in leaves.items():
+        arr = np.zeros(info["shape"], np.float32)
+        filled = 0
+        for p in info["pieces"]:
+            if p["file"] not in files:
+                files[p["file"]] = np.load(
+                    os.path.join(ckpt_dir, p["file"]), allow_pickle=False)
+            shape = [b - a for a, b in zip(p["start"], p["stop"])]
+            piece = _decode(files[p["file"]][p["key"]], info["dtype"], shape)
+            sl = tuple(slice(a, b) for a, b in zip(p["start"], p["stop"]))
+            arr[sl] = piece
+            filled += int(np.prod(shape))
+        if filled != arr.size:
+            raise IOError(
+                f"{path}: assembled {filled} of {arr.size} elements — a "
+                f"rank's shard files are missing from {ckpt_dir}")
+        out[path] = arr
+    for f in files.values():
+        f.close()
+    return out
+
+
+def _decode(raw, dtype, shape):
+    """Pieces are stored as raw bytes (npz can't round-trip bfloat16);
+    decode without requiring ml_dtypes: bf16 widens via a <<16 bit shift."""
+    buf = raw.tobytes()
+    if dtype == "bfloat16":
+        u16 = np.frombuffer(buf, np.uint16).astype(np.uint32) << 16
+        return u16.view(np.float32).astype(np.float32).reshape(shape)
+    return np.asarray(
+        np.frombuffer(buf, np.dtype(dtype)).reshape(shape), np.float32)
 
 
 def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir, output_file,

@@ -1,0 +1,93 @@
+"""Sharded checkpoint format unit tests (multi-process behavior:
+tests/test_multiprocess_dist.py::test_sharded_checkpoint_two_processes_and_resize)."""
+
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime import checkpointing as ckpt
+
+
+class _State:
+    def __init__(self, params, opt_state):
+        self.params = params
+        self.opt_state = opt_state
+        self.scaler = {"loss_scale": jnp.float32(1.0)}
+        self.global_step = jnp.int32(3)
+        self.skipped_steps = jnp.int32(0)
+
+
+def _roundtrip(tmp_path, params, opt):
+    ckpt.save_checkpoint(str(tmp_path), "t", _State(params, opt),
+                         {"global_steps": 3})
+    state, meta = ckpt.load_checkpoint(str(tmp_path))
+    return state, meta
+
+
+def test_bf16_leaves_roundtrip(tmp_path):
+    """npz cannot store ml_dtypes arrays (bfloat16 -> void '|V2'); the raw
+    byte encoding must bring them back bit-exact."""
+    params = {"w": jnp.asarray(
+        np.random.RandomState(0).randn(8, 16), jnp.bfloat16),
+        "b": jnp.zeros((16,), jnp.float32)}
+    opt = {"exp_avg": {"w": jnp.asarray(
+        np.random.RandomState(1).randn(8, 16), jnp.bfloat16)}}
+    state, meta = _roundtrip(tmp_path, params, opt)
+    assert state["params"]["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(state["params"]["w"], np.float32),
+        np.asarray(params["w"], np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(state["opt_state"]["exp_avg"]["w"], np.float32),
+        np.asarray(opt["exp_avg"]["w"], np.float32))
+    assert int(state["global_step"]) == 3
+    assert meta["global_steps"] == 3
+
+
+def test_sharded_save_load_across_mesh(tmp_path):
+    """Save from an 8-device sharded state, reload windows under a
+    different sharding and without shardings at all."""
+    if len(jax.devices()) < 8:
+        pytest.skip("need 8 devices")
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+    mesh = make_mesh(MeshConfig(data=8))
+    sh = NamedSharding(mesh, P(None, "data"))
+    w = jax.device_put(
+        jnp.asarray(np.random.RandomState(0).randn(8, 32), jnp.float32), sh)
+    state, _ = _roundtrip(tmp_path, {"w": w}, {})
+    np.testing.assert_array_equal(np.asarray(state["params"]["w"]),
+                                  np.asarray(w))
+    # reload through explicit shardings on a different layout
+    reader = ckpt.ShardedCheckpoint(os.path.join(str(tmp_path), "t"))
+    sh2 = NamedSharding(mesh, P("data", None))
+    tree = reader.assemble("model_states", {"params": {"w": sh2}})
+    reader.close()
+    np.testing.assert_array_equal(np.asarray(tree["params"]["w"]),
+                                  np.asarray(w))
+
+
+def test_missing_shard_file_raises(tmp_path):
+    """A deleted shard file must fail the load loudly, not resume from
+    uninitialized memory."""
+    params = {"w": jnp.ones((4, 4), jnp.float32)}
+    ckpt.save_checkpoint(str(tmp_path), "t", _State(params, {}), {})
+    tag_dir = os.path.join(str(tmp_path), "t")
+    os.remove(os.path.join(tag_dir, "model_states_shard_0.npz"))
+    with pytest.raises((IOError, FileNotFoundError, KeyError)):
+        state, _ = ckpt.load_checkpoint(str(tmp_path))
+        np.asarray(state["params"]["w"])
+
+
+def test_zero_to_fp32_reads_sharded_format(tmp_path):
+    from deepspeed_tpu.utils import zero_to_fp32 as z2f
+    params = {"w": jnp.asarray(
+        np.random.RandomState(2).randn(4, 8), jnp.bfloat16)}
+    ckpt.save_checkpoint(str(tmp_path), "t", _State(params, {}), {})
+    sd = z2f.get_fp32_state_dict_from_zero_checkpoint(str(tmp_path))
+    assert sd["w"].dtype == np.float32
+    np.testing.assert_allclose(sd["w"],
+                               np.asarray(params["w"], np.float32))

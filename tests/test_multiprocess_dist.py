@@ -183,3 +183,128 @@ def test_engine_trains_across_two_processes(tmp_path):
         batch = random_batch()
         ref = [float(engine.train_batch(batch)) for _ in range(3)]
         np.testing.assert_allclose(curves[0], ref, rtol=1e-4, atol=1e-5)
+
+
+_CKPT_WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from deepspeed_tpu.utils.distributed import init_distributed
+    init_distributed()
+
+    import numpy as np
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+    from tests.simple_model import SimpleModel, random_batch, base_config
+
+    ckpt_dir = sys.argv[1]
+    mesh = make_mesh(MeshConfig(data=8))
+    cfg = base_config()
+    cfg["zero_optimization"] = {"stage": 3,
+                                "stage3_param_persistence_threshold": 0}
+    cfg["seed"] = 3
+
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                       mesh=mesh)
+    batch = random_batch()
+    for _ in range(2):
+        engine.train_batch(batch)
+    engine.save_checkpoint(ckpt_dir, tag="t0")
+    cont = float(engine.train_batch(batch))
+
+    # fresh engine, restore, repeat the 3rd step — must match exactly
+    engine2, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                        mesh=mesh)
+    tag, _ = engine2.load_checkpoint(ckpt_dir, tag="t0")
+    assert tag == "t0"
+    resumed = float(engine2.train_batch(batch))
+    print(f"STEP3 {jax.process_index()} {cont:.6f} {resumed:.6f}",
+          flush=True)
+""")
+
+
+def test_sharded_checkpoint_two_processes_and_resize(tmp_path):
+    """ZeRO-3 sharded save across 2 real processes: each rank writes only
+    its own shard windows (no full-tree gather), restore reproduces the
+    training trajectory bit-exactly, and the same checkpoint restores into
+    a SINGLE-process engine (world-size resize, the reference's elastic
+    restore zero/stage1.py:898-1031)."""
+    script = tmp_path / "ckpt_worker.py"
+    ckpt_dir = tmp_path / "ckpt"
+    script.write_text(_CKPT_WORKER)
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "DSTPU_COORDINATOR_ADDR": "127.0.0.1",
+            "DSTPU_COORDINATOR_PORT": str(port),
+            "DSTPU_NUM_PROCESSES": "2",
+            "DSTPU_PROCESS_ID": str(rank),
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "PYTHONPATH": REPO_ROOT + os.pathsep
+            + os.environ.get("PYTHONPATH", ""),
+        })
+        env.pop("DSTPU_LOCAL_DEVICE_IDS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), str(ckpt_dir)], env=env,
+            cwd=REPO_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"rank {rank} hung")
+        outs.append(out)
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+
+    import re
+    for out in outs:
+        m = re.search(r"STEP3 \d+ ([\d.-]+) ([\d.-]+)", out)
+        assert m, out
+        assert m.group(1) == m.group(2), f"resume diverged: {out}"
+
+    # every rank wrote its own shard files; the optimizer state was never
+    # gathered into one file
+    import json
+    import numpy as np
+    tag_dir = ckpt_dir / "t0"
+    for rank in range(2):
+        assert (tag_dir / f"optim_states_shard_{rank}.npz").exists()
+        assert (tag_dir / f"shard_index_{rank}.json").exists()
+    per_rank_elems = []
+    for rank in range(2):
+        with open(tag_dir / f"shard_index_{rank}.json") as f:
+            idx = json.load(f)
+        key = "optim_states:opt_state/exp_avg/Dense_0/kernel"
+        info = idx[key]
+        full = int(np.prod(info["shape"]))
+        elems = sum(int(np.prod([b - a for a, b in
+                                 zip(p["start"], p["stop"])]))
+                    for p in info["pieces"])
+        per_rank_elems.append(elems)
+        assert 0 < elems < full, (rank, elems, full)
+    assert sum(per_rank_elems) == int(np.prod(info["shape"]))
+
+    # world-size resize: restore the 2-process checkpoint into THIS
+    # single process (8 local devices)
+    import jax
+    if len(jax.devices()) >= 8:
+        import deepspeed_tpu as dstpu
+        from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+        from tests.simple_model import SimpleModel, random_batch, base_config
+        cfg = base_config()
+        cfg["zero_optimization"] = {"stage": 3,
+                                    "stage3_param_persistence_threshold": 0}
+        cfg["seed"] = 3
+        engine, _, _, _ = dstpu.initialize(
+            config=cfg, model=SimpleModel(),
+            mesh=make_mesh(MeshConfig(data=8)))
+        tag, _ = engine.load_checkpoint(str(ckpt_dir), tag="t0")
+        assert tag == "t0"
+        resumed = float(engine.train_batch(random_batch()))
+        assert np.isfinite(resumed)
