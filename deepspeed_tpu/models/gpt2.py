@@ -241,6 +241,15 @@ class ScanBody(nn.Module):
 class GPT2LMHeadModel(nn.Module):
     config: GPT2Config
 
+    @property
+    def sparse_grad_params(self):
+        """Leaves eligible for the engine's row-sparse gradient exchange
+        (sparse_gradients config). Only the UNTIED input embedding
+        qualifies: a tied LM head adds a dense d(logits)/d(wte) term
+        touching every vocabulary row, so compressing would drop real
+        gradient."""
+        return () if self.config.tie_word_embeddings else ("wte",)
+
     @nn.compact
     def __call__(self, input_ids, deterministic=True, keep_prob=1.0,
                  labels=None):

@@ -46,8 +46,71 @@ def _gpt2_leaf_spec(path_names, shape):
 
 def gpt2_tp_specs(params):
     """PartitionSpec tree matching a GPT2LMHeadModel params tree."""
+    return _walk_specs(params, _gpt2_leaf_spec)
+
+
+def _bert_leaf_spec(path_names, shape):
+    """Megatron TP layout for the fused BERT encoder
+    (ops/transformer/transformer.py param names):
+      attn_qkvw / inter_w kernels+biases → column parallel
+      attn_ow / output_w kernels         → row parallel
+      word_embeddings                    → vocab parallel
+      layernorms, heads, position/token-type embeddings → replicated
+    """
+    name = path_names[-1]
+    parent = path_names[-2] if len(path_names) >= 2 else ""
+    ndim = len(shape)
+    if name == "word_embeddings":
+        s = [None] * ndim
+        s[0] = MODEL_AXIS
+        return P(*s)
+    if parent in ("attn_qkvw", "inter_w"):
+        return P(*([None] * (ndim - 1) + [MODEL_AXIS]))
+    if parent in ("attn_ow", "output_w") and name == "kernel":
+        s = [None] * ndim
+        s[ndim - 2] = MODEL_AXIS
+        return P(*s)
+    return P(*([None] * ndim))
+
+
+def _walk_specs(params, leaf_fn):
     def walk(tree, path):
         if isinstance(tree, dict):
             return {k: walk(v, path + (k,)) for k, v in tree.items()}
-        return _gpt2_leaf_spec(path, tree.shape)
+        return leaf_fn(path, tree.shape)
     return walk(params, ())
+
+
+# -- registry ---------------------------------------------------------------
+# Maps model CLASS NAMES (strings, so model modules need not import here)
+# to leaf-spec functions. The engine consults this when the mesh has a
+# model axis and the model doesn't expose param_partition_specs itself —
+# the replacement for the reference's delegation to an external Megatron
+# `mpu` (SURVEY §2.3).
+
+_TP_RULES = {
+    "GPT2LMHeadModel": _gpt2_leaf_spec,
+    "BertModel": _bert_leaf_spec,
+    "BertForPreTraining": _bert_leaf_spec,
+    "BertForQuestionAnswering": _bert_leaf_spec,
+    "BertForSequenceClassification": _bert_leaf_spec,
+}
+
+
+def register_tp_rules(model_cls_or_name, leaf_fn):
+    """Register Megatron-style sharding rules for a model class:
+    leaf_fn(path_names, shape) -> PartitionSpec. Accepts the class or its
+    name. User models can also just expose `param_partition_specs`."""
+    name = model_cls_or_name if isinstance(model_cls_or_name, str) \
+        else model_cls_or_name.__name__
+    _TP_RULES[name] = leaf_fn
+
+
+def tp_specs_for(model, params):
+    """Resolve registered TP rules for `model` over a params(-shapes) tree;
+    None when no rules are registered for its class (or bases)."""
+    for cls in type(model).__mro__:
+        fn = _TP_RULES.get(cls.__name__)
+        if fn is not None:
+            return _walk_specs(params, fn)
+    return None

@@ -79,8 +79,11 @@ def load_tree(path):
 
 def _local_pieces(leaf):
     """Yield (piece_array, start, stop) for this process's replica-0 shards
-    of `leaf` (whole-array for plain numpy / single-device values)."""
-    if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+    of `leaf` (whole-array for plain numpy / process-local values)."""
+    is_global_jax = isinstance(leaf, jax.Array) \
+        and hasattr(leaf, "addressable_shards") \
+        and not (jax.process_count() > 1 and leaf.is_fully_addressable)
+    if is_global_jax:
         for sh in leaf.addressable_shards:
             if sh.replica_id != 0:
                 continue
@@ -90,6 +93,11 @@ def _local_pieces(leaf):
                     for d, s in enumerate(idx)]
             yield np.asarray(sh.data), start, stop
     else:
+        # plain numpy, or a PROCESS-LOCAL jax array in a multi-process job
+        # (fully addressable on every process — each process would claim a
+        # replica-0 full window and double-cover the leaf): rank 0's value
+        # is saved, like the reference's rank-criteria model save
+        # (engine.py:508-524)
         arr = np.asarray(leaf)
         if jax.process_index() == 0:
             yield arr, [0] * arr.ndim, list(arr.shape)
@@ -199,10 +207,12 @@ class ShardedCheckpoint:
         # less means a rank's shard/index files are missing and resuming
         # would read uninitialized memory
         if filled != out.size:
+            why = "missing" if filled < out.size \
+                else "duplicated (stale save generations?)"
             raise IOError(
-                f"checkpoint window incomplete: assembled {filled} of "
-                f"{out.size} elements (missing shard files in "
-                f"{self.ckpt_dir}?)")
+                f"checkpoint window inconsistent: assembled {filled} of "
+                f"{out.size} elements — shard files in {self.ckpt_dir} "
+                f"are {why}")
         return out
 
     def assemble(self, stem, shardings=None):
@@ -229,11 +239,26 @@ class ShardedCheckpoint:
 
 # ---------------------------------------------------------------- public API
 
+def _sync(label):
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(label)
+
+
 def save_checkpoint(save_dir, tag, state, extra, save_latest=True,
                     zero_stage=0):
-    ckpt_dir = os.path.join(save_dir, str(tag))
-    os.makedirs(ckpt_dir, exist_ok=True)
+    final_dir = os.path.join(save_dir, str(tag))
+    # write into a staging directory and swap in at the end: re-saving an
+    # existing tag must neither mix shard generations (world-size changes
+    # leave stale higher-rank files whose windows would double-cover) nor
+    # destroy the previous valid save if the job dies mid-write
+    ckpt_dir = final_dir + ".saving"
     rank = jax.process_index()
+    if rank == 0:
+        import shutil
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        os.makedirs(ckpt_dir, exist_ok=True)
+    _sync(f"ckpt_stage:{tag}")
 
     _save_sharded_trees(ckpt_dir, {
         "model_states": {"params": state.params},
@@ -245,19 +270,27 @@ def save_checkpoint(save_dir, tag, state, extra, save_latest=True,
         },
     })
 
-    if jax.process_count() > 1:
-        # loaders need EVERY rank's shard files, so the `latest` pointer
-        # (and meta) must not be published until all ranks finished writing
-        # (the reference's tag-consistency barrier, engine.py:1745-1760)
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(f"ckpt_save:{tag}")
+    # loaders need EVERY rank's shard files, so the swap-in (and the
+    # `latest` pointer) must not happen until all ranks finished writing
+    # (the reference's tag-consistency barrier, engine.py:1745-1760)
+    _sync(f"ckpt_save:{tag}")
 
     if rank == 0:
+        import shutil
         meta = dict(extra)
         meta["zero_stage"] = zero_stage
         meta["world_size"] = jax.process_count()
         with open(os.path.join(ckpt_dir, "meta.json"), "w") as f:
             json.dump(meta, f, default=str)
+        # swap the finished save into place; a crash in this window leaves
+        # either the old tag or `{tag}.old` on disk, never nothing
+        old_dir = final_dir + ".old"
+        shutil.rmtree(old_dir, ignore_errors=True)
+        if os.path.isdir(final_dir):
+            os.rename(final_dir, old_dir)
+        os.rename(ckpt_dir, final_dir)
+        shutil.rmtree(old_dir, ignore_errors=True)
+        ckpt_dir = final_dir
         if save_latest:
             with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
                 f.write(str(tag))
@@ -296,7 +329,8 @@ def _load_meta(ckpt_dir):
     return meta
 
 
-def load_checkpoint(load_dir, tag=None, shardings_fn=None):
+def load_checkpoint(load_dir, tag=None, shardings_fn=None,
+                    load_optimizer=True):
     """Returns ({params, opt_state, scaler, global_step, skipped_steps},
     meta) or None if nothing to load (reference engine.py:1600 warns and
     returns None).
@@ -305,6 +339,11 @@ def load_checkpoint(load_dir, tag=None, shardings_fn=None):
     given and the checkpoint is in the sharded format, each process reads
     only its own shard windows. `struct` has the same {"params":...,
     "opt_state":..., ...} layout with ShapeDtypeStruct leaves.
+
+    load_optimizer=False skips reading the opt_state shards entirely
+    (typically 2x the parameter bytes of disk IO) — the returned tree has
+    opt_state={}; callers doing module-only restores substitute their live
+    optimizer state.
     """
     if tag is None:
         tag = read_latest_tag(load_dir)
@@ -315,6 +354,11 @@ def load_checkpoint(load_dir, tag=None, shardings_fn=None):
         reader = ShardedCheckpoint(ckpt_dir)
     except (FileNotFoundError, NotADirectoryError):
         return _load_checkpoint_legacy(ckpt_dir)
+
+    if not load_optimizer:
+        for full in list(reader.leaves):
+            if full.startswith("optim_states:opt_state/"):
+                del reader.leaves[full]
 
     struct = dict(reader.struct("model_states"))
     struct.update(reader.struct("optim_states"))
@@ -328,8 +372,10 @@ def load_checkpoint(load_dir, tag=None, shardings_fn=None):
     optim_sh = None
     if shardings is not None:
         optim_sh = {k: shardings.get(k) for k in
-                    ("opt_state", "scaler", "global_step", "skipped_steps")}
+                    ("opt_state", "scaler", "global_step", "skipped_steps")
+                    if k in struct}
     state.update(reader.assemble("optim_states", optim_sh))
+    state.setdefault("opt_state", {})
     reader.close()
     return state, _load_meta(ckpt_dir)
 

@@ -142,7 +142,8 @@ class DeepSpeedEngine:
                   else DeepSpeedConfig.load_param_dict(config))
             mc = MeshConfigSection(pd)
             mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(
-                data=mc.data, model=mc.model, pipe=mc.pipe, seq=mc.seq))
+                data=mc.data, model=mc.model, pipe=mc.pipe, seq=mc.seq,
+                expert=mc.expert))
         self.mesh = mesh
         mesh_lib.set_current_mesh(mesh)
         # pipeline modules re-layout their params for the 1F1B executor;
@@ -379,7 +380,7 @@ class DeepSpeedEngine:
         pure_dp = (self.zero_optimization_stage() == 0 and all(
             mesh_lib.mesh_axis_size(self.mesh, a) == 1
             for a in (mesh_lib.PIPE_AXIS, mesh_lib.SEQ_AXIS,
-                      mesh_lib.MODEL_AXIS)))
+                      mesh_lib.MODEL_AXIS, mesh_lib.EXPERT_AXIS)))
         if not pure_dp:
             logger.warning(
                 "1-bit optimizer requested with ZeRO stage "
@@ -427,16 +428,26 @@ class DeepSpeedEngine:
         if mesh_lib.mesh_axis_size(self.mesh, mesh_lib.MODEL_AXIS) <= 1:
             return
         try:
-            from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
-            from deepspeed_tpu.models.sharding import gpt2_tp_specs
-            if isinstance(self.module, GPT2LMHeadModel):
-                shapes = jax.eval_shape(
-                    lambda r, xx: self.module.init(r, xx), self._rng, x)
-                self._param_tp_specs = gpt2_tp_specs(
-                    shapes["params"] if "params" in shapes else shapes)
-                self.zero.tp_specs = self._param_tp_specs
+            from deepspeed_tpu.models.sharding import tp_specs_for
+            shapes = jax.eval_shape(
+                lambda r, xx: self.module.init(r, xx), self._rng, x)
+            specs = tp_specs_for(
+                self.module, shapes["params"] if "params" in shapes
+                else shapes)
+            if specs is not None:
+                self._param_tp_specs = specs
+                self.zero.tp_specs = specs
+                return
         except Exception as e:
             logger.warning(f"TP spec auto-derivation failed: {e}")
+        logger.warning(
+            f"mesh has model axis "
+            f"{mesh_lib.mesh_axis_size(self.mesh, mesh_lib.MODEL_AXIS)} but "
+            f"no tensor-parallel sharding rules are known for "
+            f"{type(self.module).__name__}: parameters will be REPLICATED "
+            f"across the model axis (TP is a no-op). Register rules via "
+            f"deepspeed_tpu.models.sharding.register_tp_rules or expose "
+            f"param_partition_specs on the model.")
 
     def _init_state(self, params=None, example_batch=None):
         if params is None:
@@ -747,6 +758,8 @@ class DeepSpeedEngine:
         self._jit_apply_grads = jax.jit(apply_grads_fn, donate_argnums=(0, 1))
         if self._compressed_comm_active():
             self._jit_train_batch = self._build_compressed_train_fn(loss_fn)
+        elif self._sparse_grad_active():
+            self._jit_train_batch = self._build_sparse_train_fn(loss_fn)
 
         try:
             accepts_det = "deterministic" in inspect.signature(
@@ -767,6 +780,61 @@ class DeepSpeedEngine:
         self._jit_eval = jax.jit(eval_fn)
         self._last_lr = None
 
+    def _local_grad_accumulator(self, loss_fn, axis):
+        """Shared scaffold for the explicit-comm (shard_map) train paths
+        (1-bit compressed, row-sparse): per-device rng folding and local
+        gradient accumulation over gas microbatches — grads come back
+        LOCAL to the data shard, in fp32, loss averaged locally."""
+        gas = self.gradient_accumulation_steps()
+        keep_fn = self._keep_prob_fn()
+
+        def accumulate(state, batch, rng):
+            tm = jax.tree_util.tree_map
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+            scale = state.scaler["loss_scale"]
+            keep_prob = keep_fn(state.global_step)
+
+            def micro_grads(micro, r):
+                def scaled(p):
+                    loss = loss_fn(p, micro, r, keep_prob)
+                    return (loss * scale).astype(jnp.float32), loss
+                return jax.grad(scaled, has_aux=True)(state.params)
+
+            if gas == 1:
+                grads, loss = micro_grads(batch, rng)
+                grads = tm(lambda g: g.astype(jnp.float32), grads)
+            else:
+                chunked = tm(lambda x: x.reshape(
+                    (gas, x.shape[0] // gas) + x.shape[1:]), batch)
+                rngs = jax.random.split(rng, gas)
+
+                def body(acc, inp):
+                    micro, r = inp
+                    g, l = micro_grads(micro, r)
+                    acc_g, acc_l = acc
+                    return (tm(lambda a, gg: a + gg.astype(jnp.float32)
+                               / gas, acc_g, g), acc_l + l / gas), None
+                zero_g = tm(lambda p: jnp.zeros(p.shape, jnp.float32),
+                            state.params)
+                (grads, loss), _ = jax.lax.scan(
+                    body, (zero_g, jnp.float32(0.0)), (chunked, rngs))
+            return grads, loss
+
+        return accumulate
+
+    @staticmethod
+    def _finish_explicit_state(state, new_params, new_opt, finite,
+                               precision):
+        """Overflow-skip + scaler/counter epilogue shared by the explicit-
+        comm train paths (mirrors _apply_grads' tail)."""
+        new_params = _tree_where(finite, new_params, state.params)
+        new_opt = _tree_where(finite, new_opt, state.opt_state)
+        new_scaler = prec.update_scaler(state.scaler, precision, finite)
+        return TrainState(
+            params=new_params, opt_state=new_opt, scaler=new_scaler,
+            global_step=state.global_step + finite.astype(jnp.int32),
+            skipped_steps=state.skipped_steps + (~finite).astype(jnp.int32))
+
     def _build_compressed_train_fn(self, loss_fn):
         """shard_map train step for 1-bit optimizers: grads stay LOCAL to
         each data shard (no GSPMD psum), the optimizer's step_local runs the
@@ -776,13 +844,12 @@ class DeepSpeedEngine:
         with a leading [dp] axis."""
         mesh = self.mesh
         axis = mesh_lib.DATA_AXIS
-        gas = self.gradient_accumulation_steps()
         cfg = self._config
         state = self.state
-        keep_fn = self._keep_prob_fn()
         lr_fn = self._lr_fn()
         opt = self.optimizer
         precision = self.precision
+        accumulate = self._local_grad_accumulator(loss_fn, axis)
         spec_like = lambda tree, s: jax.tree_util.tree_map(  # noqa: E731
             lambda _: s, tree)
 
@@ -811,34 +878,8 @@ class DeepSpeedEngine:
             def inner(state, batch, rng):
                 tm = jax.tree_util.tree_map
                 # per-device dropout streams over distinct data shards
-                rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+                grads, loss = accumulate(state, batch, rng)
                 scale = state.scaler["loss_scale"]
-                keep_prob = keep_fn(state.global_step)
-
-                def micro_grads(micro, r):
-                    def scaled(p):
-                        loss = loss_fn(p, micro, r, keep_prob)
-                        return (loss * scale).astype(jnp.float32), loss
-                    return jax.grad(scaled, has_aux=True)(state.params)
-
-                if gas == 1:
-                    grads, loss = micro_grads(batch, rng)
-                    grads = tm(lambda g: g.astype(jnp.float32), grads)
-                else:
-                    chunked = tm(lambda x: x.reshape(
-                        (gas, x.shape[0] // gas) + x.shape[1:]), batch)
-                    rngs = jax.random.split(rng, gas)
-
-                    def body(acc, inp):
-                        micro, r = inp
-                        g, l = micro_grads(micro, r)
-                        acc_g, acc_l = acc
-                        return (tm(lambda a, gg: a + gg.astype(jnp.float32)
-                                   / gas, acc_g, g), acc_l + l / gas), None
-                    zero_g = tm(lambda p: jnp.zeros(p.shape, jnp.float32),
-                                state.params)
-                    (grads, loss), _ = jax.lax.scan(
-                        body, (zero_g, jnp.float32(0.0)), (chunked, rngs))
 
                 inv = 1.0 / scale
                 grads = tm(lambda g: g * inv, grads)
@@ -864,22 +905,147 @@ class DeepSpeedEngine:
                 for key in ("worker_error", "server_error"):
                     new_opt[key] = tm(lambda x: x[None], new_opt[key])
 
-                new_params = _tree_where(finite, new_params, state.params)
-                new_opt = _tree_where(finite, new_opt, state.opt_state)
-                new_scaler = prec.update_scaler(state.scaler, precision,
-                                                finite)
-                new_state = TrainState(
-                    params=new_params,
-                    opt_state=new_opt,
-                    scaler=new_scaler,
-                    global_step=state.global_step
-                    + finite.astype(jnp.int32),
-                    skipped_steps=state.skipped_steps
-                    + (~finite).astype(jnp.int32))
+                new_state = self._finish_explicit_state(
+                    state, new_params, new_opt, finite, precision)
                 return new_state, {
                     "loss": loss, "grad_norm": grad_norm, "lr": lr,
                     "overflow": ~finite,
-                    "loss_scale": new_scaler["loss_scale"]}
+                    "loss_scale": new_state.scaler["loss_scale"]}
+
+            return inner(state, batch, rng)
+
+        return jax.jit(train_fn, donate_argnums=(0,))
+
+    def _sparse_grad_active(self):
+        """True when the train step should exchange embedding gradients
+        row-compressed (reference sparse_gradients, engine.py:195-202 +
+        the CSR bucket split at :1459-1515). Requires the explicit-comm
+        layout (pure DP, replicated params) since GSPMD otherwise reduces
+        gradients implicitly with no collective to replace."""
+        if not self._config.sparse_gradients_enabled:
+            return False
+        pure_dp = all(
+            mesh_lib.mesh_axis_size(self.mesh, a) == 1
+            for a in (mesh_lib.MODEL_AXIS, mesh_lib.SEQ_AXIS,
+                      mesh_lib.PIPE_AXIS, mesh_lib.EXPERT_AXIS))
+        if not pure_dp or self.zero_optimization_stage() > 0 \
+                or self._offload_cfg.enabled \
+                or self._compressed_comm_active():
+            log_dist("sparse_gradients requires a pure-DP mesh with ZeRO "
+                     "stage 0 (explicit grad exchange); falling back to "
+                     "dense reduction", ranks=[0])
+            return False
+        if not self._sparse_leaf_paths():
+            log_dist(
+                "sparse_gradients enabled but the model declares no "
+                "sparse_grad_params — falling back to dense reduction. "
+                "(The declaration is deliberate: a name heuristic would "
+                "silently drop gradient for tied embeddings, whose head "
+                "term is dense over the vocabulary.)", ranks=[0])
+            return False
+        return True
+
+    def _sparse_leaf_paths(self):
+        # strictly opt-in: models declare which leaves have row-sparse
+        # gradients (GPT2LMHeadModel does, when untied)
+        pats = getattr(self.module, "sparse_grad_params", ())
+        return tuple(p.lower() for p in pats)
+
+    def _build_sparse_train_fn(self, loss_fn):
+        """shard_map train step exchanging embedding grads as compressed
+        rows: per-shard grads stay local, dense leaves psum, sparse leaves
+        go through CSRTensor compress → all_gather(rows) → scatter-add
+        (runtime/csr_tensor.py). Numerically exact: the row budget is the
+        shard's token count, and every token touches one row."""
+        from deepspeed_tpu.runtime.csr_tensor import CSRTensor
+        mesh = self.mesh
+        axis = mesh_lib.DATA_AXIS
+        cfg = self._config
+        lr_fn = self._lr_fn()
+        opt = self.optimizer
+        precision = self.precision
+        accumulate = self._local_grad_accumulator(loss_fn, axis)
+        sparse_pats = self._sparse_leaf_paths()
+        spec_like = lambda tree, s: jax.tree_util.tree_map(  # noqa: E731
+            lambda _: s, tree)
+        state_specs = TrainState(
+            params=spec_like(self.state.params, PartitionSpec()),
+            opt_state=spec_like(self.state.opt_state, PartitionSpec()),
+            scaler=spec_like(self.state.scaler, PartitionSpec()),
+            global_step=PartitionSpec(),
+            skipped_steps=PartitionSpec())
+
+        def is_sparse_path(path):
+            name = "/".join(str(getattr(k, "key", k)) for k in path).lower()
+            return any(p in name for p in sparse_pats)
+
+        def local_tokens(batch):
+            # the CSR row budget must cover the LARGEST token stream in the
+            # batch (a smaller auxiliary id array must not shrink it — the
+            # exchange would silently drop gradient rows)
+            counts = [int(np.prod(leaf.shape))
+                      for leaf in jax.tree_util.tree_leaves(batch)
+                      if jnp.issubdtype(leaf.dtype, jnp.integer)
+                      and leaf.ndim >= 2]
+            return max(counts) if counts else None
+
+        def train_fn(state, batch, rng):
+            batch_specs = spec_like(batch, PartitionSpec(axis))
+
+            @functools.partial(
+                jax.shard_map, mesh=mesh,
+                in_specs=(state_specs, batch_specs, PartitionSpec()),
+                out_specs=(state_specs, spec_like(
+                    {"loss": 0, "grad_norm": 0, "lr": 0, "overflow": 0,
+                     "loss_scale": 0}, PartitionSpec())),
+                check_vma=False)
+            def inner(state, batch, rng):
+                tm = jax.tree_util.tree_map
+                grads, loss = accumulate(state, batch, rng)
+                scale = state.scaler["loss_scale"]
+                tokens = local_tokens(batch)
+
+                def reduce_leaf(path, g):
+                    if tokens is not None and g.ndim == 2 \
+                            and is_sparse_path(path) and tokens < g.shape[0]:
+                        # row-compressed exchange (reference CSR allreduce)
+                        csr = CSRTensor.from_dense(g, tokens)
+                        all_idx = jax.lax.all_gather(csr.indices, axis)
+                        all_val = jax.lax.all_gather(csr.values, axis)
+                        out = jnp.zeros_like(g)
+                        return out.at[all_idx.reshape(-1)].add(
+                            all_val.reshape(-1, g.shape[1]), mode="drop")
+                    return jax.lax.psum(g, axis)
+
+                grads = jax.tree_util.tree_map_with_path(reduce_leaf, grads)
+                # loss_fn averaged over the LOCAL shard; the exchange above
+                # sums shard gradients, so normalize to the global mean
+                dp = mesh.shape[axis]
+                grads = tm(lambda g: g / dp, grads)
+                loss = jax.lax.pmean(loss, axis)
+                finite = prec.grads_finite(grads) if precision.fp16 \
+                    else jnp.asarray(True)
+                grad_norm = _global_norm(grads)
+                inv = 1.0 / scale
+                gscale = inv
+                if cfg.gradient_clipping and cfg.gradient_clipping > 0:
+                    gscale = inv * jnp.minimum(
+                        1.0, cfg.gradient_clipping / (grad_norm * inv + 1e-6))
+                lr = lr_fn(state.global_step)
+                if "grad_scale" in inspect.signature(opt.step).parameters:
+                    new_params, new_opt = opt.step(
+                        state.params, grads, state.opt_state, lr,
+                        grad_scale=gscale)
+                else:
+                    grads = tm(lambda g: g * gscale, grads)
+                    new_params, new_opt = opt.step(state.params, grads,
+                                                   state.opt_state, lr)
+                new_state = self._finish_explicit_state(
+                    state, new_params, new_opt, finite, precision)
+                return new_state, {
+                    "loss": loss, "grad_norm": grad_norm * inv, "lr": lr,
+                    "overflow": ~finite,
+                    "loss_scale": new_state.scaler["loss_scale"]}
 
             return inner(state, batch, rng)
 
@@ -1275,7 +1441,7 @@ class DeepSpeedEngine:
         try:
             param_sh = self.zero.param_shardings(struct["params"])
             opt_sh = self.zero.opt_state_shardings(
-                struct["opt_state"], struct["params"],
+                struct.get("opt_state", {}), struct["params"],
                 getattr(self.optimizer, "param_like_state_fields", ()))
         except Exception as e:
             logger.warning(f"sharded-load sharding derivation failed ({e}); "
@@ -1293,8 +1459,12 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime import checkpointing as ckpt
         shardings_fn = None if self._offload_cfg.enabled \
             else self._ckpt_shardings
-        loaded = ckpt.load_checkpoint(load_dir, tag,
-                                      shardings_fn=shardings_fn)
+        # module-only restores substitute the live optimizer state below —
+        # skip the (2x param bytes) opt_state shard reads entirely then
+        want_opt = load_optimizer_states and not load_module_only
+        loaded = ckpt.load_checkpoint(
+            load_dir, tag, shardings_fn=shardings_fn,
+            load_optimizer=want_opt or self.state is None)
         if loaded is None:
             logger.warning(f"Unable to find checkpoint in {load_dir}, tag={tag}")
             return None, {}

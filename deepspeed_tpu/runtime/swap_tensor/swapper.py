@@ -82,13 +82,57 @@ class TensorSwapper:
         shutil.rmtree(self.dir, ignore_errors=True)
 
 
+class _StagingArena:
+    """Staging buffers for the swap path served from one contiguous arena
+    (reference: stage 3 backs its fp16 partitions with the
+    ContiguousMemoryAllocator and defragments on demand, stage3.py:1073).
+    Live buffers are never moved — an async read may be in flight into
+    them — so the arena only defragments when nothing is live; requests it
+    cannot place contiguously fall back to a plain numpy allocation."""
+
+    def __init__(self):
+        self.arena = None
+        self._live = 0
+        self._max_numel = 0
+
+    def take(self, shape):
+        """Returns (tid_or_None, float32 array of `shape`)."""
+        from deepspeed_tpu.runtime.zero.contiguous_memory_allocator import (
+            ContiguousMemoryAllocator)
+        numel = int(np.prod(shape))
+        # grow to the LARGEST leaf seen whenever idle, so heterogeneous
+        # leaf sizes converge on an arena that fits everything after one
+        # full fetch/store cycle (first-leaf sizing would permanently
+        # exile every bigger leaf to the numpy fallback)
+        self._max_numel = max(self._max_numel, numel)
+        if self.arena is None or (self._live == 0
+                                  and self.arena.size < 4 * self._max_numel):
+            # size for double-buffering both Adam moments (2 fields x 2
+            # leaves in flight)
+            self.arena = ContiguousMemoryAllocator(4 * self._max_numel,
+                                                   np.float32)
+        can_place = self.arena._largest_free() >= numel or self._live == 0
+        if not can_place or numel > self.arena.total_free:
+            return None, np.empty(shape, np.float32)
+        tid, view = self.arena.allocate_tensor(numel)
+        self._live += 1
+        return tid, view.reshape(shape)
+
+    def give(self, tid):
+        if tid is not None:
+            self.arena.release_tensor(tid)
+            self._live -= 1
+
+
 class OptimizerStateSwapper:
     """NVMe-resident Adam moments (the ZeRO-Infinity optimizer tier —
     reference optimizer_utils.py:118). Reads are double-buffered on a
     DEDICATED aio handle (the reference's PipelinedOptimizerSwapper
     overlap, pipelined_optimizer_swapper.py:60): ``prefetch(next_leaf)``
     starts the async read of the next leaf's moments while the caller
-    computes on the current one; writes stay on the main handle."""
+    computes on the current one; writes stay on the main handle. Staging
+    buffers come from a contiguous arena (_StagingArena) instead of
+    per-call numpy churn."""
 
     FIELDS = ("exp_avg", "exp_avg_sq")
 
@@ -103,7 +147,9 @@ class OptimizerStateSwapper:
             single_submit=getattr(cfg, "single_submit", False),
             overlap_events=getattr(cfg, "overlap_events", True),
             thread_count=getattr(cfg, "thread_count", 2))
-        self._pf = None  # (leaf_id, [bufs], [fds])
+        self._pf = None  # (leaf_id, [bufs], [fds], [tids])
+        self._arena = _StagingArena()
+        self._consumed = {}  # leaf_id -> [tids] handed out by fetch()
 
     def init_state(self, leaf_id, shape):
         self.shapes[leaf_id] = tuple(shape)
@@ -114,51 +160,73 @@ class OptimizerStateSwapper:
     def _drain_prefetch(self):
         if self._pf is None:
             return None
-        leaf_id, bufs, fds = self._pf
+        leaf_id, bufs, fds, tids = self._pf
         self._pf = None
         try:
             self._pf_handle.wait()
         finally:
             for fd in fds:
                 self._pf_handle.close(fd)
-        return leaf_id, bufs
+        return leaf_id, bufs, tids
+
+    def _discard_prefetch(self):
+        drained = self._drain_prefetch()
+        if drained is not None:
+            for tid in drained[2]:
+                self._arena.give(tid)
+
+    def _release_consumed(self, leaf_id):
+        for tid in self._consumed.pop(leaf_id, ()):
+            self._arena.give(tid)
 
     def prefetch(self, leaf_id):
         """Start the async read of ``leaf_id``'s moments; the matching
         fetch() consumes them without blocking on the disk."""
         if self._pf is not None and self._pf[0] == leaf_id:
             return
-        self._drain_prefetch()
+        self._discard_prefetch()
         shape = self.shapes[leaf_id]
-        bufs, fds = [], []
+        bufs, fds, tids = [], [], []
         for field in self.FIELDS:
-            buf = np.empty(shape, np.float32)
+            tid, buf = self._arena.take(shape)
             fd = self._pf_handle.open(
                 self.swapper._path(f"{leaf_id}.{field}"), False)
             self._pf_handle.async_pread(buf, fd)
             bufs.append(buf)
             fds.append(fd)
-        self._pf = (leaf_id, bufs, fds)
+            tids.append(tid)
+        self._pf = (leaf_id, bufs, fds, tids)
 
     def fetch(self, leaf_id):
+        # a re-fetch without an intervening store (e.g. state_dict() walks
+        # every leaf read-only) must not orphan the previous staging slots
+        self._release_consumed(leaf_id)
         if self._pf is not None and self._pf[0] == leaf_id:
-            return self._drain_prefetch()[1]
-        self._drain_prefetch()
+            _, bufs, tids = self._drain_prefetch()
+            self._consumed[leaf_id] = tids
+            return bufs
+        self._discard_prefetch()
         shape = self.shapes[leaf_id]
-        out = []
+        out, tids = [], []
         for field in self.FIELDS:
-            buf = np.empty(shape, np.float32)
+            tid, buf = self._arena.take(shape)
             self.swapper.swap_in(f"{leaf_id}.{field}", buf)
             out.append(buf)
+            tids.append(tid)
+        self._consumed[leaf_id] = tids
         return out
 
     def store(self, leaf_id, exp_avg, exp_avg_sq):
         self.swapper.swap_out(f"{leaf_id}.exp_avg", exp_avg)
         self.swapper.swap_out(f"{leaf_id}.exp_avg_sq", exp_avg_sq)
+        # the fetched staging views are dead once the new moments hit disk
+        self._release_consumed(leaf_id)
 
     def release(self):
         try:
-            self._drain_prefetch()
+            self._discard_prefetch()
         except Exception:
             pass
+        for leaf in list(self._consumed):
+            self._release_consumed(leaf)
         self.swapper.release()

@@ -447,3 +447,35 @@ def test_aio_split_large_transfer_roundtrip(tmp_path):
     h.wait()
     h.close(fd)
     np.testing.assert_array_equal(out2, data)
+
+
+def test_optimizer_swapper_uses_contiguous_arena(tmp_path):
+    """The swap staging buffers come from the ContiguousMemoryAllocator
+    (reference stage3.py:1073 backs partitions with the arena): steady-state
+    double-buffering reuses the same arena instead of allocating fresh host
+    buffers every step."""
+    if not has_native():
+        pytest.skip("no C++ toolchain")
+    from deepspeed_tpu.runtime.swap_tensor.swapper import OptimizerStateSwapper
+    sw = OptimizerStateSwapper(str(tmp_path))
+    a = np.arange(64, dtype=np.float32).reshape(8, 8)
+    for leaf in ("l0", "l1"):
+        sw.init_state(leaf, a.shape)
+    sw.prefetch("l0")
+    for step in range(4):
+        for i, leaf in enumerate(("l0", "l1")):
+            m, v = sw.fetch(leaf)
+            sw.prefetch(("l0", "l1")[(i + 1) % 2])
+            m += 1.0
+            v += 2.0
+            sw.store(leaf, m, v)
+    m, v = sw.fetch("l0")
+    np.testing.assert_array_equal(m, np.full((8, 8), 4.0, np.float32))
+    np.testing.assert_array_equal(v, np.full((8, 8), 8.0, np.float32))
+    arena = sw._arena.arena
+    assert arena is not None and arena.size == 4 * 64
+    # steady state never outgrew the arena: no numpy fallback, and at most
+    # the double-buffered pairs were ever live at once
+    assert arena.max_allocated <= arena.size
+    assert sw._arena._live <= 4
+    sw.release()
