@@ -1,1 +1,3 @@
-from deepspeed_tpu.moe.layer import MoE, MoEMLP, TopKGate, load_balance_loss
+from deepspeed_tpu.moe.layer import (
+    MoE, MoEMLP, TopKGate, load_balance_loss, expert_shardings,
+    apply_with_losses)

@@ -156,13 +156,13 @@ class MoE(nn.Module):
         xe = jnp.einsum("bsec,bsh->ebch", dispatch.astype(self.dtype), x)
         xe = xe.reshape(E, B * C, H)
         mesh = mesh_lib.current_mesh()
-        ep = mesh is not None and \
-            mesh_lib.mesh_axis_size(mesh, mesh_lib.DATA_AXIS) > 1 and \
-            E % mesh_lib.mesh_axis_size(mesh, mesh_lib.DATA_AXIS) == 0
+        eaxis = _expert_axis(mesh)
+        ep = eaxis is not None and \
+            E % mesh_lib.mesh_axis_size(mesh, eaxis) == 0
         if ep:
             from jax.sharding import NamedSharding, PartitionSpec as P
             xe = jax.lax.with_sharding_constraint(
-                xe, NamedSharding(mesh, P(mesh_lib.DATA_AXIS)))
+                xe, NamedSharding(mesh, P(eaxis)))
         ye = MoEMLP(E, H, self.d_ff, dropout=self.dropout,
                     out_init_std=self.out_init_std, dtype=self.dtype,
                     param_dtype=self.param_dtype,
@@ -177,18 +177,53 @@ class MoE(nn.Module):
         return y
 
 
+def _expert_axis(mesh):
+    """The mesh axis experts shard over: a dedicated 'expert' axis when the
+    mesh has one (EP independent of DP), otherwise aliased onto 'data'
+    (classic expert-parallel-over-DP), None when neither is non-trivial."""
+    if mesh is None:
+        return None
+    if mesh_lib.mesh_axis_size(mesh, mesh_lib.EXPERT_AXIS) > 1:
+        return mesh_lib.EXPERT_AXIS
+    if mesh_lib.mesh_axis_size(mesh, mesh_lib.DATA_AXIS) > 1:
+        return mesh_lib.DATA_AXIS
+    return None
+
+
 def expert_shardings(params, mesh):
     """PartitionSpec tree sharding the stacked expert kernels over the
-    expert(=data) axis; router + everything else replicated. Kernels whose
-    expert count does not divide the axis stay replicated (matching the
-    guard MoE.__call__ applies)."""
+    expert axis (dedicated 'expert' axis when present, else aliased onto
+    'data'); router + everything else replicated. Kernels whose expert
+    count does not divide the axis stay replicated (matching the guard
+    MoE.__call__ applies)."""
     from jax.sharding import PartitionSpec as P
-    axis = mesh_lib.mesh_axis_size(mesh, mesh_lib.DATA_AXIS)
+    eaxis = _expert_axis(mesh)
+    axis = mesh_lib.mesh_axis_size(mesh, eaxis) if eaxis else 0
 
     def leaf(path, x):
         names = [str(getattr(p, "key", p)) for p in path]
         if "experts" in names and names[-1] in ("wi", "wo") \
-                and axis > 0 and x.shape[0] % axis == 0:
-            return P(mesh_lib.DATA_AXIS)
+                and axis > 1 and x.shape[0] % axis == 0:
+            return P(eaxis)
         return P()
     return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def apply_with_losses(model, variables, *args, **kwargs):
+    """Run a model that contains MoE blocks and return
+    ``(output, aux_loss_sum)`` — the documented way for CUSTOM loss
+    functions to include the router load-balancing term (the engine's
+    default loss does this automatically; a user loss_fn that calls
+    ``model.apply`` directly would silently train an unbalanced router).
+
+    Usage inside a loss_fn::
+
+        def loss_fn(params, batch):
+            out, aux = moe.apply_with_losses(model, {"params": params}, x)
+            return my_loss(out, y) + coeff * aux
+    """
+    import jax.numpy as jnp
+    out, vs = model.apply(variables, *args, mutable=["losses"], **kwargs)
+    aux = sum(jnp.sum(l) for l in
+              jax.tree_util.tree_leaves(vs.get("losses", {})))
+    return out, aux

@@ -22,14 +22,15 @@ from jax.sharding import Mesh, PartitionSpec, NamedSharding
 
 # Mesh axis names. ZeRO shards over DATA_AXIS; tensor parallelism over
 # MODEL_AXIS; pipeline stages over PIPE_AXIS; ring-attention/sequence
-# parallelism over SEQ_AXIS; MoE experts over EXPERT_AXIS (aliased onto data).
+# parallelism over SEQ_AXIS; MoE experts over EXPERT_AXIS (a dedicated
+# axis when MeshConfig.expert > 1, else experts alias onto data).
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 EXPERT_AXIS = "expert"
 
-AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
+AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
 
 _current_mesh: Optional[Mesh] = None
 
@@ -74,15 +75,17 @@ class MeshConfig:
     expert: int = 1
 
     def resolve(self, n_devices: int) -> "MeshConfig":
-        explicit = self.model * self.pipe * self.seq
+        explicit = self.model * self.pipe * self.seq * self.expert
         data = self.data
         if data == -1:
             assert n_devices % explicit == 0, (
-                f"device count {n_devices} not divisible by pipe*seq*model={explicit}")
+                f"device count {n_devices} not divisible by "
+                f"pipe*expert*seq*model={explicit}")
             data = n_devices // explicit
         total = data * explicit
         assert total == n_devices, (
-            f"mesh {self.pipe}x{data}x{self.seq}x{self.model} != {n_devices} devices")
+            f"mesh {self.pipe}x{data}x{self.expert}x{self.seq}x"
+            f"{self.model} != {n_devices} devices")
         return MeshConfig(data=data, model=self.model, pipe=self.pipe,
                           seq=self.seq, expert=self.expert)
 
@@ -102,6 +105,7 @@ def make_mesh(config: Optional[MeshConfig] = None,
     shape = tuple({
         PIPE_AXIS: config.pipe,
         DATA_AXIS: config.data,
+        EXPERT_AXIS: config.expert,
         SEQ_AXIS: config.seq,
         MODEL_AXIS: config.model,
     }[a] for a in axis_order)
@@ -114,7 +118,8 @@ def make_mesh(config: Optional[MeshConfig] = None,
 
 
 def single_device_mesh() -> Mesh:
-    return Mesh(np.asarray(jax.devices()[:1]).reshape((1, 1, 1, 1)), AXIS_ORDER)
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(
+        (1,) * len(AXIS_ORDER)), AXIS_ORDER)
 
 
 def mesh_axis_size(mesh: Mesh, axis: str) -> int:
@@ -132,8 +137,12 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Input batches shard over (data,) on dim 0 and seq axis on dim 1 when a
-    sequence axis exists."""
+    """Input batches shard dim 0 over (data, expert) — dp_world_size counts
+    both, so a dedicated expert axis carries its share of the batch instead
+    of replicating non-MoE compute — and dim 1 over the seq axis when one
+    exists."""
+    dim0 = (DATA_AXIS, EXPERT_AXIS) \
+        if mesh_axis_size(mesh, EXPERT_AXIS) > 1 else DATA_AXIS
     if mesh_axis_size(mesh, SEQ_AXIS) > 1:
-        return NamedSharding(mesh, PartitionSpec(DATA_AXIS, SEQ_AXIS))
-    return NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+        return NamedSharding(mesh, PartitionSpec(dim0, SEQ_AXIS))
+    return NamedSharding(mesh, PartitionSpec(dim0))
