@@ -63,13 +63,15 @@ def main():
     # GPT-2 large (774M), the largest dense config that trains in 16 GB.
     # Measured fastest recipe on v5e (see docs/perf_tuning.md): bs8
     # (8192-row matmuls feed the MXU at its efficiency knee), remat with
-    # the dots_flash_fc policy (keep projections + flash residuals,
-    # recompute only the qkv matmul), fused chunked head+loss (no [B,S,V]
-    # buffer), bf16 gradients + bf16 Adam moments (fp32 update math).
+    # the dots_flash_fc_lean policy (keep mlp matmuls + flash residuals;
+    # qkv and the attention projection recompute), fused chunked
+    # head+loss (no [B,S,V] buffer), bf16 gradients + a bf16 Adam first
+    # moment (fp32 update math; the second moment stays fp32 — a bf16
+    # EMA freezes below its ulp).
     model_cfg = GPT2Config(vocab_size=50304, n_positions=seq, n_embd=1280,
                            n_layer=36, n_head=20, dtype=jnp.bfloat16,
                            scan_layers=True, remat=True,
-                           remat_policy="dots_flash_fc", loss_chunk=1024)
+                           remat_policy="dots_flash_fc_lean", loss_chunk=1024)
     batch_size = 8
 
     cfg = {
@@ -116,6 +118,13 @@ def main():
     achieved = flops_per_step / dt
     mfu = achieved / peak_flops(dev)
     samples_per_sec = batch_size / dt
+    final_loss = float(jax.device_get(loss))
+
+    # free the ~8 GB of training state before the decode models allocate
+    # their params + KV caches (same ordering rule as the BERT section)
+    del engine, model, loss
+    jax.clear_caches()
+    decode = bench_decode(jnp)
 
     result = {
         "metric": "gpt2_large_774m_zero3_mfu",
@@ -128,13 +137,62 @@ def main():
             "step_time_ms": round(dt * 1000, 2),
             "achieved_tflops": round(achieved / 1e12, 2),
             "device": getattr(dev, "device_kind", str(dev)),
-            "loss": float(jax.device_get(loss)),
+            "loss": final_loss,
             # fused-kernel BERT pretraining headline (reference: 272
             # samples/s @ seq128 on one V100, 2020-05-28 blog)
             "bert_base_seq128_samples_per_sec": bert_sps,
+            # serving decode throughput (reference ships 6.5k LoC of
+            # inference kernels because decode perf mattered; here the
+            # fused inference layer + KV cache, models/gpt2_inference.py)
+            "decode": decode,
         },
     }
     print(json.dumps(result))
+
+
+def bench_decode(jnp):
+    """GPT-2 large KV-cache decode tokens/sec. b1 at 2k context is the
+    latency case; b32 uses a 512 context because 36 layers of bf16 KV at
+    2k x 32 alone is ~24 GB (past a 16 GB chip)."""
+    import time
+    import jax
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.models.gpt2_inference import generate
+
+    out = {}
+    for name, bs, ctx in (("b1_ctx2048", 1, 2048), ("b32_ctx512", 32, 512)):
+        cfg = GPT2Config(vocab_size=50304, n_positions=ctx, n_embd=1280,
+                         n_layer=36, n_head=20, dtype=jnp.bfloat16,
+                         param_dtype=jnp.bfloat16, scan_layers=True)
+        rng = np.random.RandomState(0)
+        prompt = rng.randint(0, 50304, size=(bs, ctx - 80)).astype(np.int32)
+        params = jax.jit(GPT2LMHeadModel(cfg).init)(
+            jax.random.PRNGKey(0), prompt[:, :8])["params"]
+
+        def run(new):
+            # scan decode (one dispatch for the whole loop) for the
+            # latency case; the b32 cache is ~6 GB and the scan's carry
+            # double-buffering doesn't fit alongside it, so the batch
+            # case uses the per-token step loop
+            toks = generate(cfg, params, prompt, max_new_tokens=new,
+                            max_out_tokens=ctx, scan_decode=(bs == 1))
+            return float(jax.device_get(toks[0, -1]))
+
+        run(4)                      # compile both lengths before timing
+        run(68)
+        t0 = time.perf_counter()
+        run(4)
+        t_short = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        run(68)
+        t_long = time.perf_counter() - t0
+        # prompt pass and fixed overheads cancel in the difference
+        decode_tps = bs * 64 / (t_long - t_short)
+        out[name] = {"decode_tokens_per_sec": round(decode_tps, 1),
+                     "prompt_plus_4_tokens_s": round(t_short, 3)}
+        del params, run   # run's closure pins params otherwise
+        jax.clear_caches()
+    return out
 
 
 def bench_bert(dstpu, make_mesh, MeshConfig, dev, batch_size=128, seq=128):

@@ -208,6 +208,13 @@ def _remat_policy(name):
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             jax.checkpoint_policies.save_only_these_names(
                 "flash_o", "flash_lse"))
+    if name == "dots_flash_fc_lean":
+        # dots_flash_fc minus attn_proj: with flash_o saved, re-deriving
+        # the attention projection is ONE matmul from a saved input
+        # (~2/24 of forward flops) — 1E/layer of HBM back for near-zero
+        # recompute. Matters when optimizer state crowds the 16 GB chip.
+        return jax.checkpoint_policies.save_only_these_names(
+            "mlp_fc", "mlp_proj", "flash_o", "flash_lse")
     if name == "projs":
         # save only the residual-branch projections (2E per layer): qkv and
         # fc recompute in backward (~58% of forward flops) but the big-batch
