@@ -179,11 +179,14 @@ def _compiled_steps(cfg: GPT2Config, max_out: int, quantize_bits: int = 0,
                     {"params": p, "cache": cache}, tok[:, None],
                     position_offset=offset, mutable=["cache"])
                 logits = logits[:, -1]
-                safe_t = jnp.where(temperature > 0, temperature, 1.0)
-                nxt = jnp.where(
+                # cond, not where: greedy decode must not pay the Gumbel
+                # sampling over [B, V] every tick (the tick body is
+                # collective-free, so diverging branches are safe here)
+                nxt = jax.lax.cond(
                     temperature > 0,
-                    jax.random.categorical(r, logits / safe_t, axis=-1),
-                    jnp.argmax(logits, axis=-1))
+                    lambda: jax.random.categorical(
+                        r, logits / jnp.maximum(temperature, 1e-6), axis=-1),
+                    lambda: jnp.argmax(logits, axis=-1))
                 return (vars_["cache"], nxt, offset + 1), tok
             (_, last, _), toks = jax.lax.scan(
                 tick, (cache, first_tok, start), rngs, length=steps)

@@ -118,3 +118,21 @@ def test_int8_storage_serving():
                    quantize_groups=4)
     assert out.shape == (2, 16)
     assert np.isfinite(np.asarray(out, np.float64)).all()
+
+
+def test_step_loop_decode_matches_scan_decode():
+    """The per-token decode_step path (streaming / big-batch callers,
+    scan_decode=False) must produce exactly the scan-compiled path's
+    greedy tokens — guards the offset/cache-donation math now that the
+    scan path is the default everywhere else."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.models.gpt2_inference import generate
+    cfg = GPT2Config(vocab_size=512, n_positions=96, n_embd=64, n_layer=2,
+                     n_head=2, dtype=jnp.float32)
+    ids = np.random.RandomState(0).randint(0, 512, (2, 40)).astype(np.int32)
+    params = GPT2LMHeadModel(cfg).init(jax.random.PRNGKey(0), ids)["params"]
+    scan = generate(cfg, params, ids, max_new_tokens=12, scan_decode=True)
+    loop = generate(cfg, params, ids, max_new_tokens=12, scan_decode=False)
+    np.testing.assert_array_equal(np.asarray(scan), np.asarray(loop))

@@ -141,3 +141,54 @@ def test_mesh_from_config_section():
     engine, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(hidden_dim=32))
     assert engine.mesh.shape["data"] == 4 and engine.mesh.shape["model"] == 2
     assert np.isfinite(float(engine.train_batch(random_batch(batch_size=8))))
+
+
+def test_stage3_persistence_threshold_sweep():
+    """SURVEY §7's stage-3 'hard part' knob: sweeping
+    stage3_param_persistence_threshold moves leaves between sharded and
+    replicated monotonically, and classification follows leaf size
+    exactly (reference stage3.py:287-310 keeps small params resident)."""
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models.gpt2 import gpt2_tiny, GPT2LMHeadModel
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+    if len(jax.devices()) < 4:
+        pytest.skip("need 4 devices")
+
+    def sharded_leaves(threshold):
+        cfg = {
+            "train_batch_size": 8,
+            "zero_optimization": {
+                "stage": 3,
+                "stage3_param_persistence_threshold": threshold},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 1000,
+        }
+        mesh = make_mesh(MeshConfig(data=4), devices=jax.devices()[:4])
+        engine, _, _, _ = dstpu.initialize(
+            config=cfg, model=GPT2LMHeadModel(gpt2_tiny()), mesh=mesh)
+        batch = {"input_ids": np.random.RandomState(0).randint(
+            0, 512, (8, 64)).astype(np.int32)}
+        engine.train_batch(batch)
+        out = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                engine.state.params)[0]:
+            name = "/".join(str(getattr(k, "key", k)) for k in path)
+            specs = leaf.sharding.spec if hasattr(leaf.sharding, "spec") \
+                else ()
+            out[name] = (int(np.prod(leaf.shape)),
+                         any(s is not None for s in specs))
+        return out
+
+    by_thresh = {t: sharded_leaves(t) for t in (0, 4096, 10**9)}
+    counts = {t: sum(sharded for _, sharded in v.values())
+              for t, v in by_thresh.items()}
+    # monotone: lower threshold → more leaves sharded; huge → none
+    assert counts[0] >= counts[4096] >= counts[10**9] == 0, counts
+    assert counts[0] > counts[4096], counts
+    # classification is exactly by size at the midpoint (divisibility
+    # permitting: leaves the partitioner cannot split stay replicated)
+    for name, (numel, sharded) in by_thresh[4096].items():
+        if numel >= 4096 and by_thresh[0][name][1]:
+            assert sharded, (name, numel)
+        if numel < 4096:
+            assert not sharded, (name, numel)
