@@ -4,10 +4,25 @@ ds_transformer_cuda.cpp): one Pallas kernel per pass that never materializes
 the [S, S] score matrix in HBM, with online softmax and a recompute-based
 backward (custom VJP), accumulating in fp32 on the MXU.
 
-Layout: q/k/v as [B, H, S, D] → kernels run on [B*H] × q-block grid; K/V for
-one (batch, head) live in VMEM (S·D·2 bytes each — fits comfortably for
-S ≤ 8k at D=128; beyond that, sequence parallelism splits S first, see
-deepspeed_tpu/parallel/ring_attention.py).
+Layout: q/k/v as [B, H, S, D] → kernels run on [B*H] × q-block grid. Two
+kernel families share the same per-block math (`_fwd_block_step` /
+`_bwd_ds_block`):
+
+- **plain**: K/V (fwd, dq) or Q/dO (dkv) rows for one (batch, head) live
+  whole in VMEM — fastest, used while S·D·itemsize fits the measured
+  ~512 KB row budget (S=4k at D=64 in bf16).
+- **chunked**: a third grid dimension streams sequence CHUNKS and
+  accumulates into revisited fp32 output blocks (forward softmax m/l state
+  rides in revisited outputs; normalization happens in-kernel on the last
+  chunk). This is how single-chip attention training reaches 32k context;
+  beyond that, sequence parallelism shards S first
+  (deepspeed_tpu/parallel/ring_attention.py).
+
+The softmax scale is folded into the [block, D] q-loads (one small VPU
+multiply instead of one per [block_q, block_k] score tile), and causal
+loops split into unmasked below-diagonal blocks + masked diagonal blocks —
+at D < 128 the kernels are VPU-bound, so score-tile passes are the cost
+that matters.
 
 On non-TPU backends the kernels run in interpreter mode so unit tests check
 the same code path numerically against the jnp reference (the
@@ -23,10 +38,67 @@ from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
 
+# measured scoped-VMEM ceiling for whole-row residency on v5e: bf16 rows of
+# S=4096, D=64 (512 KB) compile; S=8192 overflows by 4.5 MB. The chunked
+# kernels use half of this per chunk to leave room for pipeline double
+# buffering (chunk 4096 at S=32k overflowed by 0.9 MB; 2048 fits).
+_UNCHUNKED_ROW_BYTES = 524288
+
 
 def _interpret_default():
     from deepspeed_tpu.utils.platform import is_tpu_backend
     return not is_tpu_backend()
+
+
+# ------------------------------------------------------ shared block math
+
+def _causal_mask(s, q_pos0, k_pos0, block_q, block_k):
+    q_pos = q_pos0 + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = k_pos0 + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
+def _fwd_block_step(q, k, v, carry, q_pos0, k_pos0, block_q, block_k,
+                    masked):
+    """One k-block of online-softmax forward. q is pre-scaled fp32;
+    carry = (o_acc [bq, D], m_acc [bq], l_acc [bq])."""
+    o_acc, m_acc, l_acc = carry
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if masked:
+        s = _causal_mask(s, q_pos0, k_pos0, block_q, block_k)
+    m_new = jnp.maximum(m_acc, jnp.max(s, axis=1))
+    alpha = jnp.exp(m_acc - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    l_new = l_acc * alpha + jnp.sum(p, axis=1)
+    o_new = o_acc * alpha[:, None] + jax.lax.dot(
+        p, v, preferred_element_type=jnp.float32)
+    return o_new, m_new, l_new
+
+
+def _bwd_ds_block(q, do, lse, delta, k, v, q_pos0, k_pos0, block_q, block_k,
+                  masked):
+    """(p, ds) for one score tile of the backward. q is pre-scaled fp32;
+    ds is in the scaled-q domain (dq needs a final ·scale; dk = dsᵀ·q is
+    exact because q is pre-scaled)."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if masked:
+        s = _causal_mask(s, q_pos0, k_pos0, block_q, block_k)
+    p = jnp.exp(s - lse[:, None])
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None])
+    return p, ds
+
+
+def _causal_split_loop(lo, full, hi, body, carry):
+    """fori_loop [lo, full) unmasked + [full, hi) masked."""
+    carry = jax.lax.fori_loop(lo, full, lambda i, c: body(i, c, False),
+                              carry)
+    return jax.lax.fori_loop(full, hi, lambda i, c: body(i, c, True), carry)
 
 
 # ---------------------------------------------------------------- forward
@@ -34,49 +106,24 @@ def _interpret_default():
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                 block_q, block_k, seq_len):
     qi = pl.program_id(1)
-    # fold the softmax scale into the [block_q, D] q-load: one small VPU
-    # multiply here instead of one [block_q, block_k] multiply per k-block
     q = q_ref[0].astype(jnp.float32) * scale
     num_kb = seq_len // block_k
 
     def body(kb, carry, masked):
-        o_acc, m_acc, l_acc = carry
         k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if masked:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m_acc, jnp.max(s, axis=1))
-        alpha = jnp.exp(m_acc - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l_acc * alpha + jnp.sum(p, axis=1)
-        o_new = o_acc * alpha[:, None] + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32)
-        return o_new, m_new, l_new
+        return _fwd_block_step(q, k, v, carry, qi * block_q, kb * block_k,
+                               block_q, block_k, masked)
 
-    o0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-
+    carry0 = (jnp.zeros((block_q, q.shape[1]), jnp.float32),
+              jnp.full((block_q,), NEG_INF, jnp.float32),
+              jnp.zeros((block_q,), jnp.float32))
     if causal:
-        # split the k-loop: blocks fully below the diagonal skip the iota
-        # mask (3 fewer VPU passes over [block_q, block_k] — at D < 128 the
-        # kernels are VPU-bound, so this is the hot path), then the blocks
-        # straddling the diagonal run masked.
         num_full = (qi * block_q) // block_k
         num_active = ((qi + 1) * block_q + block_k - 1) // block_k
-        carry = jax.lax.fori_loop(
-            0, num_full, lambda kb, c: body(kb, c, False), (o0, m0, l0))
-        o, m, l = jax.lax.fori_loop(
-            num_full, num_active, lambda kb, c: body(kb, c, True), carry)
+        o, m, l = _causal_split_loop(0, num_full, num_active, body, carry0)
     else:
-        o, m, l = jax.lax.fori_loop(
-            0, num_kb, lambda kb, c: body(kb, c, False), (o0, m0, l0))
+        o, m, l = _causal_split_loop(0, num_kb, num_kb, body, carry0)
 
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (o / l_safe[:, None]).astype(o_ref.dtype)
@@ -125,31 +172,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def body(kb, dq_acc, masked):
         k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if masked:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
-        return dq_acc + jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
+        _, ds = _bwd_ds_block(q, do, lse, delta, k, v, qi * block_q,
+                              kb * block_k, block_q, block_k, masked)
+        return dq_acc + jax.lax.dot(ds, k,
+                                    preferred_element_type=jnp.float32)
 
     dq0 = jnp.zeros_like(q)
     if causal:
         num_full = (qi * block_q) // block_k
         num_active = ((qi + 1) * block_q + block_k - 1) // block_k
-        dq = jax.lax.fori_loop(0, num_full,
-                               lambda kb, c: body(kb, c, False), dq0)
-        dq = jax.lax.fori_loop(num_full, num_active,
-                               lambda kb, c: body(kb, c, True), dq)
+        dq = _causal_split_loop(0, num_full, num_active, body, dq0)
     else:
-        dq = jax.lax.fori_loop(0, num_kb,
-                               lambda kb, c: body(kb, c, False), dq0)
+        dq = _causal_split_loop(0, num_kb, num_kb, body, dq0)
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
@@ -163,53 +197,41 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(qb, carry, masked):
         dk_acc, dv_acc = carry
-        # pre-scaled q: s needs no [block_q, block_k] multiply, and
-        # dk = dsᵀ·(scale·q) absorbs the chain-rule scale exactly
         q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(
             jnp.float32) * scale
         do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         lse = lse_ref[0, pl.ds(qb * block_q, block_q), 0]
         delta = delta_ref[0, pl.ds(qb * block_q, block_q), 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if masked:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p, ds = _bwd_ds_block(q, do, lse, delta, k, v, qb * block_q,
+                              ki * block_k, block_q, block_k, masked)
         dv_new = dv_acc + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        # dk = dsᵀ·(scale·q): q was pre-scaled, so this is exact
         dk_new = dk_acc + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
-    dk0 = jnp.zeros_like(k)
-    dv0 = jnp.zeros_like(v)
+    carry0 = (jnp.zeros_like(k), jnp.zeros_like(v))
     if causal:
         # q-blocks straddling the diagonal run masked; strictly-below-
-        # diagonal q-blocks (q_pos >= all k_pos of this k-block) don't
+        # diagonal q-blocks don't
         first_active = (ki * block_k) // block_q
         first_full = ((ki + 1) * block_k + block_q - 1) // block_q
         carry = jax.lax.fori_loop(
             first_active, jnp.minimum(first_full, num_qb),
-            lambda qb, c: body(qb, c, True), (dk0, dv0))
+            lambda qb, c: body(qb, c, True), carry0)
         dk, dv = jax.lax.fori_loop(
             first_full, num_qb, lambda qb, c: body(qb, c, False), carry)
     else:
-        dk, dv = jax.lax.fori_loop(
-            0, num_qb, lambda qb, c: body(qb, c, False), (dk0, dv0))
+        dk, dv = _causal_split_loop(0, num_qb, num_qb, body, carry0)
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, interpret):
+def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
+               interpret):
     BH, S, D = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)[:, :, None]  # [BH, S, 1]
@@ -256,16 +278,243 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, interpret):
     return dq, dk, dv
 
 
+# ------------------------------------------------- long-S chunked variants
+
+def _fwd_kernel_chunked(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                        *, scale, causal, block_q, block_k, chunk,
+                        n_chunks):
+    qi = pl.program_id(1)
+    kc = pl.program_id(2)
+    cb = chunk // block_k                      # k-blocks per chunk
+    q = q_ref[0].astype(jnp.float32) * scale
+
+    @pl.when(kc == 0)
+    def _init():
+        o_ref[0] = jnp.zeros_like(o_ref[0])
+        m_ref[0] = jnp.full_like(m_ref[0], NEG_INF)
+        l_ref[0] = jnp.zeros_like(l_ref[0])
+
+    def body(j, carry, masked):
+        kb = kc * cb + j                       # global k-block index
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        return _fwd_block_step(q, k, v, carry, qi * block_q, kb * block_k,
+                               block_q, block_k, masked)
+
+    carry0 = (o_ref[0], m_ref[0, :, 0], l_ref[0, :, 0])
+    if causal:
+        num_full = (qi * block_q) // block_k
+        num_active = ((qi + 1) * block_q + block_k - 1) // block_k
+        j_full = jnp.clip(num_full - kc * cb, 0, cb)
+        j_hi = jnp.clip(num_active - kc * cb, 0, cb)
+        o, m, l = _causal_split_loop(0, j_full, j_hi, body, carry0)
+    else:
+        o, m, l = _causal_split_loop(0, cb, cb, body, carry0)
+
+    # accumulate raw (o, m, l) across chunk revisits; the last chunk holds
+    # the final softmax state, so normalize in-kernel there — no separate
+    # [BH, S, D] normalization pass in HBM
+    last = kc == n_chunks - 1
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = jnp.where(last,
+                         jnp.where((l > 0)[:, None], o / l_safe[:, None],
+                                   0.0),
+                         o)
+    m_ref[0, :, 0] = jnp.where(last, m + jnp.log(l_safe), m)
+    l_ref[0, :, 0] = l
+
+
+def _flash_fwd_chunked(q, k, v, scale, causal, block_q, block_k, chunk,
+                       interpret):
+    BH, S, D = q.shape
+    n_chunks = S // chunk
+    kernel = functools.partial(_fwd_kernel_chunked, scale=scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k, chunk=chunk,
+                               n_chunks=n_chunks)
+    o32, lse, _ = pl.pallas_call(
+        kernel,
+        grid=(BH, S // block_q, n_chunks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, c: (b, i, 0)),
+            pl.BlockSpec((1, chunk, D), lambda b, i, c: (b, c, 0)),
+            pl.BlockSpec((1, chunk, D), lambda b, i, c: (b, c, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, c: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, c: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, c: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o32.astype(q.dtype), lse
+
+
+def _bwd_dq_kernel_chunked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dq_ref, *, scale, causal, block_q, block_k,
+                           chunk, n_chunks):
+    qi = pl.program_id(1)
+    kc = pl.program_id(2)
+    cb = chunk // block_k
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+
+    @pl.when(kc == 0)
+    def _init():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
+
+    def body(j, dq_acc, masked):
+        kb = kc * cb + j
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        _, ds = _bwd_ds_block(q, do, lse, delta, k, v, qi * block_q,
+                              kb * block_k, block_q, block_k, masked)
+        return dq_acc + jax.lax.dot(ds, k,
+                                    preferred_element_type=jnp.float32)
+
+    if causal:
+        num_full = (qi * block_q) // block_k
+        num_active = ((qi + 1) * block_q + block_k - 1) // block_k
+        j_full = jnp.clip(num_full - kc * cb, 0, cb)
+        j_hi = jnp.clip(num_active - kc * cb, 0, cb)
+        dq = _causal_split_loop(0, j_full, j_hi, body, dq_ref[0])
+    else:
+        dq = _causal_split_loop(0, cb, cb, body, dq_ref[0])
+    # accumulate UNscaled across chunk revisits; apply the folded-scale
+    # chain rule once on the final chunk (dq = scale · Σ ds·k)
+    dq_ref[0] = jnp.where(pl.program_id(2) == n_chunks - 1, dq * scale, dq)
+
+
+def _bwd_dkv_kernel_chunked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                            dk_ref, dv_ref, *, scale, causal, block_q,
+                            block_k, chunk, n_chunks):
+    ki = pl.program_id(1)
+    qc = pl.program_id(2)
+    cb = chunk // block_q
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+
+    @pl.when(qc == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    def body(j, carry, masked):
+        dk_acc, dv_acc = carry
+        qb = qc * cb + j
+        q = q_ref[0, pl.ds(j * block_q, block_q), :].astype(
+            jnp.float32) * scale
+        do = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(j * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(j * block_q, block_q), 0]
+        p, ds = _bwd_ds_block(q, do, lse, delta, k, v, qb * block_q,
+                              ki * block_k, block_q, block_k, masked)
+        dv_new = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_new = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    carry0 = (dk_ref[0], dv_ref[0])
+    if causal:
+        # within this q-chunk: blocks before the diagonal skip entirely,
+        # blocks straddling it run masked, strictly-after blocks unmasked
+        first_active = (ki * block_k) // block_q
+        first_full = ((ki + 1) * block_k + block_q - 1) // block_q
+        j_lo = jnp.clip(first_active - qc * cb, 0, cb)
+        j_mid = jnp.clip(first_full - qc * cb, 0, cb)
+        carry = jax.lax.fori_loop(
+            j_lo, j_mid, lambda j, c: body(j, c, True), carry0)
+        dk, dv = jax.lax.fori_loop(
+            j_mid, cb, lambda j, c: body(j, c, False), carry)
+    else:
+        dk, dv = _causal_split_loop(0, cb, cb, body, carry0)
+    dk_ref[0] = dk
+    dv_ref[0] = dv
+
+
+def _flash_bwd_chunked(q, k, v, o, lse, do, scale, causal, block_q, block_k,
+                       chunk, interpret):
+    BH, S, D = q.shape
+    n_chunks = S // chunk
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)[:, :, None]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_chunked, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, chunk=chunk,
+                          n_chunks=n_chunks),
+        grid=(BH, S // block_q, n_chunks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, c: (b, i, 0)),
+            pl.BlockSpec((1, chunk, D), lambda b, i, c: (b, c, 0)),
+            pl.BlockSpec((1, chunk, D), lambda b, i, c: (b, c, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, c: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, c: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, c: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, c: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_chunked, scale=scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          chunk=chunk, n_chunks=n_chunks),
+        grid=(BH, S // block_k, n_chunks),
+        in_specs=[
+            pl.BlockSpec((1, chunk, D), lambda b, i, c: (b, c, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, c: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, c: (b, i, 0)),
+            pl.BlockSpec((1, chunk, D), lambda b, i, c: (b, c, 0)),
+            pl.BlockSpec((1, chunk, 1), lambda b, i, c: (b, c, 0)),
+            pl.BlockSpec((1, chunk, 1), lambda b, i, c: (b, c, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, i, c: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, c: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq.astype(q.dtype), dk.astype(q.dtype), dv.astype(q.dtype)
+
+
 # ---------------------------------------------------------------- public op
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+def _dispatch_fwd(q, k, v, scale, causal, block_q, block_k, chunk,
+                  interpret):
+    if chunk:
+        return _flash_fwd_chunked(q, k, v, scale, causal, block_q, block_k,
+                                  chunk, interpret)
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_attention(q, k, v, scale, causal, block_q, block_k, chunk,
+                     interpret):
+    o, _ = _dispatch_fwd(q, k, v, scale, causal, block_q, block_k, chunk,
+                         interpret)
     return o
 
 
-def _flash_attention_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+def _flash_attention_fwd(q, k, v, scale, causal, block_q, block_k, chunk,
+                         interpret):
+    o, lse = _dispatch_fwd(q, k, v, scale, causal, block_q, block_k, chunk,
+                           interpret)
     # name the residuals so remat policies can elect to keep them: saving
     # o (+tiny lse) lets the backward kernels run without re-executing the
     # forward kernel under rematerialization (models/gpt2.py "dots_flash")
@@ -275,11 +524,15 @@ def _flash_attention_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     return o, (q, k, v, o, lse)
 
 
-def _flash_attention_bwd(scale, causal, block_q, block_k, interpret,
+def _flash_attention_bwd(scale, causal, block_q, block_k, chunk, interpret,
                          residuals, do):
     q, k, v, o, lse = residuals
-    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, scale, causal,
-                            block_q, block_k, interpret)
+    if chunk:
+        dq, dk, dv = _flash_bwd_chunked(q, k, v, o, lse, do, scale, causal,
+                                        block_q, block_k, chunk, interpret)
+    else:
+        dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, scale, causal,
+                                block_q, block_k, interpret)
     return dq, dk, dv
 
 
@@ -287,9 +540,11 @@ _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
-                    block_k=None, interpret=None):
+                    block_k=None, interpret=None, chunk=None):
     """[B, H, S, D] flash attention. Falls back to the jnp reference for
-    shapes the kernel can't tile (tiny S/D in unit tests)."""
+    shapes the kernel can't tile (tiny S/D in unit tests). ``chunk``
+    forces the long-S chunked kernels (auto-selected past the VMEM row
+    budget); it must divide S and be a multiple of both block sizes."""
     B, H, S, D = q.shape
     scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
     if interpret is None:
@@ -314,10 +569,27 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     if not block_q or not block_k or S % block_q or S % block_k:
         from deepspeed_tpu.ops.attention import reference_attention
         return reference_attention(q, k, v, causal=causal, scale=scale)
+    if chunk is not None:
+        if S % chunk or chunk % block_q or chunk % block_k:
+            raise ValueError(
+                f"chunk={chunk} must divide S={S} and be a multiple of "
+                f"block_q={block_q} and block_k={block_k}")
+    itemsize = jnp.dtype(q.dtype).itemsize
+    if chunk is None and S * D * itemsize > _UNCHUNKED_ROW_BYTES:
+        # whole-row residency stops fitting scoped VMEM — stream chunks
+        budget = max(_UNCHUNKED_ROW_BYTES // 2 // (D * itemsize), 1)
+        for cand in (4096, 2048, 1024, 512, 256, 128, 64):
+            if cand <= budget and S % cand == 0 \
+                    and cand % block_q == 0 and cand % block_k == 0:
+                chunk = cand
+                break
+        else:
+            from deepspeed_tpu.ops.attention import reference_attention
+            return reference_attention(q, k, v, causal=causal, scale=scale)
 
     qf = q.reshape(B * H, S, D)
     kf = k.reshape(B * H, S, D)
     vf = v.reshape(B * H, S, D)
     o = _flash_attention(qf, kf, vf, scale, causal, block_q, block_k,
-                         bool(interpret))
+                         int(chunk) if chunk else 0, bool(interpret))
     return o.reshape(B, H, S, D)
