@@ -101,11 +101,12 @@ def main():
         loss = engine.train_batch(batch)
     float(jax.device_get(loss))
 
-    # two timed windows, best wins: the tunneled chip shows ±5% run-to-run
-    # noise and the benchmark should report the machine, not the tunnel
+    # three timed windows, best wins: the tunneled chip shows ±5%
+    # run-to-run noise and the benchmark should report the machine, not
+    # the tunnel
     iters = 12
     best = float("inf")
-    for _ in range(2):
+    for _ in range(3):
         t0 = time.perf_counter()
         for _ in range(iters):
             loss = engine.train_batch(batch)
