@@ -1,12 +1,16 @@
-"""HuggingFace interop — load HF GPT-2 checkpoints into the TPU framework.
+"""HuggingFace interop — load HF GPT-2 and BERT checkpoints into the TPU
+framework.
 
 The reference consumes HF/Megatron models by module surgery
 (module_inject/replace_module.py) and by Megatron checkpoint resharding
 (runtime/state_dict_factory.py:272). The flax equivalents here are pure
-pytree converters: HF Flax GPT-2 params → `GPT2LMHeadModel` params (either
-unrolled or scan-stacked layout), plus config translation — so a user can
-bring an HF GPT-2 and train it under ZeRO/offload/1-bit or serve it through
-the fused inference stack (`models/gpt2_inference.py`).
+pytree converters: HF Flax GPT-2 params → `GPT2LMHeadModel` params and HF
+Flax BERT params → `BertModel` params (either unrolled or scan-stacked
+layout), plus config translation — so a user can bring an HF checkpoint
+and train it under ZeRO/offload/1-bit or serve it through the fused
+inference stack (`models/gpt2_inference.py`). The BERT path doubles as a
+numerics cross-check of the fused encoder layer against transformers'
+independent implementation.
 """
 
 from typing import Any
@@ -67,8 +71,7 @@ def convert_hf_gpt2_params(hf_params, cfg: GPT2Config):
     }
     blocks = [_hf_layer(p["h"][str(i)]) for i in range(cfg.n_layer)]
     if cfg.scan_layers:
-        out["h"] = {"blk": jax.tree_util.tree_map(
-            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *blocks)}
+        out["h"] = {"blk": _stack_layers(blocks)}
     else:
         for i, blk in enumerate(blocks):
             out[f"h_{i}"] = blk
@@ -81,3 +84,101 @@ def from_hf_gpt2(hf_model, **config_overrides):
     """(our_config, our_params) from a transformers FlaxGPT2LMHeadModel."""
     cfg = config_from_hf_gpt2(hf_model.config, **config_overrides)
     return cfg, convert_hf_gpt2_params(hf_model.params, cfg)
+
+
+# ----------------------------------------------------------------- BERT
+
+def _stack_layers(layers):
+    """Per-layer subtrees → one subtree with a leading [L] axis (the
+    nn.scan parameter layout)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *layers)
+
+
+def config_from_hf_bert(hf_config, **overrides):
+    """transformers.BertConfig → models.bert.BertConfig (post-LN, exact
+    GELU on both sides)."""
+    from deepspeed_tpu.models.bert import BertConfig
+    act = getattr(hf_config, "hidden_act", "gelu")
+    if act != "gelu":
+        raise ValueError(
+            f"hidden_act={act!r} is not convertible: the fused encoder "
+            f"layer computes exact GELU (transformer.py nn.gelu "
+            f"approximate=False); converting would silently change every "
+            f"FFN activation")
+    pos = getattr(hf_config, "position_embedding_type", "absolute")
+    if pos != "absolute":
+        raise ValueError(
+            f"position_embedding_type={pos!r} is not convertible: the "
+            f"rebuild uses learned absolute position embeddings")
+    base = dict(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_hidden_layers=hf_config.num_hidden_layers,
+        num_attention_heads=hf_config.num_attention_heads,
+        intermediate_size=hf_config.intermediate_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        type_vocab_size=hf_config.type_vocab_size,
+        hidden_dropout_prob=hf_config.hidden_dropout_prob,
+        attention_probs_dropout_prob=hf_config.attention_probs_dropout_prob,
+        layer_norm_eps=hf_config.layer_norm_eps,
+    )
+    base.update(overrides)
+    return BertConfig(**base)
+
+
+def _hf_bert_layer(layer):
+    """One HF flax BERT encoder layer → our fused-layer subtree: separate
+    q/k/v Denses concatenate into attn_qkvw (the fused layer splits in
+    q,k,v order along the out axis — the reference's qkvw packing,
+    replace_module.py:34-41)."""
+    att = layer["attention"]
+    qkv_k = jnp.concatenate(
+        [jnp.asarray(att["self"][n]["kernel"]) for n in
+         ("query", "key", "value")], axis=1)
+    qkv_b = jnp.concatenate(
+        [jnp.asarray(att["self"][n]["bias"]) for n in
+         ("query", "key", "value")])
+    return {
+        "attn_qkvw": {"kernel": qkv_k, "bias": qkv_b},
+        "attn_ow": dict(att["output"]["dense"]),
+        "attn_nw": dict(att["output"]["LayerNorm"]),
+        "inter_w": dict(layer["intermediate"]["dense"]),
+        "output_w": dict(layer["output"]["dense"]),
+        "norm_w": dict(layer["output"]["LayerNorm"]),
+    }
+
+
+def convert_hf_bert_params(hf_params, cfg):
+    """HF FlaxBertModel params → our BertModel params (unrolled or
+    scan-stacked per ``cfg.scan_layers``)."""
+    p = hf_params.get("params", hf_params)
+    emb = p["embeddings"]
+    out = {
+        "embeddings": {
+            "word_embeddings": jnp.asarray(
+                emb["word_embeddings"]["embedding"]),
+            "position_embeddings": jnp.asarray(
+                emb["position_embeddings"]["embedding"]),
+            "token_type_embeddings": jnp.asarray(
+                emb["token_type_embeddings"]["embedding"]),
+            "LayerNorm": dict(emb["LayerNorm"]),
+        },
+        "pooler": dict(p["pooler"]["dense"]),
+    }
+    layers = [_hf_bert_layer(p["encoder"]["layer"][str(i)])
+              for i in range(cfg.num_hidden_layers)]
+    if cfg.scan_layers:
+        out["encoder"] = {
+            "layer": {"DeepSpeedTransformerLayer_0": _stack_layers(layers)}}
+    else:
+        out["encoder"] = {
+            f"DeepSpeedTransformerLayer_{i}": layers[i]
+            for i in range(cfg.num_hidden_layers)}
+    return out
+
+
+def from_hf_bert(hf_model, **config_overrides):
+    """(our_config, our_params) from a transformers FlaxBertModel."""
+    cfg = config_from_hf_bert(hf_model.config, **config_overrides)
+    return cfg, convert_hf_bert_params(hf_model.params, cfg)
