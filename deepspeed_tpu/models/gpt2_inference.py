@@ -38,6 +38,9 @@ def inference_config(cfg: GPT2Config, max_out_tokens: int = 0,
         triangular_masking=True,
         max_out_tokens=max_out_tokens or cfg.n_positions,
         gelu_approximate=True,   # GPT-2 trains with tanh-approx GELU
+        moe_experts=cfg.moe_experts,
+        moe_k=cfg.moe_k,
+        moe_capacity_factor=cfg.moe_capacity_factor,
         quantize_bits=quantize_bits,
         quantize_groups=quantize_groups,
         dtype=dtype or cfg.dtype,
@@ -96,20 +99,21 @@ class GPT2InferenceModel(nn.Module):
 def _convert_block(blk):
     """Training Block subtree → fused inference layer subtree (the weight
     copy of replace_module.py:24-79; orientations are identical since both
-    sides are flax Dense kernels [in, out])."""
-    if "moe" in blk:
-        raise NotImplementedError(
-            "MoE GPT-2 serving is not supported by the fused inference "
-            "stack yet — run inference through the training model "
-            "(model.apply) for moe_experts > 0")
-    return {
+    sides are flax Dense kernels [in, out]). MoE blocks carry their
+    gate+expert bank through verbatim (the inference layer instantiates
+    the same MoE module under the same name)."""
+    out = {
         "attn_nw": dict(blk["ln_1"]),
         "attn_qkvw": dict(blk["attn"]["c_attn"]),
         "attn_ow": dict(blk["attn"]["c_proj"]),
         "norm_w": dict(blk["ln_2"]),
-        "inter_w": dict(blk["mlp"]["c_fc"]),
-        "output_w": dict(blk["mlp"]["c_proj"]),
     }
+    if "moe" in blk:
+        out["moe"] = dict(blk["moe"])
+    else:
+        out["inter_w"] = dict(blk["mlp"]["c_fc"])
+        out["output_w"] = dict(blk["mlp"]["c_proj"])
+    return out
 
 
 def convert_gpt2_params(params, cfg: GPT2Config):

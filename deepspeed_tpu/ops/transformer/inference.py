@@ -52,6 +52,20 @@ class DeepSpeedInferenceConfig:
     # dequantize at the matmul read — 4x weight-memory reduction
     quantize_bits: int = 0               # 0 = off; 8 = int8 storage
     quantize_groups: int = 1
+    # MoE FFN serving: the block's dense FFN is replaced by the
+    # expert-parallel MoE bank (deepspeed_tpu/moe), routed per token at
+    # decode time. Expert weights are served unquantized. NOTE on
+    # capacity semantics: training-time routing truncates to a capacity
+    # derived from the ROUTED sequence length, so its outputs are
+    # length-dependent whenever truncation binds; decode routes each new
+    # token alone (capacity never binds for it — no decoded token is
+    # dropped) and prompt tokens at prompt length. The two coincide
+    # exactly when capacity_factor is high enough that truncation never
+    # binds; under binding capacity there is no single "training
+    # equivalent" to match.
+    moe_experts: int = 0
+    moe_k: int = 1
+    moe_capacity_factor: float = 1.25
     dtype: Any = None
     param_dtype: Any = jnp.float32
 
@@ -136,6 +150,13 @@ class DeepSpeedTransformerInference(nn.Module):
             return make_dense(E, "attn_ow")(ctx)
 
         def ffn(h):
+            if cfg.moe_experts:
+                from deepspeed_tpu.moe import MoE
+                return MoE(num_experts=cfg.moe_experts, d_ff=cfg.ffn_size,
+                           k=cfg.moe_k,
+                           capacity_factor=cfg.moe_capacity_factor,
+                           dtype=dt, param_dtype=cfg.param_dtype,
+                           name="moe")(h, deterministic=True)
             inter = make_dense(cfg.ffn_size, "inter_w")(h)
             # must match the training model's GELU variant bit-for-bit or
             # injected params serve shifted logits (GPT-2 trains with the

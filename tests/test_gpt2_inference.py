@@ -136,3 +136,31 @@ def test_step_loop_decode_matches_scan_decode():
     scan = generate(cfg, params, ids, max_new_tokens=12, scan_decode=True)
     loop = generate(cfg, params, ids, max_new_tokens=12, scan_decode=False)
     np.testing.assert_array_equal(np.asarray(scan), np.asarray(loop))
+
+
+def test_moe_gpt2_serves_through_inference_stack():
+    """MoE GPT-2 decode: the fused inference layer routes each token
+    through the expert bank. Exact equality with training-model
+    re-forward holds iff expert capacity never binds (capacity_factor >=
+    num_experts here guarantees it): under binding capacity the training
+    model's own outputs are routed-length-dependent, so there is no
+    single re-forward to match (see DeepSpeedInferenceConfig's capacity
+    note)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    cfg = GPT2Config(vocab_size=256, n_positions=64, n_embd=32, n_layer=2,
+                     n_head=2, dtype=jnp.float32, moe_experts=4, moe_k=1,
+                     moe_capacity_factor=4.0, scan_layers=True)
+    model = GPT2LMHeadModel(cfg)
+    ids = np.random.RandomState(0).randint(0, 256, (2, 10)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+
+    steps = 6
+    slow = jnp.asarray(ids)
+    for _ in range(steps):
+        logits = model.apply({"params": params}, slow)
+        nxt = jnp.argmax(logits[:, -1], axis=-1)
+        slow = jnp.concatenate([slow, nxt[:, None]], axis=1)
+
+    fast = generate(cfg, params, ids, max_new_tokens=steps, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
