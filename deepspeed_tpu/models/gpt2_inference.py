@@ -29,7 +29,8 @@ from deepspeed_tpu.ops.transformer.inference import (
 
 def inference_config(cfg: GPT2Config, max_out_tokens: int = 0,
                      dtype=None, quantize_bits: int = 0,
-                     quantize_groups: int = 1) -> DeepSpeedInferenceConfig:
+                     quantize_groups: int = 1,
+                     kv_cache_bits: int = 0) -> DeepSpeedInferenceConfig:
     return DeepSpeedInferenceConfig(
         hidden_size=cfg.n_embd,
         heads=cfg.n_head,
@@ -43,6 +44,7 @@ def inference_config(cfg: GPT2Config, max_out_tokens: int = 0,
         moe_capacity_factor=cfg.moe_capacity_factor,
         quantize_bits=quantize_bits,
         quantize_groups=quantize_groups,
+        kv_cache_bits=kv_cache_bits,
         dtype=dtype or cfg.dtype,
         param_dtype=cfg.param_dtype,
     )
@@ -65,13 +67,15 @@ class GPT2InferenceModel(nn.Module):
     max_out_tokens: int = 0
     quantize_bits: int = 0      # int8-storage serving (4x weight memory)
     quantize_groups: int = 1
+    kv_cache_bits: int = 0      # int8 KV cache (2x cache memory vs bf16)
 
     @nn.compact
     def __call__(self, input_ids, position_offset=0):
         cfg = self.config
         icfg = inference_config(cfg, self.max_out_tokens,
                                 quantize_bits=self.quantize_bits,
-                                quantize_groups=self.quantize_groups)
+                                quantize_groups=self.quantize_groups,
+                                kv_cache_bits=self.kv_cache_bits)
         B, S = input_ids.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
@@ -140,18 +144,19 @@ _STEP_CACHE = {}
 
 
 def _compiled_steps(cfg: GPT2Config, max_out: int, quantize_bits: int = 0,
-                    quantize_groups: int = 1):
+                    quantize_groups: int = 1, kv_cache_bits: int = 0):
     """(prompt_pass, decode_step, decode_scan) jitted once per (config,
     cache length) — repeated generate() calls hit jit's cache instead of
     retracing the whole model per request. decode_scan additionally
     recompiles per distinct step COUNT (its scan length is static);
     callers generating many different lengths should bucket them or use
     the per-token decode_step path (generate(..., scan_decode=False))."""
-    key = (cfg, max_out, quantize_bits, quantize_groups)
+    key = (cfg, max_out, quantize_bits, quantize_groups, kv_cache_bits)
     if key not in _STEP_CACHE:
         model = GPT2InferenceModel(cfg, max_out_tokens=max_out,
                                    quantize_bits=quantize_bits,
-                                   quantize_groups=quantize_groups)
+                                   quantize_groups=quantize_groups,
+                                   kv_cache_bits=kv_cache_bits)
 
         @jax.jit
         def prompt_pass(p, ids):
@@ -214,7 +219,7 @@ def quantize_gpt2_inference_params(iparams, groups: int = 1):
 def generate(cfg: GPT2Config, params, input_ids, max_new_tokens=20,
              temperature: float = 0.0, rng=None, max_out_tokens: int = 0,
              quantize_bits: int = 0, quantize_groups: int = 1,
-             scan_decode: bool = True):
+             kv_cache_bits: int = 0, scan_decode: bool = True):
     """KV-cache generation. ``temperature == 0`` → greedy. Returns
     [B, S + max_new_tokens] token ids.
 
@@ -238,7 +243,7 @@ def generate(cfg: GPT2Config, params, input_ids, max_new_tokens=20,
     max_out = max_out_tokens or cfg.n_positions
     assert total <= max_out, (total, max_out)
     prompt_pass, decode_step, decode_scan = _compiled_steps(
-        cfg, max_out, quantize_bits, quantize_groups)
+        cfg, max_out, quantize_bits, quantize_groups, kv_cache_bits)
     converted = "h" in params and "blk" in params.get("h", {}) and \
         any(k in params["h"]["blk"] for k in ("attn_qkvw",))
     iparams = params if converted else convert_gpt2_params(params, cfg)
