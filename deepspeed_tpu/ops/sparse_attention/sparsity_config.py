@@ -4,18 +4,61 @@ Behavioral rebuild of the reference's layout family
 (deepspeed/ops/sparse_attention/sparsity_config.py:94 Fixed, :243 Variable,
 :421 BigBird, :544 BSLongformer) producing `[num_heads, num_blocks,
 num_blocks]` 0/1 layouts consumed by the Pallas block-sparse kernels
-(deepspeed_tpu/ops/pallas/blocksparse.py). Implemented on numpy — layouts
-are host-side static data baked into the kernel grid at trace time.
+(deepspeed_tpu/ops/pallas/blocksparse.py).
+
+Construction is vectorized numpy: every pattern is the union of a few
+boolean component masks built from index arithmetic over the block grid —
+a same-window equivalence mask for local attention, a banded mask for
+sliding windows, and row/column stripe masks for global attention — with
+causality applied once as a final `np.tril`. (Building bidirectionally and
+lower-triangling at the end is equivalent to the reference's per-loop
+causal clipping: the intersection of any of these masks with the lower
+triangle is the same either way.) Layouts are host-side static data baked
+into the kernel grid at trace time.
 
 TPU note: the reference's Triton kernels used block=16 defaults; on TPU the
 MXU/VMEM tiling prefers block sizes that are multiples of 128 in the lane
-dim, so `block` here defaults to 128 for kernel use, while any value is legal
-for layout math (kept at 16 by the config-schema default for config parity).
+dim, so `block` here defaults to 128 for kernel use, while any value is
+legal for layout math (kept at 16 by the config-schema default for config
+parity).
 """
 
-import random
-
 import numpy as np
+
+
+def _stripe(nb, indices=None, ranges=None):
+    """Boolean [nb] vector marking global block positions, from either a
+    list of single block indices (negative = from the end, numpy-style) or
+    (start, end) ranges. Out-of-range entries are clipped/ignored."""
+    cols = np.zeros(nb, dtype=bool)
+    if ranges is not None:
+        for start, end in ranges:
+            cols[start:min(end, nb)] = True
+    elif indices is not None:
+        valid = [i for i in indices if -nb <= i < nb]
+        cols[valid] = True
+    return cols
+
+
+def _same_window(window_ids):
+    """[nb] window ids -> [nb, nb] mask of (row, col) in the same window."""
+    return window_ids[:, None] == window_ids[None, :]
+
+
+def _banded(nb, half_width):
+    """[nb, nb] mask of |row - col| <= half_width (sliding window)."""
+    idx = np.arange(nb)
+    return np.abs(idx[:, None] - idx[None, :]) <= half_width
+
+
+def _random_cols(nb, k):
+    """[nb, nb] mask with k distinct random columns per row (vectorized:
+    rank a random score matrix per row and keep the k smallest)."""
+    mask = np.zeros((nb, nb), dtype=bool)
+    if k > 0:
+        picks = np.argpartition(np.random.rand(nb, nb), k - 1, axis=1)[:, :k]
+        mask[np.arange(nb)[:, None], picks] = True
+    return mask
 
 
 class SparsityConfig:
@@ -27,37 +70,37 @@ class SparsityConfig:
         self.different_layout_per_head = different_layout_per_head
         self.num_layout_heads = num_heads if different_layout_per_head else 1
 
-    def setup_layout(self, seq_len):
+    def _num_blocks(self, seq_len):
         if seq_len % self.block != 0:
             raise ValueError(
                 f"Sequence length {seq_len} must be divisible by block size {self.block}")
-        num_blocks = seq_len // self.block
-        return np.zeros((self.num_heads, num_blocks, num_blocks), dtype=np.int64)
+        return seq_len // self.block
 
-    def check_and_propagate_first_head_layout(self, layout):
-        if not self.different_layout_per_head:
-            layout[1:] = layout[0]
-        return layout
+    def _head_mask(self, h, num_blocks):
+        """Boolean [num_blocks, num_blocks] attention-block mask for head h."""
+        raise NotImplementedError
 
     def make_layout(self, seq_len):
-        raise NotImplementedError
+        nb = self._num_blocks(seq_len)
+        heads = [self._head_mask(h, nb) for h in range(self.num_layout_heads)]
+        heads.extend(heads[0] for _ in range(self.num_heads - len(heads)))
+        return np.stack(heads).astype(np.int64)
 
 
 class DenseSparsityConfig(SparsityConfig):
     """All-ones layout: lets the sparse kernel path run dense (reference
     sparsity_config.py:60-ish Dense class)."""
 
-    def make_layout(self, seq_len):
-        layout = self.setup_layout(seq_len)
-        layout[:, :, :] = 1
-        return layout
+    def _head_mask(self, h, num_blocks):
+        return np.ones((num_blocks, num_blocks), dtype=bool)
 
 
 class FixedSparsityConfig(SparsityConfig):
     """'Fixed' pattern (Sparse Transformers, Child et al. 2019): local windows
-    of `num_local_blocks`, plus global attention to the last
-    `num_global_blocks` block-columns of each window; optionally different
-    global offsets per head group and horizontal (row) global attention."""
+    of `num_local_blocks`, plus global attention to a `num_global_blocks`-wide
+    column slot inside each window; the slot offset rotates across head
+    groups when `num_different_global_patterns` > 1, and rows of the same
+    slots become global too under `horizontal_global_attention`."""
 
     def __init__(self,
                  num_heads,
@@ -94,47 +137,31 @@ class FixedSparsityConfig(SparsityConfig):
                 f"global blocks")
         self.num_different_global_patterns = num_different_global_patterns
 
-    def set_local_layout(self, h, layout):
-        num_blocks = layout.shape[1]
-        for i in range(0, num_blocks, self.num_local_blocks):
-            end = min(i + self.num_local_blocks, num_blocks)
-            for row in range(i, end):
-                for col in range(i, (row + 1) if self.attention == "unidirectional" else end):
-                    layout[h, row, col] = 1
-        return layout
+    def _global_cols(self, h, num_blocks):
+        """Boolean [nb] vector of global block-columns for head h: inside
+        every complete window, the G-wide slot ending `pattern_index`
+        slots from the window end; in an incomplete tail window, its last
+        G columns."""
+        L, G = self.num_local_blocks, self.num_global_blocks
+        slot_start = L - (1 + h % self.num_different_global_patterns) * G
+        idx = np.arange(num_blocks)
+        phase = idx % L
+        complete = num_blocks - num_blocks % L
+        cols = (idx < complete) & (phase >= slot_start) & (phase < slot_start + G)
+        if complete < num_blocks:
+            cols |= idx >= max(complete, num_blocks - G)
+        return cols
 
-    def set_global_layout(self, h, layout):
-        num_blocks = layout.shape[1]
-        first_global_block_idx = (
-            self.num_local_blocks - (1 + h % self.num_different_global_patterns)
-            * self.num_global_blocks)
-        # set all global blocks except the last one if (num_blocks % num_local_blocks) != 0
-        end = num_blocks - (num_blocks % self.num_local_blocks)
-        for i in range(first_global_block_idx, end, self.num_local_blocks):
-            # vertical global attention
-            first_row = 0 if self.attention == "bidirectional" else i
-            # (((i // self.num_local_blocks) + 1) * self.num_local_blocks)
-            layout[h, first_row:, i:i + self.num_global_blocks] = 1
-            # horizontal global attention
-            if self.horizontal_global_attention:
-                layout[h, i:i + self.num_global_blocks, :] = 1
-        # residue block-window shorter than num_local_blocks at the tail
-        if end < num_blocks:
-            start = max(end, num_blocks - self.num_global_blocks)
-            first_row = 0 if self.attention == "bidirectional" else start
-            layout[h, first_row:, start:] = 1
-            if self.horizontal_global_attention:
-                layout[h, start:, :] = 1
+    def _head_mask(self, h, num_blocks):
+        window_ids = np.arange(num_blocks) // self.num_local_blocks
+        mask = _same_window(window_ids)
+        gcols = self._global_cols(h, num_blocks)
+        mask |= gcols[None, :]
+        if self.horizontal_global_attention:
+            mask |= gcols[:, None]
         if self.attention == "unidirectional":
-            layout[h] = np.tril(layout[h])
-        return layout
-
-    def make_layout(self, seq_len):
-        layout = self.setup_layout(seq_len)
-        for h in range(self.num_layout_heads):
-            layout = self.set_local_layout(h, layout)
-            layout = self.set_global_layout(h, layout)
-        return self.check_and_propagate_first_head_layout(layout)
+            mask = np.tril(mask)
+        return mask
 
 
 class VariableSparsityConfig(SparsityConfig):
@@ -176,70 +203,34 @@ class VariableSparsityConfig(SparsityConfig):
                 "only bidirectional attention can support horizontal global attention")
         self.horizontal_global_attention = horizontal_global_attention
 
-    def set_random_layout(self, h, layout):
-        num_blocks = layout.shape[1]
+    def _window_ids(self, num_blocks):
+        """Assign each block a window id from the configured window sizes;
+        the last size repeats to cover the rest of the sequence."""
+        bounds = list(np.cumsum(self.local_window_blocks))
+        tail = self.local_window_blocks[-1]
+        while bounds[-1] < num_blocks:
+            bounds.append(bounds[-1] + tail)
+        return np.searchsorted(np.asarray(bounds), np.arange(num_blocks),
+                               side="right")
+
+    def _head_mask(self, h, num_blocks):
         if num_blocks < self.num_random_blocks:
             raise ValueError(
                 f"Number of random blocks ({self.num_random_blocks}) must be smaller "
                 f"than overall number of blocks in a row ({num_blocks})")
-        for row in range(num_blocks):
-            rnd_cols = random.sample(range(num_blocks), self.num_random_blocks)
-            layout[h, row, rnd_cols] = 1
-        return layout
-
-    def set_local_layout(self, h, layout):
-        num_blocks = layout.shape[1]
-        start_block_idx = 0
-        end_block_idx = 0
-        for block_size in self.local_window_blocks:
-            end_block_idx += block_size
-            end_block_idx = min(end_block_idx, num_blocks)
-            for row in range(start_block_idx, end_block_idx):
-                for col in range(start_block_idx,
-                                 (row + 1) if self.attention == "unidirectional"
-                                 else end_block_idx):
-                    layout[h, row, col] = 1
-            start_block_idx += block_size
-        # repeat the last window size for remaining blocks
-        for i in range(start_block_idx, num_blocks, self.local_window_blocks[-1]):
-            end_block_idx = min(i + self.local_window_blocks[-1], num_blocks)
-            for row in range(i, end_block_idx):
-                for col in range(i,
-                                 (row + 1) if self.attention == "unidirectional"
-                                 else end_block_idx):
-                    layout[h, row, col] = 1
-        return layout
-
-    def set_global_layout(self, h, layout):
-        num_blocks = layout.shape[1]
-        if self.global_block_end_indices is None:
-            for idx in self.global_block_indices:
-                if idx < num_blocks:
-                    # vertical
-                    first_row = 0 if self.attention == "bidirectional" else idx
-                    layout[h, first_row:, idx] = 1
-                    # horizontal
-                    if self.horizontal_global_attention:
-                        layout[h, idx, :] = 1
+        mask = _random_cols(num_blocks, self.num_random_blocks)
+        mask |= _same_window(self._window_ids(num_blocks))
+        if self.global_block_end_indices is not None:
+            gcols = _stripe(num_blocks, ranges=zip(self.global_block_indices,
+                                                   self.global_block_end_indices))
         else:
-            for start, end in zip(self.global_block_indices, self.global_block_end_indices):
-                end = min(end, num_blocks)
-                for idx in range(start, end):
-                    first_row = 0 if self.attention == "bidirectional" else idx
-                    layout[h, first_row:, idx] = 1
-                    if self.horizontal_global_attention:
-                        layout[h, idx, :] = 1
+            gcols = _stripe(num_blocks, indices=self.global_block_indices)
+        mask |= gcols[None, :]
+        if self.horizontal_global_attention:
+            mask |= gcols[:, None]
         if self.attention == "unidirectional":
-            layout[h] = np.tril(layout[h])
-        return layout
-
-    def make_layout(self, seq_len):
-        layout = self.setup_layout(seq_len)
-        for h in range(self.num_layout_heads):
-            layout = self.set_random_layout(h, layout)
-            layout = self.set_local_layout(h, layout)
-            layout = self.set_global_layout(h, layout)
-        return self.check_and_propagate_first_head_layout(layout)
+            mask = np.tril(mask)
+        return mask
 
 
 class BigBirdSparsityConfig(SparsityConfig):
@@ -258,49 +249,21 @@ class BigBirdSparsityConfig(SparsityConfig):
         self.num_sliding_window_blocks = num_sliding_window_blocks
         self.num_global_blocks = num_global_blocks
 
-    def set_random_layout(self, h, layout):
-        num_blocks = layout.shape[1]
-        if num_blocks < self.num_random_blocks:
-            raise ValueError(
-                f"Number of random blocks ({self.num_random_blocks}) must be smaller "
-                f"than overall number of blocks in a row ({num_blocks})")
-        for row in range(num_blocks):
-            rnd_cols = random.sample(range(num_blocks), self.num_random_blocks)
-            layout[h, row, rnd_cols] = 1
-        return layout
-
-    def set_sliding_window_layout(self, h, layout):
-        num_blocks = layout.shape[1]
-        if num_blocks < self.num_sliding_window_blocks:
-            raise ValueError(
-                f"Number of sliding window blocks ({self.num_sliding_window_blocks}) "
-                f"must be smaller than overall number of blocks in a row ({num_blocks})")
-        w = self.num_sliding_window_blocks // 2
-        for row in range(num_blocks):
-            start = max(0, row - w)
-            end = min(row + w + 1, num_blocks)
-            layout[h, row, start:end] = 1
-        return layout
-
-    def set_global_layout_itc(self, h, layout):
-        num_blocks = layout.shape[1]
-        if num_blocks < self.num_global_blocks:
-            raise ValueError(
-                f"Number of global blocks ({self.num_global_blocks}) must be smaller "
-                f"than overall number of blocks in a row ({num_blocks})")
-        layout[h, 0:self.num_global_blocks, :] = 1
-        layout[h, :, 0:self.num_global_blocks] = 1
-        layout[h, -self.num_global_blocks:, :] = 1
-        layout[h, :, -self.num_global_blocks:] = 1
-        return layout
-
-    def make_layout(self, seq_len):
-        layout = self.setup_layout(seq_len)
-        for h in range(self.num_layout_heads):
-            layout = self.set_random_layout(h, layout)
-            layout = self.set_sliding_window_layout(h, layout)
-            layout = self.set_global_layout_itc(h, layout)
-        return self.check_and_propagate_first_head_layout(layout)
+    def _head_mask(self, h, num_blocks):
+        for name, need in (("random", self.num_random_blocks),
+                           ("sliding window", self.num_sliding_window_blocks),
+                           ("global", self.num_global_blocks)):
+            if num_blocks < need:
+                raise ValueError(
+                    f"Number of {name} blocks ({need}) must be smaller than "
+                    f"overall number of blocks in a row ({num_blocks})")
+        mask = _random_cols(num_blocks, self.num_random_blocks)
+        mask |= _banded(num_blocks, self.num_sliding_window_blocks // 2)
+        g = self.num_global_blocks
+        edges = _stripe(num_blocks, ranges=[(0, g), (num_blocks - g, num_blocks)])
+        mask |= edges[None, :]
+        mask |= edges[:, None]
+        return mask
 
 
 class BSLongformerSparsityConfig(SparsityConfig):
@@ -330,39 +293,20 @@ class BSLongformerSparsityConfig(SparsityConfig):
         self.global_block_end_indices = (list(global_block_end_indices)
                                          if global_block_end_indices is not None else None)
 
-    def set_sliding_window_layout(self, h, layout):
-        num_blocks = layout.shape[1]
+    def _head_mask(self, h, num_blocks):
         if num_blocks < self.num_sliding_window_blocks:
             raise ValueError(
                 f"Number of sliding window blocks ({self.num_sliding_window_blocks}) "
                 f"must be smaller than overall number of blocks in a row ({num_blocks})")
-        w = self.num_sliding_window_blocks // 2
-        for row in range(num_blocks):
-            start = max(0, row - w)
-            end = min(row + w + 1, num_blocks)
-            layout[h, row, start:end] = 1
-        return layout
-
-    def set_global_layout(self, h, layout):
-        num_blocks = layout.shape[1]
-        if self.global_block_end_indices is None:
-            for idx in self.global_block_indices:
-                if idx < num_blocks:
-                    layout[h, :, idx] = 1
-                    layout[h, idx, :] = 1
+        mask = _banded(num_blocks, self.num_sliding_window_blocks // 2)
+        if self.global_block_end_indices is not None:
+            g = _stripe(num_blocks, ranges=zip(self.global_block_indices,
+                                               self.global_block_end_indices))
         else:
-            for start, end in zip(self.global_block_indices, self.global_block_end_indices):
-                end = min(end, num_blocks)
-                layout[h, :, start:end] = 1
-                layout[h, start:end, :] = 1
-        return layout
-
-    def make_layout(self, seq_len):
-        layout = self.setup_layout(seq_len)
-        for h in range(self.num_layout_heads):
-            layout = self.set_sliding_window_layout(h, layout)
-            layout = self.set_global_layout(h, layout)
-        return self.check_and_propagate_first_head_layout(layout)
+            g = _stripe(num_blocks, indices=self.global_block_indices)
+        mask |= g[None, :]
+        mask |= g[:, None]
+        return mask
 
 
 def config_to_sparsity(sa_config, num_heads):

@@ -10,32 +10,33 @@ accessor surface (get_stage_id, get_data_parallel_rank, …) but is backed by a
 """
 
 from collections import namedtuple
-from itertools import product
+
+import numpy as np
 
 
 class ProcessTopology:
     """Maps n-dim cartesian coordinates to linear ranks, axes major→minor.
 
-    Mirrors reference pipe/topology.py:12 (ProcessCoord namedtuples, filter
-    queries, etc.)."""
+    API parity with reference pipe/topology.py:12, but backed by a numpy
+    rank grid the way `jax.sharding.Mesh` is backed by a devices ndarray:
+    a coordinate lookup is an array index, a comm list is an axis slice,
+    and a filter query is fancy indexing — no dict scans."""
 
     def __init__(self, axes, dims):
-        self.axes = axes
-        self.dims = dims
+        self.axes = list(axes)
+        self.dims = list(dims)
         self.ProcessCoord = namedtuple("ProcessCoord", axes)
-        self.mapping = {}
-        ranges = [range(d) for d in dims]
-        for global_rank, coord in enumerate(product(*ranges)):
-            key = {axis: coord[self.axes.index(axis)] for axis in self.axes}
-            key = self.ProcessCoord(**key)
-            self.mapping[key] = global_rank
+        # C-order reshape gives the odometer rank numbering (last axis
+        # fastest) that the reference's coordinate enumeration produced.
+        self._grid = np.arange(int(np.prod(self.dims))).reshape(self.dims)
 
     def get_rank(self, **coord_kwargs):
-        if len(coord_kwargs) != len(self.axes):
-            raise ValueError(f"get_rank() does not support slices, use filter_match")
-        key = self.ProcessCoord(**coord_kwargs)
-        assert key in self.mapping, f"key {coord_kwargs} invalid"
-        return self.mapping[key]
+        if set(coord_kwargs) != set(self.axes):
+            raise ValueError("get_rank() does not support slices, use filter_match")
+        idx = tuple(coord_kwargs[a] for a in self.axes)
+        if any(not 0 <= i < d for i, d in zip(idx, self.dims)):
+            raise AssertionError(f"key {coord_kwargs} invalid")
+        return int(self._grid[idx])
 
     def get_axis_names(self):
         return self.axes
@@ -43,13 +44,10 @@ class ProcessTopology:
     def get_rank_repr(self, rank, omit_axes=("data", "pipe"), inner_sep="_", outer_sep="-"):
         """String used in checkpoint filenames (reference topology.py:87):
         e.g. mp_rank_00 style naming omits data/pipe axes."""
-        omit_axes = list(omit_axes)
-        axes = [a for a in self.get_axis_names() if a not in omit_axes]
-        names = []
-        for ax in axes:
-            ax_rank = getattr(self.get_coord(rank=rank), ax)
-            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
-        return outer_sep.join(names)
+        coord = self.get_coord(rank)._asdict()
+        return outer_sep.join(
+            f"{ax}{inner_sep}{coord[ax]:02d}"
+            for ax in self.axes if ax not in omit_axes)
 
     def get_dim(self, axis):
         if axis not in self.axes:
@@ -57,44 +55,45 @@ class ProcessTopology:
         return self.dims[self.axes.index(axis)]
 
     def get_coord(self, rank):
-        for coord, idx in self.mapping.items():
-            if idx == rank:
-                return coord
-        raise ValueError(f"rank {rank} not found in topology.")
+        if not 0 <= rank < self._grid.size:
+            raise ValueError(f"rank {rank} not found in topology.")
+        return self.ProcessCoord(*map(int, np.unravel_index(rank, self.dims)))
 
     def get_axis_comm_lists(self, axis):
         """All groups of ranks that vary along ``axis`` with other coords
         fixed — the reference built process groups from these lists
-        (topology.py:139)."""
+        (topology.py:139). Here: move ``axis`` last and flatten the rest,
+        so each row of the resulting matrix is one comm group."""
         if axis not in self.axes:
             return []
-        other_axes = [a for a in self.axes if a != axis]
-        lists = []
-        ranges = [range(self.get_dim(a)) for a in other_axes]
-        for coord in product(*ranges):
-            other_keys = {a: coord[other_axes.index(a)] for a in other_axes}
-            sub_list = []
-            for axis_key in range(self.get_dim(axis)):
-                key = self.ProcessCoord(**other_keys, **{axis: axis_key})
-                sub_list.append(self.mapping[key])
-            lists.append(sub_list)
-        return lists
+        rows = np.moveaxis(self._grid, self.axes.index(axis), -1)
+        return rows.reshape(-1, self.get_dim(axis)).tolist()
 
     def filter_match(self, **filter_kwargs):
-        """Ranks whose coords match all kwargs (reference topology.py:167)."""
-        def _filter_helper(x):
-            for key, val in filter_kwargs.items():
-                if getattr(x, key) != val:
-                    return False
-            return True
-        coords = filter(_filter_helper, self.mapping.keys())
-        return [self.mapping[coord] for coord in coords]
+        """Ranks whose coords match all kwargs (reference topology.py:167),
+        as a sorted list: index the grid with the fixed coordinates and
+        flatten whatever remains. Unknown axis names raise (the dict-based
+        original raised AttributeError); out-of-range values match nothing."""
+        for axis, val in filter_kwargs.items():
+            if axis not in self.axes:
+                raise AttributeError(f"unknown topology axis {axis!r}; "
+                                     f"have {self.axes}")
+            if not 0 <= val < self.get_dim(axis):
+                return []
+        selector = tuple(
+            filter_kwargs.get(a, slice(None)) for a in self.axes)
+        return np.atleast_1d(self._grid[selector]).ravel().tolist()
 
     def get_axis_list(self, axis, idx):
         return self.filter_match(**{axis: idx})
 
     def world_size(self):
-        return len(self.mapping)
+        return int(self._grid.size)
+
+    @property
+    def mapping(self):
+        """coord→rank dict view (kept for repr/debug parity)."""
+        return {self.get_coord(r): r for r in range(self.world_size())}
 
     def __str__(self):
         return str(self.mapping)

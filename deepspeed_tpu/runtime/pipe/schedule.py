@@ -161,54 +161,49 @@ class InferenceSchedule(PipeSchedule):
 
 
 class TrainSchedule(PipeSchedule):
-    """Even/odd-step alternating fwd/bwd with a 1F1B-like memory profile —
-    reference schedule.py:182 (µbatch mapping :249-289, buffer count
-    :243-247)."""
+    """1F1B interleave, derived from the closed form the SPMD executor runs
+    (parallel/pipeline_1f1b.py:90; behavioral contract = reference
+    schedule.py:182): on stage s of S,
+
+        fwd(m) computes at tick  2m + s
+        bwd(m) computes at tick  2m + 2S - 1 - s
+
+    Ticks therefore alternate direction per stage (fwd ticks share the
+    stage's parity), and communication needs no separate bookkeeping: a
+    tensor produced at tick t is shipped at tick t + 1, which by the same
+    equations is exactly the tick the neighbor consumes it."""
 
     def steps(self):
-        prev_micro_batch_id = -1
-        total_steps = 2 * (self.micro_batches + self.stages - 1)
-        for step_id in range(total_steps):
-            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
-
-            if self._valid_micro_batch(prev_micro_batch_id):
-                prev_buffer = self._buffer_idx(prev_micro_batch_id)
-            if self._valid_micro_batch(micro_batch_id):
-                curr_buffer = self._buffer_idx(micro_batch_id)
-
+        last_tick = 2 * (self.micro_batches + self.stages - 1) - 1
+        prev = -1  # micro-batch computed on the previous tick (may be invalid)
+        for tick in range(last_tick + 1):
+            m, is_forward = self._step_to_micro_batch(tick)
             cmds = []
-            # exchange activations/grads with neighbors
             if is_forward:
-                if self._valid_micro_batch(prev_micro_batch_id) and \
-                        self._valid_stage(self.prev_stage):
-                    cmds.append(SendGrad(prev_buffer))
-                if self._valid_micro_batch(micro_batch_id) and \
-                        self._valid_stage(self.prev_stage):
-                    cmds.append(RecvActivation(curr_buffer))
-            else:
-                if self._valid_micro_batch(micro_batch_id) and \
-                        self._valid_stage(self.next_stage):
-                    cmds.append(RecvGrad(curr_buffer))
-                if self._valid_micro_batch(prev_micro_batch_id) and \
-                        self._valid_stage(self.next_stage):
-                    cmds.append(SendActivation(prev_buffer))
-
-            # compute
-            if self._valid_micro_batch(micro_batch_id):
-                if is_forward:
+                # prev tick was a bwd: its input-cotangent goes upstream now,
+                # while the upstream neighbor's fresh activation arrives.
+                if self._valid_micro_batch(prev) and not self.is_first_stage:
+                    cmds.append(SendGrad(self._buffer_idx(prev)))
+                if self._valid_micro_batch(m):
+                    if not self.is_first_stage:
+                        cmds.append(RecvActivation(self._buffer_idx(m)))
                     if self.is_first_stage or self.is_last_stage:
-                        cmds.append(LoadMicroBatch(curr_buffer))
-                    cmds.append(ForwardPass(curr_buffer))
-                else:
-                    cmds.append(BackwardPass(curr_buffer))
-
-            # model step at the end of the batch
-            if step_id == total_steps - 1:
+                        cmds.append(LoadMicroBatch(self._buffer_idx(m)))
+                    cmds.append(ForwardPass(self._buffer_idx(m)))
+            else:
+                # prev tick was a fwd: its activation goes downstream now,
+                # while the downstream neighbor's cotangent arrives.
+                if self._valid_micro_batch(m) and not self.is_last_stage:
+                    cmds.append(RecvGrad(self._buffer_idx(m)))
+                if self._valid_micro_batch(prev) and not self.is_last_stage:
+                    cmds.append(SendActivation(self._buffer_idx(prev)))
+                if self._valid_micro_batch(m):
+                    cmds.append(BackwardPass(self._buffer_idx(m)))
+            if tick == last_tick:
                 cmds.append(ReduceTiedGrads())
                 cmds.append(ReduceGrads())
                 cmds.append(OptimizerStep())
-
-            prev_micro_batch_id = micro_batch_id
+            prev = m
             yield cmds
 
     def num_pipe_buffers(self):
@@ -218,37 +213,15 @@ class TrainSchedule(PipeSchedule):
         return max(2, buffers)
 
     def _step_to_micro_batch(self, step_id):
-        if _is_even(step_id) and _is_even(self.stage_id):
-            micro_batch_id = self._even_step_forward_id(step_id)
-            is_forward = True
-        elif _is_odd(step_id) and _is_odd(self.stage_id):
-            micro_batch_id = self._odd_step_forward_id(step_id)
-            is_forward = True
-        elif _is_even(step_id) and _is_odd(self.stage_id):
-            micro_batch_id = self._even_step_backward_id(step_id)
-            is_forward = False
-        elif _is_odd(step_id) and _is_even(self.stage_id):
-            micro_batch_id = self._odd_step_backward_id(step_id)
-            is_forward = False
+        """Invert the tick equations for this stage: which micro-batch does
+        tick `step_id` carry, and in which direction? The id is unclipped —
+        fill/drain ticks yield ids outside [0, M) that callers skip."""
+        is_forward = (step_id - self.stage_id) % 2 == 0
+        if is_forward:
+            micro_batch_id = (step_id - self.stage_id) // 2
         else:
-            assert False
+            micro_batch_id = (step_id - (2 * self.stages - 1 - self.stage_id)) // 2
         return micro_batch_id, is_forward
-
-    def _even_step_forward_id(self, step_id):
-        base = step_id // 2
-        return int(base - self.stage_id // 2)
-
-    def _odd_step_forward_id(self, step_id):
-        base = (step_id - 1) // 2
-        return int(base - self.stage_id // 2)
-
-    def _even_step_backward_id(self, step_id):
-        base = step_id // 2
-        return int(base - self.stages + (self.stage_id + 1) // 2)
-
-    def _odd_step_backward_id(self, step_id):
-        base = ((step_id - 1) // 2) - self.stages + 1
-        return int(base + self.stage_id // 2)
 
 
 class DataParallelSchedule(PipeSchedule):
@@ -267,11 +240,3 @@ class DataParallelSchedule(PipeSchedule):
 
     def num_pipe_buffers(self):
         return 1
-
-
-def _is_even(x):
-    return x % 2 == 0
-
-
-def _is_odd(x):
-    return x % 2 != 0
