@@ -18,6 +18,12 @@ def load():
         lib.aio_handle_create.argtypes = [ctypes.c_int64, ctypes.c_int,
                                           ctypes.c_int, ctypes.c_int,
                                           ctypes.c_int]
+        lib.aio_handle_create2.restype = ctypes.c_void_p
+        lib.aio_handle_create2.argtypes = [ctypes.c_int64, ctypes.c_int,
+                                           ctypes.c_int, ctypes.c_int,
+                                           ctypes.c_int, ctypes.c_int]
+        lib.aio_handle_backend.argtypes = [ctypes.c_void_p]
+        lib.aio_handle_backend.restype = ctypes.c_int
         lib.aio_handle_destroy.argtypes = [ctypes.c_void_p]
         lib.aio_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
         lib.aio_open.restype = ctypes.c_int
@@ -45,16 +51,29 @@ class AsyncIOHandle:
     wait."""
 
     def __init__(self, block_size=1048576, queue_depth=8, single_submit=False,
-                 overlap_events=True, thread_count=1):
+                 overlap_events=True, thread_count=1, backend="auto"):
+        """``backend``: "auto" (io_uring when the kernel allows, else the
+        thread pool), "threads", or "io_uring" (raises if unsupported)."""
         self.lib = load()
         self.block_size = block_size
         self.queue_depth = queue_depth
         self.single_submit = single_submit
         self.overlap_events = overlap_events
         self.thread_count = thread_count
-        self._h = self.lib.aio_handle_create(
+        codes = {"auto": 0, "threads": 1, "io_uring": 2}
+        if backend not in codes:
+            raise ValueError(f"backend must be one of {sorted(codes)}, "
+                             f"got {backend!r}")
+        self._h = self.lib.aio_handle_create2(
             block_size, queue_depth, thread_count,
-            int(single_submit), int(overlap_events))
+            int(single_submit), int(overlap_events), codes[backend])
+        if not self._h:
+            raise OSError("io_uring backend requested but unsupported by "
+                          "this kernel/seccomp profile")
+
+    @property
+    def backend(self):
+        return "io_uring" if self.lib.aio_handle_backend(self._h) else "threads"
 
     def __del__(self):
         try:

@@ -1,0 +1,103 @@
+"""Async-IO throughput harness — the role of the reference's aio perf suite
+(csrc/aio/py_test/: ds_aio_basic.py sweep of block size / queue depth /
+submit mode against libaio).
+
+Measures MB/s for write + read of a tensor-sized file through each backend
+(io_uring ring vs pread/pwrite thread pool) across queue depths and block
+sizes. Run directly for the sweep table, or import `quick_throughput` for
+the single-point number bench.py reports.
+
+Usage: python tests/perf/aio_bench.py [--mb 512] [--dir /tmp]
+"""
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+
+def _run_case(handle, arr, path, write_first=True):
+    """One write+read pass; returns (write_mbps, read_mbps). The file's
+    pages are dropped from the page cache between write and read (fsync
+    makes them clean, fadvise evicts) so read_mbps measures the device,
+    not memcpy out of cache."""
+    nbytes = arr.nbytes
+    fd = handle.open(path, True)
+    t0 = time.perf_counter()
+    handle.async_pwrite(arr, fd)
+    handle.wait()
+    os.fsync(fd)
+    wt = time.perf_counter() - t0
+    os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+    handle.close(fd)
+
+    out = np.empty_like(arr)
+    fd = handle.open(path, False)
+    t0 = time.perf_counter()
+    handle.async_pread(out, fd)
+    handle.wait()
+    rt = time.perf_counter() - t0
+    handle.close(fd)
+    assert np.array_equal(arr, out), "aio roundtrip corrupted data"
+    return nbytes / wt / 2**20, nbytes / rt / 2**20
+
+
+def quick_throughput(mb=256, directory=None, queue_depth=32,
+                     block_size=1 << 20):
+    """Single-point MB/s for bench.py: best backend, one size. Returns a
+    dict {backend, write_mbps, read_mbps, mb} or None if the native lib is
+    unavailable."""
+    try:
+        from deepspeed_tpu.ops.native.aio import AsyncIOHandle
+        handle = AsyncIOHandle(block_size=block_size, queue_depth=queue_depth,
+                               thread_count=4)
+    except Exception:
+        return None
+    arr = np.random.randint(0, 255, size=mb << 20, dtype=np.uint8)
+    path = tempfile.mktemp(dir=directory, suffix=".aio")
+    try:
+        w, r = _run_case(handle, arr, path)
+        return {"backend": handle.backend, "write_mbps": round(w, 1),
+                "read_mbps": round(r, 1), "mb": mb}
+    finally:
+        if os.path.exists(path):
+            os.unlink(path)
+
+
+def sweep(mb, directory):
+    from deepspeed_tpu.ops.native.aio import AsyncIOHandle
+    arr = np.random.randint(0, 255, size=mb << 20, dtype=np.uint8)
+    rows = []
+    for backend in ("io_uring", "threads"):
+        for queue_depth in (4, 16, 64):
+            for block_kb in (256, 1024, 4096):
+                try:
+                    handle = AsyncIOHandle(block_size=block_kb << 10,
+                                           queue_depth=queue_depth,
+                                           thread_count=4, backend=backend)
+                except OSError:
+                    continue  # io_uring unsupported here
+                path = tempfile.mktemp(dir=directory, suffix=".aio")
+                try:
+                    w, r = _run_case(handle, arr, path)
+                finally:
+                    if os.path.exists(path):
+                        os.unlink(path)
+                rows.append({"backend": backend, "queue_depth": queue_depth,
+                             "block_kb": block_kb, "write_mbps": round(w, 1),
+                             "read_mbps": round(r, 1)})
+                print(json.dumps(rows[-1]))
+    best = max(rows, key=lambda x: x["read_mbps"])
+    print(json.dumps({"best": best, "mb": mb}))
+    return rows
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=512)
+    ap.add_argument("--dir", default=None)
+    args = ap.parse_args()
+    sweep(args.mb, args.dir)
