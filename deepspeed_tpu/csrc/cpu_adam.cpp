@@ -96,6 +96,144 @@ void ds_adam_step_plus_copy(float* params,
   }
 }
 
+// Extended single-pass step for the pipelined offload tier
+// (runtime/zero/offload.py step_streamed): reads grads directly in their
+// wire dtype (bf16 halves the d2h bytes) with the unscale/clip coefficient
+// folded into the read, updates master fp32 params + moments, and emits
+// the bf16 copy the engine pushes back to the device — one memory pass
+// where the unextended path needed three (widen, scale, step) plus a
+// separate conversion pass. The reference overlaps the same stages with
+// CUDA streams (csrc/adam/cpu_adam.cpp:67-120).
+void ds_adam_step_ex(float* params,
+                     const void* grads,
+                     int grads_bf16,      // 1: grads are bf16 (uint16 bits)
+                     float grad_scale,    // multiplied into every grad read
+                     float* exp_avg,
+                     float* exp_avg_sq,
+                     uint16_t* params_bf16_out,  // nullable
+                     int64_t n,
+                     int64_t step,
+                     float lr,
+                     float beta1,
+                     float beta2,
+                     float eps,
+                     float weight_decay,
+                     int adamw_mode,
+                     int bias_correction) {
+  float bc1 = 1.0f, bc2 = 1.0f;
+  if (bias_correction) {
+    bc1 = 1.0f - std::pow(beta1, (float)step);
+    bc2 = 1.0f - std::pow(beta2, (float)step);
+  }
+  const float omb1 = 1.0f - beta1;
+  const float omb2 = 1.0f - beta2;
+  const float inv_bc1 = 1.0f / bc1;
+  const float inv_bc2_sqrt = 1.0f / std::sqrt(bc2);
+  const float* gf = static_cast<const float*>(grads);
+  const uint16_t* gh = static_cast<const uint16_t*>(grads);
+
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    float g;
+    if (grads_bf16) {
+      uint32_t bits = ((uint32_t)gh[i]) << 16;
+      __builtin_memcpy(&g, &bits, 4);
+    } else {
+      g = gf[i];
+    }
+    g *= grad_scale;
+    float p = params[i];
+    if (weight_decay != 0.0f && !adamw_mode) g += weight_decay * p;
+    float m = beta1 * exp_avg[i] + omb1 * g;
+    float v = beta2 * exp_avg_sq[i] + omb2 * g * g;
+    exp_avg[i] = m;
+    exp_avg_sq[i] = v;
+    float denom = std::sqrt(v) * inv_bc2_sqrt + eps;
+    float update = (m * inv_bc1) / denom;
+    if (weight_decay != 0.0f && adamw_mode) update += weight_decay * p;
+    p -= lr * update;
+    params[i] = p;
+    if (params_bf16_out) {
+      uint32_t bits;
+      __builtin_memcpy(&bits, &p, 4);
+      params_bf16_out[i] = fp32_bits_to_bf16(bits);
+    }
+  }
+}
+
+// LAMB twin of ds_adam_step_ex (trust-ratio semantics of ds_lamb_step).
+void ds_lamb_step_ex(float* params,
+                     const void* grads,
+                     int grads_bf16,
+                     float grad_scale,
+                     float* exp_avg,
+                     float* exp_avg_sq,
+                     float* update_buf,   // scratch, n floats
+                     uint16_t* params_bf16_out,  // nullable
+                     int64_t n,
+                     int64_t step,
+                     float lr,
+                     float beta1,
+                     float beta2,
+                     float eps,
+                     float weight_decay,
+                     float max_coeff,
+                     float min_coeff,
+                     int bias_correction) {
+  float bc1 = 1.0f, bc2 = 1.0f;
+  if (bias_correction) {
+    bc1 = 1.0f - std::pow(beta1, (float)step);
+    bc2 = 1.0f - std::pow(beta2, (float)step);
+  }
+  const float omb1 = 1.0f - beta1;
+  const float omb2 = 1.0f - beta2;
+  const float inv_bc1 = 1.0f / bc1;
+  const float inv_bc2_sqrt = 1.0f / std::sqrt(bc2);
+  const float* gf = static_cast<const float*>(grads);
+  const uint16_t* gh = static_cast<const uint16_t*>(grads);
+
+  double p_sq = 0.0, u_sq = 0.0;
+#pragma omp parallel for schedule(static) reduction(+ : p_sq, u_sq)
+  for (int64_t i = 0; i < n; ++i) {
+    float g;
+    if (grads_bf16) {
+      uint32_t bits = ((uint32_t)gh[i]) << 16;
+      __builtin_memcpy(&g, &bits, 4);
+    } else {
+      g = gf[i];
+    }
+    g *= grad_scale;
+    float p = params[i];
+    float m = beta1 * exp_avg[i] + omb1 * g;
+    float v = beta2 * exp_avg_sq[i] + omb2 * g * g;
+    exp_avg[i] = m;
+    exp_avg_sq[i] = v;
+    float denom = std::sqrt(v) * inv_bc2_sqrt + eps;
+    float u = (m * inv_bc1) / denom;
+    if (weight_decay != 0.0f) u += weight_decay * p;
+    update_buf[i] = u;
+    p_sq += (double)p * p;
+    u_sq += (double)u * u;
+  }
+  float trust = 1.0f;
+  if (p_sq > 0.0 && u_sq > 0.0) {
+    trust = (float)(std::sqrt(p_sq) / std::sqrt(u_sq));
+    if (trust > max_coeff) trust = max_coeff;
+    if (trust < min_coeff) trust = min_coeff;
+  }
+  const float step_size = lr * trust;
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    float p = params[i] - step_size * update_buf[i];
+    params[i] = p;
+    if (params_bf16_out) {
+      uint32_t bits;
+      __builtin_memcpy(&bits, &p, 4);
+      params_bf16_out[i] = fp32_bits_to_bf16(bits);
+    }
+  }
+}
+
 // Multi-tensor apply (reference csrc/adam/multi_tensor_adam.cu:163 /
 // multi_tensor_apply.cuh): one call steps a whole parameter list. The
 // OpenMP region spans all tensors so small leaves don't serialize on

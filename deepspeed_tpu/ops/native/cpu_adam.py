@@ -43,6 +43,20 @@ class _NativeCpuAdam:
             ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
             ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int]
         lib.ds_lamb_step.restype = None
+        lib.ds_adam_step_ex.argtypes = [
+            _F32P, ctypes.c_void_p, ctypes.c_int, ctypes.c_float,
+            _F32P, _F32P, _U16P,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_int, ctypes.c_int]
+        lib.ds_adam_step_ex.restype = None
+        lib.ds_lamb_step_ex.argtypes = [
+            _F32P, ctypes.c_void_p, ctypes.c_int, ctypes.c_float,
+            _F32P, _F32P, _F32P, _U16P,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int]
+        lib.ds_lamb_step_ex.restype = None
         lib.ds_fp32_to_bf16.argtypes = [_F32P, _U16P, ctypes.c_int64]
         lib.ds_bf16_to_fp32.argtypes = [_U16P, _F32P, ctypes.c_int64]
         lib.ds_l2_norm_sq.argtypes = [_F32P, ctypes.c_int64]
@@ -78,6 +92,56 @@ class _NativeCpuAdam:
             ptr_array(exp_avg_sq), sizes, n, int(step), float(lr),
             float(beta1), float(beta2), float(eps), float(weight_decay),
             int(bool(adamw_mode)), int(bool(bias_correction)))
+
+    @staticmethod
+    def _grad_ptr(grads):
+        """(void* ptr, is_bf16) for fp32 or bf16(-as-uint16/ml_dtypes) grads."""
+        assert isinstance(grads, np.ndarray) and grads.flags["C_CONTIGUOUS"]
+        if grads.dtype == np.float32:
+            return ctypes.c_void_p(grads.ctypes.data), 0
+        if grads.dtype == np.uint16 or grads.dtype.name == "bfloat16":
+            return ctypes.c_void_p(grads.ctypes.data), 1
+        # float16 has itemsize 2 too but its bits are NOT bf16 — widen first
+        raise TypeError(f"grads must be fp32 or bf16, got {grads.dtype}")
+
+    def adam_step_ex(self, params, grads, exp_avg, exp_avg_sq, step, lr,
+                     beta1, beta2, eps, weight_decay, adamw_mode,
+                     bias_correction=True, grad_scale=1.0, params_bf16=None):
+        """Single-pass step: grads read in wire dtype (fp32 or bf16 bits)
+        scaled by ``grad_scale``; optional bf16 copy of the updated params
+        written to ``params_bf16`` (uint16 bits) for the device push."""
+        _check(params, exp_avg, exp_avg_sq)
+        gptr, gbf16 = self._grad_ptr(grads)
+        out = None
+        if params_bf16 is not None:
+            _check(params_bf16, dtype=np.uint16)
+            out = params_bf16.ctypes.data_as(_U16P)
+        self.lib.ds_adam_step_ex(
+            params.ctypes.data_as(_F32P), gptr, gbf16, float(grad_scale),
+            exp_avg.ctypes.data_as(_F32P), exp_avg_sq.ctypes.data_as(_F32P),
+            out, params.size, int(step), float(lr), float(beta1),
+            float(beta2), float(eps), float(weight_decay),
+            int(bool(adamw_mode)), int(bool(bias_correction)))
+
+    def lamb_step_ex(self, params, grads, exp_avg, exp_avg_sq, step, lr,
+                     beta1, beta2, eps, weight_decay, max_coeff, min_coeff,
+                     bias_correction=True, grad_scale=1.0, params_bf16=None,
+                     update_buf=None):
+        _check(params, exp_avg, exp_avg_sq)
+        gptr, gbf16 = self._grad_ptr(grads)
+        if update_buf is None:
+            update_buf = np.empty_like(params)
+        out = None
+        if params_bf16 is not None:
+            _check(params_bf16, dtype=np.uint16)
+            out = params_bf16.ctypes.data_as(_U16P)
+        self.lib.ds_lamb_step_ex(
+            params.ctypes.data_as(_F32P), gptr, gbf16, float(grad_scale),
+            exp_avg.ctypes.data_as(_F32P), exp_avg_sq.ctypes.data_as(_F32P),
+            update_buf.ctypes.data_as(_F32P), out,
+            params.size, int(step), float(lr), float(beta1), float(beta2),
+            float(eps), float(weight_decay), float(max_coeff),
+            float(min_coeff), int(bool(bias_correction)))
 
     def lamb_step(self, params, grads, exp_avg, exp_avg_sq, step, lr,
                   beta1, beta2, eps, weight_decay, max_coeff, min_coeff,

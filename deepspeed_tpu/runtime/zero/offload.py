@@ -39,6 +39,13 @@ class HostOffloadOptimizer:
                 f"only, got {type(optimizer).__name__}")
         self.is_lamb = isinstance(optimizer, FusedLamb)
         self.optimizer = optimizer
+        if getattr(optimizer, "moment_dtype", "fp32") != "fp32":
+            # the SIMD step and the swapper both run on fp32 host arrays;
+            # half-storage moments are a device-optimizer feature
+            logger.warning(
+                "moment_dtype=%s ignored by the host offload tier: offloaded "
+                "moments are stored fp32 in host DRAM/NVMe",
+                optimizer.moment_dtype)
         self.device_nvme = offload_cfg.device == C.OFFLOAD_NVME_DEVICE
         self.step_count = 0
 
@@ -66,6 +73,8 @@ class HostOffloadOptimizer:
         else:
             self.m = [np.zeros_like(x) for x in self.master]
             self.v = [np.zeros_like(x) for x in self.master]
+        self._bf16_out = None   # per-leaf uint16 staging for the device push
+        self._lamb_buf = None   # per-leaf fp32 scratch for LAMB's update
 
     def _hyper(self):
         opt = self.optimizer
@@ -129,6 +138,100 @@ class HostOffloadOptimizer:
             trust = np.clip(p_norm / max(u_norm, 1e-12),
                             hyper["min_coeff"], hyper["max_coeff"])
         pf -= lr * trust * update
+
+    def step_streamed(self, grad_leaves, lr: float, grad_scale: float = 1.0,
+                      push_fn=None, out_dtype=None):
+        """Pipelined offload step — the overlap architecture of the
+        reference's pipelined swapper + tiled param copies
+        (swap_tensor/pipelined_optimizer_swapper.py:60,
+        csrc/adam/cpu_adam.cpp:67-120), built on JAX async transfers:
+
+        1. every gradient leaf starts its d2h copy up front
+           (`copy_to_host_async`) so transfers stream while earlier leaves
+           run their SIMD step;
+        2. each leaf steps as it arrives — one single-pass native call
+           (wire-dtype grads, ``grad_scale`` folded into the read, bf16
+           push copy written in the same pass);
+        3. ``push_fn(i, host_array)`` dispatches the h2d put immediately
+           (JAX device puts are async), overlapping the remaining steps;
+           on the NVMe tier, leaf i+1's moments prefetch while leaf i
+           steps, as in `step`.
+
+        Returns the list of push_fn results (None entries without one).
+        """
+        import ml_dtypes
+
+        self.step_count += 1
+        hyper = self._hyper()
+        n = len(self.master)
+        assert len(grad_leaves) == n, (len(grad_leaves), n)
+        for g in grad_leaves:
+            if hasattr(g, "copy_to_host_async"):
+                try:
+                    g.copy_to_host_async()
+                except Exception:
+                    pass  # backend without async host copies: asarray blocks
+        want_bf16_out = (
+            push_fn is not None and out_dtype is not None
+            and np.dtype(out_dtype) == np.dtype(ml_dtypes.bfloat16)
+            and self._native is not None)
+        if want_bf16_out and self._bf16_out is None:
+            self._bf16_out = [np.empty(p.shape, np.uint16)
+                              for p in self.master]
+        outs = []
+        if self.swapper is not None and n > 0:
+            self.swapper.prefetch(0)
+        for i in range(n):
+            g_np = np.ascontiguousarray(np.asarray(grad_leaves[i]))
+            if g_np.dtype == np.float16:
+                g_np = g_np.astype(np.float32)
+            p = self.master[i]
+            if self.swapper is not None:
+                m, v = self.swapper.fetch(i)
+                if i + 1 < n:
+                    self.swapper.prefetch(i + 1)
+            else:
+                m, v = self.m[i], self.v[i]
+            bf16_buf = self._bf16_out[i].reshape(-1) if want_bf16_out else None
+            if self._native is not None:
+                if self.is_lamb:
+                    if self._lamb_buf is None or self._lamb_buf.size < p.size:
+                        self._lamb_buf = np.empty(p.size, np.float32)
+                    self._native.lamb_step_ex(
+                        p.reshape(-1), g_np.reshape(-1), m.reshape(-1),
+                        v.reshape(-1), self.step_count, lr,
+                        hyper["beta1"], hyper["beta2"], hyper["eps"],
+                        hyper["weight_decay"], hyper["max_coeff"],
+                        hyper["min_coeff"], hyper["bias_correction"],
+                        grad_scale=grad_scale, params_bf16=bf16_buf,
+                        update_buf=self._lamb_buf[:p.size])
+                else:
+                    self._native.adam_step_ex(
+                        p.reshape(-1), g_np.reshape(-1), m.reshape(-1),
+                        v.reshape(-1), self.step_count, lr,
+                        hyper["beta1"], hyper["beta2"], hyper["eps"],
+                        hyper["weight_decay"], hyper["adamw_mode"],
+                        hyper["bias_correction"], grad_scale=grad_scale,
+                        params_bf16=bf16_buf)
+            else:
+                g32 = np.asarray(g_np, np.float32)
+                if grad_scale != 1.0:
+                    g32 = g32 * np.float32(grad_scale)
+                self._apply_leaf(p, g32, m, v, lr, hyper)
+            if self.swapper is not None:
+                self.swapper.store(i, m, v)
+            if push_fn is None:
+                outs.append(None)
+                continue
+            if bf16_buf is not None:
+                host_out = self._bf16_out[i].view(ml_dtypes.bfloat16)
+            elif out_dtype is not None \
+                    and np.dtype(out_dtype) != np.float32:
+                host_out = p.astype(out_dtype)
+            else:
+                host_out = p
+            outs.append(push_fn(i, host_out))
+        return outs
 
     def step(self, grads_np: List[np.ndarray], lr: float):
         self.step_count += 1

@@ -51,6 +51,101 @@ def test_native_cpu_adam_matches_jax_adam():
                                rtol=2e-5, atol=2e-6)
 
 
+def test_native_adam_step_ex_matches_plain():
+    """The single-pass _ex kernel (wire-dtype grads + folded scale + bf16
+    out copy) must match scale-then-step with the plain kernel."""
+    if not has_native():
+        pytest.skip("no C++ toolchain")
+    import ml_dtypes
+    from deepspeed_tpu.ops.native import cpu_adam
+    lib = cpu_adam.load()
+    rng = np.random.RandomState(7)
+    n = 4097
+    p0 = rng.randn(n).astype(np.float32)
+    g = (rng.randn(n) * 3).astype(np.float32)
+    scale = 0.37
+
+    p_ref, m_ref, v_ref = p0.copy(), np.zeros(n, np.float32), np.zeros(n, np.float32)
+    for step in range(1, 4):
+        lib.adam_step(p_ref, np.ascontiguousarray(g * scale), m_ref, v_ref,
+                      step, 1e-2, 0.9, 0.999, 1e-8, 0.01, True)
+
+    # fp32 grads through _ex
+    p1, m1, v1 = p0.copy(), np.zeros(n, np.float32), np.zeros(n, np.float32)
+    out_bf16 = np.empty(n, np.uint16)
+    for step in range(1, 4):
+        lib.adam_step_ex(p1, g, m1, v1, step, 1e-2, 0.9, 0.999, 1e-8,
+                         0.01, True, grad_scale=scale, params_bf16=out_bf16)
+    np.testing.assert_allclose(p1, p_ref, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(m1, m_ref, rtol=1e-6, atol=1e-7)
+    # the bf16 out copy is the rounded updated params
+    np.testing.assert_allclose(out_bf16.view(ml_dtypes.bfloat16)
+                               .astype(np.float32), p1, rtol=8e-3, atol=1e-5)
+
+    # bf16 grads through _ex: matches stepping on widened bf16 grads
+    g_bf16 = g.astype(ml_dtypes.bfloat16)
+    p2, m2, v2 = p0.copy(), np.zeros(n, np.float32), np.zeros(n, np.float32)
+    p3, m3, v3 = p0.copy(), np.zeros(n, np.float32), np.zeros(n, np.float32)
+    for step in range(1, 4):
+        lib.adam_step_ex(p2, g_bf16, m2, v2, step, 1e-2, 0.9, 0.999, 1e-8,
+                         0.01, True, grad_scale=scale)
+        lib.adam_step(p3, np.ascontiguousarray(
+            g_bf16.astype(np.float32) * scale), m3, v3,
+            step, 1e-2, 0.9, 0.999, 1e-8, 0.01, True)
+    np.testing.assert_allclose(p2, p3, rtol=1e-6, atol=1e-7)
+
+
+def test_native_lamb_step_ex_matches_plain():
+    if not has_native():
+        pytest.skip("no C++ toolchain")
+    from deepspeed_tpu.ops.native import cpu_adam
+    lib = cpu_adam.load()
+    rng = np.random.RandomState(8)
+    n = 1031
+    p0 = rng.randn(n).astype(np.float32)
+    g = rng.randn(n).astype(np.float32)
+    scale = 2.5
+
+    p_ref, m_ref, v_ref = p0.copy(), np.zeros(n, np.float32), np.zeros(n, np.float32)
+    p_ex, m_ex, v_ex = p0.copy(), np.zeros(n, np.float32), np.zeros(n, np.float32)
+    for step in range(1, 4):
+        lib.lamb_step(p_ref, np.ascontiguousarray(g * scale), m_ref, v_ref,
+                      step, 1e-2, 0.9, 0.999, 1e-8, 0.01, 10.0, 0.01)
+        lib.lamb_step_ex(p_ex, g, m_ex, v_ex, step, 1e-2, 0.9, 0.999, 1e-8,
+                         0.01, 10.0, 0.01, grad_scale=scale)
+    np.testing.assert_allclose(p_ex, p_ref, rtol=1e-6, atol=1e-7)
+
+
+def test_offload_streamed_matches_unstreamed():
+    """HostOffloadOptimizer.step_streamed (pipelined d2h/step/h2d) must be
+    numerically identical to the batch `step` path."""
+    if not has_native():
+        pytest.skip("no C++ toolchain")
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.adam import FusedAdam
+    from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+    from deepspeed_tpu.config.config import ZeroOffloadConfig
+
+    rng = np.random.RandomState(9)
+    params = {"a": jnp.asarray(rng.randn(64, 32).astype(np.float32)),
+              "b": jnp.asarray(rng.randn(33).astype(np.float32))}
+    grads = {"a": jnp.asarray(rng.randn(64, 32).astype(np.float32)),
+             "b": jnp.asarray(rng.randn(33).astype(np.float32))}
+    off_cfg = ZeroOffloadConfig({"device": "cpu"})
+
+    r1 = HostOffloadOptimizer(params, FusedAdam(lr=1e-2), off_cfg)
+    r2 = HostOffloadOptimizer(params, FusedAdam(lr=1e-2), off_cfg)
+    scale = 0.5
+    for _ in range(3):
+        leaves = [np.ascontiguousarray(np.asarray(g, np.float32) * scale)
+                  for g in jax.tree_util.tree_leaves(grads)]
+        r1.step(leaves, 1e-2)
+        r2.step_streamed(jax.tree_util.tree_leaves(grads), 1e-2,
+                         grad_scale=scale)
+    for x, y in zip(r1.master, r2.master):
+        np.testing.assert_allclose(x, y, rtol=1e-6, atol=1e-7)
+
+
 def test_aio_roundtrip(tmp_path):
     if not has_native():
         pytest.skip("no C++ toolchain")
@@ -62,6 +157,65 @@ def test_aio_roundtrip(tmp_path):
     out = np.empty_like(data)
     assert h.sync_pread(out, path) == 1
     np.testing.assert_array_equal(data, out)
+
+
+@pytest.mark.parametrize("backend", ["threads", "io_uring", "auto"])
+def test_aio_backends_roundtrip(tmp_path, backend):
+    """Both backends (kernel ring + thread pool) move the same bytes; the
+    reference only had the libaio path (deepspeed_aio_common.cpp)."""
+    if not has_native():
+        pytest.skip("no C++ toolchain")
+    from deepspeed_tpu.ops.native.aio import AsyncIOHandle
+    try:
+        h = AsyncIOHandle(block_size=8192, queue_depth=8, thread_count=2,
+                          backend=backend)
+    except OSError:
+        assert backend == "io_uring"
+        pytest.skip("kernel without io_uring")
+    assert h.backend in ("threads", "io_uring")
+    if backend != "auto":
+        assert h.backend == backend
+    data = np.random.RandomState(2).randn(100000).astype(np.float32)
+    path = str(tmp_path / "t.bin")
+    fd = h.open(path, True)
+    h.async_pwrite(data, fd)
+    assert h.wait() == 1
+    h.close(fd)
+    out = np.empty_like(data)
+    fd = h.open(path, False)
+    h.async_pread(out, fd)
+    assert h.wait() == 1
+    h.close(fd)
+    np.testing.assert_array_equal(data, out)
+
+
+def test_aio_many_small_requests(tmp_path):
+    """Queue-depth pressure: many outstanding requests on one handle all
+    complete and are counted per user request."""
+    if not has_native():
+        pytest.skip("no C++ toolchain")
+    from deepspeed_tpu.ops.native.aio import AsyncIOHandle
+    h = AsyncIOHandle(block_size=1024, queue_depth=4, thread_count=2)
+    rng = np.random.RandomState(3)
+    chunks = [rng.randn(1000 + i).astype(np.float32) for i in range(32)]
+    path = str(tmp_path / "many.bin")
+    fd = h.open(path, True)
+    off = 0
+    for c in chunks:
+        h.async_pwrite(c, fd, offset=off)
+        off += c.nbytes
+    assert h.wait() == len(chunks)
+    h.close(fd)
+    outs = [np.empty_like(c) for c in chunks]
+    fd = h.open(path, False)
+    off = 0
+    for o in outs:
+        h.async_pread(o, fd, offset=off)
+        off += o.nbytes
+    assert h.wait() == len(chunks)
+    h.close(fd)
+    for c, o in zip(chunks, outs):
+        np.testing.assert_array_equal(c, o)
 
 
 def test_tensor_swapper(tmp_path):
@@ -98,6 +252,28 @@ def test_offload_cpu_training_matches_device():
     assert l_off == pytest.approx(l_dev, rel=1e-3)
     assert e_off._host_runner is not None
     assert e_off.state.opt_state == {}  # no optimizer state in HBM
+
+
+def test_offload_overlap_comm_matches_fused_accumulation():
+    """overlap_comm offload (per-micro streamed accumulation) must match the
+    device-fused gas scan numerically."""
+    cfg_fused = base_config()
+    cfg_fused["train_batch_size"] = 8
+    cfg_fused["gradient_accumulation_steps"] = 4
+    cfg_fused["zero_optimization"] = {
+        "stage": 2, "offload_optimizer": {"device": "cpu"}}
+    cfg_ovl = {**cfg_fused,
+               "zero_optimization": {"stage": 2, "overlap_comm": True,
+                                     "offload_optimizer": {"device": "cpu"}}}
+    e_fused, _, _, _ = dstpu.initialize(config=cfg_fused, model=SimpleModel(),
+                                        mesh=one_device_mesh())
+    e_ovl, _, _, _ = dstpu.initialize(config=cfg_ovl, model=SimpleModel(),
+                                      mesh=one_device_mesh())
+    batch = random_batch(batch_size=8)
+    for _ in range(3):
+        l_fused = float(e_fused.train_batch(batch))
+        l_ovl = float(e_ovl.train_batch(batch))
+    assert l_ovl == pytest.approx(l_fused, rel=2e-3)
 
 
 def test_offload_nvme_training(tmp_path):
