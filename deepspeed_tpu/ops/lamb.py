@@ -25,13 +25,23 @@ class FusedLamb(TpuOptimizer):
     bias_correction: bool = True
     max_coeff: float = 10.0
     min_coeff: float = 0.01
+    # storage dtype for exp_avg ("fp32" | "bf16"); compute stays fp32 and
+    # exp_avg_sq stays fp32 regardless (see FusedAdam.moment_dtype for why
+    # a bf16 second moment freezes at beta2=0.999)
+    moment_dtype: str = "fp32"
 
     param_like_state_fields = ("exp_avg", "exp_avg_sq")
 
+    def __post_init__(self):
+        if self.moment_dtype not in ("fp32", "bf16"):
+            raise ValueError(f"moment_dtype must be 'fp32' or 'bf16', got "
+                             f"{self.moment_dtype!r}")
+
     def init(self, params):
+        mdtype = jnp.bfloat16 if self.moment_dtype == "bf16" else jnp.float32
         return {
             "step": jnp.zeros((), jnp.int32),
-            "exp_avg": tree_zeros_like(params, jnp.float32),
+            "exp_avg": tree_zeros_like(params, mdtype),
             "exp_avg_sq": tree_zeros_like(params, jnp.float32),
         }
 
@@ -51,7 +61,7 @@ class FusedLamb(TpuOptimizer):
             if grad_scale is not None:
                 g32 = g32 * grad_scale
             p32 = p.astype(jnp.float32)
-            m_new = beta1 * m + (1.0 - beta1) * g32
+            m_new = beta1 * m.astype(jnp.float32) + (1.0 - beta1) * g32
             v_new = beta2 * v + (1.0 - beta2) * (g32 * g32)
             update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + self.eps)
             if self.weight_decay != 0.0:
@@ -63,7 +73,7 @@ class FusedLamb(TpuOptimizer):
                               jnp.float32(1.0))
             trust = jnp.clip(trust, self.min_coeff, self.max_coeff)
             p_new = p32 - lr * trust * update
-            return p_new.astype(p.dtype), m_new, v_new
+            return p_new.astype(p.dtype), m_new.astype(m.dtype), v_new
 
         flat = jax.tree_util.tree_map(update_leaf, params, grads,
                                       state["exp_avg"], state["exp_avg_sq"])

@@ -314,7 +314,12 @@ def pipeline_1f1b(stage_fn, stage_params, microbatches, mesh,
             _, vjp_fn = jax.vjp(stage_fn, local, x)
             dp, dx = vjp_fn(g)
             # garbage ticks ran the vjp (to keep collectives aligned) but
-            # contribute zero: g was zeroed above, and vjp is linear in g
+            # must contribute exactly zero; the zeroed cotangent makes dp/dx
+            # zero by linearity ONLY if the stale buffer input produced
+            # finite intermediates (0×Inf = NaN), so mask explicitly
+            dp = jax.tree_util.tree_map(
+                lambda a: jnp.where(valid, a, jnp.zeros_like(a)), dp)
+            dx = jnp.where(valid, dx, jnp.zeros_like(dx))
             dparams = jax.tree_util.tree_map(jnp.add, dparams, dp)
             dmb_upd = jax.lax.dynamic_update_index_in_dim(dmb, dx, mc, 0)
             dmb = jnp.where((idx == 0) & valid, dmb_upd, dmb)

@@ -313,6 +313,17 @@ def read_latest_tag(load_dir):
     return None
 
 
+def resolve_ckpt_dir(load_dir, tag):
+    """Directory for `tag`, falling back to the `{tag}.old` staging name: a
+    crash between save_checkpoint's two renames leaves the only valid save
+    at `{tag}.old`, and a restart must find it rather than silently train
+    from scratch."""
+    final_dir = os.path.join(load_dir, str(tag))
+    if not os.path.isdir(final_dir) and os.path.isdir(final_dir + ".old"):
+        return final_dir + ".old"
+    return final_dir
+
+
 def _load_meta(ckpt_dir):
     meta_path = os.path.join(ckpt_dir, "meta.json")
     meta = {}
@@ -349,7 +360,7 @@ def load_checkpoint(load_dir, tag=None, shardings_fn=None,
         tag = read_latest_tag(load_dir)
         if tag is None:
             return None
-    ckpt_dir = os.path.join(load_dir, str(tag))
+    ckpt_dir = resolve_ckpt_dir(load_dir, tag)
     try:
         reader = ShardedCheckpoint(ckpt_dir)
     except (FileNotFoundError, NotADirectoryError):

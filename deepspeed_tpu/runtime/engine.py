@@ -20,6 +20,7 @@ functional core, same call pattern as the reference loop, engine.py:1005,
 import functools
 import inspect
 import os
+import time
 from typing import Any, Callable, Optional
 
 import numpy as np
@@ -47,6 +48,9 @@ from deepspeed_tpu.utils.memory import see_memory_usage
 FORWARD_MICRO_TIMER = "forward_microstep"
 BACKWARD_MICRO_TIMER = "backward_microstep"
 STEP_MICRO_TIMER = "step_microstep"
+FORWARD_GLOBAL_TIMER = "forward"
+BACKWARD_GLOBAL_TIMER = "backward"
+STEP_GLOBAL_TIMER = "step"
 
 
 @flax.struct.dataclass
@@ -78,29 +82,41 @@ def _build_optimizer(name, params_dict):
                   weight_decay=p.pop("weight_decay", 0.0))
     if name in (C.ADAM_OPTIMIZER, "fusedadam"):
         adam_w = p.pop("adam_w_mode", True)
-        return FusedAdam(adam_w_mode=adam_w,
-                         bias_correction=p.pop("bias_correction", True),
-                         moment_dtype=p.pop("moment_dtype", "fp32"), **common)
-    if name == C.ADAMW_OPTIMIZER:
-        return FusedAdam(adam_w_mode=True,
-                         moment_dtype=p.pop("moment_dtype", "fp32"), **common)
-    if name == C.CPU_ADAM_OPTIMIZER:
-        return DeepSpeedCPUAdam(adam_w_mode=p.pop("adam_w_mode", True), **common)
-    if name in (C.LAMB_OPTIMIZER, "fusedlamb"):
-        return FusedLamb(bias_correction=p.pop("bias_correction", True),
-                         max_coeff=p.pop("max_coeff", 10.0),
-                         min_coeff=p.pop("min_coeff", 0.01), **common)
-    if name == C.ONEBIT_ADAM_OPTIMIZER:
+        opt = FusedAdam(adam_w_mode=adam_w,
+                        bias_correction=p.pop("bias_correction", True),
+                        moment_dtype=p.pop("moment_dtype", "fp32"), **common)
+    elif name == C.ADAMW_OPTIMIZER:
+        opt = FusedAdam(adam_w_mode=True,
+                        bias_correction=p.pop("bias_correction", True),
+                        moment_dtype=p.pop("moment_dtype", "fp32"), **common)
+    elif name == C.CPU_ADAM_OPTIMIZER:
+        opt = DeepSpeedCPUAdam(adam_w_mode=p.pop("adam_w_mode", True),
+                               bias_correction=p.pop("bias_correction", True),
+                               moment_dtype=p.pop("moment_dtype", "fp32"),
+                               **common)
+    elif name in (C.LAMB_OPTIMIZER, "fusedlamb"):
+        opt = FusedLamb(bias_correction=p.pop("bias_correction", True),
+                        max_coeff=p.pop("max_coeff", 10.0),
+                        min_coeff=p.pop("min_coeff", 0.01),
+                        moment_dtype=p.pop("moment_dtype", "fp32"), **common)
+    elif name == C.ONEBIT_ADAM_OPTIMIZER:
         from deepspeed_tpu.runtime.fp16.onebit.adam import OnebitAdam
-        return OnebitAdam(freeze_step=p.pop("freeze_step", 100000), **common)
-    if name == C.ONEBIT_LAMB_OPTIMIZER:
+        opt = OnebitAdam(freeze_step=p.pop("freeze_step", 100000), **common)
+    elif name == C.ONEBIT_LAMB_OPTIMIZER:
         from deepspeed_tpu.runtime.fp16.onebit.lamb import OnebitLamb
-        return OnebitLamb(freeze_step=p.pop("freeze_step", 100000), **common)
-    if name == C.SGD_OPTIMIZER:
-        return SGD(lr=common["lr"], momentum=p.pop("momentum", 0.0),
-                   weight_decay=common["weight_decay"],
-                   nesterov=p.pop("nesterov", False))
-    raise ValueError(f"Unknown optimizer type {name}")
+        opt = OnebitLamb(freeze_step=p.pop("freeze_step", 100000), **common)
+    elif name == C.SGD_OPTIMIZER:
+        opt = SGD(lr=common["lr"], momentum=p.pop("momentum", 0.0),
+                  weight_decay=common["weight_decay"],
+                  nesterov=p.pop("nesterov", False))
+    else:
+        raise ValueError(f"Unknown optimizer type {name}")
+    if p:
+        # a key the chosen optimizer never reads must not vanish silently
+        # (e.g. moment_dtype on an optimizer without half-storage support)
+        logger.warning(f"optimizer '{name}' ignores config params: "
+                       f"{sorted(p)}")
+    return opt
 
 
 class DeepSpeedEngine:
@@ -136,6 +152,7 @@ class DeepSpeedEngine:
         # -- config + mesh (reference engine.py:566 + _set_distributed_vars)
         # peek only at the mesh section first — full validation needs the
         # mesh-derived dp world size (batch triangle, config.py:837)
+        explicit_mesh = mesh is not None
         if mesh is None:
             from deepspeed_tpu.config.config import MeshConfigSection
             pd = (config._param_dict if isinstance(config, DeepSpeedConfig)
@@ -144,6 +161,8 @@ class DeepSpeedEngine:
             mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(
                 data=mc.data, model=mc.model, pipe=mc.pipe, seq=mc.seq,
                 expert=mc.expert))
+        if mpu is not None:
+            mesh = self._adopt_mpu(mpu, mesh, explicit_mesh)
         self.mesh = mesh
         mesh_lib.set_current_mesh(mesh)
         # pipeline modules re-layout their params for the 1F1B executor;
@@ -283,6 +302,8 @@ class DeepSpeedEngine:
         self.state_shardings = None
         self._jit_train_batch = None
         self._jit_micro_grads = None
+        self._jit_grads_finite = None
+        self._jit_grad_norm = None
         self._jit_apply_grads = None
         self._jit_eval = None
         self._pending_grads = None
@@ -756,6 +777,29 @@ class DeepSpeedEngine:
         self._jit_train_batch = jax.jit(train_batch_fn, donate_argnums=(0,))
         self._jit_micro_grads = jax.jit(micro_grads_fn)
         self._jit_apply_grads = jax.jit(apply_grads_fn, donate_argnums=(0, 1))
+
+        def loss_batch_fn(state, batch, rng):
+            # forward-only twin of accumulate_grads, for the
+            # wall_clock_breakdown forward-phase measurement
+            if gas == 1:
+                b = jax.tree_util.tree_map(
+                    lambda x: jax.lax.with_sharding_constraint(x, batch_sh),
+                    batch)
+                return self._micro_loss(state, b, rng, loss_fn=loss_fn)
+            chunked = jax.tree_util.tree_map(
+                lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]),
+                batch)
+            rngs = jax.random.split(rng, gas)
+
+            def micro(acc, inp):
+                b, r = inp
+                b = jax.tree_util.tree_map(
+                    lambda x: jax.lax.with_sharding_constraint(x, batch_sh), b)
+                return acc + self._micro_loss(state, b, r,
+                                              loss_fn=loss_fn) / gas, None
+            total, _ = jax.lax.scan(micro, jnp.float32(0.0), (chunked, rngs))
+            return total
+        self._jit_loss_batch = jax.jit(loss_batch_fn)
         if self._compressed_comm_active():
             self._jit_train_batch = self._build_compressed_train_fn(loss_fn)
         elif self._sparse_grad_active():
@@ -1083,6 +1127,22 @@ class DeepSpeedEngine:
         grads = self.zero.constrain_grads(grads)
         return loss, grads
 
+    def _micro_loss(self, state, micro_batch, rng, loss_fn=None):
+        """Forward-only loss (no grad) — the wall_clock_breakdown forward
+        phase. Mirrors _micro_loss_and_grads' param handling."""
+        if loss_fn is None:
+            loss_fn = self._resolve_loss_fn()
+        keep_prob = self._keep_prob_fn()(state.global_step)
+        params = state.params
+        if self._param_offload_host:
+            params = jax.device_put(
+                params, self.zero.device_param_shardings(params))
+        if self._config.grad_dtype == "bf16":
+            params = jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.bfloat16)
+                if x.dtype == jnp.float32 else x, params)
+        return loss_fn(params, micro_batch, rng, keep_prob)
+
     def _globalize_batch(self, batch):
         """Multi-host: every process feeds the FULL global batch (the
         reference gives each rank a per-rank loader instead); jax extracts
@@ -1128,6 +1188,11 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         if self._host_runner is not None:
             metrics = self._host_offload_step(batch)
+        elif self.wall_clock_breakdown() and not (
+                self._compressed_comm_active() or self._sparse_grad_active()):
+            # (1-bit / CSR paths keep their fused shard_map programs — their
+            # comm state lives inside the step and cannot be split)
+            metrics = self._train_batch_instrumented(batch)
         else:
             self.state, metrics = self._jit_train_batch(self.state, batch,
                                                         self._next_rng())
@@ -1146,40 +1211,213 @@ class DeepSpeedEngine:
             self._report_progress(loss)
         return loss
 
+    @staticmethod
+    def _adopt_mpu(mpu, mesh, explicit_mesh):
+        """Map a Megatron-style mpu object onto the mesh (the reference
+        adopts mpu groups for TP, engine.py:636-641) — or reject loudly.
+        On TPU, tensor parallelism IS the mesh 'model' axis: an mpu that
+        agrees with the mesh is redundant-but-welcome; one that disagrees
+        would silently train with the wrong sharding, so it is an error.
+        When the mesh came from config defaults (model=1), the mpu's TP
+        degree is adopted by rebuilding the mesh with model=mp."""
+        mp = None
+        for name in ("get_model_parallel_world_size",
+                     "get_tensor_model_parallel_world_size"):
+            if hasattr(mpu, name):
+                mp = int(getattr(mpu, name)())
+                break
+        if mp is None:
+            raise ValueError(
+                "initialize(mpu=...) requires an object exposing "
+                "get_model_parallel_world_size(); on TPU, express tensor "
+                "parallelism as the mesh 'model' axis instead "
+                "(make_mesh(MeshConfig(model=N)))")
+        mesh_mp = mesh_lib.mesh_axis_size(mesh, mesh_lib.MODEL_AXIS)
+        if mesh_mp == mp:
+            return mesh
+        if explicit_mesh or mesh_mp != 1:
+            raise ValueError(
+                f"mpu reports model_parallel_world_size={mp} but the mesh "
+                f"'model' axis is {mesh_mp}; make them agree (or drop the "
+                f"mpu argument — the mesh axis alone defines TP here)")
+        # config-default mesh: adopt the mpu's TP degree
+        shape = dict(mesh.shape)
+        log_dist(f"adopting mpu model_parallel_world_size={mp} as the mesh "
+                 f"'model' axis", ranks=[0])
+        return mesh_lib.make_mesh(
+            mesh_lib.MeshConfig(data=-1, model=mp,
+                                pipe=shape.get(mesh_lib.PIPE_AXIS, 1),
+                                seq=shape.get(mesh_lib.SEQ_AXIS, 1),
+                                expert=shape.get(mesh_lib.EXPERT_AXIS, 1)),
+            devices=list(mesh.devices.flat))
+
+    def _train_batch_instrumented(self, batch):
+        """wall_clock_breakdown for the fused train path (reference wraps
+        every phase with synchronized timers, engine.py:1028-1047): the step
+        splits into forward-loss, fwd+bwd-grads and optimizer-apply
+        programs with a data-dependent readback as the fence after each —
+        the TPU analog of the reference's cuda.synchronize-per-phase.
+        Numerics match the fused program; while the flag is on, throughput
+        pays one extra forward and loses cross-phase fusion, exactly as the
+        reference pays its per-phase synchronize — a measurement mode, not
+        the production path. The backward phase is reported as (grads
+        program − forward program) since XLA computes fwd+bwd fused."""
+        rng = self._next_rng()
+        t0 = time.perf_counter()
+        lval = self._jit_loss_batch(self.state, batch, rng)
+        float(jax.device_get(lval))  # data-dependent fence (tunnel-safe)
+        fwd_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        grads, loss, _, _ = self._jit_grads_batch(self.state, batch, rng)
+        float(jax.device_get(loss))
+        fwdbwd_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        self.state, metrics = self._jit_apply_grads(self.state, grads, loss)
+        float(jax.device_get(metrics["grad_norm"]))
+        step_s = time.perf_counter() - t0
+
+        self.timers(FORWARD_GLOBAL_TIMER).elapsed_ += fwd_s
+        # grads program = fwd+bwd fused; report bwd as its excess over fwd
+        self.timers(BACKWARD_GLOBAL_TIMER).elapsed_ += \
+            max(fwdbwd_s - fwd_s, 0.0)
+        self.timers(STEP_GLOBAL_TIMER).elapsed_ += step_s
+
+        if self.global_steps % self.steps_per_print() == 0:
+            # per-step means over the print interval (reference resets each
+            # log; cumulative totals would read as ever-growing phase times)
+            self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
+                             STEP_GLOBAL_TIMER], reset=True,
+                            normalizer=max(self.steps_per_print(), 1))
+        return metrics
+
+    def wall_clock_times(self, reset=False):
+        """Per-phase seconds accumulated since the last reset/log by the
+        instrumented path ({'forward', 'backward', 'step'}; offload engines
+        report 'backward' as the fused fwd+bwd program and 'step' as the
+        host optimizer). Empty unless wall_clock_breakdown is enabled."""
+        out = {}
+        for name in (FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
+                     STEP_GLOBAL_TIMER):
+            if name in self.timers.timers:
+                out[name] = self.timers(name).elapsed(reset=reset)
+        return out
+
     def _host_offload_step(self, batch):
         """Device grads → host SIMD Adam (cpu/NVMe state) → device params.
-        The ZeRO-Offload step (reference stage2.py:747-925 + cpu_adam)."""
+        The ZeRO-Offload step (reference stage2.py:747-925 + cpu_adam).
+
+        With ``zero_optimization.overlap_comm`` and gas > 1, gradients
+        stream to the host per microbatch while the device computes the
+        next one (the reference's reduction-stream overlap,
+        stage2.py:679-746); otherwise the accumulation runs fused on
+        device and only the final tree transfers."""
+        gas = self.gradient_accumulation_steps()
+        if gas > 1 and self._config.zero_config.overlap_comm:
+            return self._host_offload_step_overlapped(batch, gas)
+        wcb = self.wall_clock_breakdown()
+        t0 = time.perf_counter()
         grads, loss, finite, scaled_norm = self._jit_grads_batch(
             self.state, batch, self._next_rng())
-        return self._host_apply_grads(grads, loss, finite=finite,
+        if wcb:
+            # phase accounting for offload (the flag must not silently
+            # no-op here): 'backward' = the fused fwd+bwd device program,
+            # 'step' = host transfer+SIMD+push
+            float(jax.device_get(loss))
+            self.timers(BACKWARD_GLOBAL_TIMER).elapsed_ += \
+                time.perf_counter() - t0
+            t0 = time.perf_counter()
+        metrics = self._host_apply_grads(grads, loss, finite=finite,
+                                         scaled_norm=scaled_norm)
+        if wcb:
+            self.timers(STEP_GLOBAL_TIMER).elapsed_ += \
+                time.perf_counter() - t0
+        return metrics
+
+    def _host_offload_step_overlapped(self, batch, gas):
+        """Per-micro dispatch: while the device computes micro k+1, micro
+        k's gradient leaves copy d2h (`copy_to_host_async`) and fold into
+        fp32 host accumulators; the final SIMD step + h2d push then run on
+        the host tree via the streamed step. Device compute hides
+        (gas-1)/gas of the transfer+accumulate time."""
+        lead = jax.tree_util.tree_leaves(batch)[0].shape[0]
+        assert lead % gas == 0, (
+            f"train_batch got leading dim {lead} not divisible by "
+            f"gradient_accumulation_steps={gas}")
+        m = lead // gas
+        inv_gas = np.float32(1.0 / gas)
+
+        acc = None
+        losses = []
+        pending = None
+
+        def fold(leaves):
+            nonlocal acc
+            if acc is None:
+                acc = [np.asarray(g, np.float32) * inv_gas for g in leaves]
+            else:
+                for i, g in enumerate(leaves):
+                    acc[i] += np.asarray(g, np.float32) * inv_gas
+
+        for k in range(gas):
+            micro = jax.tree_util.tree_map(
+                lambda x: x[k * m:(k + 1) * m], batch)
+            loss_k, grads_k = self._jit_micro_grads(self.state, micro,
+                                                    self._next_rng())
+            losses.append(loss_k)
+            leaves_k = jax.tree_util.tree_leaves(grads_k)
+            for g in leaves_k:
+                if hasattr(g, "copy_to_host_async"):
+                    try:
+                        g.copy_to_host_async()
+                    except Exception:
+                        pass
+            if pending is not None:
+                fold(pending)   # overlaps micro k's device compute
+            pending = leaves_k
+        fold(pending)
+        loss = sum(float(jax.device_get(l)) for l in losses) / gas
+
+        # norm on host (BLAS dot per leaf): serves clipping AND the fp16
+        # finite check — inf/nan gradients make the norm non-finite
+        scaled_norm = float(np.sqrt(sum(
+            float(np.dot(a.ravel(), a.ravel())) for a in acc)))
+        finite = bool(np.isfinite(scaled_norm)) if self.precision.fp16 \
+            else True
+        grads_tree = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(self.state.params), acc)
+        return self._host_apply_grads(grads_tree, jnp.float32(loss),
+                                      finite=finite,
                                       scaled_norm=scaled_norm)
 
     def _host_apply_grads(self, grads, loss, finite=None, scaled_norm=None):
-        """Shared offload update: unscale by loss scale, fp16 overflow-skip,
-        clip, host optimizer step, push params back (reference
-        stage2.py:747-925 + fused_optimizer.py:194-246).
+        """Shared offload update, pipelined: overflow/norm resolve from two
+        device scalars, then the leaves stream d2h while earlier leaves run
+        the SIMD step and updated leaves push h2d — the reference's
+        overlapped offload step (stage2.py:747-925 + pipelined swapper),
+        expressed with JAX async transfers (see
+        HostOffloadOptimizer.step_streamed).
 
         ``finite``/``scaled_norm`` are device scalars when coming from the
-        fused grads fn; the forward/backward/step path computes them here."""
+        fused grads fn; the forward/backward/step path computes them here
+        (also on device — the host never scans the gradient tree)."""
         fp16 = self.precision.fp16
         scale = float(jax.device_get(self.state.scaler["loss_scale"])) \
             if fp16 else 1.0
-
-        def pull_grads():
-            return [np.ascontiguousarray(np.asarray(jax.device_get(g),
-                                                    np.float32))
-                    for g in jax.tree_util.tree_leaves(grads)]
 
         # overflow-skip applies under fp16 only, matching _apply_grads —
         # bf16/fp32 runs step unconditionally like the device path. Resolve
         # the device finite scalar BEFORE transferring the gradient tree so
         # skipped steps don't pull the full model's grads just to drop them.
-        grads_np = None
         if finite is not None:
             finite = bool(jax.device_get(finite))
+        elif fp16:
+            if self._jit_grads_finite is None:
+                self._jit_grads_finite = jax.jit(prec.grads_finite)
+            finite = bool(jax.device_get(self._jit_grads_finite(grads)))
         else:
-            grads_np = pull_grads()
-            finite = not fp16 or all(np.isfinite(g).all() for g in grads_np)
+            finite = True
         new_scaler = prec.update_scaler(self.state.scaler, self.precision,
                                         jnp.asarray(finite))
         step_now = int(jax.device_get(self.state.global_step))
@@ -1193,30 +1431,38 @@ class DeepSpeedEngine:
                     "lr": jnp.float32(lr), "overflow": jnp.asarray(True),
                     "loss_scale": new_scaler["loss_scale"]}
 
-        if grads_np is None:
-            grads_np = pull_grads()
-        if scaled_norm is not None:
-            norm = float(jax.device_get(scaled_norm)) / scale
-        else:
-            # fp32 BLAS dot per leaf — no float64 temporaries
-            norm = float(np.sqrt(sum(float(np.dot(g.ravel(), g.ravel()))
-                                     for g in grads_np))) / scale
+        if scaled_norm is None:
+            if self._jit_grad_norm is None:
+                self._jit_grad_norm = jax.jit(_global_norm)
+            scaled_norm = self._jit_grad_norm(grads)
+        norm = float(jax.device_get(scaled_norm)) / scale
 
-        # fold unscale + clip into one coefficient; copy leaves only when
-        # it actually rescales (device_get views are read-only)
+        # fold unscale + clip into one coefficient, consumed inside the
+        # native step's gradient read — no host-side rescale pass
         coef = 1.0 / scale
         clip = self._config.gradient_clipping
         if clip and clip > 0 and norm > clip:
             coef *= clip / (norm + 1e-6)
-        if coef != 1.0:
-            coef32 = np.float32(coef)
-            grads_np = [np.ascontiguousarray(g * coef32) for g in grads_np]
 
-        self._host_runner.step(grads_np, lr)
-        new_params = jax.tree_util.tree_map(
-            lambda p, s: jax.device_put(
-                np.asarray(p, self.precision.compute_dtype), s),
-            self._host_runner.params_tree(), self.state_shardings.params)
+        shard_leaves = jax.tree_util.tree_leaves(self.state_shardings.params)
+        out_dtype = self.precision.compute_dtype
+        # on the CPU backend device_put ALIASES host memory — the runner's
+        # staging buffers are reused next step, so alias would corrupt the
+        # live params; accelerator backends copy over the wire
+        aliases_host = self.mesh.devices.flat[0].platform == "cpu"
+
+        def push(i, host_arr):
+            # async dispatch: the h2d copy overlaps the remaining leaf steps,
+            # and the next step's jit consumes the futures directly
+            if aliases_host:
+                host_arr = np.array(host_arr, copy=True)
+            return jax.device_put(host_arr, shard_leaves[i])
+
+        new_leaves = self._host_runner.step_streamed(
+            jax.tree_util.tree_leaves(grads), lr, grad_scale=coef,
+            push_fn=push, out_dtype=out_dtype)
+        new_params = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(self.state.params), new_leaves)
         self.state = TrainState(
             params=new_params,
             opt_state=self.state.opt_state,

@@ -128,6 +128,8 @@ class PipelineModule:
         self.num_microbatches = num_microbatches
         self._spmd_mesh = None        # set by lower_to_spmd
         self._trunk = None            # (start, stop) homogeneous layer run
+        self._trunk_refined = False   # shape-refinement pinned (in _stack_trunk)
+        self._warned_sequential_layout = False
 
         if num_stages is None and topology is None:
             num_stages = 1
@@ -244,6 +246,7 @@ class PipelineModule:
         # the suffix (run uniformly on all stages)
         stop = start + (run // S) * S
         self._trunk = (start, stop)
+        self._trunk_refined = False   # fresh lowering invalidates refinement
         self._spmd_mesh = mesh
         if self.num_stages != S:
             if self.num_stages not in (None, 1):
@@ -270,7 +273,8 @@ class PipelineModule:
         """Spec equality can't see data-dependent shapes (the first Dense
         of a width-W run has an input-width kernel); shrink the trunk to
         the longest sub-run whose param trees match exactly, then floor to
-        a stage multiple."""
+        a stage multiple. Pure: returns (start, stop) without touching
+        self — freezing happens once in _stack_trunk."""
         start, stop = self._trunk
         S = self.num_stages
 
@@ -294,13 +298,25 @@ class PipelineModule:
                 f"trunk is {best[1] - best[0]} layers — fewer than the "
                 f"{S} pipeline stages. Express the repeated block as "
                 f"shape-identical LayerSpecs to pipeline it.")
-        self._trunk = (start, stop)
         return start, stop
 
-    def _stack_trunk(self, params):
-        """Per-layer params → stage-stacked trunk + the rest untouched."""
+    def _stack_trunk(self, params, freeze=True, bounds=None):
+        """Per-layer params → stage-stacked trunk + the rest untouched.
+
+        ``freeze=True`` (init/lowering time) pins the shape-refined trunk
+        bounds on the module; apply-time conversions pass freeze=False with
+        precomputed ``bounds`` so tracing stays side-effect-free and
+        mixed-layout callers can't move the trunk between calls."""
         from deepspeed_tpu.parallel.pipeline_1f1b import stack_stage_params
-        start, stop = self._refine_trunk_by_shapes(params)
+        if bounds is not None:
+            start, stop = bounds
+        elif self._trunk_refined:
+            start, stop = self._trunk
+        else:
+            start, stop = self._refine_trunk_by_shapes(params)
+            if freeze:
+                self._trunk = (start, stop)
+                self._trunk_refined = True
         layer_trees = [params[f"layer_{i}"] for i in range(start, stop)]
         stacked = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs), *layer_trees)
@@ -396,10 +412,19 @@ class PipelineModule:
                 # user-supplied params in the sequential layout: re-layout
                 # (pure reshape/stack — safe under jit) instead of silently
                 # running un-pipelined on a pipe>1 mesh
-                logger.warning(
-                    "PipelineModule: converting sequential-layout params "
-                    "to the stage-stacked layout for pipelined execution")
-                params = self._stack_trunk(dict(params))
+                if not self._warned_sequential_layout:
+                    self._warned_sequential_layout = True
+                    logger.warning(
+                        "PipelineModule: converting sequential-layout params "
+                        "to the stage-stacked layout for pipelined execution")
+                # compute the shape-refined bounds once and hand the SAME
+                # bounds to both the stacking and the prefix/suffix loops —
+                # _apply_pipelined must not read stale self._trunk here
+                trunk = self._trunk if self._trunk_refined \
+                    else self._refine_trunk_by_shapes(params)
+                params = self._stack_trunk(dict(params), freeze=False,
+                                           bounds=trunk)
+                return self._apply_pipelined(params, x, trunk=trunk)
             return self._apply_pipelined(params, x)
         tied = params.get("tied", {})
         h = x
@@ -414,10 +439,10 @@ class PipelineModule:
                 h = self._apply_layer(i, layer_params, h, tied)
         return h
 
-    def _apply_pipelined(self, params, x):
+    def _apply_pipelined(self, params, x, trunk=None):
         """Prefix layers (replicated w.r.t. pipe) → 1F1B trunk → suffix."""
         from deepspeed_tpu.parallel.pipeline_1f1b import pipeline_1f1b
-        start, stop = self._trunk
+        start, stop = trunk if trunk is not None else self._trunk
         tied = params.get("tied", {})
         trunk_module = self.forward_funcs[start]
 

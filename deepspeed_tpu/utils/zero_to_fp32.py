@@ -43,6 +43,10 @@ def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None):
             raise FileNotFoundError(
                 f"no 'latest' file in {checkpoint_dir}; pass an explicit tag")
     ckpt_dir = os.path.join(checkpoint_dir, str(tag))
+    if not os.path.isdir(ckpt_dir) and os.path.isdir(ckpt_dir + ".old"):
+        # a crash between save_checkpoint's renames leaves the only valid
+        # save under the `.old` staging name
+        ckpt_dir = ckpt_dir + ".old"
     indexes = [f for f in sorted(os.listdir(ckpt_dir))
                if f.startswith("shard_index_") and f.endswith(".json")]
     if indexes:

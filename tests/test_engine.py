@@ -240,3 +240,65 @@ def test_flops_profiler_detailed_breakdown():
     assert "GPT2LMHeadModel" in table and "flops" in table
     flops, macs, n_params = get_model_profile(model, (1, 8))
     assert flops > 0 and n_params > 0
+
+
+def test_wall_clock_breakdown_fused_path():
+    """wall_clock_breakdown instruments the real train_batch (reference
+    engine.py:1028-1047): per-phase fwd/bwd/step timers populate, and the
+    instrumented step matches the fused step numerically."""
+    cfg = base_config(train_batch_size=8, gradient_accumulation_steps=2)
+    cfg["wall_clock_breakdown"] = True
+    e_inst = make_engine(cfg)
+    e_fused = make_engine(base_config(train_batch_size=8,
+                                      gradient_accumulation_steps=2))
+    batch = random_batch(batch_size=8)
+    for _ in range(3):
+        l_inst = float(e_inst.train_batch(batch))
+        l_fused = float(e_fused.train_batch(batch))
+    assert l_inst == pytest.approx(l_fused, rel=1e-4)
+    times = e_inst.wall_clock_times()
+    assert set(times) == {"forward", "backward", "step"}
+    assert times["forward"] > 0 and times["step"] > 0
+    # uninstrumented engine reports no phase timers
+    assert e_fused.wall_clock_times() == {}
+
+
+class _FakeMpu:
+    def __init__(self, mp):
+        self._mp = mp
+
+    def get_model_parallel_world_size(self):
+        return self._mp
+
+
+def test_mpu_adopted_into_mesh():
+    """initialize(mpu=...) maps the client TP object onto the mesh 'model'
+    axis (reference engine.py:636-641 adopts mpu groups) instead of
+    silently ignoring it."""
+    if len(jax.devices()) < 2:
+        pytest.skip("need 2 devices")
+    from deepspeed_tpu.models.sharding import gpt2_tp_specs
+    from deepspeed_tpu.models.gpt2 import gpt2_tiny, GPT2LMHeadModel
+    model = GPT2LMHeadModel(gpt2_tiny(dtype=jnp.float32))
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=model,
+                                       mpu=_FakeMpu(2))
+    assert dict(engine.mesh.shape)["model"] == 2
+    batch = {"input_ids": np.random.RandomState(0)
+             .randint(0, 512, (8, 32)).astype(np.int32)}
+    assert np.isfinite(float(engine.train_batch(batch)))
+
+
+def test_mpu_mesh_mismatch_raises():
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+    mesh = make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+    with pytest.raises(ValueError, match="model_parallel_world_size"):
+        dstpu.initialize(config=base_config(), model=SimpleModel(),
+                         mesh=mesh, mpu=_FakeMpu(2))
+
+
+def test_mpu_without_interface_raises():
+    with pytest.raises(ValueError, match="get_model_parallel_world_size"):
+        dstpu.initialize(config=base_config(), model=SimpleModel(),
+                         mpu=object())
