@@ -36,6 +36,8 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--zero", type=int, default=1)
     ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--repeat-batch", action="store_true",
+                    help="train on one fixed batch (smoke-test convergence)")
     dstpu.add_config_arguments(ap)
     args = ap.parse_args()
 
@@ -61,15 +63,23 @@ def main():
                                        loss_fn=loss_fn)
 
     rng = np.random.RandomState(0)
-    for step in range(args.steps):
-        batch = {
+
+    def sample():
+        return {
             "input_ids": rng.randint(0, model_cfg.vocab_size,
                                      (8, args.seq)).astype(np.int32),
             "attention_mask": np.ones((8, args.seq), np.int32),
             "start_positions": rng.randint(0, args.seq, (8,)).astype(np.int32),
             "end_positions": rng.randint(0, args.seq, (8,)).astype(np.int32),
         }
-        loss = engine.train_batch(batch)
+
+    fixed = sample()
+    first = None
+    for step in range(args.steps):
+        loss = engine.train_batch(fixed if args.repeat_batch else sample())
+        if first is None:
+            first = float(loss)
+    print(f"first loss: {first:.4f}")
     print(f"final loss: {float(loss):.4f}")
 
 

@@ -55,8 +55,12 @@ def main():
         args=args, config=config, model=Net(),
         training_data=synthetic_cifar())
     it = iter(dstpu.runtime.dataloader.RepeatingLoader(loader))
+    first = None
     for step in range(args.steps):
         loss = engine.train_batch(next(it))
+        if first is None:
+            first = float(loss)
+    print(f"first loss: {first:.4f}")
     print(f"final loss: {float(loss):.4f}")
 
 

@@ -86,8 +86,12 @@ def main():
                  rng.randint(0, 8, (4 * args.data,)).astype(np.int32))
 
     engine, _, _, _ = dstpu.initialize(config=cfg, model=model, mesh=mesh)
+    first = None
     for step in range(args.steps):
         loss = engine.train_batch(batch)
+        if first is None:
+            first = float(jax.device_get(loss))
+    print(f"first loss: {first:.4f}")
     print(f"final loss after {args.steps} steps: "
           f"{float(jax.device_get(loss)):.4f}")
 

@@ -29,6 +29,8 @@ def main():
     ap.add_argument("--seq", type=int, default=512)
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--sp", type=int, default=1)
+    ap.add_argument("--repeat-batch", action="store_true",
+                    help="train on one fixed batch (smoke-test convergence)")
     dstpu.add_config_arguments(ap)
     args = ap.parse_args()
 
@@ -51,11 +53,18 @@ def main():
         config=config, model=gpt2_lib.GPT2LMHeadModel(model_cfg))
 
     rng = np.random.RandomState(0)
+    fixed = {"input_ids": rng.randint(
+        0, model_cfg.vocab_size,
+        size=(args.batch, args.seq)).astype(np.int32)}
+    first = None
     for step in range(args.steps):
-        batch = {"input_ids": rng.randint(
+        batch = fixed if args.repeat_batch else {"input_ids": rng.randint(
             0, model_cfg.vocab_size,
             size=(args.batch, args.seq)).astype(np.int32)}
         loss = engine.train_batch(batch)
+        if first is None:
+            first = float(loss)
+    print(f"first loss: {first:.4f}")
     print(f"final loss: {float(loss):.4f}")
 
 
