@@ -18,7 +18,7 @@ from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel, Block
 from deepspeed_tpu.models.sharding import _gpt2_leaf_spec
 from deepspeed_tpu.parallel import mesh as mesh_lib
 from deepspeed_tpu.parallel.pipeline_1f1b import (
-    pipeline_1f1b, stack_stage_params)
+    pipeline_1f1b, pipeline_infer, stack_stage_params)
 from jax.sharding import PartitionSpec as P
 
 
@@ -37,6 +37,7 @@ class GPT2PipeModel:
             f"n_layer={config.n_layer} must divide into {self.num_stages} stages")
         self.num_microbatches = num_microbatches or max(self.num_stages, 1)
         self._inner = GPT2LMHeadModel(config)
+        self._infer_fn = None   # jitted inference apply, built on first use
 
     # introspection stub so the engine's loss-fn resolver sees the kwargs
     def __call__(self, input_ids, deterministic=True, keep_prob=1.0):
@@ -57,7 +58,11 @@ class GPT2PipeModel:
             stages)}
         return inner
 
-    def apply(self, variables, input_ids, deterministic=True, keep_prob=1.0):
+    def apply(self, variables, input_ids, deterministic=True, keep_prob=1.0,
+              inference=False):
+        """``inference=True`` executes the forward-only InferenceSchedule
+        program (parallel/pipeline_1f1b.py pipeline_infer) instead of the
+        differentiable 1F1B run — the serving/eval path."""
         params = variables["params"]
         cfg = self.config
         B, T = input_ids.shape
@@ -78,7 +83,8 @@ class GPT2PipeModel:
             return h
 
         mb = x.reshape((M, B // M) + x.shape[1:])
-        h = pipeline_1f1b(stage_fn, params["h_stages"], mb, self.mesh)
+        run = pipeline_infer if inference else pipeline_1f1b
+        h = run(stage_fn, params["h_stages"], mb, self.mesh)
         x = h.reshape(B, T, cfg.n_embd)
 
         from flax import linen as nn
@@ -87,6 +93,29 @@ class GPT2PipeModel:
             {"params": params["ln_f"]}, x)
         logits = jnp.einsum("bse,ve->bsv", x, wte.astype(cfg.dtype))
         return logits
+
+    def generate(self, variables, input_ids, max_new_tokens=8):
+        """Greedy multi-stage decode through the InferenceSchedule program:
+        each step pipelines the full-context forward (microbatches fill the
+        stages) and writes the argmax token — the reference's
+        pipelined-inference role (pipe/engine.py:1209 on
+        InferenceSchedule). Logits must match the single-device model's
+        re-forward decode exactly (tests/test_pipeline_1f1b.py).
+
+        The context is right-padded to T + max_new_tokens up front so every
+        step runs the SAME shape — one compilation for the whole decode
+        (causal attention makes the not-yet-written positions inert)."""
+        B, T = input_ids.shape
+        ids = jnp.pad(input_ids, ((0, 0), (0, max_new_tokens)))
+        if self._infer_fn is None:
+            self._infer_fn = jax.jit(
+                lambda v, x: self.apply(v, x, inference=True))
+        for i in range(max_new_tokens):
+            logits = self._infer_fn(variables, ids)
+            nxt = jnp.argmax(logits[:, T + i - 1, :].astype(jnp.float32),
+                             axis=-1)
+            ids = ids.at[:, T + i].set(nxt.astype(ids.dtype))
+        return ids
 
     def param_partition_specs(self, params_shapes):
         """Base sharding specs: 'pipe' on the stage dim of h_stages,

@@ -125,55 +125,17 @@ def _psum_pipe(x):
                         mesh_lib.PIPE_AXIS).astype(x.dtype)
 
 
-def pipeline_1f1b(stage_fn, stage_params, microbatches, mesh,
-                  interleave=None):
-    """Run M microbatches through S = mesh.shape['pipe'] stages; returns the
-    last stage's outputs [M, ...] (replicated over 'pipe').
-
-    stage_fn(stage_local_params, x) -> y with y.shape == x.shape.
-    stage_params: pytree, every leaf with leading stage dim S.
-    microbatches: [M, mb, ...] activations entering stage 0.
-    interleave: True → reference 1F1B interleaved ticks (stage body must be
-      collective-free, see module doc); False → uniform ticks (composes
-      with ZeRO/TP/SP); None → auto (interleave iff 'pipe' is the only
-      non-trivial mesh axis).
-
-    Differentiable: gradients flow to both stage_params and microbatches
-    through the hand-written backward program.
-
-    Only the 'pipe' axis is shard_mapped — data/seq/model stay in GSPMD
-    auto mode, so ZeRO/TP/SP shardings compose untouched.
-    """
-    S = mesh.shape[mesh_lib.PIPE_AXIS]
-    if S == 1:
-        squeezed = jax.tree_util.tree_map(lambda p: p[0], stage_params)
-        return jax.lax.map(lambda x: stage_fn(squeezed, x), microbatches)
-    if interleave is None:
-        others = 1
-        for name, size in mesh.shape.items():
-            if name != mesh_lib.PIPE_AXIS:
-                others *= size
-        interleave = others == 1
-
-    M = microbatches.shape[0]
-    NB = num_pipe_buffers(S, M) if interleave else M
-    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
-    bwd_perm = [((i + 1) % S, i) for i in range(S)]
-    param_specs = jax.tree_util.tree_map(
-        lambda x: P(mesh_lib.PIPE_AXIS, *([None] * (x.ndim - 1))),
-        stage_params)
-    shard = functools.partial(
-        jax.shard_map, mesh=mesh,
-        axis_names=frozenset({mesh_lib.PIPE_AXIS}))
-
-    def local_params(params_sharded):
-        # [1, ...] per-device leaf -> drop the stage dim
-        return jax.tree_util.tree_map(lambda p: p[0], params_sharded)
-
-    # ---- forward: GPipe fill/drain, nothing saved ------------------------
+def _make_forward_program(stage_fn, M, S, interleave, fwd_perm, shard,
+                          param_specs):
+    """Forward fill/drain tick program, shared by the training pipeline
+    (as the custom-vjp primal) and `pipeline_infer` (as the executed
+    InferenceSchedule): stage i computes micro m at tick t = m + i over
+    M + S - 1 ticks — exactly InferenceSchedule's step→µbatch mapping
+    (runtime/pipe/schedule.py:138, `micro_batch_id = step_id - stage_id`);
+    the rotating activation hop (ppermute) is its 2-slot buffer."""
     @functools.partial(shard, in_specs=(param_specs, P()), out_specs=P())
     def _forward_program(sp, mb):
-        local = local_params(sp)
+        local = jax.tree_util.tree_map(lambda p: p[0], sp)
         idx = jax.lax.axis_index(mesh_lib.PIPE_AXIS)
         zero_mb = jnp.zeros_like(mb[0])
 
@@ -206,6 +168,103 @@ def pipeline_1f1b(stage_fn, stage_params, microbatches, mesh,
         # (loss) code is stage-agnostic
         return _psum_pipe(jnp.where(idx == S - 1, out_buf,
                                     jnp.zeros_like(out_buf)))
+    return _forward_program
+
+
+def _pipeline_prologue(stage_params, microbatches, mesh, interleave):
+    """Shared setup for the training and inference executors: resolves the
+    interleave mode (warning on the forced-interleave + live-collective-axes
+    hazard), permutations, param specs and the pipe-only shard_map.
+    Returns None when S == 1 (callers fall back to a sequential map)."""
+    S = mesh.shape[mesh_lib.PIPE_AXIS]
+    if S == 1:
+        return None
+    others = 1
+    for name, size in mesh.shape.items():
+        if name != mesh_lib.PIPE_AXIS:
+            others *= size
+    if interleave is None:
+        interleave = others == 1
+    elif interleave and others > 1:
+        # forced interleave on a mesh with live data/model/seq axes: any
+        # GSPMD collective inside the stage body lands in diverging
+        # lax.cond branches and the devices DEADLOCK (see module doc).
+        # Legal only for genuinely collective-free bodies — warn, don't
+        # block, since batch-sharded elementwise bodies are fine.
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning(
+            "pipeline interleave=True forced on a mesh with non-pipe axes "
+            "%s: the stage body must be collective-free or the program "
+            "deadlocks; the uniform schedule composes safely",
+            {k: v for k, v in mesh.shape.items()
+             if k != mesh_lib.PIPE_AXIS and v > 1})
+
+    M = microbatches.shape[0]
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    param_specs = jax.tree_util.tree_map(
+        lambda x: P(mesh_lib.PIPE_AXIS, *([None] * (x.ndim - 1))),
+        stage_params)
+    shard = functools.partial(
+        jax.shard_map, mesh=mesh,
+        axis_names=frozenset({mesh_lib.PIPE_AXIS}))
+    return S, M, interleave, fwd_perm, param_specs, shard
+
+
+def pipeline_infer(stage_fn, stage_params, microbatches, mesh,
+                   interleave=None):
+    """Execute the InferenceSchedule: forward-only pipelining of M
+    microbatches through S stages (the role of the reference's
+    _exec_schedule interpreting InferenceSchedule,
+    pipe/engine.py:1209 + schedule.py:129). No backward program is built
+    and nothing differentiates through this — use for eval/serving.
+
+    Same contract as pipeline_1f1b's forward: returns the last stage's
+    outputs [M, ...], replicated over 'pipe'.
+    """
+    setup = _pipeline_prologue(stage_params, microbatches, mesh, interleave)
+    if setup is None:
+        squeezed = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+        return jax.lax.map(lambda x: stage_fn(squeezed, x), microbatches)
+    S, M, interleave, fwd_perm, param_specs, shard = setup
+    program = _make_forward_program(stage_fn, M, S, interleave, fwd_perm,
+                                    shard, param_specs)
+    return program(stage_params, microbatches)
+
+
+def pipeline_1f1b(stage_fn, stage_params, microbatches, mesh,
+                  interleave=None):
+    """Run M microbatches through S = mesh.shape['pipe'] stages; returns the
+    last stage's outputs [M, ...] (replicated over 'pipe').
+
+    stage_fn(stage_local_params, x) -> y with y.shape == x.shape.
+    stage_params: pytree, every leaf with leading stage dim S.
+    microbatches: [M, mb, ...] activations entering stage 0.
+    interleave: True → reference 1F1B interleaved ticks (stage body must be
+      collective-free, see module doc); False → uniform ticks (composes
+      with ZeRO/TP/SP); None → auto (interleave iff 'pipe' is the only
+      non-trivial mesh axis).
+
+    Differentiable: gradients flow to both stage_params and microbatches
+    through the hand-written backward program.
+
+    Only the 'pipe' axis is shard_mapped — data/seq/model stay in GSPMD
+    auto mode, so ZeRO/TP/SP shardings compose untouched.
+    """
+    setup = _pipeline_prologue(stage_params, microbatches, mesh, interleave)
+    if setup is None:
+        squeezed = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+        return jax.lax.map(lambda x: stage_fn(squeezed, x), microbatches)
+    S, M, interleave, fwd_perm, param_specs, shard = setup
+    NB = num_pipe_buffers(S, M) if interleave else M
+    bwd_perm = [((i + 1) % S, i) for i in range(S)]
+
+    def local_params(params_sharded):
+        # [1, ...] per-device leaf -> drop the stage dim
+        return jax.tree_util.tree_map(lambda p: p[0], params_sharded)
+
+    # ---- forward: GPipe fill/drain, nothing saved ------------------------
+    _forward_program = _make_forward_program(stage_fn, M, S, interleave,
+                                             fwd_perm, shard, param_specs)
 
     # ---- backward: even/odd 1F1B replay (interleaved) --------------------
     dparam_specs = param_specs

@@ -811,16 +811,26 @@ class DeepSpeedEngine:
         except (TypeError, ValueError):
             accepts_det = False
 
+        try:
+            accepts_inference = "inference" in inspect.signature(
+                self.module.apply).parameters
+        except (TypeError, ValueError, AttributeError):
+            accepts_inference = False
+
         def eval_fn(state, x):
             x = jax.lax.with_sharding_constraint(x, batch_sh)
             params = state.params
             if self._param_offload_host:
                 params = jax.device_put(
                     params, self.zero.device_param_shardings(params))
+            kwargs = {}
+            if accepts_inference:
+                # pipeline modules: run the forward-only InferenceSchedule
+                # program instead of the differentiable 1F1B primal
+                kwargs["inference"] = True
             if accepts_det:
-                return self.module.apply({"params": params}, x,
-                                         deterministic=True)
-            return self.module.apply({"params": params}, x)
+                kwargs["deterministic"] = True
+            return self.module.apply({"params": params}, x, **kwargs)
         self._jit_eval = jax.jit(eval_fn)
         self._last_lr = None
 

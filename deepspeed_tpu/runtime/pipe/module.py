@@ -405,7 +405,7 @@ class PipelineModule:
             params = self._stack_trunk(params)
         return {"params": params}
 
-    def apply(self, variables, x, **kwargs):
+    def apply(self, variables, x, inference=False, **kwargs):
         params = variables["params"]
         if self._spmd_mesh is not None:
             if "trunk_stages" not in params:
@@ -424,8 +424,9 @@ class PipelineModule:
                     else self._refine_trunk_by_shapes(params)
                 params = self._stack_trunk(dict(params), freeze=False,
                                            bounds=trunk)
-                return self._apply_pipelined(params, x, trunk=trunk)
-            return self._apply_pipelined(params, x)
+                return self._apply_pipelined(params, x, trunk=trunk,
+                                             inference=inference)
+            return self._apply_pipelined(params, x, inference=inference)
         tied = params.get("tied", {})
         h = x
         for i in range(self._num_layers):
@@ -439,9 +440,12 @@ class PipelineModule:
                 h = self._apply_layer(i, layer_params, h, tied)
         return h
 
-    def _apply_pipelined(self, params, x, trunk=None):
-        """Prefix layers (replicated w.r.t. pipe) → 1F1B trunk → suffix."""
-        from deepspeed_tpu.parallel.pipeline_1f1b import pipeline_1f1b
+    def _apply_pipelined(self, params, x, trunk=None, inference=False):
+        """Prefix layers (replicated w.r.t. pipe) → pipelined trunk →
+        suffix. ``inference=True`` runs the forward-only InferenceSchedule
+        program (no backward is built; for eval/serving)."""
+        from deepspeed_tpu.parallel.pipeline_1f1b import (
+            pipeline_1f1b, pipeline_infer)
         start, stop = trunk if trunk is not None else self._trunk
         tied = params.get("tied", {})
         trunk_module = self.forward_funcs[start]
@@ -469,8 +473,8 @@ class PipelineModule:
             return hh
 
         mb = h.reshape((M, B // M) + h.shape[1:])
-        h = pipeline_1f1b(stage_fn, params["trunk_stages"], mb,
-                          self._spmd_mesh)
+        run = pipeline_infer if inference else pipeline_1f1b
+        h = run(stage_fn, params["trunk_stages"], mb, self._spmd_mesh)
         h = h.reshape((B,) + h.shape[2:])
 
         for i in range(stop, self._num_layers):

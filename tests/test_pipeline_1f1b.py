@@ -150,3 +150,88 @@ def test_1f1b_single_stage_fallback():
     local = jax.tree_util.tree_map(lambda p: p[0], params)
     ref = jax.lax.map(lambda xx: _stage_fn(local, xx), x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+# ----------------------------------------- tier 3: InferenceSchedule executes
+
+@pytest.mark.parametrize("stages,micro", [(2, 3), (4, 4), (3, 5)])
+def test_inference_schedule_closed_form(stages, micro):
+    """InferenceSchedule's instruction stream is the executable contract of
+    the forward fill/drain program: stage i computes micro m at tick
+    t = m + i (schedule.py:138)."""
+    for stage in range(stages):
+        sched = pipe_schedule.InferenceSchedule(
+            micro_batches=micro, stages=stages, stage_id=stage)
+        for tick, cmds in enumerate(sched.steps()):
+            fwd = [c for c in cmds
+                   if isinstance(c, pipe_schedule.ForwardPass)]
+            m = tick - stage            # the program's fill/drain mapping
+            if fwd:
+                assert 0 <= m < micro, (stages, micro, stage, tick)
+                assert fwd[0].buffer_id == m % sched.num_pipe_buffers()
+            else:
+                assert not (0 <= m < micro), (stages, micro, stage, tick)
+
+
+@pytest.mark.parametrize("pp,micro", [(2, 4), (4, 4)])
+def test_pipeline_infer_matches_sequential(pp, micro):
+    """pipeline_infer (the executed InferenceSchedule) reproduces the
+    sequential forward exactly."""
+    from deepspeed_tpu.parallel.pipeline_1f1b import pipeline_infer
+    devs = jax.devices()
+    if len(devs) < pp:
+        pytest.skip(f"need {pp} devices")
+    d, mb = 16, 4
+    params = _stage_params(jax.random.PRNGKey(3), pp, 2, d)
+    x = jax.random.normal(jax.random.PRNGKey(4), (micro, mb, d))
+    mesh = make_mesh(MeshConfig(pipe=pp), devices=devs[:pp])
+    out_pipe = jax.jit(
+        lambda p, xx: pipeline_infer(_stage_fn, p, xx, mesh))(params, x)
+
+    def apply_all(h):
+        for s in range(pp):
+            local = jax.tree_util.tree_map(lambda p: p[s], params)
+            h = _stage_fn(local, h)
+        return h
+    out_seq = jax.lax.map(apply_all, x)
+    np.testing.assert_allclose(np.asarray(out_pipe), np.asarray(out_seq),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_multistage_decode_matches_single_device():
+    """Multi-stage greedy decode through the InferenceSchedule program
+    produces the same tokens and logits as the single-device model
+    (VERDICT r2 item 5 done-condition)."""
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("need 2 devices")
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.models.gpt2_pipe import GPT2PipeModel
+
+    cfg = GPT2Config(vocab_size=128, n_positions=32, n_embd=32, n_layer=4,
+                     n_head=2, dtype=jnp.float32, scan_layers=True)
+    mesh = make_mesh(MeshConfig(pipe=2), devices=devs[:2])
+    pipe_model = GPT2PipeModel(cfg, mesh, num_microbatches=2)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (2, 8)),
+                      jnp.int32)
+    variables = pipe_model.init(jax.random.PRNGKey(0), ids)
+
+    # single-device reference shares the SAME weights (unstack the stages)
+    ref_model = GPT2LMHeadModel(cfg)
+    ref_params = pipe_model._unstacked(variables["params"])
+
+    logits_pipe = pipe_model.apply(variables, ids, inference=True)
+    logits_ref = ref_model.apply({"params": ref_params}, ids)
+    np.testing.assert_allclose(np.asarray(logits_pipe, np.float32),
+                               np.asarray(logits_ref, np.float32),
+                               rtol=2e-4, atol=2e-5)
+
+    out_pipe = pipe_model.generate(variables, ids, max_new_tokens=4)
+    # greedy single-device decode by full re-forward
+    ref_ids = ids
+    for _ in range(4):
+        lg = ref_model.apply({"params": ref_params}, ref_ids)
+        nxt = jnp.argmax(lg[:, -1, :].astype(jnp.float32), axis=-1)
+        ref_ids = jnp.concatenate(
+            [ref_ids, nxt[:, None].astype(ref_ids.dtype)], axis=1)
+    np.testing.assert_array_equal(np.asarray(out_pipe), np.asarray(ref_ids))
