@@ -4,15 +4,28 @@ reference's Triton SDD/DSD/DDS matmuls + block softmax
 training exactly like the reference's sparse_self_attention.py:14.
 
 Strategy (splash-attention style): the static layout [H, nb, nb] is
-compiled into, per (head, q-block), the list of active k-blocks; the kernel
-iterates only those, with online softmax — so compute and HBM traffic scale
-with nnz blocks, matching the reference's 6x speedup story (SURVEY §6).
+compiled into, per (head, q-block), the list of active k-blocks, and that
+table drives the KERNEL GRID — the innermost grid dimension walks the
+active blocks of the current row, and the k/v BlockSpec index maps read the
+scalar-prefetched table to pick which [block, D] tile streams into VMEM
+each step. Compute and HBM traffic scale with nnz blocks (matching the
+reference's 6x speedup story, SURVEY §6), and VMEM holds only one tile per
+operand — no whole-[S, D] row ever becomes resident, so sequence length is
+bounded by HBM, not by the 16 MB VMEM (the pre-streaming kernel capped at
+S·D ≈ 256k; BigBird at S=16k-32k now stays in-kernel).
 
-Backward mirrors ops/pallas/flash_attention.py: a dq pass over the layout
-rows and a dk/dv pass over the layout's TRANSPOSE (per k-block, the list of
-q-blocks that attend to it), both rematerializing p from the forward's
-logsumexp. The softmax scale is folded into the q-loads; nothing here is
-autodiff-traced — `blocksparse_attention` carries a custom VJP.
+Backward mirrors ops/pallas/flash_attention.py's chunked family: a dq pass
+over the layout rows and a dk/dv pass over the layout's TRANSPOSE (per
+k-block, the list of q-blocks that attend to it), both rematerializing p
+from the forward's logsumexp, accumulating into revisited output blocks
+(init on the first grid step, finalize on the last). The softmax scale is
+folded into the q-loads; nothing here is autodiff-traced —
+`blocksparse_attention` carries a custom VJP.
+
+Grid cost note: every q-block row runs max_nnz steps (the table is padded
+to the widest row), so heads/rows with far fewer active blocks than the
+maximum waste steps; the standard layouts (fixed, bigbird, bslongformer)
+are near-uniform per row, where the padding overhead is small.
 """
 
 import functools
@@ -48,194 +61,229 @@ def _layout_tables(layout):
 
 # ---------------------------------------------------------------- forward
 
-def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                   *, scale, block, num_heads):
-    # counts/cols are scalar-prefetched whole into SMEM (Mosaic requires
-    # ≥(8,128) tiles for VMEM blocks; control tables belong in SMEM anyway).
-    # Tables are per-HEAD (identical across the batch) to fit SMEM.
-    h, r = pl.program_id(0) % num_heads, pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # [block, D]
-    nnz = counts_ref[h, r]
+def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_ref, v_ref,
+                   o_ref, stat_ref, *, scale, num_heads, max_nnz):
+    """One grid step = one (q-block, active k-block) pair. The k/v tiles
+    for step j were already selected by the BlockSpec index maps from the
+    prefetched cols table; this body only does the online-softmax update.
+    stat holds (m, l) interleaved on the last axis: [block, 2]."""
+    b, r, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    h = b % num_heads
 
-    def body(j, carry):
-        o_acc, m_acc, l_acc = carry
-        kb = cols_ref[h, r, j]
-        k = k_ref[0, pl.ds(kb * block, block), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block, block), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        m_new = jnp.maximum(m_acc, jnp.max(s, axis=1))
-        alpha = jnp.exp(m_acc - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l_acc * alpha + jnp.sum(p, axis=1)
-        o_new = o_acc * alpha[:, None] + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32)
-        return o_new, m_new, l_new
+    @pl.when(j == 0)
+    def _init():
+        o_ref[0] = jnp.zeros_like(o_ref[0])
+        stat_ref[0, :, 0] = jnp.full_like(stat_ref[0, :, 0], NEG_INF)
+        stat_ref[0, :, 1] = jnp.zeros_like(stat_ref[0, :, 1])
 
-    o0 = jnp.zeros((q.shape[0], q.shape[1]), jnp.float32)
-    m0 = jnp.full((q.shape[0],), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((q.shape[0],), jnp.float32)
-    o, m, l = jax.lax.fori_loop(0, nnz, body, (o0, m0, l0))
+    active = j < counts_ref[h, r]
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    o_acc = o_ref[0].astype(jnp.float32)
+    m_acc = stat_ref[0, :, 0]
+    l_acc = stat_ref[0, :, 1]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    m_new = jnp.maximum(m_acc, jnp.max(s, axis=1))
+    alpha = jnp.exp(m_acc - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    l_new = l_acc * alpha + jnp.sum(p, axis=1)
+    o_new = o_acc * alpha[:, None] + jax.lax.dot(
+        p, v, preferred_element_type=jnp.float32)
+
+    o = jnp.where(active, o_new, o_acc)
+    m = jnp.where(active, m_new, m_acc)
+    l = jnp.where(active, l_new, l_acc)
+
+    last = j == max_nnz - 1
     l_safe = jnp.maximum(l, 1e-30)
-    o = jnp.where((l > 0)[:, None], o / l_safe[:, None], 0.0)
-    o_ref[0] = o.astype(o_ref.dtype)
+    o_final = jnp.where((l > 0)[:, None], o / l_safe[:, None], 0.0)
+    o_ref[0] = jnp.where(last, o_final, o)
     # rows with no active blocks get +inf so backward's exp(s - lse) is 0
-    lse_ref[0, :, 0] = jnp.where(l > 0, m + jnp.log(l_safe), POS_INF)
+    lse = jnp.where(l > 0, m + jnp.log(l_safe), POS_INF)
+    stat_ref[0, :, 0] = jnp.where(last, lse, m)
+    stat_ref[0, :, 1] = l
 
 
 # ---------------------------------------------------------------- backward
 
 def _bs_dq_kernel(counts_ref, cols_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                  delta_ref, dq_ref, *, scale, block, num_heads):
-    h, r = pl.program_id(0) % num_heads, pl.program_id(1)
+                  delta_ref, dq_ref, *, scale, num_heads, max_nnz):
+    b, r, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    h = b % num_heads
+
+    @pl.when(j == 0)
+    def _init():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
+
+    active = j < counts_ref[h, r]
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
-    nnz = counts_ref[h, r]
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
 
-    def body(j, dq_acc):
-        kb = cols_ref[h, r, j]
-        k = k_ref[0, pl.ds(kb * block, block), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block, block), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        p = jnp.exp(s - lse[:, None])
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
-        return dq_acc + jax.lax.dot(ds, k,
-                                    preferred_element_type=jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    p = jnp.exp(s - lse[:, None])
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None])
+    contrib = jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, nnz, body, jnp.zeros_like(q))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    dq = dq_ref[0].astype(jnp.float32) + jnp.where(active, contrib, 0.0)
+    # accumulate unscaled; apply the folded-scale chain rule on the last step
+    dq_ref[0] = jnp.where(j == max_nnz - 1, dq * scale, dq).astype(
+        dq_ref.dtype)
 
 
 def _bs_dkv_kernel(countsT_ref, rows_ref, q_ref, k_ref, v_ref, do_ref,
-                   lse_ref, delta_ref, dk_ref, dv_ref, *, scale, block,
-                   num_heads):
-    h, c = pl.program_id(0) % num_heads, pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)   # [block, D]
+                   lse_ref, delta_ref, dk_ref, dv_ref, *, scale, num_heads,
+                   max_nnzT):
+    b, c, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    h = b % num_heads
+
+    @pl.when(j == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    active = j < countsT_ref[h, c]
+    k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
-    nnz = countsT_ref[h, c]
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
 
-    def body(j, carry):
-        dk_acc, dv_acc = carry
-        qb = rows_ref[h, c, j]
-        q = q_ref[0, pl.ds(qb * block, block), :].astype(jnp.float32) * scale
-        do = do_ref[0, pl.ds(qb * block, block), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * block, block), 0]
-        delta = delta_ref[0, pl.ds(qb * block, block), 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        p = jnp.exp(s - lse[:, None])
-        dv_new = dv_acc + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
-        # dk = dsᵀ·(scale·q): q was pre-scaled, so this is exact
-        dk_new = dk_acc + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return dk_new, dv_new
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    p = jnp.exp(s - lse[:, None])
+    dv_c = jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None])
+    # dk = dsᵀ·(scale·q): q was pre-scaled, so this is exact
+    dk_c = jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
 
-    dk, dv = jax.lax.fori_loop(0, nnz, body,
-                               (jnp.zeros_like(k), jnp.zeros_like(v)))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dk_ref[0] = (dk_ref[0].astype(jnp.float32)
+                 + jnp.where(active, dk_c, 0.0)).astype(dk_ref.dtype)
+    dv_ref[0] = (dv_ref[0].astype(jnp.float32)
+                 + jnp.where(active, dv_c, 0.0)).astype(dv_ref.dtype)
 
 
 # ---------------------------------------------------------------- plumbing
 
 def _bs_fwd(qf, kf, vf, tables, scale, block, interpret):
-    (counts_bh, cols_bh, _, _, _, _, _) = tables
+    (counts_bh, cols_bh, max_nnz, _, _, _, H) = tables
     BH, S, D = qf.shape
     nb = S // block
-    kernel = functools.partial(_bs_fwd_kernel, scale=scale, block=block,
-                               num_heads=tables[-1])
-    # index maps under scalar prefetch receive the scalar refs after the
-    # grid indices; the q/k/v blocks don't depend on them
+    kernel = functools.partial(_bs_fwd_kernel, scale=scale, num_heads=H,
+                               max_nnz=max_nnz)
+
+    # k/v tiles are chosen by the index map from the prefetched cols table
+    # (the splash-attention move): VMEM sees one [block, D] tile per step
+    def kv_map(b, i, j, counts, cols):
+        return (b, cols[b % H, i, j], 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(BH, nb),
+        grid=(BH, nb, max_nnz),
         in_specs=[
-            pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i, *_: (b, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i, *_: (b, 0, 0)),
+            pl.BlockSpec((1, block, D), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((1, block, D), kv_map),
+            pl.BlockSpec((1, block, D), kv_map),
         ],
         out_specs=[
-            pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
-            pl.BlockSpec((1, block, 1), lambda b, i, *_: (b, i, 0)),
+            pl.BlockSpec((1, block, D), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((1, block, 2), lambda b, i, j, *_: (b, i, 0)),
         ],
     )
-    o, lse = pl.pallas_call(
+    # fp32 out buffer: the revisited o block doubles as the softmax
+    # accumulator across grid steps, and rounding it to bf16 per active
+    # block would compound error per block (flash's chunked family does
+    # the same)
+    o32, stat = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
-            jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, 2), jnp.float32),
         ],
         interpret=interpret,
     )(counts_bh, cols_bh, qf, kf, vf)
-    return o, lse
+    return o32, stat[:, :, :1]
 
 
 def _bs_bwd(qf, kf, vf, o, lse, do, tables, scale, block, interpret):
     (counts_bh, cols_bh, max_nnz,
-     countsT_bh, rows_bh, max_nnzT, _) = tables
+     countsT_bh, rows_bh, max_nnzT, H) = tables
     BH, S, D = qf.shape
     nb = S // block
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)[:, :, None]
 
+    def kv_map(b, i, j, counts, cols):
+        return (b, cols[b % H, i, j], 0)
+
     dq = pl.pallas_call(
-        functools.partial(_bs_dq_kernel, scale=scale, block=block,
-                          num_heads=tables[-1]),
+        functools.partial(_bs_dq_kernel, scale=scale, num_heads=H,
+                          max_nnz=max_nnz),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(BH, nb),
+            grid=(BH, nb, max_nnz),
             in_specs=[
-                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
-                pl.BlockSpec((1, S, D), lambda b, i, *_: (b, 0, 0)),
-                pl.BlockSpec((1, S, D), lambda b, i, *_: (b, 0, 0)),
-                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
-                pl.BlockSpec((1, block, 1), lambda b, i, *_: (b, i, 0)),
-                pl.BlockSpec((1, block, 1), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, block, D), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block, D), kv_map),
+                pl.BlockSpec((1, block, D), kv_map),
+                pl.BlockSpec((1, block, D), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block, 1), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block, 1), lambda b, i, j, *_: (b, i, 0)),
             ],
-            out_specs=pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+            out_specs=pl.BlockSpec((1, block, D),
+                                   lambda b, i, j, *_: (b, i, 0)),
         ),
-        out_shape=jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
+        # fp32 revisited accumulator (see forward)
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
         interpret=interpret,
     )(counts_bh, cols_bh, qf, kf, vf, do, lse, delta)
 
+    # transpose pass: grid walks each K-block's attending q-blocks
+    def q_map(b, i, j, counts, rows):
+        return (b, rows[b % H, i, j], 0)
+
     dk, dv = pl.pallas_call(
-        functools.partial(_bs_dkv_kernel, scale=scale, block=block,
-                          num_heads=tables[-1]),
+        functools.partial(_bs_dkv_kernel, scale=scale, num_heads=H,
+                          max_nnzT=max_nnzT),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(BH, nb),
+            grid=(BH, nb, max_nnzT),
             in_specs=[
-                pl.BlockSpec((1, S, D), lambda b, i, *_: (b, 0, 0)),
-                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
-                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
-                pl.BlockSpec((1, S, D), lambda b, i, *_: (b, 0, 0)),
-                pl.BlockSpec((1, S, 1), lambda b, i, *_: (b, 0, 0)),
-                pl.BlockSpec((1, S, 1), lambda b, i, *_: (b, 0, 0)),
+                pl.BlockSpec((1, block, D), q_map),
+                pl.BlockSpec((1, block, D), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block, D), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block, D), q_map),
+                pl.BlockSpec((1, block, 1), q_map),
+                pl.BlockSpec((1, block, 1), q_map),
             ],
             out_specs=[
-                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
-                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, block, D), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block, D), lambda b, i, j, *_: (b, i, 0)),
             ],
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
-            jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
         ],
         interpret=interpret,
     )(countsT_bh, rows_bh, qf, kf, vf, do, lse, delta)
-    return dq, dk, dv
+    # cotangent dtypes must match the primals
+    return (dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype))
 
 
 def blocksparse_attention(q, k, v, layout, block, scale=None,
@@ -247,6 +295,10 @@ def blocksparse_attention(q, k, v, layout, block, scale=None,
     Triton path). Extra element-level masks are not supported in the kernel
     path (the reference applied them inside the Triton softmax); callers
     pass masks via the dense fallback in sparse_self_attention.py.
+
+    Sequence length is bounded by HBM only: K/V stream one [block, D] tile
+    per grid step (selected by the layout table), never materializing a
+    whole [S, D] row in VMEM.
     """
     if key_padding_mask is not None or attn_mask is not None:
         raise NotImplementedError("mask args use the dense fallback path")
@@ -260,15 +312,6 @@ def blocksparse_attention(q, k, v, layout, block, scale=None,
         interpret = _interpret_default()
     if S % block or block < 8:
         raise NotImplementedError("layout block too small for kernel tiling")
-    if S * D > 262144:
-        # the bwd kernels keep whole [S, D] q/do rows resident in VMEM
-        # (plus double buffering); measured ceiling on v5e is S·D ≈ 256k
-        # (S=4096 at D=64 fits, S=8192 overflows the 16 MB scoped vmem).
-        # Beyond that the caller's dense fallback handles it; the long-S
-        # regime belongs to ring attention (parallel/ring_attention.py)
-        # which shards S before attention runs.
-        raise NotImplementedError(
-            f"S*D={S * D} exceeds the kernel's VMEM row budget")
 
     counts, cols, max_nnz = _layout_tables(layout)
     countsT, rows, max_nnzT = _layout_tables(layout.transpose(0, 2, 1))
@@ -296,4 +339,6 @@ def blocksparse_attention(q, k, v, layout, block, scale=None,
                        bool(interpret))
 
     run.defvjp(run_fwd, run_bwd)
-    return run(qf, kf, vf).reshape(B, H, S, D)
+    # the kernel's fp32 output casts back to the caller dtype here, outside
+    # the custom VJP, so backward's delta uses the unrounded o
+    return run(qf, kf, vf).astype(q.dtype).reshape(B, H, S, D)
