@@ -8,10 +8,27 @@ chip flops resolved from the device kind.
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+
+def _enable_compile_cache():
+    """Persistent XLA compilation cache (repo-local): the 1.5B offload
+    program compiles in ~40 min through the tunneled backend; caching it
+    makes the gpt2_xl bench case a cache-hit re-run on later invocations
+    on the same machine."""
+    import jax
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".jax_cache")
+    os.makedirs(path, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 10)
+    except Exception:
+        pass
 
 
 PEAK_BF16_FLOPS = {
@@ -44,12 +61,75 @@ def model_flops_per_token(cfg):
     return flops
 
 
+XL_WARM_SENTINEL = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".jax_cache", "xl_warmed")
+
+
+def bench_xl_case(budget_s=1800):
+    """gpt2_xl 1.5B ZeRO-Offload in a bounded subprocess (VERDICT r2 item
+    6: driver-visible, produced by bench.py itself). Must run BEFORE this
+    process claims the chip — the axon TPU claim is exclusive.
+
+    Cold compile is ~40 min through the tunnel and a killed compile never
+    populates the persistent cache, so the case only runs once
+    bench_xl.py has completed on this machine (it drops a sentinel next
+    to the cache); a cold machine reports skipped with instructions
+    instead of burning the budget for nothing."""
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    if not os.path.exists(XL_WARM_SENTINEL):
+        return {"skipped": "compilation cache cold for the 1.5B program "
+                           "(~40 min compile through the tunnel); run "
+                           "`python bench_xl.py` once to warm it — later "
+                           "bench.py runs then include this case"}
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "bench_xl.py"),
+             "--steps", "1"],
+            capture_output=True, text=True, timeout=budget_s, cwd=here)
+    except subprocess.TimeoutExpired:
+        return {"skipped": f"budget {budget_s}s exceeded despite warm "
+                           f"cache (chip contention?)"}
+    if proc.returncode == 0:
+        for line in reversed((proc.stdout or "").strip().splitlines()):
+            try:
+                parsed = json.loads(line)
+            except (ValueError, json.JSONDecodeError):
+                continue
+            if isinstance(parsed, dict):
+                return parsed
+    return {"skipped": f"rc={proc.returncode}: "
+                       f"{(proc.stderr or '')[-300:]}"}
+
+
 def main():
+    # the XL case subprocess needs the chip to itself — run it before this
+    # process initializes the backend
+    xl = bench_xl_case()
+
     import jax
+    _enable_compile_cache()
     import jax.numpy as jnp
     import deepspeed_tpu as dstpu
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
     from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+
+    # chip claim can lag a just-exited subprocess (exclusive + flaky)
+    for attempt in range(6):
+        try:
+            jax.devices()
+            break
+        except Exception:
+            if attempt == 5:
+                raise
+            time.sleep(20)
+    if all(d.platform == "cpu" for d in jax.devices()) \
+            and not os.environ.get("DSTPU_BENCH_ALLOW_CPU"):
+        # a failed accelerator init silently falls back to CPU; an MFU
+        # against TPU peak computed from a CPU run would be absurd
+        raise RuntimeError(
+            "bench.py found only CPU devices; the TPU claim failed "
+            "(set DSTPU_BENCH_ALLOW_CPU=1 to run on CPU anyway)")
 
     dev = jax.devices()[0]
     mesh = make_mesh(MeshConfig(data=1), devices=[dev])
@@ -172,9 +252,9 @@ def main():
             # tunneled backends). True peak is BELOW the sum of these two
             # — donated state buffers are reused for temporaries — and
             # bounded by the 15.75 GB the chip actually has (the step
-            # runs). Max params/chip: 1.557B trains on this 16 GB chip
-            # via ZeRO-Offload — bench_xl.py is the evidence run (out of
-            # the driver path: ~25 min compile).
+            # runs). Max params/chip: 1.558B trains on this 16 GB chip
+            # via ZeRO-Offload — the "gpt2_xl" entry below is that
+            # evidence run (bounded subprocess, cache-warmed).
             "hbm_compiled_buffers_gb": {
                 "state_and_batch": round(mem["argument_bytes"] / 2**30, 2),
                 "activations_and_temps": round(mem["temp_bytes"] / 2**30, 2),
@@ -195,6 +275,10 @@ def main():
             # claim: up to 6.1x + 10x longer sequences; 16k runs the
             # streaming kernel past the old S*D cap)
             "sparse_attention": sparse,
+            # 1.5B ZeRO-Offload on this one chip (bounded subprocess; the
+            # honest MFU measures the harness's 1-core host, not the
+            # architecture — see bench_xl.py)
+            "gpt2_xl": xl,
             # async-IO tier (io_uring or thread pool; cache-cold read)
             "aio_disk": aio,
         },

@@ -1,25 +1,39 @@
 """GPT-2 1.5B (gpt2_xl, the BASELINE.md north-star config) on ONE 16 GB
-chip via ZeRO-Offload — the max-params-per-chip evidence run.
+chip via ZeRO-Offload — the max-params-per-chip evidence run, and the
+gpt2_xl entry bench.py embeds (it runs this script as a bounded
+subprocess).
 
-Not part of bench.py's driver path: the 48-layer offload program takes
-~25 min to compile through the tunneled backend, and the steady-state step
-is dominated by the host optimizer (on this harness the host has a single
-CPU core and sits behind the tunnel; measured 425 s/step, loss falling
-11.16 -> 10.49 over 4 steps on 2026-07-30. A real TPU-VM host with its
-usual core count and PCIe runs the same host step in seconds).
+The 48-layer offload program takes ~40 min to compile through the
+tunneled backend — a persistent XLA compilation cache (.jax_cache) makes
+re-runs on the same machine compile-free. The steady-state step is
+dominated by the host optimizer: this harness host has a single CPU core
+behind the tunnel (measured ~405 s/step with the pipelined d2h/SIMD/h2d
+streamed step, loss falling 11.16 → 10.49 over 4 steps; a real TPU-VM
+host with its usual core count and PCIe runs the same host step in
+seconds). MFU is reported honestly against the chip peak — on this
+harness it measures the 1-core host, not the architecture.
 
-Prints one JSON line: params, fit evidence, samples/sec.
+Prints one JSON line: params, fit evidence, samples/sec, honest MFU.
 """
 
+import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
 
-def main():
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=3,
+                    help="steady-state steps to time after the first")
+    args = ap.parse_args(argv)
+
     import jax
+    from bench import _enable_compile_cache, peak_flops, model_flops_per_token
+    _enable_compile_cache()
     import jax.numpy as jnp
     import deepspeed_tpu as dstpu
     from deepspeed_tpu.models.gpt2 import gpt2_xl, GPT2LMHeadModel
@@ -29,14 +43,15 @@ def main():
                     remat_policy="projs", loss_chunk=1024)
     cfg = {
         "train_batch_size": 4,
-        "zero_optimization": {"stage": 3,
+        "zero_optimization": {"stage": 3, "overlap_comm": True,
                               "offload_optimizer": {"device": "cpu"}},
         "bf16": {"enabled": True},
         "data_types": {"grad_dtype": "bf16"},
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
         "steps_per_print": 1000,
     }
-    mesh = make_mesh(MeshConfig(data=1), devices=[jax.devices()[0]])
+    dev = jax.devices()[0]
+    mesh = make_mesh(MeshConfig(data=1), devices=[dev])
     engine, _, _, _ = dstpu.initialize(config=cfg,
                                        model=GPT2LMHeadModel(cfg_m),
                                        mesh=mesh)
@@ -48,9 +63,13 @@ def main():
     losses.append(float(engine.train_batch(batch)))
     compile_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    for _ in range(3):
+    for _ in range(args.steps):
         losses.append(float(engine.train_batch(batch)))
-    dt = (time.perf_counter() - t0) / 3
+    dt = (time.perf_counter() - t0) / args.steps
+
+    tokens_per_step = 4 * 1024
+    achieved = model_flops_per_token(cfg_m) * tokens_per_step / dt
+    mfu = achieved / peak_flops(dev)
     print(json.dumps({
         "metric": "gpt2_xl_1p5b_zero_offload_params_per_chip",
         "value": round(cfg_m.num_params() / 1e9, 3),
@@ -58,8 +77,19 @@ def main():
         "detail": {"first_loss": losses[0], "last_loss": losses[-1],
                    "compile_s": round(compile_s, 1),
                    "steady_step_s": round(dt, 1),
-                   "samples_per_sec": round(4 / dt, 4)},
+                   "samples_per_sec": round(4 / dt, 4),
+                   # honest: the step is host-SIMD-bound on this 1-core
+                   # harness host; the number measures the host, not the
+                   # TPU architecture (see module docstring)
+                   "mfu_pct_on_this_harness": round(mfu * 100, 3)},
     }))
+    # mark the compilation cache warm for bench.py's bounded subprocess
+    try:
+        from bench import XL_WARM_SENTINEL
+        with open(XL_WARM_SENTINEL, "w") as f:
+            f.write("ok")
+    except OSError:
+        pass
 
 
 if __name__ == "__main__":

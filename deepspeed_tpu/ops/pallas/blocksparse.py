@@ -61,219 +61,305 @@ def _layout_tables(layout):
 
 # ---------------------------------------------------------------- forward
 
-def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_ref, v_ref,
-                   o_ref, stat_ref, *, scale, num_heads, max_nnz):
-    """One grid step = one (q-block, active k-block) pair. The k/v tiles
-    for step j were already selected by the BlockSpec index maps from the
-    prefetched cols table; this body only does the online-softmax update.
-    stat holds (m, l) interleaved on the last axis: [block, 2]."""
-    b, r, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    h = b % num_heads
+def _kv_copy(hbm, buf, sem, b, kb, slot, block):
+    """Async HBM→VMEM copy descriptor for one [block, D] tile (slot of the
+    double buffer). The source is block-major (BH, nb, block, D) so every
+    copy is a contiguous chunk — Mosaic rejects strided DMA slices when
+    D < the 128-lane tile. The same descriptor is rebuilt to wait()."""
+    return pltpu.make_async_copy(hbm.at[b, kb], buf.at[slot], sem.at[slot])
 
-    @pl.when(j == 0)
-    def _init():
-        o_ref[0] = jnp.zeros_like(o_ref[0])
-        stat_ref[0, :, 0] = jnp.full_like(stat_ref[0, :, 0], NEG_INF)
-        stat_ref[0, :, 1] = jnp.zeros_like(stat_ref[0, :, 1])
 
-    active = j < counts_ref[h, r]
+def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
+                   k_buf, v_buf, k_sem, v_sem, *, scale, block, d_head,
+                   num_heads, table_heads):
+    """One grid step = one q-block ROW: loop over exactly this row's nnz
+    active k-blocks (no max_nnz padding — a BigBird global row costs nb
+    steps, a window row costs ~4), double-buffering the K/V tile DMAs
+    against the online-softmax update."""
+    b, r = pl.program_id(0), pl.program_id(1)
+    h = (b % num_heads) if table_heads > 1 else 0
+    nnz = counts_ref[h, r]
     q = q_ref[0].astype(jnp.float32) * scale
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    o_acc = o_ref[0].astype(jnp.float32)
-    m_acc = stat_ref[0, :, 0]
-    l_acc = stat_ref[0, :, 1]
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    m_new = jnp.maximum(m_acc, jnp.max(s, axis=1))
-    alpha = jnp.exp(m_acc - m_new)
-    p = jnp.exp(s - m_new[:, None])
-    l_new = l_acc * alpha + jnp.sum(p, axis=1)
-    o_new = o_acc * alpha[:, None] + jax.lax.dot(
-        p, v, preferred_element_type=jnp.float32)
+    def copies(j, slot):
+        kb = cols_ref[h, r, j]
+        return (_kv_copy(k_hbm, k_buf, k_sem, b, kb, slot, block),
+                _kv_copy(v_hbm, v_buf, v_sem, b, kb, slot, block))
 
-    o = jnp.where(active, o_new, o_acc)
-    m = jnp.where(active, m_new, m_acc)
-    l = jnp.where(active, l_new, l_acc)
+    @pl.when(nnz > 0)
+    def _prefetch_first():
+        ck, cv = copies(0, 0)
+        ck.start()
+        cv.start()
 
-    last = j == max_nnz - 1
+    def body(j, carry):
+        o_acc, m_acc, l_acc = carry
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < nnz)
+        def _prefetch_next():
+            ck, cv = copies(j + 1, jax.lax.rem(j + 1, 2))
+            ck.start()
+            cv.start()
+
+        ck, cv = copies(j, slot)
+        ck.wait()
+        cv.wait()
+        # tiles are streamed lane-padded to 128; compute on the real D
+        k = k_buf[slot, :, :d_head].astype(jnp.float32)
+        v = v_buf[slot, :, :d_head].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        m_new = jnp.maximum(m_acc, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_acc - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_acc * alpha + jnp.sum(p, axis=1)
+        o_new = o_acc * alpha[:, None] + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((block, q.shape[1]), jnp.float32)
+    m0 = jnp.full((block,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block,), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, nnz, body, (o0, m0, l0))
     l_safe = jnp.maximum(l, 1e-30)
-    o_final = jnp.where((l > 0)[:, None], o / l_safe[:, None], 0.0)
-    o_ref[0] = jnp.where(last, o_final, o)
+    o_ref[0] = jnp.where((l > 0)[:, None], o / l_safe[:, None],
+                         0.0).astype(o_ref.dtype)
     # rows with no active blocks get +inf so backward's exp(s - lse) is 0
-    lse = jnp.where(l > 0, m + jnp.log(l_safe), POS_INF)
-    stat_ref[0, :, 0] = jnp.where(last, lse, m)
-    stat_ref[0, :, 1] = l
+    lse_ref[0, :, 0] = jnp.where(l > 0, m + jnp.log(l_safe), POS_INF)
 
 
 # ---------------------------------------------------------------- backward
 
-def _bs_dq_kernel(counts_ref, cols_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                  delta_ref, dq_ref, *, scale, num_heads, max_nnz):
-    b, r, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    h = b % num_heads
-
-    @pl.when(j == 0)
-    def _init():
-        dq_ref[0] = jnp.zeros_like(dq_ref[0])
-
-    active = j < counts_ref[h, r]
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, :, 0]
-    delta = delta_ref[0, :, 0]
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    p = jnp.exp(s - lse[:, None])
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta[:, None])
-    contrib = jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
-
-    dq = dq_ref[0].astype(jnp.float32) + jnp.where(active, contrib, 0.0)
-    # accumulate unscaled; apply the folded-scale chain rule on the last step
-    dq_ref[0] = jnp.where(j == max_nnz - 1, dq * scale, dq).astype(
-        dq_ref.dtype)
-
-
-def _bs_dkv_kernel(countsT_ref, rows_ref, q_ref, k_ref, v_ref, do_ref,
-                   lse_ref, delta_ref, dk_ref, dv_ref, *, scale, num_heads,
-                   max_nnzT):
-    b, c, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    h = b % num_heads
-
-    @pl.when(j == 0)
-    def _init():
-        dk_ref[0] = jnp.zeros_like(dk_ref[0])
-        dv_ref[0] = jnp.zeros_like(dv_ref[0])
-
-    active = j < countsT_ref[h, c]
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+def _bs_dq_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, do_ref, lse_ref,
+                  delta_ref, dq_ref, k_buf, v_buf, k_sem, v_sem, *, scale,
+                  block, d_head, num_heads, table_heads):
+    b, r = pl.program_id(0), pl.program_id(1)
+    h = (b % num_heads) if table_heads > 1 else 0
+    nnz = counts_ref[h, r]
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    p = jnp.exp(s - lse[:, None])
-    dv_c = jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                               preferred_element_type=jnp.float32)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta[:, None])
-    # dk = dsᵀ·(scale·q): q was pre-scaled, so this is exact
-    dk_c = jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                               preferred_element_type=jnp.float32)
+    def copies(j, slot):
+        kb = cols_ref[h, r, j]
+        return (_kv_copy(k_hbm, k_buf, k_sem, b, kb, slot, block),
+                _kv_copy(v_hbm, v_buf, v_sem, b, kb, slot, block))
 
-    dk_ref[0] = (dk_ref[0].astype(jnp.float32)
-                 + jnp.where(active, dk_c, 0.0)).astype(dk_ref.dtype)
-    dv_ref[0] = (dv_ref[0].astype(jnp.float32)
-                 + jnp.where(active, dv_c, 0.0)).astype(dv_ref.dtype)
+    @pl.when(nnz > 0)
+    def _prefetch_first():
+        ck, cv = copies(0, 0)
+        ck.start()
+        cv.start()
+
+    def body(j, dq_acc):
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < nnz)
+        def _prefetch_next():
+            ck, cv = copies(j + 1, jax.lax.rem(j + 1, 2))
+            ck.start()
+            cv.start()
+
+        ck, cv = copies(j, slot)
+        ck.wait()
+        cv.wait()
+        k = k_buf[slot, :, :d_head].astype(jnp.float32)
+        v = v_buf[slot, :, :d_head].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq_acc + jax.lax.dot(ds, k,
+                                    preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nnz, body,
+                           jnp.zeros((block, q.shape[1]), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bs_dkv_kernel(countsT_ref, rows_ref, q_hbm, k_ref, v_ref, do_hbm,
+                   lse_ref, delta_ref, dk_ref, dv_ref, q_buf, do_buf,
+                   q_sem, do_sem, *, scale, block, d_head, num_heads,
+                   table_heads):
+    """Transpose pass: per K-block COLUMN, loop over the q-blocks that
+    attend to it, streaming q/do tiles; lse/delta are 1 float per token,
+    packed (nb, block) with the block on the LANE axis — a (S, 1) layout
+    would lane-pad 1→128 (8 MB at 16k), this stays S·4 B — so the whole
+    row is VMEM-resident and read per q-block in-kernel."""
+    b, c = pl.program_id(0), pl.program_id(1)
+    h = (b % num_heads) if table_heads > 1 else 0
+    nnz = countsT_ref[h, c]
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+
+    def copies(j, slot):
+        qb = rows_ref[h, c, j]
+        return (_kv_copy(q_hbm, q_buf, q_sem, b, qb, slot, block),
+                _kv_copy(do_hbm, do_buf, do_sem, b, qb, slot, block))
+
+    @pl.when(nnz > 0)
+    def _prefetch_first():
+        for cp in copies(0, 0):
+            cp.start()
+
+    def body(j, carry):
+        dk_acc, dv_acc = carry
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < nnz)
+        def _prefetch_next():
+            for cp in copies(j + 1, jax.lax.rem(j + 1, 2)):
+                cp.start()
+
+        for cp in copies(j, slot):
+            cp.wait()
+        qb = rows_ref[h, c, j]
+        q = q_buf[slot, :, :d_head].astype(jnp.float32) * scale
+        do = do_buf[slot, :, :d_head].astype(jnp.float32)
+        lse = lse_ref[0, qb, :]
+        delta = delta_ref[0, qb, :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        p = jnp.exp(s - lse[:, None])
+        dv_new = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        # dk = dsT·(scale·q): q was pre-scaled, so this is exact
+        dk_new = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    z = jnp.zeros((block, k.shape[1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, nnz, body, (z, z))
+    dk_ref[0] = dk
+    dv_ref[0] = dv
 
 
 # ---------------------------------------------------------------- plumbing
 
+def _block_major(x, nb, block, Dp):
+    """[BH, S, D] → [BH, nb, block, Dp]: block-major, lane-padded to 128 so
+    every streamed DMA chunk is contiguous and tile-aligned (Mosaic
+    requires the copied chunk's last dim to be a multiple of 128)."""
+    BH, S, D = x.shape
+    x = x.reshape(BH, nb, block, D)
+    if Dp != D:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, Dp - D)))
+    return x
+
+
 def _bs_fwd(qf, kf, vf, tables, scale, block, interpret):
-    (counts_bh, cols_bh, max_nnz, _, _, _, H) = tables
+    (counts_bh, cols_bh, max_nnz, _, _, _, H, TH) = tables
     BH, S, D = qf.shape
     nb = S // block
-    kernel = functools.partial(_bs_fwd_kernel, scale=scale, num_heads=H,
-                               max_nnz=max_nnz)
-
-    # k/v tiles are chosen by the index map from the prefetched cols table
-    # (the splash-attention move): VMEM sees one [block, D] tile per step
-    def kv_map(b, i, j, counts, cols):
-        return (b, cols[b % H, i, j], 0)
-
+    Dp = ((D + 127) // 128) * 128    # lane-pad streamed tiles to 128
+    kernel = functools.partial(_bs_fwd_kernel, scale=scale, block=block,
+                               d_head=D, num_heads=H, table_heads=TH)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(BH, nb, max_nnz),
+        grid=(BH, nb),
         in_specs=[
-            pl.BlockSpec((1, block, D), lambda b, i, j, *_: (b, i, 0)),
-            pl.BlockSpec((1, block, D), kv_map),
-            pl.BlockSpec((1, block, D), kv_map),
+            pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),   # k stays in HBM; DMA'd
+            pl.BlockSpec(memory_space=pl.ANY),   # v stays in HBM; DMA'd
         ],
         out_specs=[
-            pl.BlockSpec((1, block, D), lambda b, i, j, *_: (b, i, 0)),
-            pl.BlockSpec((1, block, 2), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+            pl.BlockSpec((1, block, 1), lambda b, i, *_: (b, i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, block, Dp), kf.dtype),
+            pltpu.VMEM((2, block, Dp), vf.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
     )
-    # fp32 out buffer: the revisited o block doubles as the softmax
-    # accumulator across grid steps, and rounding it to bf16 per active
-    # block would compound error per block (flash's chunked family does
-    # the same)
-    o32, stat = pl.pallas_call(
+    kb4 = _block_major(kf, nb, block, Dp)
+    vb4 = _block_major(vf, nb, block, Dp)
+    o, lse = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
+            # fp32: run_fwd saves this o as the residual, so backward's
+            # delta = sum(do*o) sees the unrounded values; the cast to the
+            # caller dtype happens outside the custom VJP
             jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
-            jax.ShapeDtypeStruct((BH, S, 2), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(counts_bh, cols_bh, qf, kf, vf)
-    return o32, stat[:, :, :1]
+    )(counts_bh, cols_bh, qf, kb4, vb4)
+    return o, lse
 
 
 def _bs_bwd(qf, kf, vf, o, lse, do, tables, scale, block, interpret):
     (counts_bh, cols_bh, max_nnz,
-     countsT_bh, rows_bh, max_nnzT, H) = tables
+     countsT_bh, rows_bh, max_nnzT, H, TH) = tables
     BH, S, D = qf.shape
     nb = S // block
+    Dp = ((D + 127) // 128) * 128
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)[:, :, None]
 
-    def kv_map(b, i, j, counts, cols):
-        return (b, cols[b % H, i, j], 0)
-
     dq = pl.pallas_call(
-        functools.partial(_bs_dq_kernel, scale=scale, num_heads=H,
-                          max_nnz=max_nnz),
+        functools.partial(_bs_dq_kernel, scale=scale, block=block,
+                          d_head=D, num_heads=H, table_heads=TH),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(BH, nb, max_nnz),
+            grid=(BH, nb),
             in_specs=[
-                pl.BlockSpec((1, block, D), lambda b, i, j, *_: (b, i, 0)),
-                pl.BlockSpec((1, block, D), kv_map),
-                pl.BlockSpec((1, block, D), kv_map),
-                pl.BlockSpec((1, block, D), lambda b, i, j, *_: (b, i, 0)),
-                pl.BlockSpec((1, block, 1), lambda b, i, j, *_: (b, i, 0)),
-                pl.BlockSpec((1, block, 1), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, block, 1), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, block, 1), lambda b, i, *_: (b, i, 0)),
             ],
             out_specs=pl.BlockSpec((1, block, D),
-                                   lambda b, i, j, *_: (b, i, 0)),
+                                   lambda b, i, *_: (b, i, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, block, Dp), kf.dtype),
+                pltpu.VMEM((2, block, Dp), vf.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
         ),
-        # fp32 revisited accumulator (see forward)
-        out_shape=jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
         interpret=interpret,
-    )(counts_bh, cols_bh, qf, kf, vf, do, lse, delta)
+    )(counts_bh, cols_bh, qf, _block_major(kf, nb, block, Dp),
+      _block_major(vf, nb, block, Dp), do, lse, delta)
 
-    # transpose pass: grid walks each K-block's attending q-blocks
-    def q_map(b, i, j, counts, rows):
-        return (b, rows[b % H, i, j], 0)
-
+    # transpose pass: per K-block column, stream its attending q-blocks
     dk, dv = pl.pallas_call(
-        functools.partial(_bs_dkv_kernel, scale=scale, num_heads=H,
-                          max_nnzT=max_nnzT),
+        functools.partial(_bs_dkv_kernel, scale=scale, block=block,
+                          d_head=D, num_heads=H, table_heads=TH),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(BH, nb, max_nnzT),
+            grid=(BH, nb),
             in_specs=[
-                pl.BlockSpec((1, block, D), q_map),
-                pl.BlockSpec((1, block, D), lambda b, i, j, *_: (b, i, 0)),
-                pl.BlockSpec((1, block, D), lambda b, i, j, *_: (b, i, 0)),
-                pl.BlockSpec((1, block, D), q_map),
-                pl.BlockSpec((1, block, 1), q_map),
-                pl.BlockSpec((1, block, 1), q_map),
+                pl.BlockSpec(memory_space=pl.ANY),   # q streamed
+                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),   # do streamed
+                pl.BlockSpec((1, nb, block), lambda b, i, *_: (b, 0, 0)),
+                pl.BlockSpec((1, nb, block), lambda b, i, *_: (b, 0, 0)),
             ],
             out_specs=[
-                pl.BlockSpec((1, block, D), lambda b, i, j, *_: (b, i, 0)),
-                pl.BlockSpec((1, block, D), lambda b, i, j, *_: (b, i, 0)),
+                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, block, Dp), qf.dtype),
+                pltpu.VMEM((2, block, Dp), do.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
             ],
         ),
         out_shape=[
@@ -281,7 +367,9 @@ def _bs_bwd(qf, kf, vf, o, lse, do, tables, scale, block, interpret):
             jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
         ],
         interpret=interpret,
-    )(countsT_bh, rows_bh, qf, kf, vf, do, lse, delta)
+    )(countsT_bh, rows_bh, _block_major(qf, nb, block, Dp), kf, vf,
+      _block_major(do, nb, block, Dp), lse.reshape(BH, nb, block),
+      delta.reshape(BH, nb, block))
     # cotangent dtypes must match the primals
     return (dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype))
 
@@ -305,7 +393,8 @@ def blocksparse_attention(q, k, v, layout, block, scale=None,
     B, H, S, D = q.shape
     nb = S // block
     layout = np.asarray(layout)[:, :nb, :nb]
-    if layout.shape[0] == 1 and H > 1:
+    shared_layout = layout.shape[0] == 1 and H > 1
+    if shared_layout:
         layout = np.broadcast_to(layout, (H, nb, nb))
     scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
     if interpret is None:
@@ -313,12 +402,30 @@ def blocksparse_attention(q, k, v, layout, block, scale=None,
     if S % block or block < 8:
         raise NotImplementedError("layout block too small for kernel tiling")
 
-    counts, cols, max_nnz = _layout_tables(layout)
-    countsT, rows, max_nnzT = _layout_tables(layout.transpose(0, 2, 1))
-    # per-head tables (identical across batch); kernels index with
-    # program_id(0) % H — [B*H]-expanded tables overflow the 1 MB SMEM
+    # SMEM budget: the tables live in SMEM (1 MB). The transpose table of
+    # a layout with global columns is dense in those columns (max_nnzT =
+    # nb), i.e. O(H·nb²) ints — at 16k/128 with 16 heads that alone is
+    # ~1 MB. Layouts are usually shared across heads
+    # (different_layout_per_head=False propagates head 0), so collapse to
+    # a single-head table whenever all heads match.
+    if shared_layout:
+        table_layout = layout[:1]
+    elif H > 1 and bool(np.all(layout == layout[:1])):
+        table_layout = layout[:1]
+    else:
+        table_layout = layout
+    counts, cols, max_nnz = _layout_tables(table_layout)
+    countsT, rows, max_nnzT = _layout_tables(table_layout.transpose(0, 2, 1))
+    smem_bytes = 4 * (counts.size + cols.size + countsT.size + rows.size)
+    if smem_bytes > 900_000:
+        raise NotImplementedError(
+            f"layout tables need ~{smem_bytes} B of SMEM (>1 MB budget): "
+            f"{table_layout.shape[0]} distinct head layouts at "
+            f"nb={nb} with max_nnz={max_nnz}/{max_nnzT}; reduce "
+            f"different_layout_per_head or the global-column count")
     tables = (jnp.asarray(counts), jnp.asarray(cols), max_nnz,
-              jnp.asarray(countsT), jnp.asarray(rows), max_nnzT, H)
+              jnp.asarray(countsT), jnp.asarray(rows), max_nnzT, H,
+              table_layout.shape[0])
 
     qf = q.reshape(B * H, S, D)
     kf = k.reshape(B * H, S, D)
