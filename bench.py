@@ -1,6 +1,8 @@
 """Benchmark — GPT-2 training MFU on the local TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints up to TWO JSON lines — an insurance line with every number except
+the long-running gpt2_xl case, then the authoritative final line including
+it. THE LAST COMPLETE JSON LINE IS THE RESULT (the driver tails output).
 North star (BASELINE.json): GPT-2 ZeRO-3 at ≥45% MFU → vs_baseline = MFU/45.
 
 Model flops per step use the standard 6·N·T (+ attention) accounting; peak
@@ -65,16 +67,21 @@ XL_WARM_SENTINEL = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".jax_cache", "xl_warmed")
 
 
-def bench_xl_case(budget_s=1800):
+def bench_xl_case(budget_s=2400):
     """gpt2_xl 1.5B ZeRO-Offload in a bounded subprocess (VERDICT r2 item
-    6: driver-visible, produced by bench.py itself). Must run BEFORE this
-    process claims the chip — the axon TPU claim is exclusive.
+    6: driver-visible, produced by bench.py itself).
 
-    Cold compile is ~40 min through the tunnel and a killed compile never
-    populates the persistent cache, so the case only runs once
-    bench_xl.py has completed on this machine (it drops a sentinel next
-    to the cache); a cold machine reports skipped with instructions
-    instead of burning the budget for nothing."""
+    The 48-layer offload program costs ~17-20 min of REMOTE compile that
+    the client-side persistent cache cannot capture, plus two ~6-min
+    host-bound steps, so the case only runs once bench_xl.py has
+    completed on this machine (it drops a sentinel proving the
+    configuration finishes); a machine without the sentinel reports
+    skipped with instructions instead of burning the budget blind.
+
+    The tunneled chip claim is shared, not exclusive (verified: a second
+    process initializes the backend while another holds it), so this can
+    run after the parent's measurements; the parent clears its caches
+    first so the subprocess gets the HBM."""
     import subprocess
     here = os.path.dirname(os.path.abspath(__file__))
     if not os.path.exists(XL_WARM_SENTINEL):
@@ -88,8 +95,10 @@ def bench_xl_case(budget_s=1800):
              "--steps", "1"],
             capture_output=True, text=True, timeout=budget_s, cwd=here)
     except subprocess.TimeoutExpired:
-        return {"skipped": f"budget {budget_s}s exceeded despite warm "
-                           f"cache (chip contention?)"}
+        return {"skipped": f"budget {budget_s}s exceeded (remote compile "
+                           f"is uncacheable ~20 min + 2 host-bound steps; "
+                           f"chip/HBM contention with the parent process "
+                           f"can also stretch this)"}
     if proc.returncode == 0:
         for line in reversed((proc.stdout or "").strip().splitlines()):
             try:
@@ -103,10 +112,6 @@ def bench_xl_case(budget_s=1800):
 
 
 def main():
-    # the XL case subprocess needs the chip to itself — run it before this
-    # process initializes the backend
-    xl = bench_xl_case()
-
     import jax
     _enable_compile_cache()
     import jax.numpy as jnp
@@ -234,6 +239,7 @@ def main():
         aio = quick_throughput(mb=128)
     except Exception:
         aio = None
+    jax.clear_caches()   # free HBM before the 1.5B subprocess needs it
 
     result = {
         "metric": "gpt2_large_774m_zero3_mfu",
@@ -277,12 +283,19 @@ def main():
             "sparse_attention": sparse,
             # 1.5B ZeRO-Offload on this one chip (bounded subprocess; the
             # honest MFU measures the harness's 1-core host, not the
-            # architecture — see bench_xl.py)
-            "gpt2_xl": xl,
+            # architecture — see bench_xl.py). Filled by the second print
+            # below; this placeholder survives if the run is cut short.
+            "gpt2_xl": {"skipped": "run interrupted before the XL case"},
             # async-IO tier (io_uring or thread pool; cache-cold read)
             "aio_disk": aio,
         },
     }
+    # insurance line: the XL case below can take ~35 min; if the harness
+    # kills us mid-way, the LAST complete JSON line still carries every
+    # other number. The final (authoritative) line replaces it on success.
+    print(json.dumps(result), flush=True)
+
+    result["detail"]["gpt2_xl"] = bench_xl_case()
     print(json.dumps(result))
 
 
