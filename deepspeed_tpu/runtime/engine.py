@@ -1358,6 +1358,8 @@ class DeepSpeedEngine:
         m = lead // gas
         inv_gas = np.float32(1.0 / gas)
 
+        wcb = self.wall_clock_breakdown()
+        t0 = time.perf_counter()
         acc = None
         losses = []
         pending = None
@@ -1397,9 +1399,19 @@ class DeepSpeedEngine:
             else True
         grads_tree = jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(self.state.params), acc)
-        return self._host_apply_grads(grads_tree, jnp.float32(loss),
-                                      finite=finite,
-                                      scaled_norm=scaled_norm)
+        if wcb:
+            # 'backward' = device compute with the overlapped d2h+fold
+            # (the losses device_get above fenced the last micro)
+            self.timers(BACKWARD_GLOBAL_TIMER).elapsed_ += \
+                time.perf_counter() - t0
+            t0 = time.perf_counter()
+        metrics = self._host_apply_grads(grads_tree, jnp.float32(loss),
+                                         finite=finite,
+                                         scaled_norm=scaled_norm)
+        if wcb:
+            self.timers(STEP_GLOBAL_TIMER).elapsed_ += \
+                time.perf_counter() - t0
+        return metrics
 
     def _host_apply_grads(self, grads, loss, finite=None, scaled_norm=None):
         """Shared offload update, pipelined: overflow/norm resolve from two

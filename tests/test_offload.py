@@ -655,3 +655,29 @@ def test_optimizer_swapper_uses_contiguous_arena(tmp_path):
     assert arena.max_allocated <= arena.size
     assert sw._arena._live <= 4
     sw.release()
+
+
+import pytest as _pytest
+
+
+@_pytest.mark.parametrize("overlap,gas", [(False, 1), (True, 4)])
+def test_offload_wall_clock_breakdown(overlap, gas):
+    """wall_clock_breakdown must not silently no-op for offload engines
+    (r3 review finding), on BOTH the fused-accumulation and the
+    overlap_comm per-micro paths: 'backward' (device compute incl.
+    overlapped transfers) and 'step' (host SIMD+push) timers populate."""
+    cfg = base_config()
+    cfg["train_batch_size"] = 8
+    cfg["gradient_accumulation_steps"] = gas
+    cfg["wall_clock_breakdown"] = True
+    cfg["zero_optimization"] = {"stage": 2, "overlap_comm": overlap,
+                                "offload_optimizer": {"device": "cpu"}}
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                       mesh=one_device_mesh())
+    batch = random_batch(batch_size=8)
+    for _ in range(2):
+        engine.train_batch(batch)
+    times = engine.wall_clock_times()
+    assert times.get("backward", 0) > 0
+    assert times.get("step", 0) > 0
+    assert "forward" not in times   # offload reports fwd+bwd fused
