@@ -171,10 +171,92 @@ def _make_forward_program(stage_fn, M, S, interleave, fwd_perm, shard,
     return _forward_program
 
 
-def _pipeline_prologue(stage_params, microbatches, mesh, interleave):
+def _nonpipe_axes_in_param_specs(stage_params):
+    """Mesh axes other than 'pipe' that appear in the stage params'
+    shardings. A param sharded over a live data/model axis forces GSPMD to
+    insert a collective (all-gather / reduce-scatter) inside the stage
+    body, which is exactly the thing the interleaved schedule cannot
+    tolerate.
+
+    Inspects concrete-array `.sharding` (eager callers) and falls back to
+    `.aval.sharding` (explicit-sharding tracers). Under plain jit in Auto
+    mode tracers expose neither — that path is covered by the jaxpr scan
+    in `_collective_axes_in_body` for explicit collectives; GSPMD-inserted
+    ones are undetectable at trace time (documented limitation)."""
+    axes = set()
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+        if spec is None:
+            spec = getattr(
+                getattr(getattr(leaf, "aval", None), "sharding", None),
+                "spec", None)
+        if spec is None:
+            continue
+        for entry in spec:
+            if entry is None:
+                continue
+            names = entry if isinstance(entry, tuple) else (entry,)
+            axes.update(n for n in names if n != mesh_lib.PIPE_AXIS)
+    return axes
+
+
+def _axis_names_in_jaxpr(jaxpr, found):
+    """Collect mesh-axis names referenced by collective-style primitives
+    (psum/ppermute/all_gather/... carry them in 'axes'/'axis_name' params),
+    recursing into sub-jaxprs (scan/cond/closed_call/shard_map bodies)."""
+    for eqn in jaxpr.eqns:
+        for key in ("axes", "axis_name"):
+            v = eqn.params.get(key)
+            if isinstance(v, str):
+                found.add(v)
+            elif isinstance(v, (tuple, list, frozenset, set)):
+                found.update(n for n in v if isinstance(n, str))
+        for v in eqn.params.values():
+            sub = getattr(v, "jaxpr", v)
+            if hasattr(sub, "eqns"):
+                _axis_names_in_jaxpr(sub, found)
+            elif isinstance(v, (tuple, list)):
+                for w in v:
+                    subw = getattr(w, "jaxpr", w)
+                    if hasattr(subw, "eqns"):
+                        _axis_names_in_jaxpr(subw, found)
+
+
+def _collective_axes_in_body(stage_fn, stage_params, microbatches, live):
+    """Best-effort trace of the stage body looking for explicit collectives
+    over live non-pipe mesh axes (ring attention's ppermute over 'seq', a
+    hand-written psum over 'model', ...). Works on tracers too — the trace
+    is abstract. Returns the offending axis names (empty = no proof).
+
+    A trace failure that names a live axis (unbound axis name) is itself
+    proof the body references that axis."""
+    try:
+        local_abs = jax.tree_util.tree_map(
+            lambda p: jax.ShapeDtypeStruct(p.shape[1:], p.dtype),
+            stage_params)
+        x_abs = jax.ShapeDtypeStruct(microbatches.shape[1:],
+                                     microbatches.dtype)
+        jaxpr = jax.make_jaxpr(stage_fn)(local_abs, x_abs)
+        found = set()
+        _axis_names_in_jaxpr(jaxpr.jaxpr, found)
+        return found & live
+    except Exception as e:
+        # JAX reports a collective over a mesh axis traced outside its
+        # binding as "unbound axis name: <axis>" — that exact failure IS
+        # the proof. Any other trace failure proves nothing; stay silent
+        # (the real error will resurface when the actual program traces).
+        msg = str(e)
+        if "unbound axis name" in msg:
+            return {a for a in live if a in msg}
+        return set()
+
+
+def _pipeline_prologue(stage_params, microbatches, mesh, interleave,
+                       stage_fn=None):
     """Shared setup for the training and inference executors: resolves the
-    interleave mode (warning on the forced-interleave + live-collective-axes
-    hazard), permutations, param specs and the pipe-only shard_map.
+    interleave mode (hard error on the forced-interleave + live-ZeRO/TP-spec
+    hazard, warning for the maybe-collective-free case), permutations, param
+    specs and the pipe-only shard_map.
     Returns None when S == 1 (callers fall back to a sequential map)."""
     S = mesh.shape[mesh_lib.PIPE_AXIS]
     if S == 1:
@@ -189,15 +271,31 @@ def _pipeline_prologue(stage_params, microbatches, mesh, interleave):
         # forced interleave on a mesh with live data/model/seq axes: any
         # GSPMD collective inside the stage body lands in diverging
         # lax.cond branches and the devices DEADLOCK (see module doc).
-        # Legal only for genuinely collective-free bodies — warn, don't
-        # block, since batch-sharded elementwise bodies are fine.
+        # When the stage params carry ZeRO/TP specs over those axes the
+        # collective is GUARANTEED (GSPMD must gather the shards to apply
+        # the layer), so refuse to build a program that cannot run.
+        # Otherwise (replicated params, batch-sharded elementwise body may
+        # be collective-free) keep the warning.
+        live = {k: v for k, v in mesh.shape.items()
+                if k != mesh_lib.PIPE_AXIS and v > 1}
+        spec_axes = _nonpipe_axes_in_param_specs(stage_params) & live.keys()
+        if not spec_axes and stage_fn is not None:
+            spec_axes = _collective_axes_in_body(
+                stage_fn, stage_params, microbatches, live.keys())
+        if spec_axes:
+            raise ValueError(
+                f"pipeline interleave=True is impossible on this mesh: the "
+                f"stage params/body use live non-pipe axes "
+                f"{sorted(spec_axes)} (mesh {live}), so collectives land "
+                f"inside the interleaved schedule's diverging lax.cond "
+                f"branches and the devices deadlock. Use interleave=False "
+                f"(the uniform schedule composes with ZeRO/TP/SP) or drop "
+                f"the ZeRO/TP specs from the stage params.")
         from deepspeed_tpu.utils.logging import logger
         logger.warning(
             "pipeline interleave=True forced on a mesh with non-pipe axes "
             "%s: the stage body must be collective-free or the program "
-            "deadlocks; the uniform schedule composes safely",
-            {k: v for k, v in mesh.shape.items()
-             if k != mesh_lib.PIPE_AXIS and v > 1})
+            "deadlocks; the uniform schedule composes safely", live)
 
     M = microbatches.shape[0]
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
@@ -221,7 +319,8 @@ def pipeline_infer(stage_fn, stage_params, microbatches, mesh,
     Same contract as pipeline_1f1b's forward: returns the last stage's
     outputs [M, ...], replicated over 'pipe'.
     """
-    setup = _pipeline_prologue(stage_params, microbatches, mesh, interleave)
+    setup = _pipeline_prologue(stage_params, microbatches, mesh, interleave,
+                               stage_fn=stage_fn)
     if setup is None:
         squeezed = jax.tree_util.tree_map(lambda p: p[0], stage_params)
         return jax.lax.map(lambda x: stage_fn(squeezed, x), microbatches)
@@ -250,7 +349,8 @@ def pipeline_1f1b(stage_fn, stage_params, microbatches, mesh,
     Only the 'pipe' axis is shard_mapped — data/seq/model stay in GSPMD
     auto mode, so ZeRO/TP/SP shardings compose untouched.
     """
-    setup = _pipeline_prologue(stage_params, microbatches, mesh, interleave)
+    setup = _pipeline_prologue(stage_params, microbatches, mesh, interleave,
+                               stage_fn=stage_fn)
     if setup is None:
         squeezed = jax.tree_util.tree_map(lambda p: p[0], stage_params)
         return jax.lax.map(lambda x: stage_fn(squeezed, x), microbatches)

@@ -205,6 +205,96 @@ def test_pipeline_module_trains_pipe2xdp_matches_pipe1():
     np.testing.assert_allclose(got, base, rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.parametrize("mesh_cfg,n_dev", [
+    ("pipe2", 2),          # pipe-only mesh -> interleaved schedule
+    ("pipe2xdp2", 4),      # live data axis  -> uniform schedule
+])
+def test_tied_weights_pipe2_matches_pipe1(mesh_cfg, n_dev):
+    """VERDICT r3 item 7: a model with tied embedding/unembedding
+    (TiedLayerSpec) trained at pipe=2 matches the pipe=1 grads and loss
+    trajectory.
+
+    Design note (the replicated-prefix/suffix equivalence): the SPMD
+    lowering excludes tied specs from the stage-stacked trunk — tied
+    layers run in the prefix/suffix, replicated over the pipe axis, and
+    both uses read the SAME ``params['tied'][key]`` subtree. Autodiff
+    therefore sums the embedding-use and unembedding-use cotangents into
+    one tied gradient automatically — the role of the reference's
+    ReduceTiedGrads all-reduce over the tied-owner group
+    (deepspeed/runtime/pipe/module.py:412-480) with no communication
+    beyond what GSPMD already inserts.
+    """
+    import jax
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+    from deepspeed_tpu.runtime.pipe.module import TiedLayerSpec
+    if len(jax.devices()) < n_dev:
+        pytest.skip(f"need {n_dev} devices")
+
+    V, D = 32, 16
+
+    def unembed(module, p, x):
+        return x @ p["embedding"].T
+
+    def build():
+        layers = [TiedLayerSpec("embed", nn.Embed, V, D)] + \
+            [LayerSpec(nn.Dense, D) for _ in range(4)] + \
+            [TiedLayerSpec("embed", nn.Embed, V, D, forward_fn=unembed)]
+        return PipelineModule(layers=layers, partition_method="uniform",
+                              num_microbatches=2)
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randint(0, V, (4, 8)), jnp.int32)
+    y = jnp.asarray(rs.randint(0, V, (4, 8)), jnp.int32)
+
+    def ce(out, y):
+        logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, y[..., None], axis=-1).mean()
+
+    # ---- grad parity: pipe=2 vs sequential, same weights ----
+    seq = build()
+    seq_vars = seq.init(jax.random.PRNGKey(0), x)
+    g_seq = jax.grad(
+        lambda p: ce(seq.apply({"params": p}, x), y))(seq_vars["params"])
+
+    cfg = MeshConfig(pipe=2) if mesh_cfg == "pipe2" \
+        else MeshConfig(pipe=2, data=2)
+    mesh = make_mesh(cfg, devices=jax.devices()[:n_dev])
+    low = build().lower_to_spmd(mesh, num_microbatches=2)
+    low_vars = low.init(jax.random.PRNGKey(0), x)
+    assert "trunk_stages" in low_vars["params"]
+    assert "embed" in low_vars["params"]["tied"]
+    g_pipe = jax.jit(jax.grad(
+        lambda p: ce(low.apply({"params": p}, x), y)))(low_vars["params"])
+
+    # tied gradient: the single shared subtree carries the summed
+    # embedding + unembedding cotangents
+    np.testing.assert_allclose(
+        np.asarray(g_pipe["tied"]["embed"]["embedding"]),
+        np.asarray(g_seq["tied"]["embed"]["embedding"]),
+        rtol=1e-4, atol=1e-5)
+    # trunk gradients match layer-for-layer after unstacking
+    flat = low.unstack_trunk(g_pipe)
+    for i in range(1, 5):
+        np.testing.assert_allclose(
+            np.asarray(flat[f"layer_{i}"]["kernel"]),
+            np.asarray(g_seq[f"layer_{i}"]["kernel"]),
+            rtol=1e-4, atol=1e-5)
+
+    # ---- loss-trajectory parity through the engine ----
+    def run(mesh):
+        pipe = build()
+        engine, _, _, _ = dstpu.initialize(
+            config=base_config(), model=pipe, mesh=mesh,
+            loss_fn=lambda params, batch, rng, keep_prob: ce(
+                pipe.apply({"params": params}, batch[0]), batch[1]))
+        return [float(engine.train_batch((x, y))) for _ in range(6)]
+
+    base = run(make_mesh(MeshConfig(data=1), devices=jax.devices()[:1]))
+    got = run(mesh)
+    assert got[-1] < got[0] - 0.05, got
+    np.testing.assert_allclose(got, base, rtol=2e-3, atol=2e-3)
+
+
 def test_pipeline_lowering_triggers_from_config_mesh():
     """pipe>1 coming from the config's mesh section (no mesh kwarg) must
     still lower the module — not silently train un-pipelined."""
