@@ -29,7 +29,7 @@ class ZeroOffloadConfig:
     """`offload_param` / `offload_optimizer` schema — reference
     zero/offload_config.py."""
 
-    def __init__(self, d):
+    def __init__(self, d, role="optimizer"):
         d = d or {}
         self.device = get_scalar_param(d, C.OFFLOAD_DEVICE, C.OFFLOAD_NONE_DEVICE)
         self.nvme_path = get_scalar_param(d, C.OFFLOAD_NVME_PATH, None)
@@ -40,6 +40,21 @@ class ZeroOffloadConfig:
         self.pipeline_read = bool(get_scalar_param(d, C.OFFLOAD_PIPELINE_READ, False))
         self.pipeline_write = bool(get_scalar_param(d, C.OFFLOAD_PIPELINE_WRITE, False))
         self.fast_init = bool(get_scalar_param(d, C.OFFLOAD_FAST_INIT, False))
+        # TPU extension (offload_optimizer only): how the offloaded
+        # optimizer step executes.
+        #   "auto"   — device-streamed step with state in pinned_host when
+        #              the backend has that memory space (TPU), else host
+        #   "device" — require the streamed path (error if unsupported)
+        #   "host"   — force the numpy/SIMD host runner (reference shape)
+        self.stream = str(get_scalar_param(d, C.OFFLOAD_STREAM, "auto"))
+        if role != "optimizer":
+            if C.OFFLOAD_STREAM in d:
+                raise DeepSpeedConfigError(
+                    "'stream' applies to offload_optimizer only (the param "
+                    "tier is pinned_host/NVMe residency, not a step mode)")
+        elif self.stream not in ("auto", "device", "host"):
+            raise DeepSpeedConfigError(
+                f"offload stream must be auto|device|host, got {self.stream!r}")
 
     @property
     def enabled(self):
@@ -86,8 +101,10 @@ class DeepSpeedZeroConfig:
                                             C.ZERO_CPU_OFFLOAD_DEFAULT))
         cpu_offload_params = bool(get_scalar_param(zero_dict, C.ZERO_CPU_OFFLOAD_PARAMS, False))
 
-        self.offload_param = ZeroOffloadConfig(zero_dict.get(C.ZERO_OFFLOAD_PARAM))
-        self.offload_optimizer = ZeroOffloadConfig(zero_dict.get(C.ZERO_OFFLOAD_OPTIMIZER))
+        self.offload_param = ZeroOffloadConfig(
+            zero_dict.get(C.ZERO_OFFLOAD_PARAM), role="param")
+        self.offload_optimizer = ZeroOffloadConfig(
+            zero_dict.get(C.ZERO_OFFLOAD_OPTIMIZER))
         if cpu_offload and not self.offload_optimizer.enabled:
             self.offload_optimizer.device = C.OFFLOAD_CPU_DEVICE
         if cpu_offload_params and not self.offload_param.enabled:

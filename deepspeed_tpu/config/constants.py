@@ -162,6 +162,8 @@ OFFLOAD_MAX_IN_CPU = "max_in_cpu"
 OFFLOAD_PIPELINE_READ = "pipeline_read"
 OFFLOAD_PIPELINE_WRITE = "pipeline_write"
 OFFLOAD_FAST_INIT = "fast_init"
+# TPU extension: how the offloaded optimizer step executes (offload_stream.py)
+OFFLOAD_STREAM = "stream"
 
 # stage-3 tuning knobs (reference zero/constants.py)
 ZERO_PREFETCH_BUCKET_SIZE = "stage3_prefetch_bucket_size"

@@ -28,6 +28,48 @@ from jax.ad_checkpoint import checkpoint_name
 from deepspeed_tpu.ops.attention import dot_product_attention
 
 
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=None)
+def _embed_lookup_fn(shape, dtype_name):
+    """Token-embedding gather whose backward pins the scatter-add to the
+    vocab-parallel (TP-only) layout. Without the pin, shardy propagates the
+    ZeRO opt-state sharding (data axis on the vocab dim) onto the scatter
+    output while the updates stay batch-sharded — GSPMD then cannot
+    partition the scatter and falls back to involuntary full
+    rematerialization (a whole-cotangent broadcast every step). Pinned to
+    the TP spec, the scatter partitions as masked local updates + a data
+    psum, and the cheap TP→opt reshard happens on the finished gradient."""
+    @jax.custom_vjp
+    def f(wte, ids):
+        return wte[ids]
+
+    def fwd(wte, ids):
+        return wte[ids], ids
+
+    def bwd(ids, g):
+        d = jnp.zeros(shape, g.dtype).at[ids].add(g)
+        from deepspeed_tpu.parallel import mesh as mesh_lib
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = mesh_lib.current_mesh()
+        if mesh is not None:
+            spec = PartitionSpec(mesh_lib.MODEL_AXIS, None) \
+                if mesh.shape.get(mesh_lib.MODEL_AXIS, 1) > 1 \
+                else PartitionSpec()
+            d = jax.lax.with_sharding_constraint(
+                d, NamedSharding(mesh, spec))
+        return d.astype(dtype_name), None
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _embed_lookup(wte, ids):
+    return _embed_lookup_fn(tuple(wte.shape),
+                            jnp.dtype(wte.dtype).name)(wte, ids)
+
+
 @dataclasses.dataclass(frozen=True)
 class GPT2Config:
     vocab_size: int = 50257
@@ -266,7 +308,21 @@ class GPT2LMHeadModel(nn.Module):
                          (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.n_positions, cfg.n_embd), cfg.param_dtype)
-        x = wte[input_ids].astype(cfg.dtype) + wpe[None, :S].astype(cfg.dtype)
+        pos = wpe[:S]
+        from deepspeed_tpu.parallel import mesh as mesh_lib
+        mesh = mesh_lib.current_mesh()
+        if mesh is not None:
+            # pin the position slice replicated AT THE PARAM EDGE (fp32,
+            # before the cast/broadcast): GSPMD otherwise propagates the
+            # batch sharding onto the broadcast's size-1 leading dim and
+            # then cannot reshard to the TP'd wpe gradient's layout without
+            # an involuntary full rematerialization — a whole-tensor
+            # broadcast inside every step on a real mesh
+            from jax.sharding import NamedSharding, PartitionSpec
+            pos = jax.lax.with_sharding_constraint(
+                pos, NamedSharding(mesh, PartitionSpec()))
+        x = _embed_lookup(wte, input_ids).astype(cfg.dtype) \
+            + pos.astype(cfg.dtype)[None]
 
         if cfg.scan_layers:
             scanned = nn.scan(ScanBody,

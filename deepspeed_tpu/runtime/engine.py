@@ -178,14 +178,22 @@ class DeepSpeedEngine:
         self.precision = prec.PrecisionConfig.from_ds_config(self._config)
         param_offload = self._config.zero_config.offload_param
         self._param_offload_host = bool(param_offload.enabled)
+        self._param_offload_nvme = False
+        self._param_swapper = None
+        self._params_parked = False
         if self._param_offload_host:
             from deepspeed_tpu.utils.platform import is_tpu_backend
             if param_offload.device == C.OFFLOAD_NVME_DEVICE:
-                logger.warning(
-                    "offload_param device=nvme: params rest in host DRAM "
-                    "(pinned_host) on TPU; the NVMe tier backs optimizer "
-                    "state via offload_optimizer")
-            if not is_tpu_backend():
+                # ZeRO-Infinity parameter tier: params REST on NVMe and
+                # stream disk -> bounded staging -> HBM around each step
+                # (swap_tensor/PartitionedParamSwapper); they are NOT
+                # pinned_host-resident
+                if not param_offload.nvme_path:
+                    raise ValueError(
+                        "offload_param device=nvme requires nvme_path")
+                self._param_offload_nvme = True
+                self._param_offload_host = False
+            elif not is_tpu_backend():
                 # the CPU PJRT backend advertises pinned_host but aborts
                 # executing programs that move between memory spaces — the
                 # tier is a no-op off-TPU (host RAM is already "host")
@@ -470,6 +478,42 @@ class DeepSpeedEngine:
             f"deepspeed_tpu.models.sharding.register_tp_rules or expose "
             f"param_partition_specs on the model.")
 
+    def _make_offload_runner(self, params):
+        """Pick the offload tier: the device-streamed step (state in the
+        accelerator host's pinned_host memory, update on device —
+        offload_stream.py) when the backend supports it, the numpy/SIMD
+        host runner (offload.py) for NVMe state, LAMB, non-pinned-host
+        backends, or an explicit ``stream: "host"``."""
+        from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+        cfg = self._offload_cfg
+        want_stream = cfg.stream != "host" \
+            and cfg.device == C.OFFLOAD_CPU_DEVICE \
+            and not isinstance(self.optimizer, FusedLamb)
+        if want_stream:
+            from deepspeed_tpu.runtime.zero.offload_stream import (
+                StreamedOffloadOptimizer, backend_supports_pinned_host)
+            if backend_supports_pinned_host(self.mesh.devices.flat[0]):
+                return StreamedOffloadOptimizer(
+                    params, self.optimizer, self.mesh, self.zero)
+            if cfg.stream == "device":
+                raise ValueError(
+                    "offload_optimizer stream='device' requires a backend "
+                    "with a pinned_host memory space")
+            logger.warning("offload: no pinned_host memory space on this "
+                           "backend; using the host runner")
+        elif cfg.stream == "device":
+            raise ValueError(
+                "offload_optimizer stream='device' supports device='cpu' "
+                "with Adam/AdamW only (NVMe state and LAMB run on the host "
+                "runner)")
+        return HostOffloadOptimizer(
+            params, self.optimizer, cfg, self._config.aio_config)
+
+    def _offload_streamed(self):
+        from deepspeed_tpu.runtime.zero.offload_stream import (
+            StreamedOffloadOptimizer)
+        return isinstance(self._host_runner, StreamedOffloadOptimizer)
+
     def _init_state(self, params=None, example_batch=None):
         if params is None:
             x = jnp.asarray(self._model_inputs(example_batch))
@@ -479,10 +523,7 @@ class DeepSpeedEngine:
         if self._offload_cfg.enabled:
             # fp32 master + moments to host/NVMe; device keeps compute-dtype
             # params only (the ZeRO-Offload memory shape)
-            from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
-            self._host_runner = HostOffloadOptimizer(
-                params, self.optimizer, self._offload_cfg,
-                self._config.aio_config)
+            self._host_runner = self._make_offload_runner(params)
             params = jax.tree_util.tree_map(
                 lambda p: jnp.asarray(p, self.precision.compute_dtype)
                 if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else
@@ -517,8 +558,48 @@ class DeepSpeedEngine:
             global_step=repl, skipped_steps=repl)
         self.state = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, s), state, self.state_shardings)
+        if self._param_offload_nvme:
+            from deepspeed_tpu.runtime.swap_tensor import (
+                PartitionedParamSwapper)
+            self._param_swapper = PartitionedParamSwapper(
+                self._config.zero_config.offload_param.nvme_path,
+                self._config.aio_config)
+            self._param_swapper.write_all(
+                jax.tree_util.tree_leaves(self.state.params))
         see_memory_usage("after engine state init",
                          force=self._config.memory_breakdown)
+
+    # -- NVMe parameter residency (ZeRO-Infinity param tier) ---------------
+    def _ensure_params_resident(self):
+        """Parked params (resting on NVMe) stream back to the device before
+        any computation that reads them."""
+        if not self._params_parked:
+            return
+        leaves = self._param_swapper.swap_in_device(
+            jax.tree_util.tree_leaves(self.state_shardings.params))
+        self.state = TrainState(
+            params=jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(self.state_shardings.params),
+                leaves),
+            opt_state=self.state.opt_state, scaler=self.state.scaler,
+            global_step=self.state.global_step,
+            skipped_steps=self.state.skipped_steps)
+        self._params_parked = False
+
+    def _park_params(self):
+        """Write the (updated) device params back to NVMe and free their
+        HBM — params rest on disk between steps, so at rest the chip holds
+        no parameter bytes and host RAM holds only the 2-buffer staging."""
+        if self._param_swapper is None or self._params_parked:
+            return
+        leaves = jax.tree_util.tree_leaves(self.state.params)
+        self._param_swapper.swap_out_device(leaves)
+        for leaf in leaves:
+            try:
+                leaf.delete()
+            except Exception:
+                pass
+        self._params_parked = True
 
     # ------------------------------------------------------------------
     # loss
@@ -1191,6 +1272,7 @@ class DeepSpeedEngine:
                 lambda *xs: np.concatenate([np.asarray(x) for x in xs]), *micro)
         # state init inspects host-side shapes; globalize only after
         self._ensure_ready(batch)
+        self._ensure_params_resident()
         batch = self._globalize_batch(batch)
         if self.flops_profiler is not None:
             self.flops_profiler.maybe_profile(batch)
@@ -1216,6 +1298,7 @@ class DeepSpeedEngine:
         if hasattr(self.lr_scheduler, "step"):
             self.lr_scheduler.step()
         self._moq_boundary(batch, metrics)
+        self._park_params()
         loss = metrics["loss"]
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(loss)
@@ -1324,7 +1407,11 @@ class DeepSpeedEngine:
         stage2.py:679-746); otherwise the accumulation runs fused on
         device and only the final tree transfers."""
         gas = self.gradient_accumulation_steps()
-        if gas > 1 and self._config.zero_config.overlap_comm:
+        if gas > 1 and self._config.zero_config.overlap_comm \
+                and not self._offload_streamed():
+            # host-fold overlap only helps when the step runs on THIS host;
+            # the streamed tier accumulates on device (the gas scan in
+            # accumulate_grads) and never moves gradients off the device
             return self._host_offload_step_overlapped(batch, gas)
         wcb = self.wall_clock_breakdown()
         t0 = time.perf_counter()
@@ -1466,23 +1553,33 @@ class DeepSpeedEngine:
         if clip and clip > 0 and norm > clip:
             coef *= clip / (norm + 1e-6)
 
-        shard_leaves = jax.tree_util.tree_leaves(self.state_shardings.params)
         out_dtype = self.precision.compute_dtype
-        # on the CPU backend device_put ALIASES host memory — the runner's
-        # staging buffers are reused next step, so alias would corrupt the
-        # live params; accelerator backends copy over the wire
-        aliases_host = self.mesh.devices.flat[0].platform == "cpu"
+        if self._offload_streamed():
+            # device-streamed tier: the update runs on the accelerator with
+            # state in pinned_host — gradients never leave the device
+            new_leaves = self._host_runner.step(
+                jax.tree_util.tree_leaves(grads), lr, grad_scale=coef,
+                out_dtype=out_dtype)
+        else:
+            shard_leaves = jax.tree_util.tree_leaves(
+                self.state_shardings.params)
+            # on the CPU backend device_put ALIASES host memory — the
+            # runner's staging buffers are reused next step, so alias would
+            # corrupt the live params; accelerator backends copy over the
+            # wire
+            aliases_host = self.mesh.devices.flat[0].platform == "cpu"
 
-        def push(i, host_arr):
-            # async dispatch: the h2d copy overlaps the remaining leaf steps,
-            # and the next step's jit consumes the futures directly
-            if aliases_host:
-                host_arr = np.array(host_arr, copy=True)
-            return jax.device_put(host_arr, shard_leaves[i])
+            def push(i, host_arr):
+                # async dispatch: the h2d copy overlaps the remaining leaf
+                # steps, and the next step's jit consumes the futures
+                # directly
+                if aliases_host:
+                    host_arr = np.array(host_arr, copy=True)
+                return jax.device_put(host_arr, shard_leaves[i])
 
-        new_leaves = self._host_runner.step_streamed(
-            jax.tree_util.tree_leaves(grads), lr, grad_scale=coef,
-            push_fn=push, out_dtype=out_dtype)
+            new_leaves = self._host_runner.step_streamed(
+                jax.tree_util.tree_leaves(grads), lr, grad_scale=coef,
+                push_fn=push, out_dtype=out_dtype)
         new_params = jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(self.state.params), new_leaves)
         self.state = TrainState(
@@ -1501,6 +1598,7 @@ class DeepSpeedEngine:
         later; under XLA fwd+bwd are one fused program)."""
         # state init inspects host-side shapes; globalize only after
         self._ensure_ready(batch)
+        self._ensure_params_resident()
         batch = self._globalize_batch(batch)
         if self.wall_clock_breakdown():
             self.timers(FORWARD_MICRO_TIMER).start()
@@ -1560,6 +1658,7 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).stop()
         self._moq_boundary(getattr(self, "_moq_batch", None), metrics)
+        self._park_params()
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(metrics["loss"])
 
@@ -1599,6 +1698,7 @@ class DeepSpeedEngine:
                                 skipped_steps=self.state.skipped_steps)
 
     def eval_batch(self, batch):
+        self._ensure_params_resident()
         # state init inspects host-side shapes; globalize only after
         self._ensure_ready(batch)
         batch = self._globalize_batch(batch)
@@ -1678,6 +1778,7 @@ class DeepSpeedEngine:
                         save_latest=True):
         from deepspeed_tpu.runtime import checkpointing as ckpt
         assert self.state is not None, "no state to save"
+        self._ensure_params_resident()
         tag = tag or f"global_step{self.global_steps}"
         self._sync_skipped_steps()
         extra = {
@@ -1784,6 +1885,21 @@ class DeepSpeedEngine:
             self._adopt_loaded_state_offload(template)
         else:
             self._adopt_loaded_state(template)
+        if self._param_offload_nvme:
+            # re-park the LOADED params: the swap files still hold the
+            # pre-load weights, and a parked engine would otherwise swap
+            # the stale copies back in on the next step. Also covers a
+            # fresh engine restoring before any train_batch (no swapper
+            # exists yet — the configured tier must not silently disable).
+            if self._param_swapper is None:
+                from deepspeed_tpu.runtime.swap_tensor import (
+                    PartitionedParamSwapper)
+                self._param_swapper = PartitionedParamSwapper(
+                    self._config.zero_config.offload_param.nvme_path,
+                    self._config.aio_config)
+            self._params_parked = False
+            self._param_swapper.write_all(
+                jax.tree_util.tree_leaves(self.state.params))
         tag = tag or ckpt.read_latest_tag(load_dir)
         self.global_steps = extra.get("global_steps", 0)
         self.micro_steps = extra.get("micro_steps", 0)
@@ -1811,10 +1927,7 @@ class DeepSpeedEngine:
             template, self.state_shardings)
 
     def _adopt_loaded_state_offload(self, template: TrainState):
-        from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
-        self._host_runner = HostOffloadOptimizer(
-            template.params, self.optimizer, self._offload_cfg,
-            self._config.aio_config)
+        self._host_runner = self._make_offload_runner(template.params)
         if template.opt_state:
             self._host_runner.load_state_dict(template.opt_state)
         device_params = jax.tree_util.tree_map(
@@ -1830,5 +1943,6 @@ class DeepSpeedEngine:
     def save_fp16_model(self, save_dir, save_filename="mp_rank_00_model_states.npz"):
         """Gathered model weights only (reference engine.py:1955)."""
         from deepspeed_tpu.runtime import checkpointing as ckpt
+        self._ensure_params_resident()
         os.makedirs(save_dir, exist_ok=True)
         ckpt.save_tree(os.path.join(save_dir, save_filename), self.state.params)

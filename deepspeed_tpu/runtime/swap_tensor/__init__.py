@@ -1,4 +1,5 @@
 from deepspeed_tpu.runtime.swap_tensor.swapper import (
     TensorSwapper,
     OptimizerStateSwapper,
+    PartitionedParamSwapper,
 )
