@@ -89,6 +89,10 @@ class GPT2Config:
     moe_capacity_factor: float = 1.25
     moe_aux_coeff: float = 0.01         # load-balance loss weight
     scan_layers: bool = True
+    # unroll factor for the layer scan: >1 lets XLA fuse/schedule across
+    # adjacent layers and amortizes per-iteration fixed costs at the price
+    # of code size / compile time. Must divide n_layer.
+    scan_unroll: int = 1
     use_flash: Optional[bool] = None   # None = auto (TPU yes)
     tie_word_embeddings: bool = True
     # fused head+loss: when __call__ gets `labels`, compute the LM cross
@@ -329,7 +333,8 @@ class GPT2LMHeadModel(nn.Module):
                               variable_axes={"params": 0, "losses": 0},
                               split_rngs={"params": True, "dropout": True},
                               in_axes=(nn.broadcast, nn.broadcast),
-                              length=cfg.n_layer)
+                              length=cfg.n_layer,
+                              unroll=max(1, cfg.scan_unroll))
             x, _ = scanned(cfg, name="h")(x, deterministic, keep_prob)
         else:
             block = _maybe_remat(cfg)

@@ -25,6 +25,42 @@ def _expand_layout_mask(layout, block, seq_len):
     return jnp.asarray(mask.astype(bool))
 
 
+def _kernel_beats_dense(layout, block, S):
+    """v5e-calibrated crossover: the streaming kernel is DMA-ISSUE bound
+    (~1.4 us per tile copy measured round 4 — compute is ~2% of its
+    runtime), so its cost scales with the ACTIVE BLOCK COUNT, while the
+    masked-dense einsum path scales with S^2 (and runs at roughly 0.4x of
+    dense flash's efficiency: unfused softmax + full score
+    materialization). Comparing the two estimates:
+
+        kernel  ~ 3 passes x active_pairs x 1.4 us (per B*H)
+        dense   ~ S^2 work at the measured einsum rate
+
+    the kernel loses only when the layout is nearly full. Measured sweep
+    (tests/perf/blocksparse_sweep.py, fwd+bwd vs dense FLASH): S=4096
+    block 128/256/512 -> 0.82x/0.92x/1.25x at density .23/.43/.73;
+    S=16384 -> 2.04x/2.78x/2.36x at density .06/.12/.23. The masked
+    einsum is ~2.5x slower than flash, so the kernel wins vs the
+    semantics-preserving dense path at every measured point; this
+    predicate only rejects near-dense layouts where block count
+    approaches (S/block)^2."""
+    nb = S // block
+    density = float(np.asarray(layout)[:, :nb, :nb].mean())
+    # per-(B*H) estimates: 3 kernel passes (fwd, dq, dkv) x issue rate;
+    # masked einsum ~2.5x the measured dense-flash rate of
+    # 0.64 ms / (B*H) at S=4096 => 9.5e-5 us per score element
+    kernel_us = 3 * density * nb * nb * 1.4
+    einsum_us = 9.5e-5 * S * S
+    return kernel_us < einsum_us
+
+
+def _dense_path_fits(layout, S, n_heads, batch):
+    """The masked-dense path materializes [B, H, S, S] scores (bf16 + an
+    fp32 softmax copy) — never send kernel-scale sequences there on a
+    time estimate alone; a slower kernel beats an OOM."""
+    return batch * n_heads * S * S * 6 < 2 << 30
+
+
 def sparse_attention(q, k, v, layout, block, key_padding_mask=None,
                      attn_mask=None, scale=None, use_kernel=None):
     """Masked attention with a static block-sparse layout.
@@ -32,14 +68,21 @@ def sparse_attention(q, k, v, layout, block, key_padding_mask=None,
     q/k/v: [B, H, S, D]. layout: [H, S//block, S//block] ndarray.
     Returns [B, H, S, D]. Differentiable on both paths (the Pallas kernel
     carries a custom VJP — trainable like the reference's Triton op).
-    use_kernel: None = auto (kernel on TPU, dense fallback elsewhere);
+    use_kernel: None = auto — the kernel on TPU unless the calibrated
+    crossover predicts the masked-dense path is faster for this layout
+    (near-full layouts; see _kernel_beats_dense), dense fallback off-TPU;
     True forces the kernel (interpret mode off-TPU — how CI exercises it).
     """
     B, H, S, D = q.shape
     scale = scale if scale is not None else 1.0 / np.sqrt(D)
 
     from deepspeed_tpu.utils.platform import is_tpu_backend
-    use_pallas = is_tpu_backend() if use_kernel is None else use_kernel
+    if use_kernel is None:
+        use_pallas = is_tpu_backend() and (
+            _kernel_beats_dense(layout, block, S)
+            or not _dense_path_fits(layout, S, H, B))
+    else:
+        use_pallas = use_kernel
     if use_pallas:
         try:
             from deepspeed_tpu.ops.pallas.blocksparse import blocksparse_attention

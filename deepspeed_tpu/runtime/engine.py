@@ -51,6 +51,9 @@ STEP_MICRO_TIMER = "step_microstep"
 FORWARD_GLOBAL_TIMER = "forward"
 BACKWARD_GLOBAL_TIMER = "backward"
 STEP_GLOBAL_TIMER = "step"
+# pure readback round-trip measured by the instrumented mode; reported so
+# tunneled/disaggregated deployments can see what the fences cost
+FENCE_TIMER = "fence"
 
 
 @flax.struct.dataclass
@@ -559,13 +562,14 @@ class DeepSpeedEngine:
         self.state = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, s), state, self.state_shardings)
         if self._param_offload_nvme:
+            # the files themselves are first written by the post-step
+            # _park_params — params are device-resident until then, so an
+            # eager write here would be dead work the first park overwrites
             from deepspeed_tpu.runtime.swap_tensor import (
                 PartitionedParamSwapper)
             self._param_swapper = PartitionedParamSwapper(
                 self._config.zero_config.offload_param.nvme_path,
                 self._config.aio_config)
-            self._param_swapper.write_all(
-                jax.tree_util.tree_leaves(self.state.params))
         see_memory_usage("after engine state init",
                          force=self._config.memory_breakdown)
 
@@ -1371,11 +1375,24 @@ class DeepSpeedEngine:
         float(jax.device_get(metrics["grad_norm"]))
         step_s = time.perf_counter() - t0
 
-        self.timers(FORWARD_GLOBAL_TIMER).elapsed_ += fwd_s
+        # each phase fence pays one full readback round trip; on tunneled
+        # backends that RTT is ~100 ms — an order of magnitude above the
+        # apply program itself — so phases must be reported NET of it.
+        # metrics["lr"] is already materialized by the grad_norm fence, so
+        # re-reading it measures the pure RTT (r3's "130 ms optimizer
+        # phase" was ~90 ms of this artifact).
+        t0 = time.perf_counter()
+        float(jax.device_get(metrics["lr"]))
+        fence_s = time.perf_counter() - t0
+
+        self.timers(FORWARD_GLOBAL_TIMER).elapsed_ += \
+            max(fwd_s - fence_s, 0.0)
         # grads program = fwd+bwd fused; report bwd as its excess over fwd
         self.timers(BACKWARD_GLOBAL_TIMER).elapsed_ += \
             max(fwdbwd_s - fwd_s, 0.0)
-        self.timers(STEP_GLOBAL_TIMER).elapsed_ += step_s
+        self.timers(STEP_GLOBAL_TIMER).elapsed_ += \
+            max(step_s - fence_s, 0.0)
+        self.timers(FENCE_TIMER).elapsed_ += fence_s
 
         if self.global_steps % self.steps_per_print() == 0:
             # per-step means over the print interval (reference resets each
@@ -1392,7 +1409,7 @@ class DeepSpeedEngine:
         host optimizer). Empty unless wall_clock_breakdown is enabled."""
         out = {}
         for name in (FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
-                     STEP_GLOBAL_TIMER):
+                     STEP_GLOBAL_TIMER, FENCE_TIMER):
             if name in self.timers.timers:
                 out[name] = self.timers(name).elapsed(reset=reset)
         return out
@@ -1886,9 +1903,10 @@ class DeepSpeedEngine:
         else:
             self._adopt_loaded_state(template)
         if self._param_offload_nvme:
-            # re-park the LOADED params: the swap files still hold the
+            # un-park onto the LOADED params: the swap files still hold
             # pre-load weights, and a parked engine would otherwise swap
-            # the stale copies back in on the next step. Also covers a
+            # the stale copies back in on the next step (the next park
+            # rewrites the files from the loaded weights). Also covers a
             # fresh engine restoring before any train_batch (no swapper
             # exists yet — the configured tier must not silently disable).
             if self._param_swapper is None:
@@ -1898,8 +1916,6 @@ class DeepSpeedEngine:
                     self._config.zero_config.offload_param.nvme_path,
                     self._config.aio_config)
             self._params_parked = False
-            self._param_swapper.write_all(
-                jax.tree_util.tree_leaves(self.state.params))
         tag = tag or ckpt.read_latest_tag(load_dir)
         self.global_steps = extra.get("global_steps", 0)
         self.micro_steps = extra.get("micro_steps", 0)

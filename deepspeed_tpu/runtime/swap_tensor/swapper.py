@@ -9,9 +9,9 @@ tensor while the caller consumes the current one — the reference's
 pipelined swapper overlap, pipelined_optimizer_swapper.py:60).
 """
 
-import atexit
 import os
 import shutil
+import weakref
 
 import numpy as np
 
@@ -40,8 +40,11 @@ class TensorSwapper:
         self.handle = _make_aio_handle(aio_config)
         self._pending_read = None  # (name, buffer, fd)
         # swap files are pid-scoped scratch — reclaim the NVMe space when
-        # the process exits (model-sized garbage otherwise accumulates)
-        atexit.register(self.release)
+        # the swapper is garbage-collected or the process exits (a weakref
+        # finalizer, unlike atexit.register(self.release), does not pin
+        # the instance and its staging buffers for the process lifetime)
+        self._finalizer = weakref.finalize(
+            self, shutil.rmtree, self.dir, ignore_errors=True)
 
     def _path(self, name):
         return os.path.join(self.dir, f"{name}.swp")
@@ -157,7 +160,8 @@ class PartitionedParamSwapper:
         self.handle = _make_aio_handle(aio_config)
         self.meta = {}            # leaf idx -> (shape, numpy dtype)
         self._staging = [None, None]
-        atexit.register(self.release)
+        self._finalizer = weakref.finalize(
+            self, shutil.rmtree, self.dir, ignore_errors=True)
 
     def _path(self, i):
         return os.path.join(self.dir, f"param_{i}.swp")
@@ -196,6 +200,11 @@ class PartitionedParamSwapper:
             self.handle.async_pread(buf, fds[i])
             return buf
 
+        # CPU device_put aliases host memory — a reused staging buffer
+        # would corrupt the "device" params. Decide from the TARGET
+        # devices (an engine may run a CPU mesh under a TPU default)
+        aliases_host = n > 0 and \
+            shardings[0].mesh.devices.flat[0].platform == "cpu"
         pending_buf = start_read(0) if n else None
         for i in range(n):
             buf = pending_buf
@@ -204,11 +213,7 @@ class PartitionedParamSwapper:
             shape, dtype = self.meta[i]
             arr = buf[:int(np.prod(shape or (1,))) * dtype.itemsize] \
                 .view(dtype).reshape(shape)
-            host_arr = arr
-            if jax.devices()[0].platform == "cpu":
-                # CPU backend device_put aliases host memory — a reused
-                # staging buffer would corrupt the "device" params
-                host_arr = np.array(arr, copy=True)
+            host_arr = np.array(arr, copy=True) if aliases_host else arr
             outs[i] = jax.device_put(host_arr, shardings[i])
             if i + 1 < n:
                 # the next read lands in buffer (i+1)%2 — leaf i-1's async
@@ -234,18 +239,6 @@ class PartitionedParamSwapper:
             arr = np.ascontiguousarray(np.asarray(leaf))
             self.meta[i] = (arr.shape, arr.dtype)
             self.handle.sync_pwrite(self._as_bytes(arr), self._path(i))
-
-    def read_all_np(self):
-        """disk → numpy leaves (checkpoint interop; off the step path)."""
-        out = []
-        for i in range(len(self.meta)):
-            shape, dtype = self.meta[i]
-            arr = np.empty(shape, dtype)
-            self.handle.sync_pread(
-                arr.view(np.uint8).reshape(-1) if arr.size else arr,
-                self._path(i))
-            out.append(arr)
-        return out
 
     def release(self):
         shutil.rmtree(self.dir, ignore_errors=True)

@@ -46,10 +46,26 @@ def _run_case(handle, arr, path, write_first=True):
 
 
 def quick_throughput(mb=256, directory=None, queue_depth=32,
-                     block_size=1 << 20):
-    """Single-point MB/s for bench.py: best backend, one size. Returns a
-    dict {backend, write_mbps, read_mbps, mb} or None if the native lib is
-    unavailable."""
+                     block_size=1 << 20, trials=3):
+    """Pinned-methodology MB/s point for bench.py.
+
+    Round-3 postmortem: a single write+read pass is measuring LUCK on a
+    virtualized disk — the guest-side fadvise(DONTNEED) drops the guest
+    page cache but cannot touch the virtio host's cache, so one-shot read
+    numbers swing 20x (43.9 vs 950 MB/s across r3 runs) with host-cache
+    state. Two pinned numbers instead:
+
+    - ``read_mbps`` / ``write_mbps``: MEDIAN of ``trials`` passes — the
+      steady-state tier. This is the number the swap tier actually sees:
+      ZeRO-Infinity re-reads the same optimizer-state files every step,
+      so steady-state (host-cache-assisted) behavior is the
+      representative regime, not an anomaly.
+    - ``first_read_mbps``: the cold first pass, reported separately (the
+      restart/first-touch case).
+
+    All knob values ride along so the number is reproducible. Returns
+    None if the native lib is unavailable.
+    """
     try:
         from deepspeed_tpu.ops.native.aio import AsyncIOHandle
         handle = AsyncIOHandle(block_size=block_size, queue_depth=queue_depth,
@@ -59,9 +75,22 @@ def quick_throughput(mb=256, directory=None, queue_depth=32,
     arr = np.random.randint(0, 255, size=mb << 20, dtype=np.uint8)
     path = tempfile.mktemp(dir=directory, suffix=".aio")
     try:
-        w, r = _run_case(handle, arr, path)
-        return {"backend": handle.backend, "write_mbps": round(w, 1),
-                "read_mbps": round(r, 1), "mb": mb}
+        ws, rs = [], []
+        for _ in range(trials):
+            w, r = _run_case(handle, arr, path)
+            ws.append(w)
+            rs.append(r)
+        return {"backend": handle.backend,
+                "write_mbps": round(float(np.median(ws)), 1),
+                "read_mbps": round(float(np.median(rs)), 1),
+                "first_read_mbps": round(rs[0], 1),
+                "mb": mb, "trials": trials,
+                "queue_depth": queue_depth,
+                "block_kb": block_size >> 10,
+                "cache_note": "guest page cache dropped (fsync+fadvise) "
+                              "each pass; virtio host cache uncontrollable "
+                              "from the guest — median == steady-state "
+                              "(the swap tier's every-step re-read regime)"}
     finally:
         if os.path.exists(path):
             os.unlink(path)

@@ -1,0 +1,80 @@
+"""Block-sparse vs dense flash at S=4096: block-size sweep.
+
+The r3 streaming kernel is DMA-issue-bound (~0.7M tile issues/s); bigger
+layout blocks cut the issue count quadratically per coverage while the
+per-issue bytes grow linearly — the lever the VERDICT asks to try before
+conceding a density crossover.
+
+Run: python -m tests.perf.blocksparse_sweep
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.sparse_attention import BigBirdSparsityConfig
+    from deepspeed_tpu.ops.pallas.blocksparse import blocksparse_attention
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+    H, D = 16, 64
+    for S, B in ((4096, 4), (16384, 1)):
+        rng = jax.random.PRNGKey(0)
+        q, k, v = (jax.random.normal(jax.random.fold_in(rng, i),
+                                     (B, H, S, D), jnp.bfloat16) * 0.3
+                   for i in range(3))
+
+        def timed(fn):
+            g = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
+            r = g(q, k, v)
+            float(jax.device_get(r[0].astype(jnp.float32).sum()))
+            best = 1e9
+            for _ in range(2):
+                t0 = time.perf_counter()
+                for _ in range(5):
+                    r = g(q, k, v)
+                float(jax.device_get(r[0].astype(jnp.float32).sum()))
+                best = min(best, (time.perf_counter() - t0) / 5)
+            return best * 1000
+
+        dn = timed(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=False).astype(jnp.float32) ** 2))
+        print(json.dumps({"S": S, "dense_flash_ms": round(dn, 2)}))
+
+        for block in (128, 256, 512):
+            if S % block:
+                continue
+            cfg = BigBirdSparsityConfig(
+                num_heads=1, block=block, num_random_blocks=1,
+                num_sliding_window_blocks=3, num_global_blocks=1)
+            np.random.seed(0)
+            try:
+                layout = cfg.make_layout(S)
+            except Exception as e:
+                print(json.dumps({"S": S, "block": block,
+                                  "error": str(e)[:120]}))
+                continue
+            density = float(layout[0].mean())
+
+            def sp(qq, kk, vv, layout=layout, block=block):
+                return jnp.sum(blocksparse_attention(
+                    qq, kk, vv, layout, block).astype(jnp.float32) ** 2)
+
+            try:
+                ms = timed(sp)
+            except Exception as e:
+                print(json.dumps({"S": S, "block": block,
+                                  "error": str(e)[:120]}))
+                continue
+            print(json.dumps({
+                "S": S, "block": block, "density": round(density, 3),
+                "sparse_ms": round(ms, 2),
+                "speedup_vs_dense": round(dn / ms, 2)}))
+
+
+if __name__ == "__main__":
+    main()

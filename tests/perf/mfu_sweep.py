@@ -1,0 +1,81 @@
+"""Headline-MFU experiment harness: one 774M config per invocation.
+
+Usage: python tests/perf/mfu_sweep.py [bs] [policy] [loss_chunk] [flags...]
+Flags: param_bf16 (store params in bf16; fp32 master lives in the
+optimizer), gas2 (gradient accumulation 2).
+Prints one JSON line with step time + MFU so sweeps are scriptable.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    bs = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    policy = sys.argv[2] if len(sys.argv) > 2 else "dots_flash_fc_lean"
+    loss_chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+    flags = set(sys.argv[4:])
+
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as dstpu
+    from bench import model_flops_per_token, peak_flops, _enable_compile_cache
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+
+    _enable_compile_cache()
+    dev = jax.devices()[0]
+    mesh = make_mesh(MeshConfig(data=1), devices=[dev])
+    seq = 1024
+    model_cfg = GPT2Config(
+        vocab_size=50304, n_positions=seq, n_embd=1280, n_layer=36,
+        n_head=20, dtype=jnp.bfloat16,
+        param_dtype=jnp.bfloat16 if "param_bf16" in flags else jnp.float32,
+        scan_layers=True, remat=True,
+        remat_policy=None if policy == "none" else policy,
+        scan_unroll=4 if "unroll4" in flags else (2 if "unroll2" in flags else 1),
+        loss_chunk=loss_chunk)
+    cfg = {
+        "train_batch_size": bs,
+        "gradient_accumulation_steps": 2 if "gas2" in flags else 1,
+        "zero_optimization": {"stage": 3},
+        "bf16": {"enabled": True},
+        "data_types": {"grad_dtype": "bf16"},
+        "gradient_clipping": 1.0,
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01,
+                                 "moment_dtype": "bf16"}},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = dstpu.initialize(
+        config=cfg, model=GPT2LMHeadModel(model_cfg), mesh=mesh)
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, 50304, size=(bs, seq))
+             .astype(np.int32)}
+    t0 = time.perf_counter()
+    for _ in range(2):
+        loss = engine.train_batch(batch)
+    float(jax.device_get(loss))
+    compile_s = time.perf_counter() - t0
+    iters = 12
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = engine.train_batch(batch)
+        float(jax.device_get(loss))
+        best = min(best, (time.perf_counter() - t0) / iters)
+    flops = model_flops_per_token(model_cfg) * bs * seq
+    mfu = flops / best / peak_flops(dev)
+    print(json.dumps({
+        "bs": bs, "policy": policy, "loss_chunk": loss_chunk,
+        "flags": sorted(flags), "step_ms": round(best * 1000, 2),
+        "mfu_pct": round(mfu * 100, 2), "compile_s": round(compile_s, 1),
+        "loss": float(jax.device_get(loss))}))
+
+
+if __name__ == "__main__":
+    main()

@@ -376,3 +376,27 @@ def test_blocksparse_grad_long_sequence():
         arr = np.asarray(gi)
         assert np.isfinite(arr).all()
         assert np.abs(arr).max() > 0
+
+
+def test_kernel_crossover_predicate():
+    """Auto mode must reject the kernel for near-dense layouts (the
+    issue-bound kernel loses to the masked-dense path there) and keep it
+    for genuinely sparse ones — the v4 crossover calibration."""
+    from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+        _kernel_beats_dense)
+    S, block = 4096, 128
+    nb = S // block
+    sparse_layout = np.zeros((1, nb, nb), np.int64)
+    for i in range(nb):
+        sparse_layout[0, i, max(0, i - 1):i + 2] = 1   # ~3-wide window
+    assert _kernel_beats_dense(sparse_layout, block, S)
+    dense_layout = np.ones((1, nb, nb), np.int64)
+    assert not _kernel_beats_dense(dense_layout, block, S)
+    # the 16k BigBird regime (density ~0.06) must stay on the kernel
+    from deepspeed_tpu.ops.sparse_attention import BigBirdSparsityConfig
+    cfg = BigBirdSparsityConfig(num_heads=1, block=128,
+                                num_random_blocks=1,
+                                num_sliding_window_blocks=3,
+                                num_global_blocks=1)
+    np.random.seed(0)
+    assert _kernel_beats_dense(cfg.make_layout(16384), 128, 16384)
