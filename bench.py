@@ -391,12 +391,16 @@ def bench_decode(jnp):
     import time
     import jax
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
-    from deepspeed_tpu.models.gpt2_inference import generate
-
+    from deepspeed_tpu.models.gpt2_inference import (
+        generate, convert_gpt2_params, quantize_gpt2_inference_params)
     out = {}
     cases = (
         # latency case: scan decode (one dispatch for the whole loop)
         ("b1_ctx2048", 1, 2048, dict(scan_decode=True)),
+        # latency case, int8 weights + int8 KV (head-major cache): the
+        # serving recipe — weight reads and cache reads both halve
+        ("b1_ctx2048_int8", 1, 2048,
+         dict(scan_decode=True, quantize_bits=8, kv_cache_bits=8)),
         # throughput, bf16 cache: ~6 GB of KV can't afford the scan
         # carry's double buffer, so per-token step loop
         ("b32_ctx512", 32, 512, dict(scan_decode=False)),
@@ -413,6 +417,9 @@ def bench_decode(jnp):
         prompt = rng.randint(0, 50304, size=(bs, ctx - 80)).astype(np.int32)
         params = jax.jit(GPT2LMHeadModel(cfg).init)(
             jax.random.PRNGKey(0), prompt[:, :8])["params"]
+        if kw.get("quantize_bits"):
+            params = quantize_gpt2_inference_params(
+                convert_gpt2_params(params, cfg))
 
         def run(new):
             toks = generate(cfg, params, prompt, max_new_tokens=new,

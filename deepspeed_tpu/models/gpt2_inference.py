@@ -85,11 +85,17 @@ class GPT2InferenceModel(nn.Module):
         x = wte[input_ids].astype(cfg.dtype) \
             + wpe[pos][None].astype(cfg.dtype)
 
+        # unroll the layer scan (GPT2Config.scan_unroll): decode ticks are
+        # ~15 small ops per layer, so per-iteration fixed costs are a real
+        # fraction of the token; unrolling also lets XLA fuse elementwise
+        # chains across layers. Measured serving-config dependent (r4
+        # ablation) — the serving entry points pick their measured best.
         scanned = nn.scan(_ScanInferenceLayer,
                           variable_axes={"params": 0, "cache": 0},
                           split_rngs={"params": True},
                           in_axes=(nn.broadcast,),
-                          length=cfg.n_layer)
+                          length=cfg.n_layer,
+                          unroll=max(1, cfg.scan_unroll))
         x, _ = scanned(icfg, name="h")(x, None)
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,

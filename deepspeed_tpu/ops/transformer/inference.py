@@ -6,9 +6,11 @@ TPU design:
 
 - One flax module serves both phases the CUDA path special-cases: full-context
   ("prompt") processing and incremental single-token decode with a KV cache.
-  The cache is flax's standard ``cache`` variable collection — static shapes
-  ([B, max_out_tokens, H, D]) so the decode step compiles once and XLA keeps
-  it resident in HBM.
+  The cache is flax's standard ``cache`` variable collection — static
+  HEAD-MAJOR shapes ([B, H, max_out_tokens, D]) so the decode step compiles
+  once, XLA keeps it resident in HBM, and the decode contraction is a
+  (B,H)-batched dot_general with L on the lane axis (the [B, L, H, D]
+  einsum form measured 3.7x over the read bound; docs/perf_tuning.md r4).
 - The CUDA custom GEMM + fused softmax (custom_gemm.cu, softmax.cu) become
   MXU matmuls with XLA-fused masking; decode attention is one [B,H,1,L]
   score row against the cache — bandwidth-bound, which HBM handles natively.
@@ -189,12 +191,13 @@ class DeepSpeedTransformerInference(nn.Module):
             x = nn.LayerNorm(**ln_kw, name="norm_w")(x + ffn(x))
         return x
 
-    def _cache_int8(self, k, v, B, L, H, D):
-        """int8 KV cache write (kv_cache_bits=8): returns codes + scales;
-        the caller keeps the contractions in the int8 domain so the full-
-        precision cache is never re-materialized (the scales are constant
-        along D and factor out of both einsums)."""
-        S = k.shape[1]
+    def _cache_int8(self, kh, vh, B, L, H, D):
+        """int8 KV cache write (kv_cache_bits=8) in the head-major
+        [B, H, L, D] layout: returns codes + scales; the caller keeps the
+        contractions in the int8 domain so the full-precision cache is
+        never re-materialized (the scales are constant along D and factor
+        out of both contractions)."""
+        S = kh.shape[2]
 
         def quant(t):
             scale = jnp.max(jnp.abs(t.astype(jnp.float32)), axis=-1) / 127.0
@@ -204,26 +207,26 @@ class DeepSpeedTransformerInference(nn.Module):
             return codes.astype(jnp.int8), scale
 
         ck = self.variable("cache", "cached_key_q8",
-                           jnp.zeros, (B, L, H, D), jnp.int8)
+                           jnp.zeros, (B, H, L, D), jnp.int8)
         cv = self.variable("cache", "cached_value_q8",
-                           jnp.zeros, (B, L, H, D), jnp.int8)
+                           jnp.zeros, (B, H, L, D), jnp.int8)
         ks = self.variable("cache", "key_scale",
-                           jnp.zeros, (B, L, H), jnp.float32)
+                           jnp.zeros, (B, H, L), jnp.float32)
         vs = self.variable("cache", "value_scale",
-                           jnp.zeros, (B, L, H), jnp.float32)
+                           jnp.zeros, (B, H, L), jnp.float32)
         idx = self.variable("cache", "cache_index",
                             lambda: jnp.zeros((), jnp.int32))
         start = idx.value
-        kq, ksc = quant(k)
-        vq, vsc = quant(v)
+        kq, ksc = quant(kh)
+        vq, vsc = quant(vh)
         ck.value = jax.lax.dynamic_update_slice(ck.value, kq,
-                                                (0, start, 0, 0))
+                                                (0, 0, start, 0))
         cv.value = jax.lax.dynamic_update_slice(cv.value, vq,
-                                                (0, start, 0, 0))
+                                                (0, 0, start, 0))
         ks.value = jax.lax.dynamic_update_slice(ks.value, ksc,
-                                                (0, start, 0))
+                                                (0, 0, start))
         vs.value = jax.lax.dynamic_update_slice(vs.value, vsc,
-                                                (0, start, 0))
+                                                (0, 0, start))
         idx.value = start + S
         return ck.value, cv.value, ks.value, vs.value, start
 
@@ -239,24 +242,31 @@ class DeepSpeedTransformerInference(nn.Module):
              self.has_variable("cache", "cached_key_q8") or
              self.is_mutable_collection("cache"))
         if use_cache:
+            # HEAD-MAJOR cache layout [B, H, L, D]: the decode contraction
+            # becomes a (B,H)-batched dot_general with L on the lane axis —
+            # measured 0.57 ms/token at the read bound for 36 layers where
+            # the [B, L, H, D] einsum form cost 2.13 ms (r4 ablation,
+            # docs/perf_tuning.md)
             L = cfg.max_out_tokens
+            kh = k.transpose(0, 2, 1, 3)
+            vh = v.transpose(0, 2, 1, 3)
             kv_scales = None
             if cfg.kv_cache_bits == 8:
                 k_all, v_all, k_scale, v_scale, start = self._cache_int8(
-                    k, v, B, L, H, D)
+                    kh, vh, B, L, H, D)
                 kv_scales = (k_scale, v_scale)
             else:
                 ck = self.variable("cache", "cached_key",
-                                   jnp.zeros, (B, L, H, D), k.dtype)
+                                   jnp.zeros, (B, H, L, D), k.dtype)
                 cv = self.variable("cache", "cached_value",
-                                   jnp.zeros, (B, L, H, D), v.dtype)
+                                   jnp.zeros, (B, H, L, D), v.dtype)
                 idx = self.variable("cache", "cache_index",
                                     lambda: jnp.zeros((), jnp.int32))
                 start = idx.value
                 ck.value = jax.lax.dynamic_update_slice(
-                    ck.value, k, (0, start, 0, 0))
+                    ck.value, kh, (0, 0, start, 0))
                 cv.value = jax.lax.dynamic_update_slice(
-                    cv.value, v, (0, start, 0, 0))
+                    cv.value, vh, (0, 0, start, 0))
                 idx.value = start + S
                 k_all, v_all = ck.value, cv.value
             # overflow guard: dynamic_update_slice clamps the write offset,
@@ -269,29 +279,33 @@ class DeepSpeedTransformerInference(nn.Module):
             q_pos = start + jnp.arange(S)[:, None]
             k_pos = jnp.arange(L)[None, :]
             visible = k_pos <= q_pos                       # [S, L]
+            qh = q.transpose(0, 2, 1, 3)                   # (B,H,S,D)
+            dn_qk = (((3,), (3,)), ((0, 1), (0, 1)))       # contract D
             if kv_scales is not None:
                 # int8 domain: scales are constant along D, so they factor
                 # out — the contraction reads 1 byte/element and the full-
                 # precision cache is never materialized
                 k_scale, v_scale = kv_scales
-                scores = jnp.einsum("bshd,blhd->bhsl", q,
-                                    k_all.astype(q.dtype)).astype(
-                    jnp.float32)
-                scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, :] \
-                    * scale
+                scores = jax.lax.dot_general(
+                    qh, k_all.astype(q.dtype), dn_qk).astype(jnp.float32)
+                scores = scores * k_scale[:, :, None, :] * scale
             else:
-                scores = jnp.einsum("bshd,blhd->bhsl", q, k_all).astype(
-                    jnp.float32) * scale
+                scores = jax.lax.dot_general(
+                    qh, k_all, dn_qk).astype(jnp.float32) * scale
             scores = jnp.where(visible[None, None], scores,
                                jnp.float32(-1e30))
             if attention_mask is not None:
                 scores = scores + _as_bias(attention_mask, L)
             probs = jax.nn.softmax(scores, axis=-1)
+            dn_pv = (((3,), (2,)), ((0, 1), (0, 1)))       # contract L
             if kv_scales is not None:
-                probs = probs * v_scale.transpose(0, 2, 1)[:, :, None, :]
-                return jnp.einsum("bhsl,blhd->bshd", probs.astype(q.dtype),
-                                  v_all.astype(q.dtype))
-            return jnp.einsum("bhsl,blhd->bshd", probs.astype(q.dtype), v_all)
+                probs = probs * v_scale[:, :, None, :]
+                ctx = jax.lax.dot_general(
+                    probs.astype(q.dtype), v_all.astype(q.dtype), dn_pv)
+            else:
+                ctx = jax.lax.dot_general(probs.astype(q.dtype), v_all,
+                                          dn_pv)
+            return ctx.transpose(0, 2, 1, 3)               # (B,S,H,D)
 
         # no cache: route through the shared attention dispatch so encoder
         # inference gets the Pallas flash kernel on TPU when unmasked
