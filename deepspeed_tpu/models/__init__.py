@@ -17,3 +17,11 @@ from deepspeed_tpu.models.bert import (
     bert_base,
     bert_large,
 )
+from deepspeed_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    llama_tiny,
+    llama_7b,
+    llama3_8b,
+    from_hf_llama,
+)

@@ -31,6 +31,31 @@ from deepspeed_tpu.ops.attention import dot_product_attention
 import functools as _functools
 
 
+def _gspmd_mesh():
+    """Mesh for the model's GSPMD layout pins (wpe slice, wte scatter),
+    or None when pins must not apply. The mesh comes from the ENGINE's
+    trace-scoped mesh_lib.layout_pins(...) — never the ambient registry:
+    set_current_mesh outlives its engine, and a later trace (another
+    engine, the pipeline executor, a bare-model test) constraining to a
+    stale foreign-device mesh crashes GSPMD (the r4 full-suite abort).
+    Pins are also off inside explicit-comm (shard_map) programs, where
+    data is already device-local and a NamedSharding over the global
+    (Auto-axis) mesh poisons downstream avals — the engine flags those
+    via no_layout_pins() because trace-context sniffing is unreliable
+    (custom_vjp backwards re-trace under whatever mesh context is live
+    at transpose time); the Manual axis check additionally catches
+    direct shard_map use of the model."""
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    from jax.sharding import get_abstract_mesh, AxisType
+    mesh = mesh_lib.pinned_mesh()
+    if mesh is None:
+        return None
+    am = get_abstract_mesh()
+    if any(t == AxisType.Manual for t in getattr(am, "axis_types", ())):
+        return None
+    return mesh
+
+
 @_functools.lru_cache(maxsize=None)
 def _embed_lookup_fn(shape, dtype_name):
     """Token-embedding gather whose backward pins the scatter-add to the
@@ -52,7 +77,11 @@ def _embed_lookup_fn(shape, dtype_name):
         d = jnp.zeros(shape, g.dtype).at[ids].add(g)
         from deepspeed_tpu.parallel import mesh as mesh_lib
         from jax.sharding import NamedSharding, PartitionSpec
-        mesh = mesh_lib.current_mesh()
+        # the engine's layout_pins context is a PYTHON-call-scoped flag,
+        # so it is still live however/whenever jax re-traces this
+        # backward (custom_vjp backwards re-trace under arbitrary mesh
+        # contexts at transpose time — context sniffing here misfires)
+        mesh = mesh_lib.pinned_mesh()
         if mesh is not None:
             spec = PartitionSpec(mesh_lib.MODEL_AXIS, None) \
                 if mesh.shape.get(mesh_lib.MODEL_AXIS, 1) > 1 \
@@ -313,8 +342,7 @@ class GPT2LMHeadModel(nn.Module):
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.n_positions, cfg.n_embd), cfg.param_dtype)
         pos = wpe[:S]
-        from deepspeed_tpu.parallel import mesh as mesh_lib
-        mesh = mesh_lib.current_mesh()
+        mesh = _gspmd_mesh()
         if mesh is not None:
             # pin the position slice replicated AT THE PARAM EDGE (fp32,
             # before the cast/broadcast): GSPMD otherwise propagates the

@@ -30,8 +30,10 @@ from deepspeed_tpu.ops.transformer.inference import (
 def inference_config(cfg: GPT2Config, max_out_tokens: int = 0,
                      dtype=None, quantize_bits: int = 0,
                      quantize_groups: int = 1,
-                     kv_cache_bits: int = 0) -> DeepSpeedInferenceConfig:
+                     kv_cache_bits: int = 0,
+                     mp_size: int = 1) -> DeepSpeedInferenceConfig:
     return DeepSpeedInferenceConfig(
+        mp_size=mp_size,
         hidden_size=cfg.n_embd,
         heads=cfg.n_head,
         layer_norm_eps=cfg.layer_norm_epsilon,
@@ -68,6 +70,7 @@ class GPT2InferenceModel(nn.Module):
     quantize_bits: int = 0      # int8-storage serving (4x weight memory)
     quantize_groups: int = 1
     kv_cache_bits: int = 0      # int8 KV cache (2x cache memory vs bf16)
+    mp_size: int = 1            # model-axis TP shards (reference mp_size)
 
     @nn.compact
     def __call__(self, input_ids, position_offset=0):
@@ -75,7 +78,8 @@ class GPT2InferenceModel(nn.Module):
         icfg = inference_config(cfg, self.max_out_tokens,
                                 quantize_bits=self.quantize_bits,
                                 quantize_groups=self.quantize_groups,
-                                kv_cache_bits=self.kv_cache_bits)
+                                kv_cache_bits=self.kv_cache_bits,
+                                mp_size=self.mp_size)
         B, S = input_ids.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
@@ -150,19 +154,22 @@ _STEP_CACHE = {}
 
 
 def _compiled_steps(cfg: GPT2Config, max_out: int, quantize_bits: int = 0,
-                    quantize_groups: int = 1, kv_cache_bits: int = 0):
+                    quantize_groups: int = 1, kv_cache_bits: int = 0,
+                    mp_size: int = 1):
     """(prompt_pass, decode_step, decode_scan) jitted once per (config,
     cache length) — repeated generate() calls hit jit's cache instead of
     retracing the whole model per request. decode_scan additionally
     recompiles per distinct step COUNT (its scan length is static);
     callers generating many different lengths should bucket them or use
     the per-token decode_step path (generate(..., scan_decode=False))."""
-    key = (cfg, max_out, quantize_bits, quantize_groups, kv_cache_bits)
+    key = (cfg, max_out, quantize_bits, quantize_groups, kv_cache_bits,
+           mp_size)
     if key not in _STEP_CACHE:
         model = GPT2InferenceModel(cfg, max_out_tokens=max_out,
                                    quantize_bits=quantize_bits,
                                    quantize_groups=quantize_groups,
-                                   kv_cache_bits=kv_cache_bits)
+                                   kv_cache_bits=kv_cache_bits,
+                                   mp_size=mp_size)
 
         @jax.jit
         def prompt_pass(p, ids):
@@ -222,10 +229,40 @@ def quantize_gpt2_inference_params(iparams, groups: int = 1):
     return quantize_inference_params(iparams, bits=8, groups=groups)
 
 
+
+
+def gpt2_inference_tp_specs(iparams):
+    """PartitionSpec tree for mp_size-sharded GPT-2 serving over the mesh
+    'model' axis (the reference's module_inject mp_size sharding,
+    replace_module.py:16-17), extended to the scan-stacked [L, ...] leaf
+    layout this model uses: qkv + FFN-in column-parallel, output
+    projections row-parallel, embeddings/norms/scales replicated. Works
+    for both bf16 (`kernel`) and int8-storage (`kernel_q`) trees."""
+    from deepspeed_tpu.parallel.mesh import MODEL_AXIS
+    from jax.sharding import PartitionSpec as P
+
+    def leaf_spec(path, leaf):
+        names = [str(getattr(k, "key", k)) for k in path]
+        nd = getattr(leaf, "ndim", 0)
+        col = any(n in ("attn_qkvw", "inter_w") for n in names)
+        row = any(n in ("attn_ow", "output_w") for n in names)
+        last = names[-1] if names else ""
+        if last in ("kernel", "kernel_q") and nd >= 2:
+            if col:
+                return P(*([None] * (nd - 1) + [MODEL_AXIS]))
+            if row:
+                return P(*([None] * (nd - 2) + [MODEL_AXIS, None]))
+        if last == "bias" and col and nd >= 1:
+            return P(*([None] * (nd - 1) + [MODEL_AXIS]))
+        return P()
+    return jax.tree_util.tree_map_with_path(leaf_spec, iparams)
+
+
 def generate(cfg: GPT2Config, params, input_ids, max_new_tokens=20,
              temperature: float = 0.0, rng=None, max_out_tokens: int = 0,
              quantize_bits: int = 0, quantize_groups: int = 1,
-             kv_cache_bits: int = 0, scan_decode: bool = True):
+             kv_cache_bits: int = 0, scan_decode: bool = True,
+             mesh=None):
     """KV-cache generation. ``temperature == 0`` → greedy. Returns
     [B, S + max_new_tokens] token ids.
 
@@ -248,11 +285,29 @@ def generate(cfg: GPT2Config, params, input_ids, max_new_tokens=20,
         f"n_positions {cfg.n_positions}")
     max_out = max_out_tokens or cfg.n_positions
     assert total <= max_out, (total, max_out)
+    # mp_size serving (reference module_inject mp_size): layer weights
+    # shard over the mesh model axis; GSPMD propagates the head sharding
+    # onto the KV caches and inserts the row-parallel psums
+    mp_size = 1
+    if mesh is not None:
+        from deepspeed_tpu.parallel.mesh import MODEL_AXIS
+        mp_size = int(mesh.shape.get(MODEL_AXIS, 1))
+        if mp_size > 1:
+            assert cfg.n_head % mp_size == 0, (
+                f"n_head {cfg.n_head} must divide over the model axis "
+                f"({mp_size} shards)")
     prompt_pass, decode_step, decode_scan = _compiled_steps(
-        cfg, max_out, quantize_bits, quantize_groups, kv_cache_bits)
+        cfg, max_out, quantize_bits, quantize_groups, kv_cache_bits,
+        mp_size)
     converted = "h" in params and "blk" in params.get("h", {}) and \
         any(k in params["h"]["blk"] for k in ("attn_qkvw",))
     iparams = params if converted else convert_gpt2_params(params, cfg)
+    if mp_size > 1:
+        from jax.sharding import NamedSharding
+        specs = gpt2_inference_tp_specs(iparams)
+        iparams = jax.device_put(
+            iparams, jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), specs))
 
     def pick(logits, r):
         if temperature and temperature > 0:

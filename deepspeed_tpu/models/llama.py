@@ -1,0 +1,351 @@
+"""LLaMA family — RoPE + RMSNorm + SwiGLU + grouped-query attention,
+TPU-first.
+
+The reference serves/trains LLaMA through HF + module injection
+(deepspeed/module_inject/containers/llama.py); here the family is
+in-tree flax with the same TPU design as the GPT-2 flagship
+(models/gpt2.py): bf16 activations over fp32 masters, `nn.scan` layers,
+remat with the SAME named-residual policies ("qkv"/"attn_proj"/
+"mlp_fc"/"mlp_proj" + the flash kernel's "flash_o"/"flash_lse" — so
+every GPT2Config remat_policy string works unchanged), Pallas flash
+attention, fused chunked head+loss, and sequence parallelism over a
+live mesh seq axis (ring or Ulysses).
+
+GQA: ``n_kv_heads < n_heads`` stores/computes K/V at the reduced head
+count and repeats them to full heads for the attention kernel — the
+repeat stays on-chip and XLA fuses it into the kernel operand
+materialization.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from jax.ad_checkpoint import checkpoint_name
+
+from deepspeed_tpu.ops.attention import dot_product_attention
+from deepspeed_tpu.models.gpt2 import (_embed_lookup, _remat_policy,
+                                       chunked_lm_loss, lm_loss)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 0              # 0 → MHA (= n_heads); <n_heads → GQA
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    remat_policy: Optional[str] = None
+    scan_layers: bool = True
+    scan_unroll: int = 1
+    sp_backend: str = "ring"         # mesh seq-axis attention backend
+    use_flash: Optional[bool] = None
+    loss_chunk: int = 0              # fused chunked head+loss (see gpt2)
+
+    @property
+    def kv_heads(self):
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.n_heads
+
+    def num_params(self):
+        E, F, L, V = (self.hidden_size, self.intermediate_size,
+                      self.n_layers, self.vocab_size)
+        Dkv = self.kv_heads * self.head_dim
+        per_layer = E * E + 2 * E * Dkv + E * E + 3 * E * F + 2 * E
+        return 2 * V * E + L * per_layer + E
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("scale", nn.initializers.ones,
+                       (x.shape[-1],), self.param_dtype)
+        xf = x.astype(jnp.float32)
+        n = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True)
+                               + self.eps)
+        return (n * w.astype(jnp.float32)).astype(self.dtype)
+
+
+def rope_angles(positions, head_dim, theta):
+    """[S] positions → (cos, sin) [S, head_dim//2] fp32."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                      dtype=jnp.float32) / head_dim))
+    ang = positions.astype(jnp.float32)[:, None] * inv[None, :]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, cos, sin):
+    """Rotary embedding on [B, H, S, D] (split-halves convention — the
+    same rotation HF's LLaMA applies; conversion from the interleaved
+    convention is folded into weight import)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[None, None].astype(x.dtype)
+    s = sin[None, None].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        B, S, E = x.shape
+        H, Hkv, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+        dense = lambda n, name: nn.Dense(  # noqa: E731
+            n, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02), name=name)
+        q = dense(H * D, "q_proj")(x)
+        k = dense(Hkv * D, "k_proj")(x)
+        v = dense(Hkv * D, "v_proj")(x)
+        q = checkpoint_name(q, "qkv")
+        qh = q.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        kh = k.reshape(B, S, Hkv, D).transpose(0, 2, 1, 3)
+        vh = v.reshape(B, S, Hkv, D).transpose(0, 2, 1, 3)
+        cos, sin = rope_angles(positions, D, cfg.rope_theta)
+        qh = apply_rope(qh, cos, sin)
+        kh = apply_rope(kh, cos, sin)
+        if Hkv != H:
+            rep = H // Hkv
+            kh = jnp.repeat(kh, rep, axis=1)
+            vh = jnp.repeat(vh, rep, axis=1)
+
+        from deepspeed_tpu.parallel import mesh as mesh_lib
+        mesh = mesh_lib.current_mesh()
+        if mesh is not None and mesh.shape.get(mesh_lib.SEQ_AXIS, 1) > 1 \
+                and S % mesh.shape[mesh_lib.SEQ_AXIS] == 0:
+            sp = mesh.shape[mesh_lib.SEQ_AXIS]
+            if cfg.sp_backend == "ulysses" and H % sp == 0:
+                from deepspeed_tpu.parallel.ulysses import ulysses_attention
+                out = ulysses_attention(qh, kh, vh, mesh, causal=True)
+            else:
+                from deepspeed_tpu.parallel.ring_attention import \
+                    ring_attention
+                out = ring_attention(qh, kh, vh, mesh, causal=True)
+        else:
+            out = dot_product_attention(qh, kh, vh, causal=True,
+                                        use_flash=cfg.use_flash)
+        out = out.transpose(0, 2, 1, 3).reshape(B, S, H * D)
+        out = dense(E, "o_proj")(out)
+        return checkpoint_name(out, "attn_proj")
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = lambda n, name: nn.Dense(  # noqa: E731
+            n, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02), name=name)
+        gate = dense(cfg.intermediate_size, "gate_proj")(x)
+        up = dense(cfg.intermediate_size, "up_proj")(x)
+        h = checkpoint_name(nn.silu(gate) * up, "mlp_fc")
+        out = dense(cfg.hidden_size, "down_proj")(h)
+        return checkpoint_name(out, "mlp_proj")
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        norm = lambda name: RMSNorm(  # noqa: E731
+            eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name=name)
+        x = x + LlamaAttention(cfg, name="attn")(
+            norm("input_norm")(x), positions)
+        x = x + LlamaMLP(cfg, name="mlp")(norm("post_attn_norm")(x))
+        return x
+
+
+def _maybe_remat(cfg):
+    if not cfg.remat:
+        return LlamaBlock
+    return nn.remat(LlamaBlock, prevent_cse=False,
+                    policy=_remat_policy(cfg.remat_policy))
+
+
+class _ScanBody(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        block = _maybe_remat(self.config)
+        return block(self.config, name="blk")(x, positions), None
+
+
+class LlamaForCausalLM(nn.Module):
+    """Decoder-only LLaMA LM. ``labels`` triggers the fused chunked
+    head+loss (models/gpt2.chunked_lm_loss works for any untied head via
+    the lm_head kernel)."""
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, deterministic=True,
+                 keep_prob=1.0):
+        cfg = self.config
+        B, S = input_ids.shape
+        embed = self.param("embed_tokens", nn.initializers.normal(0.02),
+                           (cfg.vocab_size, cfg.hidden_size),
+                           cfg.param_dtype)
+        x = _embed_lookup(embed, input_ids).astype(cfg.dtype)
+        positions = jnp.arange(S)
+
+        if cfg.scan_layers:
+            scanned = nn.scan(_ScanBody,
+                              variable_axes={"params": 0},
+                              split_rngs={"params": True},
+                              in_axes=(nn.broadcast,),
+                              length=cfg.n_layers,
+                              unroll=max(1, cfg.scan_unroll))
+            x, _ = scanned(cfg, name="layers")(x, positions)
+        else:
+            block = _maybe_remat(cfg)
+            for i in range(cfg.n_layers):
+                x = block(cfg, name=f"layers_{i}")(x, positions)
+
+        x = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype, name="norm")(x)
+        head = self.param("lm_head", nn.initializers.normal(0.02),
+                          (cfg.vocab_size, cfg.hidden_size),
+                          cfg.param_dtype)
+        if labels is not None and cfg.loss_chunk > 0:
+            return chunked_lm_loss(x, head.astype(cfg.dtype), labels,
+                                   cfg.loss_chunk)
+        logits = jnp.einsum("bse,ve->bsv", x, head.astype(cfg.dtype))
+        if labels is not None:
+            return lm_loss(logits, labels)
+        return logits
+
+
+# ------------------------------------------------------------- TP rules
+
+def _llama_leaf_spec(path_names, shape):
+    """Megatron-style TP: q/k/v/gate/up column-parallel, o/down
+    row-parallel, embeddings + head vocab-parallel, norms replicated."""
+    from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu.parallel.mesh import MODEL_AXIS
+    name = path_names[-1]
+    parent = path_names[-2] if len(path_names) >= 2 else ""
+    ndim = len(shape)
+
+    def spec_dim(d, axis_name):
+        s = [None] * ndim
+        s[d] = axis_name
+        return P(*s)
+
+    if name in ("embed_tokens", "lm_head"):
+        return spec_dim(0, MODEL_AXIS)
+    if parent in ("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj") \
+            and name == "kernel":
+        return spec_dim(ndim - 1, MODEL_AXIS)
+    if parent in ("o_proj", "down_proj") and name == "kernel":
+        return spec_dim(ndim - 2, MODEL_AXIS)
+    return P(*([None] * ndim))
+
+
+def register_llama_tp_rules():
+    from deepspeed_tpu.models.sharding import register_tp_rules
+    register_tp_rules("LlamaForCausalLM", _llama_leaf_spec)
+
+
+register_llama_tp_rules()
+
+
+# ------------------------------------------------------------- presets
+
+def llama_tiny(**over):
+    kw = dict(vocab_size=512, hidden_size=128, intermediate_size=352,
+              n_layers=2, n_heads=4, n_kv_heads=2, max_seq_len=128,
+              dtype=jnp.float32, param_dtype=jnp.float32)
+    kw.update(over)
+    return LlamaConfig(**kw)
+
+
+def llama_7b(**over):
+    kw = dict(vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+              n_layers=32, n_heads=32, max_seq_len=2048)
+    kw.update(over)
+    return LlamaConfig(**kw)
+
+
+def llama3_8b(**over):
+    kw = dict(vocab_size=128256, hidden_size=4096,
+              intermediate_size=14336, n_layers=32, n_heads=32,
+              n_kv_heads=8, max_seq_len=8192, rope_theta=500000.0)
+    kw.update(over)
+    return LlamaConfig(**kw)
+
+
+# ------------------------------------------------------------- HF import
+
+def from_hf_llama(hf_model, cfg: LlamaConfig, scan_layers=True):
+    """transformers LlamaForCausalLM → this model's param tree. The HF
+    checkpoint uses the same split-halves RoPE convention, so weights map
+    1:1 (transpose only)."""
+    sd = {k: np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach")
+                        else v) for k, v in hf_model.state_dict().items()}
+
+    def lin(name):
+        return sd[name].T.astype(np.float32)
+
+    layers = []
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        layers.append({
+            "attn": {
+                "q_proj": {"kernel": lin(p + "self_attn.q_proj.weight")},
+                "k_proj": {"kernel": lin(p + "self_attn.k_proj.weight")},
+                "v_proj": {"kernel": lin(p + "self_attn.v_proj.weight")},
+                "o_proj": {"kernel": lin(p + "self_attn.o_proj.weight")},
+            },
+            "mlp": {
+                "gate_proj": {"kernel": lin(p + "mlp.gate_proj.weight")},
+                "up_proj": {"kernel": lin(p + "mlp.up_proj.weight")},
+                "down_proj": {"kernel": lin(p + "mlp.down_proj.weight")},
+            },
+            "input_norm": {
+                "scale": sd[p + "input_layernorm.weight"]
+                .astype(np.float32)},
+            "post_attn_norm": {
+                "scale": sd[p + "post_attention_layernorm.weight"]
+                .astype(np.float32)},
+        })
+    if scan_layers:
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *layers)
+        tree = {"layers": {"blk": stacked}}
+    else:
+        tree = {f"layers_{i}": lyr for i, lyr in enumerate(layers)}
+    head = sd.get("lm_head.weight",
+                  sd["model.embed_tokens.weight"])  # tied fallback
+    tree.update({
+        "embed_tokens": jnp.asarray(
+            sd["model.embed_tokens.weight"].astype(np.float32)),
+        "norm": {"scale": jnp.asarray(
+            sd["model.norm.weight"].astype(np.float32))},
+        "lm_head": jnp.asarray(head.astype(np.float32)),
+    })
+    return tree

@@ -1,0 +1,389 @@
+"""Fused decode (S=1) kernels for int8-weight serving.
+
+The scan-decode step at GPT-2-large b1/ctx2048 spends ~1.6 ms/token on
+weight+cache reads but ~5.2 ms/token wall — the rest is per-op fixed cost
+across ~30 small XLA ops per layer (docs/perf_tuning.md r4 ablation).
+These kernels collapse the big ones:
+
+- ``matvec_int8``: y = act(x @ dequant(Wq)·s + b) — one kernel per
+  projection instead of dequant+dot+bias(+act) chains. The int8 codes are
+  cast to the compute dtype INSIDE the kernel (VMEM), so HBM traffic is
+  the 1-byte codes — the XLA path materializes a bf16 weight copy for
+  some shapes, which doubles effective weight read.
+- ``decode_attention_int8``: one (B,H)-grid kernel for the S=1 cached-
+  attention read: scores over the int8 K cache, masked online softmax,
+  context over the int8 V cache — replaces the dequant/dot/mask/softmax/
+  dot chain (~10 ops).
+
+Reference role: csrc/transformer/inference/csrc/pt_binding.cpp ships
+fused decode GEMM+softmax CUDA kernels for exactly this regime.
+
+All kernels are bandwidth-bound at decode shapes; grids are sized so each
+program's working set fits VMEM with double-buffered DMA.
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret_default():
+    from deepspeed_tpu.utils.platform import is_tpu_backend
+    return not is_tpu_backend()
+
+
+def _pick_block(n, budget_cols):
+    """Largest lane-aligned (multiple-of-128) divisor of ``n`` whose
+    column count stays within the VMEM tile budget; falls back to ``n``
+    itself for small/irregular shapes (one whole-array block)."""
+    cap = min(n, max(128, budget_cols))
+    for cand in range(cap - cap % 128, 0, -128):
+        if n % cand == 0:
+            return cand
+    return n
+
+
+# ------------------------------------------------------------ int8 matvec
+
+def _matvec_kernel(x_ref, w_ref, s_ref, b_ref, o_ref, *, act, out_dtype):
+    x = x_ref[...]                              # [B, E] compute dtype
+    w = w_ref[...].astype(x.dtype)              # [E, bn] int8 -> compute
+    y = jax.lax.dot(x, w, preferred_element_type=jnp.float32)
+    y = y * s_ref[0, 0] + b_ref[...].astype(jnp.float32)
+    if act == "gelu_tanh":
+        y = jax.nn.gelu(y, approximate=True)
+    elif act == "gelu":
+        y = jax.nn.gelu(y, approximate=False)
+    o_ref[...] = y.astype(out_dtype)
+
+
+def matvec_int8(x, wq, scale, bias, act=None, block_n=None, interpret=None):
+    """x [B, E] @ int8 Wq [E, N] · scale (+ bias, + act) → [B, N].
+
+    ``scale`` is the per-tensor (quantize_groups=1) symmetric scale; the
+    kernel applies it to the fp32 accumulator, so dequantized weights
+    never exist outside VMEM."""
+    if interpret is None:
+        interpret = _interpret_default()
+    B, E = x.shape
+    E2, N = wq.shape
+    assert E == E2, (x.shape, wq.shape)
+    if block_n is None:
+        block_n = _pick_block(N, budget_cols=(1 << 21) // max(E, 1))
+    assert N % block_n == 0, (N, block_n)
+    scale = jnp.asarray(scale, jnp.float32).reshape(1, 1)
+    bias2 = jnp.asarray(bias).reshape(1, N)     # 2-D: Mosaic tiles 1-D
+    out = pl.pallas_call(                       # operands at 1024
+        functools.partial(_matvec_kernel, act=act, out_dtype=x.dtype),
+        grid=(N // block_n,),
+        in_specs=[
+            pl.BlockSpec((B, E), lambda j: (0, 0)),
+            pl.BlockSpec((E, block_n), lambda j: (0, j)),
+            pl.BlockSpec((1, 1), lambda j: (0, 0)),
+            pl.BlockSpec((1, block_n), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((B, block_n), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((B, N), x.dtype),
+        interpret=interpret,
+    )(x, wq, scale, bias2)
+    return out
+
+
+# ------------------------------------------- fused int8-cache decode attn
+
+def _decode_attn_kernel(pos_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+                        o_ref, m_ref, l_ref, acc_ref, *, scale, block_l,
+                        seq_len):
+    """grid=(B, L/block_l): ALL heads of one batch element per program —
+    a per-(b,h) grid pays ~4 us of program overhead x H x layers, which
+    measured 3.0 of 4.7 ms/token at GPT-2-large (H=20, 36 layers). Head-
+    batched MXU dot_generals give [H, 1, bl] scores LANE-major, matching
+    the [B, H, 1, L] scale layout (lane-major scales — a trailing-1
+    [B,H,L,1] layout pads every scale block to 128 lanes and made DMA the
+    bottleneck). Softmax state is carried across L-blocks in scratch with
+    online rescaling; blocks past ``pos`` skip compute."""
+    lb = pl.program_id(1)
+    nb = seq_len // block_l
+    pos = pos_ref[0]
+
+    @pl.when(lb == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref[...], -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref[...])
+        acc_ref[...] = jnp.zeros_like(acc_ref[...])
+
+    base = lb * block_l
+
+    @pl.when(base <= pos)
+    def _block():
+        q = q_ref[0]                                # [H, 1, D]
+        k = k_ref[0].astype(q.dtype)                # [H, bl, D]
+        s = jax.lax.dot_general(                    # [H, 1, bl]
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        s = s * ks_ref[0] * scale                   # ks [H, 1, bl]
+        k_pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(k_pos <= pos, s, -1e30)
+        m_acc = m_ref[...]                          # [H, 1, 1]
+        m_new = jnp.maximum(m_acc, jnp.max(s, axis=2, keepdims=True))
+        m_ref[...] = m_new
+        alpha = jnp.exp(m_acc - m_new)              # [H, 1, 1]
+        p = jnp.exp(s - m_new)                      # [H, 1, bl]
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=2,
+                                                  keepdims=True)
+        pv = (p * vs_ref[0]).astype(q.dtype)        # [H, 1, bl]
+        v = v_ref[0].astype(q.dtype)                # [H, bl, D]
+        ctx = jax.lax.dot_general(                  # [H, 1, D]
+            pv, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + ctx
+
+    @pl.when(lb == nb - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_ref[...], 1e-30)     # [H, 1, 1]
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def decode_attention_int8(q, k_codes, k_scale, v_codes, v_scale, pos,
+                          scale=None, block_l=None, interpret=None):
+    """S=1 cached attention over the int8 head-major cache.
+
+    q [B, H, 1, D]; k_codes/v_codes [B, H, L, D] int8;
+    k_scale/v_scale [B, H, L] fp32; pos: scalar int32 — index of the
+    newest valid cache row (queries attend to positions <= pos).
+    Returns [B, H, 1, D] in q.dtype."""
+    if interpret is None:
+        interpret = _interpret_default()
+    B, H, S, D = q.shape
+    assert S == 1, "decode kernel is S=1 only"
+    L = k_codes.shape[2]
+    scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
+    if block_l is None:
+        block_l = min(L, 512)
+        while L % block_l:
+            block_l //= 2
+    ks4 = k_scale.reshape(B, H, 1, L)
+    vs4 = v_scale.reshape(B, H, 1, L)
+    pos = jnp.asarray(pos, jnp.int32).reshape(1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, L // block_l),
+        in_specs=[
+            pl.BlockSpec((1, H, 1, D), lambda b, lb, *_: (b, 0, 0, 0)),
+            pl.BlockSpec((1, H, block_l, D),
+                         lambda b, lb, *_: (b, 0, lb, 0)),
+            pl.BlockSpec((1, H, 1, block_l),
+                         lambda b, lb, *_: (b, 0, 0, lb)),
+            pl.BlockSpec((1, H, block_l, D),
+                         lambda b, lb, *_: (b, 0, lb, 0)),
+            pl.BlockSpec((1, H, 1, block_l),
+                         lambda b, lb, *_: (b, 0, 0, lb)),
+        ],
+        out_specs=pl.BlockSpec((1, H, 1, D),
+                               lambda b, lb, *_: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1, 1), jnp.float32),
+            pltpu.VMEM((H, 1, 1), jnp.float32),
+            pltpu.VMEM((H, 1, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_attn_kernel, scale=scale,
+                          block_l=block_l, seq_len=L),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
+        interpret=interpret,
+    )(pos, q, k_codes, ks4, v_codes, vs4)
+    return out
+
+
+def _ln(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean((xf - mu) ** 2, axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return y * w.astype(jnp.float32) + b.astype(jnp.float32)
+
+
+def _ln_qkv_kernel(x_ref, lnw_ref, lnb_ref, w_ref, s_ref, b_ref,
+                   o_ref, u_ref, *, eps):
+    """grid over column tiles of the packed qkv projection: j=0 computes
+    LN once into scratch; every j projects one tile. No in-kernel
+    reshapes (Mosaic cannot shape-cast across lanes)."""
+    j = pl.program_id(0)
+    dt = x_ref.dtype
+
+    @pl.when(j == 0)
+    def _ln_pass():
+        u_ref[...] = _ln(x_ref[...], lnw_ref[...], lnb_ref[...],
+                         eps).astype(dt)
+
+    u = u_ref[...]                                  # [B, E]
+    w = w_ref[...].astype(dt)                       # [E, bn]
+    y = jax.lax.dot(u, w, preferred_element_type=jnp.float32)
+    o_ref[...] = (y * s_ref[0, 0]
+                  + b_ref[...].astype(jnp.float32)).astype(dt)
+
+
+def ln_qkv_int8(x, ln_w, ln_b, wq, s, b, eps=1e-5, block_n=None,
+                interpret=None):
+    """Fused LayerNorm + int8 qkv projection: x [B, E] -> qkv [B, 3E]
+    (one kernel instead of LN + dequant + matmul + bias chains)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    B, E = x.shape
+    N = 3 * E
+    assert wq.shape == (E, N)
+    if block_n is None:
+        block_n = _pick_block(N, budget_cols=(1 << 23) // max(E, 1))
+    assert N % block_n == 0
+    s = jnp.asarray(s, jnp.float32).reshape(1, 1)
+    out = pl.pallas_call(
+        functools.partial(_ln_qkv_kernel, eps=eps),
+        grid=(N // block_n,),
+        in_specs=[
+            pl.BlockSpec((B, E), lambda j: (0, 0)),
+            pl.BlockSpec((1, E), lambda j: (0, 0)),
+            pl.BlockSpec((1, E), lambda j: (0, 0)),
+            pl.BlockSpec((E, block_n), lambda j: (0, j)),
+            pl.BlockSpec((1, 1), lambda j: (0, 0)),
+            pl.BlockSpec((1, block_n), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((B, block_n), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((B, N), x.dtype),
+        scratch_shapes=[pltpu.VMEM((B, E), x.dtype)],
+        interpret=interpret,
+    )(x, ln_w.reshape(1, E), ln_b.reshape(1, E), wq, s,
+      jnp.asarray(b).reshape(1, N))
+    return out
+
+
+def _kv_quant_kernel(k_ref, v_ref, kq_ref, ks_ref, vq_ref, vs_ref):
+    """Per-head symmetric int8 quant of the new K/V rows ([B, H, D],
+    head axis on sublanes — no reshape needed). The cache append itself
+    stays an XLA dynamic_update_slice: Mosaic cannot DMA a single row of
+    a sublane-tiled cache axis (slices on tiled dims must be 8-aligned),
+    and XLA updates the donated cache in place anyway."""
+    def quant(t_ref, q_ref, s_ref):
+        t = t_ref[...].astype(jnp.float32)          # [B, H, D]
+        amax = jnp.max(jnp.abs(t), axis=-1, keepdims=True)
+        sc = jnp.maximum(amax / 127.0, 1e-12)       # [B, H, 1]
+        q_ref[...] = jnp.clip(jnp.round(t / sc), -127,
+                              127).astype(jnp.int8)
+        s_ref[...] = sc.astype(jnp.float32)
+
+    quant(k_ref, kq_ref, ks_ref)
+    quant(v_ref, vq_ref, vs_ref)
+
+
+def kv_quant_int8(k, v, interpret=None):
+    """Quantize new K/V rows per head in one kernel. k/v: [B, H, D] ->
+    (k_codes int8 [B,H,D], k_scale fp32 [B,H,1], v_codes, v_scale)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    B, H, D = k.shape
+    spec = pl.BlockSpec((B, H, D), lambda: (0, 0, 0))
+    sspec = pl.BlockSpec((B, H, 1), lambda: (0, 0, 0))
+    kq, ks, vq, vs = pl.pallas_call(
+        _kv_quant_kernel,
+        in_specs=[spec, spec],
+        out_specs=[spec, sspec, spec, sspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, D), jnp.int8),
+            jax.ShapeDtypeStruct((B, H, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, D), jnp.int8),
+            jax.ShapeDtypeStruct((B, H, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(k, v)
+    return kq, ks, vq, vs
+
+
+def _out_ffn_kernel(ctx_ref, x_ref, wp_ref, lnw_ref, lnb_ref, sc_ref,
+                    bp_ref, w1_ref, b1_ref, w2_ref, b2_ref, o_ref,
+                    x1_ref, u_ref, acc_ref, *, eps, act, n_tiles):
+    """grid=(n_tiles,) over FFN columns: j=0 additionally runs the
+    attention output projection + residual + LN; every j accumulates one
+    FFN tile; the last j adds the second residual and writes out."""
+    j = pl.program_id(0)
+    dt = ctx_ref.dtype
+
+    @pl.when(j == 0)
+    def _proj():
+        ctx = ctx_ref[...]
+        wp = wp_ref[...].astype(dt)
+        t = jax.lax.dot(ctx, wp, preferred_element_type=jnp.float32)
+        t = t * sc_ref[0, 0] + bp_ref[...].astype(jnp.float32)
+        x1 = x_ref[...].astype(jnp.float32) + t
+        x1_ref[...] = x1.astype(dt)
+        u_ref[...] = _ln(x1, lnw_ref[...], lnb_ref[...], eps).astype(dt)
+        acc_ref[...] = jnp.zeros_like(acc_ref[...])
+
+    u = u_ref[...]
+    w1 = w1_ref[...].astype(dt)
+    h = jax.lax.dot(u, w1, preferred_element_type=jnp.float32)
+    h = h * sc_ref[0, 1] + b1_ref[...].astype(jnp.float32)
+    if act == "gelu_tanh":
+        h = jax.nn.gelu(h, approximate=True)
+    else:
+        h = jax.nn.gelu(h, approximate=False)
+    w2 = w2_ref[...].astype(dt)
+    acc_ref[...] += jax.lax.dot(h.astype(dt), w2,
+                                preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_tiles - 1)
+    def _finish():
+        o_ref[...] = (x1_ref[...].astype(jnp.float32)
+                      + acc_ref[...] * sc_ref[0, 2]
+                      + b2_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def out_ffn_int8(ctx, x, wp, sp, bp, ln_w, ln_b, w1, s1, b1, w2, s2, b2,
+                 act="gelu_tanh", eps=1e-5, block_f=None, interpret=None):
+    """Fused decode output path: x + proj(ctx), then LN and the whole
+    FFN with a second residual — one kernel instead of ~12 ops. All
+    weights int8 with per-tensor scales."""
+    if interpret is None:
+        interpret = _interpret_default()
+    B, E = ctx.shape
+    Ew, F = w1.shape
+    assert Ew == E and w2.shape == (F, E) and wp.shape == (E, E)
+    if block_f is None:
+        block_f = _pick_block(F, budget_cols=(1 << 21) // max(E, 1))
+    assert F % block_f == 0, (F, block_f)
+    n_tiles = F // block_f
+    scales = jnp.stack([jnp.asarray(v, jnp.float32).reshape(())
+                        for v in (sp, s1, s2)]).reshape(1, 3)
+    out = pl.pallas_call(
+        functools.partial(_out_ffn_kernel, eps=eps, act=act,
+                          n_tiles=n_tiles),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((B, E), lambda j: (0, 0)),
+            pl.BlockSpec((B, E), lambda j: (0, 0)),
+            pl.BlockSpec((E, E), lambda j: (0, 0)),
+            pl.BlockSpec((1, E), lambda j: (0, 0)),
+            pl.BlockSpec((1, E), lambda j: (0, 0)),
+            pl.BlockSpec((1, 3), lambda j: (0, 0)),
+            pl.BlockSpec((1, E), lambda j: (0, 0)),
+            pl.BlockSpec((E, block_f), lambda j: (0, j)),
+            pl.BlockSpec((1, block_f), lambda j: (0, j)),
+            pl.BlockSpec((block_f, E), lambda j: (j, 0)),
+            pl.BlockSpec((1, E), lambda j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((B, E), lambda j: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, E), ctx.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((B, E), ctx.dtype),
+            pltpu.VMEM((B, E), ctx.dtype),
+            pltpu.VMEM((B, E), jnp.float32),
+        ],
+        interpret=interpret,
+    )(ctx, x, wp, ln_w.reshape(1, E), ln_b.reshape(1, E), scales,
+      jnp.asarray(bp).reshape(1, E), w1, jnp.asarray(b1).reshape(1, F),
+      w2, jnp.asarray(b2).reshape(1, E))
+    return out

@@ -42,6 +42,9 @@ NEG_INF = -1e30
 # S=4096, D=64 (512 KB) compile; S=8192 overflows by 4.5 MB. The chunked
 # kernels use half of this per chunk to leave room for pipeline double
 # buffering (chunk 4096 at S=32k overflowed by 0.9 MB; 2048 fits).
+# Re-validated for the fused backward (which additionally keeps a fp32
+# [S, D] dq row resident): causal bf16 S=4096, D=64 fwd+bwd compiles and
+# runs on the chip at this threshold.
 _UNCHUNKED_ROW_BYTES = 524288
 
 
@@ -61,12 +64,18 @@ def _causal_mask(s, q_pos0, k_pos0, block_q, block_k):
 
 
 def _fwd_block_step(q, k, v, carry, q_pos0, k_pos0, block_q, block_k,
-                    masked):
-    """One k-block of online-softmax forward. q is pre-scaled fp32;
-    carry = (o_acc [bq, D], m_acc [bq], l_acc [bq])."""
+                    masked, scale):
+    """One k-block of online-softmax forward. q/k/v stay in their native
+    (typically bf16) dtype so the MXU runs at full rate — fp32 dot inputs
+    run the systolic array at ~1/8 throughput, which made attention ~10%
+    of peak and THE forward bottleneck at S=1k (r4 measurement). All dots
+    accumulate fp32 (preferred_element_type); softmax state is fp32; the
+    scale is applied to the fp32 scores (exactly equivalent to pre-scaled
+    q up to bf16 rounding of q·scale, and independent of D).
+    carry = (o_acc [bq, D] f32, m_acc [bq] f32, l_acc [bq] f32)."""
     o_acc, m_acc, l_acc = carry
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=jnp.float32) * scale
     if masked:
         s = _causal_mask(s, q_pos0, k_pos0, block_q, block_k)
     m_new = jnp.maximum(m_acc, jnp.max(s, axis=1))
@@ -74,17 +83,18 @@ def _fwd_block_step(q, k, v, carry, q_pos0, k_pos0, block_q, block_k,
     p = jnp.exp(s - m_new[:, None])
     l_new = l_acc * alpha + jnp.sum(p, axis=1)
     o_new = o_acc * alpha[:, None] + jax.lax.dot(
-        p, v, preferred_element_type=jnp.float32)
+        p.astype(v.dtype), v, preferred_element_type=jnp.float32)
     return o_new, m_new, l_new
 
 
 def _bwd_ds_block(q, do, lse, delta, k, v, q_pos0, k_pos0, block_q, block_k,
-                  masked):
-    """(p, ds) for one score tile of the backward. q is pre-scaled fp32;
-    ds is in the scaled-q domain (dq needs a final ·scale; dk = dsᵀ·q is
-    exact because q is pre-scaled)."""
+                  masked, scale):
+    """(p, ds) fp32 for one score tile of the backward; dot inputs stay in
+    the native dtype (see _fwd_block_step). ds is d(loss)/d(s) with
+    s = scale·q·kᵀ, so dq = scale·(ds·k) and dk = scale·(dsᵀ·q) — callers
+    apply the final ·scale once on the accumulated result."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=jnp.float32) * scale
     if masked:
         s = _causal_mask(s, q_pos0, k_pos0, block_q, block_k)
     p = jnp.exp(s - lse[:, None])
@@ -106,14 +116,14 @@ def _causal_split_loop(lo, full, hi, body, carry):
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                 block_q, block_k, seq_len):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
+    q = q_ref[0]
     num_kb = seq_len // block_k
 
     def body(kb, carry, masked):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         return _fwd_block_step(q, k, v, carry, qi * block_q, kb * block_k,
-                               block_q, block_k, masked)
+                               block_q, block_k, masked, scale)
 
     carry0 = (jnp.zeros((block_q, q.shape[1]), jnp.float32),
               jnp.full((block_q,), NEG_INF, jnp.float32),
@@ -158,65 +168,53 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
 # ---------------------------------------------------------------- backward
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, block_q, block_k, seq_len):
-    qi = pl.program_id(1)
-    # s is computed against pre-scaled q; the chain rule's ds·scale then
-    # collapses into one [block_q, D] multiply on the accumulated dq below
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, :, 0]
-    delta = delta_ref[0, :, 0]
-    num_kb = seq_len // block_k
-
-    def body(kb, dq_acc, masked):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        _, ds = _bwd_ds_block(q, do, lse, delta, k, v, qi * block_q,
-                              kb * block_k, block_q, block_k, masked)
-        return dq_acc + jax.lax.dot(ds, k,
-                                    preferred_element_type=jnp.float32)
-
-    dq0 = jnp.zeros_like(q)
-    if causal:
-        num_full = (qi * block_q) // block_k
-        num_active = ((qi + 1) * block_q + block_k - 1) // block_k
-        dq = _causal_split_loop(0, num_full, num_active, body, dq0)
-    else:
-        dq = _causal_split_loop(0, num_kb, num_kb, body, dq0)
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q, block_k,
-                    seq_len):
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, *, scale, causal, block_q,
+                      block_k, seq_len):
+    """Single-pass backward: the grid walks k-blocks; dk/dv accumulate
+    block-locally over the q-blocks of the inner loop, while dq
+    accumulates into a VMEM-resident full row (its index map ignores the
+    k-block grid dim, so Pallas keeps the block resident across grid
+    steps). Each (q-block, k-block) score tile — the dots AND the exp —
+    is computed ONCE, where the split dq/dkv kernels computed everything
+    but the final products twice; the exp on [bq, bk] fp32 tiles is
+    VPU-bound, so halving it is the biggest attention-bwd lever at
+    training shapes (measured 2.4 ms/layer -> target <1.5 at the 774M
+    headline: B*H=160, S=1024, D=64)."""
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)   # [block_k, D]
-    v = v_ref[0].astype(jnp.float32)
+    num_kb = seq_len // block_k
     num_qb = seq_len // block_q
+    k = k_ref[0]   # [block_k, D]
+    v = v_ref[0]
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
 
     def body(qb, carry, masked):
         dk_acc, dv_acc = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(
-            jnp.float32) * scale
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[0, pl.ds(qb * block_q, block_q), 0]
         delta = delta_ref[0, pl.ds(qb * block_q, block_q), 0]
         p, ds = _bwd_ds_block(q, do, lse, delta, k, v, qb * block_q,
-                              ki * block_k, block_q, block_k, masked)
+                              ki * block_k, block_q, block_k, masked,
+                              scale)
+        dsl = ds.astype(q.dtype)
         dv_new = dv_acc + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        # dk = dsᵀ·(scale·q): q was pre-scaled, so this is exact
         dk_new = dk_acc + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            dsl, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        sl = pl.ds(qb * block_q, block_q)
+        dq_ref[0, sl, :] += jax.lax.dot(
+            dsl, k, preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
-    carry0 = (jnp.zeros_like(k), jnp.zeros_like(v))
+    carry0 = (jnp.zeros(k.shape, jnp.float32),
+              jnp.zeros(v.shape, jnp.float32))
     if causal:
-        # q-blocks straddling the diagonal run masked; strictly-below-
-        # diagonal q-blocks don't
         first_active = (ki * block_k) // block_q
         first_full = ((ki + 1) * block_k + block_q - 1) // block_q
         carry = jax.lax.fori_loop(
@@ -226,8 +224,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             first_full, num_qb, lambda qb, c: body(qb, c, False), carry)
     else:
         dk, dv = _causal_split_loop(0, num_qb, num_qb, body, carry0)
-    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)   # dk = scale·Σ dsᵀ·q
     dv_ref[0] = dv.astype(dv_ref.dtype)
+
+    @pl.when(ki == num_kb - 1)
+    def _finish():
+        # dq = scale·Σ ds·k, applied once after every k-block contributed
+        dq_ref[0] *= scale
 
 
 def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
@@ -236,25 +239,8 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)[:, :, None]  # [BH, S, 1]
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_len=S),
-        grid=(BH, S // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_len=S),
         grid=(BH, S // block_k),
         in_specs=[
@@ -266,16 +252,18 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
             pl.BlockSpec((1, S, 1), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         ],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+    return dq.astype(q.dtype), dk, dv
 
 
 # ------------------------------------------------- long-S chunked variants
@@ -286,7 +274,7 @@ def _fwd_kernel_chunked(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
     qi = pl.program_id(1)
     kc = pl.program_id(2)
     cb = chunk // block_k                      # k-blocks per chunk
-    q = q_ref[0].astype(jnp.float32) * scale
+    q = q_ref[0]
 
     @pl.when(kc == 0)
     def _init():
@@ -296,10 +284,10 @@ def _fwd_kernel_chunked(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
 
     def body(j, carry, masked):
         kb = kc * cb + j                       # global k-block index
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
         return _fwd_block_step(q, k, v, carry, qi * block_q, kb * block_k,
-                               block_q, block_k, masked)
+                               block_q, block_k, masked, scale)
 
     carry0 = (o_ref[0], m_ref[0, :, 0], l_ref[0, :, 0])
     if causal:
@@ -361,8 +349,8 @@ def _bwd_dq_kernel_chunked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qi = pl.program_id(1)
     kc = pl.program_id(2)
     cb = chunk // block_k
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
 
@@ -372,11 +360,12 @@ def _bwd_dq_kernel_chunked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(j, dq_acc, masked):
         kb = kc * cb + j
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
         _, ds = _bwd_ds_block(q, do, lse, delta, k, v, qi * block_q,
-                              kb * block_k, block_q, block_k, masked)
-        return dq_acc + jax.lax.dot(ds, k,
+                              kb * block_k, block_q, block_k, masked,
+                              scale)
+        return dq_acc + jax.lax.dot(ds.astype(k.dtype), k,
                                     preferred_element_type=jnp.float32)
 
     if causal:
@@ -398,8 +387,8 @@ def _bwd_dkv_kernel_chunked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     ki = pl.program_id(1)
     qc = pl.program_id(2)
     cb = chunk // block_q
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]
+    v = v_ref[0]
 
     @pl.when(qc == 0)
     def _init():
@@ -409,18 +398,18 @@ def _bwd_dkv_kernel_chunked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def body(j, carry, masked):
         dk_acc, dv_acc = carry
         qb = qc * cb + j
-        q = q_ref[0, pl.ds(j * block_q, block_q), :].astype(
-            jnp.float32) * scale
-        do = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(j * block_q, block_q), :]
+        do = do_ref[0, pl.ds(j * block_q, block_q), :]
         lse = lse_ref[0, pl.ds(j * block_q, block_q), 0]
         delta = delta_ref[0, pl.ds(j * block_q, block_q), 0]
         p, ds = _bwd_ds_block(q, do, lse, delta, k, v, qb * block_q,
-                              ki * block_k, block_q, block_k, masked)
+                              ki * block_k, block_q, block_k, masked,
+                              scale)
         dv_new = dv_acc + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dk_new = dk_acc + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
@@ -438,7 +427,9 @@ def _bwd_dkv_kernel_chunked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             j_mid, cb, lambda j, c: body(j, c, False), carry)
     else:
         dk, dv = _causal_split_loop(0, cb, cb, body, carry0)
-    dk_ref[0] = dk
+    # dk accumulates UNscaled across chunk revisits; the folded-scale
+    # chain rule (dk = scale·Σ dsᵀ·q) lands once on the final chunk
+    dk_ref[0] = jnp.where(qc == n_chunks - 1, dk * scale, dk)
     dv_ref[0] = dv
 
 

@@ -114,18 +114,46 @@ class QuantDense(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
-        in_features = x.shape[-1]
+    def raw(self, in_features):
+        """Declare and return this projection's (codes, scale, bias)
+        without running the matmul — lets the parent layer feed the
+        fused decode kernels (ops/pallas/decode.py) with several
+        projections' params in one pallas_call. Param names/shapes are
+        identical either way, so checkpoints and injection policies see
+        one layout regardless of path. NOTE: per-Pallas-call overhead is
+        ~9 µs on v5e, so per-projection matvec kernels LOSE to XLA at
+        decode shapes — only multi-matmul fusions (whole FFN) win."""
         kq = self.param("kernel_q", nn.initializers.zeros,
                         (in_features, self.features), jnp.int8)
         scale = self.param("kernel_scale", nn.initializers.ones,
                            (self.groups, 1), jnp.float32)
         bias = self.param("bias", nn.initializers.zeros,
                           (self.features,), self.param_dtype)
+        return kq, scale, bias
+
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        kq, scale, bias = self.raw(in_features)
         w = (kq.astype(jnp.float32).reshape(self.groups, -1)
              * scale).reshape(in_features, self.features)
         y = x @ w.astype(self.dtype)
         return y + bias.astype(self.dtype)
+
+
+class _LNParams(nn.Module):
+    """Declares LayerNorm params (same names/shapes/init as nn.LayerNorm)
+    without running the normalization — the fused decode kernels compute
+    LN in-kernel but the param tree must stay checkpoint-identical."""
+    features: int
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self):
+        scale = self.param("scale", nn.initializers.ones,
+                           (self.features,), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), self.param_dtype)
+        return scale, bias
 
 
 class DeepSpeedTransformerInference(nn.Module):
@@ -146,6 +174,18 @@ class DeepSpeedTransformerInference(nn.Module):
         dt = cfg.compute_dtype
         H, D = cfg.heads, cfg.head_dim
         x = hidden_states.astype(dt)
+
+        if (cfg.quantize_bits == 8 and cfg.kv_cache_bits == 8 and S == 1
+                and attention_mask is None and cfg.pre_layer_norm
+                and cfg.triangular_masking and not cfg.moe_experts
+                and cfg.quantize_groups == 1 and B <= 8
+                and cfg.mp_size == 1
+                and E % 128 == 0 and self.config.ffn_size % 128 == 0
+                and (self.has_variable("cache", "cached_key_q8")
+                     or self.is_mutable_collection("cache"))):
+            # mp_size > 1 keeps the GSPMD path: the fused kernels are
+            # opaque custom calls XLA cannot shard over the model axis
+            return self._decode_step_fused(x, B, E, H, D, dt)
 
         ln_kw = dict(epsilon=cfg.layer_norm_eps, dtype=dt,
                      param_dtype=cfg.param_dtype)
@@ -190,6 +230,76 @@ class DeepSpeedTransformerInference(nn.Module):
             x = nn.LayerNorm(**ln_kw, name="attn_nw")(x + attn(x))
             x = nn.LayerNorm(**ln_kw, name="norm_w")(x + ffn(x))
         return x
+
+    def _decode_step_fused(self, x, B, E, H, D, dt):
+        """Single-token serving fast path (int8 weights + int8 KV cache):
+        FOUR Pallas kernels per layer — LN+qkv (decode.ln_qkv_int8),
+        per-head KV quant (decode.kv_quant_int8; the cache append itself
+        stays an XLA dynamic_update_slice), head-batched cached attention
+        (decode.decode_attention_int8), and proj+residual+LN+FFN+residual
+        (decode.out_ffn_int8) — instead of ~35 XLA ops. Param trees and
+        cache variables are IDENTICAL to the general path, so the same
+        weights serve both and the prompt pass fills the cache through
+        the general path. Measured 5.2 -> 3.82 ms/token (262 tok/s) at
+        GPT-2-large b1/ctx2048 on v5e (docs/perf_tuning.md r4b)."""
+        from deepspeed_tpu.ops.pallas.decode import (
+            ln_qkv_int8, kv_quant_int8, decode_attention_int8,
+            out_ffn_int8)
+        cfg = self.config
+        L = cfg.max_out_tokens
+        ln1 = _LNParams(E, cfg.param_dtype, name="attn_nw")()
+        ln2 = _LNParams(E, cfg.param_dtype, name="norm_w")()
+        kqkv, sqkv, bqkv = QuantDense(
+            3 * E, groups=1, dtype=dt, param_dtype=cfg.param_dtype,
+            name="attn_qkvw").raw(E)
+        kp, sp, bp = QuantDense(
+            E, groups=1, dtype=dt, param_dtype=cfg.param_dtype,
+            name="attn_ow").raw(E)
+        k1, s1, b1 = QuantDense(
+            cfg.ffn_size, groups=1, dtype=dt, param_dtype=cfg.param_dtype,
+            name="inter_w").raw(E)
+        k2, s2, b2 = QuantDense(
+            E, groups=1, dtype=dt, param_dtype=cfg.param_dtype,
+            name="output_w").raw(cfg.ffn_size)
+        ck = self.variable("cache", "cached_key_q8",
+                           jnp.zeros, (B, H, L, D), jnp.int8)
+        cv = self.variable("cache", "cached_value_q8",
+                           jnp.zeros, (B, H, L, D), jnp.int8)
+        ks = self.variable("cache", "key_scale",
+                           jnp.zeros, (B, H, L), jnp.float32)
+        vs = self.variable("cache", "value_scale",
+                           jnp.zeros, (B, H, L), jnp.float32)
+        idx = self.variable("cache", "cache_index",
+                            lambda: jnp.zeros((), jnp.int32))
+        start = idx.value
+        x2 = x.reshape(B, E)
+        # overflow: clamped cache writes would silently serve stale
+        # context — poison like the general path does
+        x2 = jnp.where(start >= L, jnp.float32(jnp.nan).astype(x2.dtype),
+                       x2)
+        qkv = ln_qkv_int8(x2, ln1[0], ln1[1], kqkv, sqkv.reshape(()),
+                          bqkv, eps=cfg.layer_norm_eps)
+        q = qkv[:, :E]
+        k3 = qkv[:, E:2 * E].reshape(B, H, D)
+        v3 = qkv[:, 2 * E:].reshape(B, H, D)
+        kq8, ksc, vq8, vsc = kv_quant_int8(k3, v3)
+        dus = jax.lax.dynamic_update_slice
+        ck.value = dus(ck.value, kq8[:, :, None, :], (0, 0, start, 0))
+        cv.value = dus(cv.value, vq8[:, :, None, :], (0, 0, start, 0))
+        ks.value = dus(ks.value, ksc.reshape(B, H, 1), (0, 0, start))
+        vs.value = dus(vs.value, vsc.reshape(B, H, 1), (0, 0, start))
+        idx.value = start + 1
+        qh = q.reshape(B, 1, H, D).transpose(0, 2, 1, 3)
+        ctx = decode_attention_int8(
+            qh, ck.value, ks.value, cv.value, vs.value, start,
+            scale=1.0 / np.sqrt(D))
+        ctx2 = ctx.transpose(0, 2, 1, 3).reshape(B, E)
+        y = out_ffn_int8(
+            ctx2, x2, kp, sp.reshape(()), bp, ln2[0], ln2[1],
+            k1, s1.reshape(()), b1, k2, s2.reshape(()), b2,
+            act="gelu_tanh" if cfg.gelu_approximate else "gelu",
+            eps=cfg.layer_norm_eps)
+        return y.reshape(B, 1, E)
 
     def _cache_int8(self, kh, vh, B, L, H, D):
         """int8 KV cache write (kv_cache_bits=8) in the head-major
@@ -275,6 +385,23 @@ class DeepSpeedTransformerInference(nn.Module):
             # output with NaN instead so overflow is loud and detectable.
             overflow = (start + S) > L
             q = jnp.where(overflow, jnp.float32(jnp.nan).astype(q.dtype), q)
+            if kv_scales is not None and S == 1 \
+                    and attention_mask is None and cfg.mp_size == 1:
+                # mp_size > 1 stays on the XLA contractions: the Pallas
+                # kernel is an opaque custom call GSPMD cannot shard, so
+                # under TP it would all-gather the head-sharded caches
+                # to every shard each token
+                # fused decode-attention kernel: scores + masked online
+                # softmax + context in ONE program over the int8 cache
+                # (compute past `pos` is skipped; the block DMAs still
+                # stream all L rows — cache reads are ~6 us/layer here)
+                from deepspeed_tpu.ops.pallas.decode import (
+                    decode_attention_int8)
+                k_scale, v_scale = kv_scales
+                ctx = decode_attention_int8(
+                    q.transpose(0, 2, 1, 3), k_all, k_scale, v_all,
+                    v_scale, start, scale=scale)
+                return ctx.transpose(0, 2, 1, 3)           # (B,1,H,D)
             # position j visible to query i (absolute i = start + i_local)
             q_pos = start + jnp.arange(S)[:, None]
             k_pos = jnp.arange(L)[None, :]

@@ -47,6 +47,72 @@ def current_mesh() -> Optional[Mesh]:
     return _current_mesh
 
 
+_pins_disabled = 0
+_pin_mesh = None
+
+
+class layout_pins:
+    """Engine-scoped activation of the models' GSPMD layout pins
+    (with_sharding_constraint on param/grad edges, e.g. the wpe slice and
+    wte-scatter pins in models/gpt2.py). The pins must NOT read the
+    ambient mesh registry: set_current_mesh outlives its engine, and a
+    later single-device jit tracing the model with a constraint over a
+    stale multi-device mesh crashes XLA's CPU compiler (the r4
+    full-suite Fatal abort — order-dependent, invisible in isolation).
+    Engines enter this around every jitted call with THEIR mesh; any
+    trace outside an engine gets no pins. Re-entrant; inner-most wins."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self._prev = None
+
+    def __enter__(self):
+        global _pin_mesh
+        self._prev = _pin_mesh
+        _pin_mesh = self.mesh
+        return self
+
+    def __exit__(self, *exc):
+        global _pin_mesh
+        _pin_mesh = self._prev
+        return False
+
+
+def pinned_mesh():
+    """Mesh for model layout pins, or None outside an engine-pinned
+    trace (or when pins are disabled for explicit-comm programs)."""
+    if _pins_disabled > 0:
+        return None
+    return _pin_mesh
+
+
+class no_layout_pins:
+    """Context manager disabling the models' GSPMD layout pins
+    (with_sharding_constraint on param/grad edges) while an engine traces
+    an EXPLICIT-COMM program (shard_map, Manual axes). Inside shard_map
+    the data is already device-local, so the pins are meaningless — and a
+    NamedSharding built over the global (Auto-axis) mesh poisons avals in
+    ways trace-context sniffing cannot reliably detect: custom_vjp
+    backwards re-trace under whatever mesh context is live at transpose
+    time (sometimes empty, sometimes the Auto mesh), so the ENGINE —
+    which knows which kind of program it is building — is the only
+    authoritative source. Re-entrant."""
+
+    def __enter__(self):
+        global _pins_disabled
+        _pins_disabled += 1
+        return self
+
+    def __exit__(self, *exc):
+        global _pins_disabled
+        _pins_disabled -= 1
+        return False
+
+
+def layout_pins_disabled() -> bool:
+    return _pins_disabled > 0
+
+
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None,

@@ -785,6 +785,26 @@ class DeepSpeedEngine:
         ), {"loss": loss, "grad_norm": grad_norm, "lr": lr,
             "overflow": ~finite, "loss_scale": new_scaler["loss_scale"]}
 
+    def _pinned(self, jitted):
+        """Run a GSPMD-jitted engine program with the models' layout pins
+        scoped to THIS engine's mesh (mesh_lib.layout_pins): the pins
+        must never read the ambient registry — it outlives engines, and
+        a trace in another context constraining to a stale foreign-device
+        mesh crashes GSPMD. Python-call scoping survives however jax
+        re-traces custom_vjp backwards. `lower` passes through for
+        train_step_memory_stats."""
+        mesh = self.mesh
+
+        def call(*args, **kwargs):
+            with mesh_lib.layout_pins(mesh):
+                return jitted(*args, **kwargs)
+
+        def lower(*args, **kwargs):
+            with mesh_lib.layout_pins(mesh):
+                return jitted.lower(*args, **kwargs)
+        call.lower = lower
+        return call
+
     def _build_jit_fns(self):
         loss_fn = self._resolve_loss_fn()
         gas = self.gradient_accumulation_steps()
@@ -847,7 +867,7 @@ class DeepSpeedEngine:
                 else jnp.asarray(True)
             return grads, loss, finite, _global_norm(grads)
 
-        self._jit_grads_batch = jax.jit(grads_batch_fn)
+        self._jit_grads_batch = self._pinned(jax.jit(grads_batch_fn))
 
         def micro_grads_fn(state, batch, rng):
             batch = jax.tree_util.tree_map(
@@ -859,9 +879,11 @@ class DeepSpeedEngine:
         def apply_grads_fn(state, grads, loss):
             return self._apply_grads(state, grads, loss)
 
-        self._jit_train_batch = jax.jit(train_batch_fn, donate_argnums=(0,))
-        self._jit_micro_grads = jax.jit(micro_grads_fn)
-        self._jit_apply_grads = jax.jit(apply_grads_fn, donate_argnums=(0, 1))
+        self._jit_train_batch = self._pinned(
+            jax.jit(train_batch_fn, donate_argnums=(0,)))
+        self._jit_micro_grads = self._pinned(jax.jit(micro_grads_fn))
+        self._jit_apply_grads = self._pinned(
+            jax.jit(apply_grads_fn, donate_argnums=(0, 1)))
 
         def loss_batch_fn(state, batch, rng):
             # forward-only twin of accumulate_grads, for the
@@ -884,7 +906,7 @@ class DeepSpeedEngine:
                                               loss_fn=loss_fn) / gas, None
             total, _ = jax.lax.scan(micro, jnp.float32(0.0), (chunked, rngs))
             return total
-        self._jit_loss_batch = jax.jit(loss_batch_fn)
+        self._jit_loss_batch = self._pinned(jax.jit(loss_batch_fn))
         if self._compressed_comm_active():
             self._jit_train_batch = self._build_compressed_train_fn(loss_fn)
         elif self._sparse_grad_active():
@@ -916,7 +938,7 @@ class DeepSpeedEngine:
             if accepts_det:
                 kwargs["deterministic"] = True
             return self.module.apply({"params": params}, x, **kwargs)
-        self._jit_eval = jax.jit(eval_fn)
+        self._jit_eval = self._pinned(jax.jit(eval_fn))
         self._last_lr = None
 
     def _local_grad_accumulator(self, loss_fn, axis):
@@ -1053,7 +1075,26 @@ class DeepSpeedEngine:
 
             return inner(state, batch, rng)
 
-        return jax.jit(train_fn, donate_argnums=(0,))
+        return self._jit_explicit_comm(train_fn)
+
+    def _jit_explicit_comm(self, train_fn):
+        """jit an explicit-comm (shard_map) train program with the models'
+        GSPMD layout pins disabled for its traces (see
+        mesh_lib.no_layout_pins — inside shard_map the pins poison avals
+        with foreign-mesh shardings). The wrapper keeps the jitted fn's
+        `lower` (train_step_memory_stats uses it), entering the same
+        pin-free mode so an explicit lowering doesn't re-poison."""
+        jitted = jax.jit(train_fn, donate_argnums=(0,))
+
+        def call(state, batch, rng):
+            with mesh_lib.no_layout_pins():
+                return jitted(state, batch, rng)
+
+        def lower(*args, **kwargs):
+            with mesh_lib.no_layout_pins():
+                return jitted.lower(*args, **kwargs)
+        call.lower = lower
+        return call
 
     def _sparse_grad_active(self):
         """True when the train step should exchange embedding gradients
@@ -1188,7 +1229,7 @@ class DeepSpeedEngine:
 
             return inner(state, batch, rng)
 
-        return jax.jit(train_fn, donate_argnums=(0,))
+        return self._jit_explicit_comm(train_fn)
 
     def _micro_loss_and_grads(self, state, micro_batch, rng, loss_fn=None):
         if loss_fn is None:

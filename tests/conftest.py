@@ -40,3 +40,14 @@ def rng():
 @pytest.fixture(autouse=True)
 def _np_seed():
     np.random.seed(0)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_compile_cache():
+    """Free jitted executables between test MODULES: the full suite
+    (300+ tests) accumulates enough XLA CPU executables to OOM-abort the
+    compiler partway through on small hosts (the r4 suite died with a
+    Fatal abort inside backend_compile at ~70%); per-module clearing
+    bounds the live set while keeping intra-module cache hits."""
+    yield
+    jax.clear_caches()

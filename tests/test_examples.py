@@ -66,3 +66,12 @@ def test_example_bert_squad():
                        "--seq", "64", "--repeat-batch", devices=1)
     first, final = _losses(out, "bert_squad")
     assert final < first, (first, final)
+
+
+def test_example_llama_pretrain():
+    out = _run_example("llama_pretrain.py", "--steps", "8", "--batch", "8",
+                       "--seq", "64", "--hidden", "128", "--layers", "2",
+                       "--heads", "4", "--kv-heads", "2", "--repeat-batch",
+                       devices=2)
+    first, final = _losses(out, "llama_pretrain")
+    assert final < first, (first, final)

@@ -6,7 +6,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.gpt2 import gpt2_tiny, GPT2LMHeadModel
+from deepspeed_tpu.models.gpt2 import gpt2_tiny, GPT2Config, \
+    GPT2LMHeadModel
 from deepspeed_tpu.models.gpt2_inference import (
     GPT2InferenceModel,
     convert_gpt2_params,
@@ -71,7 +72,6 @@ def test_generate_sampling_shape_and_determinism():
 
 
 def test_untied_embeddings_served_correctly():
-    from deepspeed_tpu.models.gpt2 import gpt2_tiny, GPT2LMHeadModel
     cfg = gpt2_tiny(dtype=jnp.float32, tie_word_embeddings=False)
     model = GPT2LMHeadModel(cfg)
     ids = np.random.RandomState(1).randint(0, 512, (2, 10)).astype(np.int32)
@@ -206,3 +206,37 @@ def test_kv_cache_bits_validation():
         DeepSpeedInferenceConfig)
     with pytest.raises(ValueError, match="kv_cache_bits"):
         DeepSpeedInferenceConfig(hidden_size=32, heads=2, kv_cache_bits=4)
+
+
+def test_tp_sharded_decode_matches_single_device(devices8):
+    """mp_size serving (reference module_inject's mp_size sharding): a
+    model-axis-sharded generate must produce the single-device tokens
+    exactly (greedy, fp32). Covers the bf16/fp32 GSPMD path AND the
+    int8-weights path (whose fused single-chip kernels must gate
+    themselves off under mp_size > 1)."""
+    from deepspeed_tpu.models.gpt2_inference import (
+        generate, convert_gpt2_params, quantize_gpt2_inference_params)
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+    cfg = GPT2Config(vocab_size=512, n_positions=128, n_embd=128,
+                     n_layer=2, n_head=4, dtype=jnp.float32,
+                     param_dtype=jnp.float32, scan_layers=True)
+    rs = np.random.RandomState(3)
+    prompt = rs.randint(0, 512, size=(2, 20)).astype(np.int32)
+    params = jax.jit(GPT2LMHeadModel(cfg).init)(
+        jax.random.PRNGKey(0), prompt[:, :8])["params"]
+    mesh = make_mesh(MeshConfig(model=2, data=1), devices=devices8[:2])
+
+    t_single = generate(cfg, params, prompt, max_new_tokens=6,
+                        max_out_tokens=128)
+    t_tp = generate(cfg, params, prompt, max_new_tokens=6,
+                    max_out_tokens=128, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(t_single), np.asarray(t_tp))
+
+    qparams = quantize_gpt2_inference_params(
+        convert_gpt2_params(params, cfg))
+    t_q = generate(cfg, qparams, prompt, max_new_tokens=6,
+                   max_out_tokens=128, quantize_bits=8, kv_cache_bits=8)
+    t_q_tp = generate(cfg, qparams, prompt, max_new_tokens=6,
+                      max_out_tokens=128, quantize_bits=8,
+                      kv_cache_bits=8, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(t_q), np.asarray(t_q_tp))
