@@ -24,4 +24,5 @@ from deepspeed_tpu.models.llama import (
     llama_7b,
     llama3_8b,
     from_hf_llama,
+    llama_generate,
 )

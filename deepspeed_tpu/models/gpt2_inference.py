@@ -258,6 +258,29 @@ def gpt2_inference_tp_specs(iparams):
     return jax.tree_util.tree_map_with_path(leaf_spec, iparams)
 
 
+
+
+def shard_inference_params(iparams, mesh):
+    """device_put the (converted) inference params onto the mesh with the
+    mp_size TP layout. Serving loops should call this ONCE and pass the
+    sharded tree to every generate(): generate() skips the transfer when
+    the leaves already carry the target shardings, but host/unsharded
+    trees would otherwise be re-transferred per request."""
+    from jax.sharding import NamedSharding
+    specs = gpt2_inference_tp_specs(iparams)
+    targets = jax.tree_util.tree_map(
+        lambda sp: NamedSharding(mesh, sp), specs)
+    already = all(
+        getattr(leaf, "sharding", None) == tgt
+        for leaf, tgt in zip(jax.tree_util.tree_leaves(iparams),
+                             jax.tree_util.tree_leaves(
+                                 targets, is_leaf=lambda x: isinstance(
+                                     x, NamedSharding))))
+    if already:
+        return iparams
+    return jax.device_put(iparams, targets)
+
+
 def generate(cfg: GPT2Config, params, input_ids, max_new_tokens=20,
              temperature: float = 0.0, rng=None, max_out_tokens: int = 0,
              quantize_bits: int = 0, quantize_groups: int = 1,
@@ -303,11 +326,7 @@ def generate(cfg: GPT2Config, params, input_ids, max_new_tokens=20,
         any(k in params["h"]["blk"] for k in ("attn_qkvw",))
     iparams = params if converted else convert_gpt2_params(params, cfg)
     if mp_size > 1:
-        from jax.sharding import NamedSharding
-        specs = gpt2_inference_tp_specs(iparams)
-        iparams = jax.device_put(
-            iparams, jax.tree_util.tree_map(
-                lambda s: NamedSharding(mesh, s), specs))
+        iparams = shard_inference_params(iparams, mesh)
 
     def pick(logits, r):
         if temperature and temperature > 0:

@@ -11,10 +11,12 @@ every GPT2Config remat_policy string works unchanged), Pallas flash
 attention, fused chunked head+loss, and sequence parallelism over a
 live mesh seq axis (ring or Ulysses).
 
-GQA: ``n_kv_heads < n_heads`` stores/computes K/V at the reduced head
-count and repeats them to full heads for the attention kernel — the
-repeat stays on-chip and XLA fuses it into the kernel operand
-materialization.
+GQA: ``n_kv_heads < n_heads`` stores/computes K/V (and their decode
+caches) at the reduced head count; the projections, optimizer state and
+cache memory all shrink by H/Hkv. The head-repeat feeding the attention
+kernel DOES materialize full-head K/V operands (pallas_call operands
+are opaque to XLA fusion) — a GQA-aware kernel index map is the
+remaining optimization.
 """
 
 import dataclasses
@@ -105,6 +107,7 @@ def apply_rope(x, cos, sin):
 
 class LlamaAttention(nn.Module):
     config: LlamaConfig
+    max_out_tokens: int = 0      # >0 → serving mode with a KV cache
 
     @nn.compact
     def __call__(self, x, positions):
@@ -124,6 +127,49 @@ class LlamaAttention(nn.Module):
         cos, sin = rope_angles(positions, D, cfg.rope_theta)
         qh = apply_rope(qh, cos, sin)
         kh = apply_rope(kh, cos, sin)
+
+        use_cache = self.max_out_tokens > 0 and (
+            self.has_variable("cache", "cached_key")
+            or self.is_mutable_collection("cache"))
+        if use_cache:
+            # serving: append RoPE'd K/V to the head-major cache and
+            # attend to the filled prefix (same layout/overflow contract
+            # as the fused GPT-2 stack, ops/transformer/inference.py)
+            L = self.max_out_tokens
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (B, Hkv, L, D), kh.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (B, Hkv, L, D), vh.dtype)
+            idx = self.variable("cache", "cache_index",
+                                lambda: jnp.zeros((), jnp.int32))
+            start = idx.value
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, kh, (0, 0, start, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, vh, (0, 0, start, 0))
+            idx.value = start + S
+            overflow = (start + S) > L
+            qh = jnp.where(overflow,
+                           jnp.float32(jnp.nan).astype(qh.dtype), qh)
+            k_all, v_all = ck.value, cv.value
+            if Hkv != H:
+                rep = H // Hkv
+                k_all = jnp.repeat(k_all, rep, axis=1)
+                v_all = jnp.repeat(v_all, rep, axis=1)
+            q_pos = start + jnp.arange(S)[:, None]
+            visible = jnp.arange(L)[None, :] <= q_pos        # [S, L]
+            dn_qk = (((3,), (3,)), ((0, 1), (0, 1)))
+            scores = jax.lax.dot_general(
+                qh, k_all, dn_qk).astype(jnp.float32) / np.sqrt(D)
+            scores = jnp.where(visible[None, None], scores,
+                               jnp.float32(-1e30))
+            probs = jax.nn.softmax(scores, axis=-1)
+            ctx = jax.lax.dot_general(
+                probs.astype(qh.dtype), v_all,
+                (((3,), (2,)), ((0, 1), (0, 1))))
+            out = ctx.transpose(0, 2, 1, 3).reshape(B, S, H * D)
+            return dense(E, "o_proj")(out)
+
         if Hkv != H:
             rep = H // Hkv
             kh = jnp.repeat(kh, rep, axis=1)
@@ -167,6 +213,7 @@ class LlamaMLP(nn.Module):
 
 class LlamaBlock(nn.Module):
     config: LlamaConfig
+    max_out_tokens: int = 0
 
     @nn.compact
     def __call__(self, x, positions):
@@ -174,7 +221,7 @@ class LlamaBlock(nn.Module):
         norm = lambda name: RMSNorm(  # noqa: E731
             eps=cfg.rms_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             name=name)
-        x = x + LlamaAttention(cfg, name="attn")(
+        x = x + LlamaAttention(cfg, self.max_out_tokens, name="attn")(
             norm("input_norm")(x), positions)
         x = x + LlamaMLP(cfg, name="mlp")(norm("post_attn_norm")(x))
         return x
@@ -189,11 +236,13 @@ def _maybe_remat(cfg):
 
 class _ScanBody(nn.Module):
     config: LlamaConfig
+    max_out_tokens: int = 0
 
     @nn.compact
     def __call__(self, x, positions):
         block = _maybe_remat(self.config)
-        return block(self.config, name="blk")(x, positions), None
+        return block(self.config, self.max_out_tokens,
+                     name="blk")(x, positions), None
 
 
 class LlamaForCausalLM(nn.Module):
@@ -201,30 +250,33 @@ class LlamaForCausalLM(nn.Module):
     head+loss (models/gpt2.chunked_lm_loss works for any untied head via
     the lm_head kernel)."""
     config: LlamaConfig
+    max_out_tokens: int = 0      # >0 → serving mode (KV caches)
 
     @nn.compact
     def __call__(self, input_ids, labels=None, deterministic=True,
-                 keep_prob=1.0):
+                 keep_prob=1.0, position_offset=0):
         cfg = self.config
         B, S = input_ids.shape
         embed = self.param("embed_tokens", nn.initializers.normal(0.02),
                            (cfg.vocab_size, cfg.hidden_size),
                            cfg.param_dtype)
         x = _embed_lookup(embed, input_ids).astype(cfg.dtype)
-        positions = jnp.arange(S)
+        positions = position_offset + jnp.arange(S)
 
         if cfg.scan_layers:
             scanned = nn.scan(_ScanBody,
-                              variable_axes={"params": 0},
+                              variable_axes={"params": 0, "cache": 0},
                               split_rngs={"params": True},
                               in_axes=(nn.broadcast,),
                               length=cfg.n_layers,
                               unroll=max(1, cfg.scan_unroll))
-            x, _ = scanned(cfg, name="layers")(x, positions)
+            x, _ = scanned(cfg, self.max_out_tokens,
+                           name="layers")(x, positions)
         else:
             block = _maybe_remat(cfg)
             for i in range(cfg.n_layers):
-                x = block(cfg, name=f"layers_{i}")(x, positions)
+                x = block(cfg, self.max_out_tokens,
+                          name=f"layers_{i}")(x, positions)
 
         x = RMSNorm(eps=cfg.rms_eps, dtype=cfg.dtype,
                     param_dtype=cfg.param_dtype, name="norm")(x)
@@ -238,6 +290,80 @@ class LlamaForCausalLM(nn.Module):
         if labels is not None:
             return lm_loss(logits, labels)
         return logits
+
+
+# ------------------------------------------------------------- serving
+
+import functools as _ft
+
+_LLAMA_STEP_CACHE = {}
+
+
+def _llama_compiled_steps(cfg: LlamaConfig, max_out: int):
+    """(prompt_pass, decode_scan) jitted once per (config, cache length)
+    — the same serving shape as models/gpt2_inference._compiled_steps."""
+    key = (cfg, max_out)
+    if key not in _LLAMA_STEP_CACHE:
+        model = LlamaForCausalLM(cfg, max_out_tokens=max_out)
+
+        @jax.jit
+        def prompt_pass(p, ids):
+            logits, vars_ = model.apply({"params": p}, ids,
+                                        mutable=["cache"])
+            return logits[:, -1], vars_["cache"]
+
+        @_ft.partial(jax.jit, static_argnums=(5,), donate_argnums=(1,))
+        def decode_scan(p, cache, first_tok, start, rngs, steps,
+                        temperature):
+            def tick(carry, r):
+                cache, tok, offset = carry
+                logits, vars_ = model.apply(
+                    {"params": p, "cache": cache}, tok[:, None],
+                    position_offset=offset, mutable=["cache"])
+                logits = logits[:, -1]
+                nxt = jax.lax.cond(
+                    temperature > 0,
+                    lambda: jax.random.categorical(
+                        r, logits / jnp.maximum(temperature, 1e-6),
+                        axis=-1),
+                    lambda: jnp.argmax(logits, axis=-1))
+                return (vars_["cache"], nxt, offset + 1), tok
+            (_, last, _), toks = jax.lax.scan(
+                tick, (cache, first_tok, start), rngs, length=steps)
+            return jnp.concatenate(
+                [toks.transpose(1, 0), last[:, None]], axis=1)
+
+        _LLAMA_STEP_CACHE[key] = (prompt_pass, decode_scan)
+    return _LLAMA_STEP_CACHE[key]
+
+
+def llama_generate(cfg: LlamaConfig, params, input_ids, max_new_tokens=20,
+                   temperature: float = 0.0, rng=None,
+                   max_out_tokens: int = 0):
+    """KV-cache generation for the LLaMA family — same contract as
+    models/gpt2_inference.generate: prompt pass fills the caches, the
+    whole decode loop is ONE compiled lax.scan program, temperature 0 is
+    greedy. RoPE positions are absolute (position_offset), so cached
+    decode matches a full re-forward exactly."""
+    input_ids = jnp.asarray(input_ids)
+    B, S = input_ids.shape
+    total = S + max_new_tokens
+    max_out = max_out_tokens or cfg.max_seq_len
+    assert total <= max_out, (total, max_out)
+    prompt_pass, decode_scan = _llama_compiled_steps(cfg, max_out)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    logits, cache = prompt_pass(params, input_ids)
+    rng, sub = jax.random.split(rng)
+    if temperature and temperature > 0:
+        first = jax.random.categorical(sub, logits / temperature, axis=-1)
+    else:
+        first = jnp.argmax(logits, axis=-1)
+    if max_new_tokens == 1:
+        return jnp.concatenate([input_ids, first[:, None]], axis=1)
+    new = decode_scan(params, cache, first, jnp.asarray(S, jnp.int32),
+                      jax.random.split(rng, max_new_tokens - 1),
+                      max_new_tokens - 1, jnp.float32(temperature or 0.0))
+    return jnp.concatenate([input_ids, new], axis=1)
 
 
 # ------------------------------------------------------------- TP rules

@@ -166,6 +166,7 @@ def decode_attention_int8(q, k_codes, k_scale, v_codes, v_scale, pos,
         block_l = min(L, 512)
         while L % block_l:
             block_l //= 2
+    assert L % block_l == 0, (L, block_l)
     ks4 = k_scale.reshape(B, H, 1, L)
     vs4 = v_scale.reshape(B, H, 1, L)
     pos = jnp.asarray(pos, jnp.int32).reshape(1)

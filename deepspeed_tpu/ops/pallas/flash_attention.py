@@ -38,14 +38,16 @@ from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
 
-# measured scoped-VMEM ceiling for whole-row residency on v5e: bf16 rows of
-# S=4096, D=64 (512 KB) compile; S=8192 overflows by 4.5 MB. The chunked
-# kernels use half of this per chunk to leave room for pipeline double
+# measured scoped-VMEM ceiling for whole-row residency on v5e. The r4
+# FUSED backward additionally keeps a fp32 [S, D] dq row resident, which
+# moved the ceiling DOWN: bf16 S=4096, D=64 compiled in a small harness
+# but the same shapes inside a larger program (bench.py's S=4096 dense
+# case, BH=64) overflow scoped vmem by 284 KB — so the unchunked cutoff
+# is now S*D*itemsize <= 256 KB (S=2048 at D=64 bf16) and S=4096 routes
+# to the chunked kernels, whose per-chunk residency is bounded. The
+# chunked kernels use half of this per chunk for pipeline double
 # buffering (chunk 4096 at S=32k overflowed by 0.9 MB; 2048 fits).
-# Re-validated for the fused backward (which additionally keeps a fp32
-# [S, D] dq row resident): causal bf16 S=4096, D=64 fwd+bwd compiles and
-# runs on the chip at this threshold.
-_UNCHUNKED_ROW_BYTES = 524288
+_UNCHUNKED_ROW_BYTES = 262144
 
 
 def _interpret_default():

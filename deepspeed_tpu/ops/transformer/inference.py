@@ -386,11 +386,15 @@ class DeepSpeedTransformerInference(nn.Module):
             overflow = (start + S) > L
             q = jnp.where(overflow, jnp.float32(jnp.nan).astype(q.dtype), q)
             if kv_scales is not None and S == 1 \
-                    and attention_mask is None and cfg.mp_size == 1:
+                    and attention_mask is None and cfg.mp_size == 1 \
+                    and B <= 8:
                 # mp_size > 1 stays on the XLA contractions: the Pallas
                 # kernel is an opaque custom call GSPMD cannot shard, so
                 # under TP it would all-gather the head-sharded caches
-                # to every shard each token
+                # to every shard each token. Large batches also stay on
+                # XLA: the kernel grid is (B, L/block) and grid steps
+                # cost ~1 us each, so per-token overhead scales with B
+                # while the XLA batched dots amortize it
                 # fused decode-attention kernel: scores + masked online
                 # softmax + context in ONE program over the int8 cache
                 # (compute past `pos` is skipped; the block DMAs still

@@ -137,3 +137,24 @@ def test_llama_matches_hf_logits():
         {"params": jax.tree_util.tree_map(jnp.asarray, params)},
         jnp.asarray(ids)))
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_llama_cached_decode_matches_reforward():
+    """Greedy KV-cache generation must equal argmax over full re-forwards
+    (the gpt2_inference serving contract; RoPE positions are absolute so
+    cached K/V match recomputed ones exactly in fp32)."""
+    from deepspeed_tpu.models.llama import llama_generate
+    cfg = llama_tiny(n_kv_heads=2)
+    rs = np.random.RandomState(6)
+    prompt = rs.randint(0, 512, (2, 20)).astype(np.int32)
+    params = jax.jit(LlamaForCausalLM(cfg).init)(
+        jax.random.PRNGKey(0), jnp.asarray(prompt[:, :8]))["params"]
+    toks = llama_generate(cfg, params, prompt, max_new_tokens=6,
+                          max_out_tokens=64)
+    model = LlamaForCausalLM(cfg)
+    cur = jnp.asarray(prompt)
+    for _ in range(6):
+        logits = model.apply({"params": params}, cur)
+        cur = jnp.concatenate(
+            [cur, jnp.argmax(logits[:, -1], axis=-1)[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(cur))
