@@ -151,22 +151,28 @@ class LlamaAttention(nn.Module):
             overflow = (start + S) > L
             qh = jnp.where(overflow,
                            jnp.float32(jnp.nan).astype(qh.dtype), qh)
-            k_all, v_all = ck.value, cv.value
-            if Hkv != H:
-                rep = H // Hkv
-                k_all = jnp.repeat(k_all, rep, axis=1)
-                v_all = jnp.repeat(v_all, rep, axis=1)
+            # GQA without materializing a repeated cache: fold the
+            # rep = H/Hkv query heads sharing each KV head into the
+            # contraction's row dim (q heads are grouped consecutively
+            # per KV head, so this is a pure reshape) — the decode loop
+            # reads the Hkv-head cache directly instead of rep x the
+            # bytes every token
+            rep = H // Hkv
+            qg = qh.reshape(B, Hkv, rep * S, D)
             q_pos = start + jnp.arange(S)[:, None]
             visible = jnp.arange(L)[None, :] <= q_pos        # [S, L]
+            vis_g = jnp.broadcast_to(visible[None],
+                                     (rep, S, L)).reshape(rep * S, L)
             dn_qk = (((3,), (3,)), ((0, 1), (0, 1)))
             scores = jax.lax.dot_general(
-                qh, k_all, dn_qk).astype(jnp.float32) / np.sqrt(D)
-            scores = jnp.where(visible[None, None], scores,
+                qg, ck.value, dn_qk).astype(jnp.float32) / np.sqrt(D)
+            scores = jnp.where(vis_g[None, None], scores,
                                jnp.float32(-1e30))
             probs = jax.nn.softmax(scores, axis=-1)
             ctx = jax.lax.dot_general(
-                probs.astype(qh.dtype), v_all,
-                (((3,), (2,)), ((0, 1), (0, 1))))
+                probs.astype(qh.dtype), cv.value,
+                (((3,), (2,)), ((0, 1), (0, 1))))           # [B,Hkv,rS,D]
+            ctx = ctx.reshape(B, H, S, D)
             out = ctx.transpose(0, 2, 1, 3).reshape(B, S, H * D)
             return dense(E, "o_proj")(out)
 
@@ -328,10 +334,13 @@ def _llama_compiled_steps(cfg: LlamaConfig, max_out: int):
                         axis=-1),
                     lambda: jnp.argmax(logits, axis=-1))
                 return (vars_["cache"], nxt, offset + 1), tok
-            (_, last, _), toks = jax.lax.scan(
+            (final_cache, last, _), toks = jax.lax.scan(
                 tick, (cache, first_tok, start), rngs, length=steps)
+            # final cache returned so the donated input aliases an output
+            # (otherwise every tick copies the caches — see
+            # gpt2_inference.decode_scan)
             return jnp.concatenate(
-                [toks.transpose(1, 0), last[:, None]], axis=1)
+                [toks.transpose(1, 0), last[:, None]], axis=1), final_cache
 
         _LLAMA_STEP_CACHE[key] = (prompt_pass, decode_scan)
     return _LLAMA_STEP_CACHE[key]
@@ -346,6 +355,8 @@ def llama_generate(cfg: LlamaConfig, params, input_ids, max_new_tokens=20,
     greedy. RoPE positions are absolute (position_offset), so cached
     decode matches a full re-forward exactly."""
     input_ids = jnp.asarray(input_ids)
+    if max_new_tokens <= 0:
+        return input_ids
     B, S = input_ids.shape
     total = S + max_new_tokens
     max_out = max_out_tokens or cfg.max_seq_len
@@ -360,9 +371,10 @@ def llama_generate(cfg: LlamaConfig, params, input_ids, max_new_tokens=20,
         first = jnp.argmax(logits, axis=-1)
     if max_new_tokens == 1:
         return jnp.concatenate([input_ids, first[:, None]], axis=1)
-    new = decode_scan(params, cache, first, jnp.asarray(S, jnp.int32),
-                      jax.random.split(rng, max_new_tokens - 1),
-                      max_new_tokens - 1, jnp.float32(temperature or 0.0))
+    new, _ = decode_scan(params, cache, first, jnp.asarray(S, jnp.int32),
+                         jax.random.split(rng, max_new_tokens - 1),
+                         max_new_tokens - 1,
+                         jnp.float32(temperature or 0.0))
     return jnp.concatenate([input_ids, new], axis=1)
 
 

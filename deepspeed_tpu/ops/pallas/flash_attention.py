@@ -48,6 +48,10 @@ NEG_INF = -1e30
 # chunked kernels use half of this per chunk for pipeline double
 # buffering (chunk 4096 at S=32k overflowed by 0.9 MB; 2048 fits).
 _UNCHUNKED_ROW_BYTES = 262144
+# per-chunk budget for the CHUNKED kernels (independent of the unchunked
+# cutoff above — they have no resident dq row): measured on v5e, chunk
+# 4096 at S=32k overflowed by 0.9 MB; 2048 fits
+_CHUNK_ROW_BYTES = 524288
 
 
 def _interpret_default():
@@ -570,7 +574,7 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     itemsize = jnp.dtype(q.dtype).itemsize
     if chunk is None and S * D * itemsize > _UNCHUNKED_ROW_BYTES:
         # whole-row residency stops fitting scoped VMEM — stream chunks
-        budget = max(_UNCHUNKED_ROW_BYTES // 2 // (D * itemsize), 1)
+        budget = max(_CHUNK_ROW_BYTES // 2 // (D * itemsize), 1)
         for cand in (4096, 2048, 1024, 512, 256, 128, 64):
             if cand <= budget and S % cand == 0 \
                     and cand % block_q == 0 and cand % block_k == 0:

@@ -133,8 +133,10 @@ def test_auto_chunk_dispatch(monkeypatch):
                     interpret)
 
     monkeypatch.setattr(fa, "_flash_fwd_chunked", spy)
-    # budget/2 // (D*itemsize) = 128 rows -> candidate 128 picked
+    # dispatch cutoff shrunk so S=512 routes to the chunked path, and
+    # chunk budget/2 // (D*itemsize) = 128 rows -> candidate 128 picked
     monkeypatch.setattr(fa, "_UNCHUNKED_ROW_BYTES", 128 * 2 * 16 * 4)
+    monkeypatch.setattr(fa, "_CHUNK_ROW_BYTES", 128 * 2 * 16 * 4)
     from deepspeed_tpu.ops.attention import reference_attention
     rng = np.random.RandomState(1)
     q = jnp.asarray(rng.randn(1, 2, 512, 16), jnp.float32)
