@@ -428,14 +428,21 @@ def bench_decode(jnp):
 
         run(4)                      # compile both lengths before timing
         run(68)
-        t0 = time.perf_counter()
-        run(4)
-        t_short = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        run(68)
-        t_long = time.perf_counter() - t0
-        # prompt pass and fixed overheads cancel in the difference
-        decode_tps = bs * 64 / (t_long - t_short)
+        # best of three difference-method windows: single samples swing
+        # ±10% through the tunnel (same reasoning as the headline's
+        # 3-window rule — report the machine, not the tunnel)
+        best_dt, t_short = float("inf"), 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            run(4)
+            t_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            run(68)
+            t_l = time.perf_counter() - t0
+            # prompt pass and fixed overheads cancel in the difference
+            if t_l - t_s < best_dt:
+                best_dt, t_short = t_l - t_s, t_s
+        decode_tps = bs * 64 / best_dt
         out[name] = {"decode_tokens_per_sec": round(decode_tps, 1),
                      "prompt_plus_4_tokens_s": round(t_short, 3)}
         del params, run   # run's closure pins params otherwise
