@@ -388,3 +388,259 @@ def out_ffn_int8(ctx, x, wp, sp, bp, ln_w, ln_b, w1, s1, b1, w2, s2, b2,
       jnp.asarray(bp).reshape(1, E), w1, jnp.asarray(b1).reshape(1, F),
       w2, jnp.asarray(b2).reshape(1, E))
     return out
+
+
+# ----------------------------- stacked-weight serving kernels (no slices)
+#
+# flax's nn.scan over layers SLICES every stacked array before the layer
+# body sees it: per tick per layer that is ~24 us of weight-slice copies
+# plus ~37 us of cache slice/unslice (device trace, b1/ctx2048 int8 —
+# ~60% of the token). These variants take the FULL [L, ...] stacks and
+# index the layer via scalar-prefetched block index maps, so the kernels
+# DMA exactly the tiles they need straight from the stacked HBM arrays.
+# The manual serving loop (models/gpt2_inference._fast_decode_scan) scans
+# layer INDICES and keeps the caches whole, updating one row in place.
+
+def ln_qkv_int8_stacked(x, ln_w, ln_b, wq_stack, s, b, layer, eps=1e-5,
+                        block_n=None, interpret=None):
+    """ln_qkv_int8 over stacked weights: wq_stack [L, E, 3E] int8 indexed
+    at ``layer`` by the block index map — no layer-slice copy. ln_w/ln_b/
+    s/b are the CURRENT layer's (small arrays travel fine as scan xs)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    B, E = x.shape
+    Lyr, Ew, N = wq_stack.shape
+    assert Ew == E and N == 3 * E
+    if block_n is None:
+        block_n = _pick_block(N, budget_cols=(1 << 23) // max(E, 1))
+    assert N % block_n == 0
+    s = jnp.asarray(s, jnp.float32).reshape(1, 1)
+    layer = jnp.asarray(layer, jnp.int32).reshape(1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(N // block_n,),
+        in_specs=[
+            pl.BlockSpec((B, E), lambda j, l: (0, 0)),
+            pl.BlockSpec((1, E), lambda j, l: (0, 0)),
+            pl.BlockSpec((1, E), lambda j, l: (0, 0)),
+            pl.BlockSpec((1, E, block_n), lambda j, l: (l[0], 0, j)),
+            pl.BlockSpec((1, 1), lambda j, l: (0, 0)),
+            pl.BlockSpec((1, block_n), lambda j, l: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((B, block_n), lambda j, l: (0, j)),
+        scratch_shapes=[pltpu.VMEM((B, E), x.dtype)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_ln_qkv_stacked_kernel, eps=eps),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, N), x.dtype),
+        interpret=interpret,
+    )(layer, x, ln_w.reshape(1, E), ln_b.reshape(1, E), wq_stack, s,
+      jnp.asarray(b).reshape(1, N))
+    return out
+
+
+def _ln_qkv_stacked_kernel(l_ref, x_ref, lnw_ref, lnb_ref, w_ref, s_ref,
+                           b_ref, o_ref, u_ref, *, eps):
+    j = pl.program_id(0)
+    dt = x_ref.dtype
+
+    @pl.when(j == 0)
+    def _ln_pass():
+        u_ref[...] = _ln(x_ref[...], lnw_ref[...], lnb_ref[...],
+                         eps).astype(dt)
+
+    u = u_ref[...]
+    w = w_ref[0].astype(dt)                        # [E, bn]
+    y = jax.lax.dot(u, w, preferred_element_type=jnp.float32)
+    o_ref[...] = (y * s_ref[0, 0]
+                  + b_ref[...].astype(jnp.float32)).astype(dt)
+
+
+def decode_attention_int8_stacked(q, k_stack, k_scale, v_stack, v_scale,
+                                  pos, layer, scale=None, block_l=None,
+                                  interpret=None):
+    """decode_attention_int8 over the stacked caches: k/v [L_layers, B,
+    H, L, D] int8 + scales [L_layers, B, H, L] fp32 indexed at ``layer``
+    by the block maps — the serving loop never slices a per-layer cache
+    out (which copied the full multi-MB cache each layer each tick)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    B, H, S, D = q.shape
+    assert S == 1
+    Lyr = k_stack.shape[0]
+    L = k_stack.shape[3]
+    scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
+    if block_l is None:
+        block_l = min(L, 512)
+        while L % block_l:
+            block_l //= 2
+    assert L % block_l == 0, (L, block_l)
+    ks5 = k_scale.reshape(Lyr, B, H, 1, L)
+    vs5 = v_scale.reshape(Lyr, B, H, 1, L)
+    scalars = jnp.stack([jnp.asarray(layer, jnp.int32).reshape(()),
+                         jnp.asarray(pos, jnp.int32).reshape(())])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, L // block_l),
+        in_specs=[
+            pl.BlockSpec((1, H, 1, D), lambda b, lb, sc: (b, 0, 0, 0)),
+            pl.BlockSpec((1, 1, H, block_l, D),
+                         lambda b, lb, sc: (sc[0], b, 0, lb, 0)),
+            pl.BlockSpec((1, 1, H, 1, block_l),
+                         lambda b, lb, sc: (sc[0], b, 0, 0, lb)),
+            pl.BlockSpec((1, 1, H, block_l, D),
+                         lambda b, lb, sc: (sc[0], b, 0, lb, 0)),
+            pl.BlockSpec((1, 1, H, 1, block_l),
+                         lambda b, lb, sc: (sc[0], b, 0, 0, lb)),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, lb, sc: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1, 1), jnp.float32),
+            pltpu.VMEM((H, 1, 1), jnp.float32),
+            pltpu.VMEM((H, 1, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_attn_stacked_kernel, scale=scale,
+                          block_l=block_l, seq_len=L),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        interpret=interpret,
+    )(scalars, q, k_stack, ks5, v_stack, vs5)
+    return out.reshape(B, H, 1, D)
+
+
+def _decode_attn_stacked_kernel(sc_ref, q_ref, k_ref, ks_ref, v_ref,
+                                vs_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                                scale, block_l, seq_len):
+    lb = pl.program_id(1)
+    nb = seq_len // block_l
+    pos = sc_ref[1]
+
+    @pl.when(lb == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref[...], -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref[...])
+        acc_ref[...] = jnp.zeros_like(acc_ref[...])
+
+    base = lb * block_l
+
+    @pl.when(base <= pos)
+    def _block():
+        q = q_ref[0]                                # [H, 1, D]
+        k = k_ref[0, 0].astype(q.dtype)             # [H, bl, D]
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        s = s * ks_ref[0, 0] * scale                # ks [H, 1, bl]
+        k_pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(k_pos <= pos, s, -1e30)
+        m_acc = m_ref[...]
+        m_new = jnp.maximum(m_acc, jnp.max(s, axis=2, keepdims=True))
+        m_ref[...] = m_new
+        alpha = jnp.exp(m_acc - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=2,
+                                                  keepdims=True)
+        pv = (p * vs_ref[0, 0]).astype(q.dtype)
+        v = v_ref[0, 0].astype(q.dtype)
+        ctx = jax.lax.dot_general(
+            pv, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha + ctx
+
+    @pl.when(lb == nb - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l_safe)[:, 0, :].astype(o_ref.dtype)
+
+
+def out_ffn_int8_stacked(ctx, x, wp_stack, sp, bp, ln_w, ln_b, w1_stack,
+                         s1, b1, w2_stack, s2, b2, layer, act="gelu_tanh",
+                         eps=1e-5, block_f=None, interpret=None):
+    """out_ffn_int8 over stacked weights: wp [L,E,E], w1 [L,E,F],
+    w2 [L,F,E] int8 indexed at ``layer`` by the block maps."""
+    if interpret is None:
+        interpret = _interpret_default()
+    B, E = ctx.shape
+    Lyr, Ew, F = w1_stack.shape
+    assert Ew == E and w2_stack.shape[1:] == (F, E)         and wp_stack.shape[1:] == (E, E)
+    if block_f is None:
+        block_f = _pick_block(F, budget_cols=(1 << 21) // max(E, 1))
+    assert F % block_f == 0, (F, block_f)
+    n_tiles = F // block_f
+    scales = jnp.stack([jnp.asarray(v, jnp.float32).reshape(())
+                        for v in (sp, s1, s2)]).reshape(1, 3)
+    layer = jnp.asarray(layer, jnp.int32).reshape(1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((B, E), lambda j, l: (0, 0)),
+            pl.BlockSpec((B, E), lambda j, l: (0, 0)),
+            pl.BlockSpec((1, E, E), lambda j, l: (l[0], 0, 0)),
+            pl.BlockSpec((1, E), lambda j, l: (0, 0)),
+            pl.BlockSpec((1, E), lambda j, l: (0, 0)),
+            pl.BlockSpec((1, 3), lambda j, l: (0, 0)),
+            pl.BlockSpec((1, E), lambda j, l: (0, 0)),
+            pl.BlockSpec((1, E, block_f), lambda j, l: (l[0], 0, j)),
+            pl.BlockSpec((1, block_f), lambda j, l: (0, j)),
+            pl.BlockSpec((1, block_f, E), lambda j, l: (l[0], j, 0)),
+            pl.BlockSpec((1, E), lambda j, l: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((B, E), lambda j, l: (0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((B, E), ctx.dtype),
+            pltpu.VMEM((B, E), ctx.dtype),
+            pltpu.VMEM((B, E), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_out_ffn_stacked_kernel, eps=eps, act=act,
+                          n_tiles=n_tiles),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, E), ctx.dtype),
+        interpret=interpret,
+    )(layer, ctx, x, wp_stack, ln_w.reshape(1, E), ln_b.reshape(1, E),
+      scales, jnp.asarray(bp).reshape(1, E), w1_stack,
+      jnp.asarray(b1).reshape(1, F), w2_stack,
+      jnp.asarray(b2).reshape(1, E))
+    return out
+
+
+def _out_ffn_stacked_kernel(l_ref, ctx_ref, x_ref, wp_ref, lnw_ref,
+                            lnb_ref, sc_ref, bp_ref, w1_ref, b1_ref,
+                            w2_ref, b2_ref, o_ref, x1_ref, u_ref,
+                            acc_ref, *, eps, act, n_tiles):
+    j = pl.program_id(0)
+    dt = ctx_ref.dtype
+
+    @pl.when(j == 0)
+    def _proj():
+        ctx = ctx_ref[...]
+        wp = wp_ref[0].astype(dt)
+        t = jax.lax.dot(ctx, wp, preferred_element_type=jnp.float32)
+        t = t * sc_ref[0, 0] + bp_ref[...].astype(jnp.float32)
+        x1 = x_ref[...].astype(jnp.float32) + t
+        x1_ref[...] = x1.astype(dt)
+        u_ref[...] = _ln(x1, lnw_ref[...], lnb_ref[...], eps).astype(dt)
+        acc_ref[...] = jnp.zeros_like(acc_ref[...])
+
+    u = u_ref[...]
+    w1 = w1_ref[0].astype(dt)
+    h = jax.lax.dot(u, w1, preferred_element_type=jnp.float32)
+    h = h * sc_ref[0, 1] + b1_ref[...].astype(jnp.float32)
+    if act == "gelu_tanh":
+        h = jax.nn.gelu(h, approximate=True)
+    else:
+        h = jax.nn.gelu(h, approximate=False)
+    w2 = w2_ref[0].astype(dt)
+    acc_ref[...] += jax.lax.dot(h.astype(dt), w2,
+                                preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_tiles - 1)
+    def _finish():
+        o_ref[...] = (x1_ref[...].astype(jnp.float32)
+                      + acc_ref[...] * sc_ref[0, 2]
+                      + b2_ref[...].astype(jnp.float32)).astype(o_ref.dtype)

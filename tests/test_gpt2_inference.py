@@ -240,3 +240,43 @@ def test_tp_sharded_decode_matches_single_device(devices8):
                       max_out_tokens=128, quantize_bits=8,
                       kv_cache_bits=8, mesh=mesh)
     np.testing.assert_array_equal(np.asarray(t_q), np.asarray(t_q_tp))
+
+
+def test_fast_decode_scan_matches_flax_path():
+    """The stacked-weight manual serving loop (_fast_decode_scan_fn —
+    kernels index whole weight/cache stacks via scalar-prefetch, caches
+    update one row in place) must produce EXACTLY the flax nn.scan
+    path's tokens, greedy and sampled, across prompts and batch>1. The
+    flax path slices every stacked array per layer per tick (~60% of the
+    decode token in copies — device trace r4c), which is why the manual
+    loop exists."""
+    import deepspeed_tpu.models.gpt2_inference as gi
+    ctx = 192
+    cfg = GPT2Config(vocab_size=512, n_positions=ctx, n_embd=256,
+                     n_layer=3, n_head=4, dtype=jnp.float32,
+                     param_dtype=jnp.float32, scan_layers=True)
+    rs = np.random.RandomState(7)
+    prompt = rs.randint(0, 512, size=(2, 40)).astype(np.int32)
+    params = jax.jit(GPT2LMHeadModel(cfg).init)(
+        jax.random.PRNGKey(3), prompt[:, :8])["params"]
+    qparams = quantize_gpt2_inference_params(
+        convert_gpt2_params(params, cfg))
+    assert gi._supports_fast_decode(cfg, 2, 8, 1, 8, 1)
+
+    def both(**kw):
+        t_fast = generate(cfg, qparams, prompt, max_new_tokens=8,
+                          max_out_tokens=ctx, scan_decode=True,
+                          quantize_bits=8, kv_cache_bits=8, **kw)
+        orig = gi._supports_fast_decode
+        gi._supports_fast_decode = lambda *a: False
+        try:
+            t_ref = generate(cfg, qparams, prompt, max_new_tokens=8,
+                             max_out_tokens=ctx, scan_decode=True,
+                             quantize_bits=8, kv_cache_bits=8, **kw)
+        finally:
+            gi._supports_fast_decode = orig
+        np.testing.assert_array_equal(np.asarray(t_fast),
+                                      np.asarray(t_ref))
+
+    both()                                        # greedy
+    both(temperature=0.8, rng=jax.random.PRNGKey(11))   # sampled
