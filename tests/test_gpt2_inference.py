@@ -12,6 +12,7 @@ from deepspeed_tpu.models.gpt2_inference import (
     GPT2InferenceModel,
     convert_gpt2_params,
     generate,
+    quantize_gpt2_inference_params,
 )
 
 
@@ -94,9 +95,6 @@ def test_generate_rejects_overlong_request():
 def test_int8_storage_serving():
     """int8 weight storage: params shrink to int8 codes, logits stay close
     to the fp path, generation runs (reference quantized inference)."""
-    from deepspeed_tpu.models.gpt2_inference import (
-        quantize_gpt2_inference_params,
-    )
     cfg, model, params, ids = _setup()
     ref = model.apply({"params": params}, ids)
     iparams = convert_gpt2_params(params, cfg)
@@ -214,8 +212,6 @@ def test_tp_sharded_decode_matches_single_device(devices8):
     exactly (greedy, fp32). Covers the bf16/fp32 GSPMD path AND the
     int8-weights path (whose fused single-chip kernels must gate
     themselves off under mp_size > 1)."""
-    from deepspeed_tpu.models.gpt2_inference import (
-        generate, convert_gpt2_params, quantize_gpt2_inference_params)
     from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
     cfg = GPT2Config(vocab_size=512, n_positions=128, n_embd=128,
                      n_layer=2, n_head=4, dtype=jnp.float32,
