@@ -285,27 +285,39 @@ def shard_inference_params(iparams, mesh):
 
 def _supports_fast_decode(cfg: GPT2Config, B, quantize_bits,
                           quantize_groups, kv_cache_bits, mp_size):
-    """Mirror of DeepSpeedTransformerInference's fused fast-path gate."""
-    return (quantize_bits == 8 and kv_cache_bits == 8
-            and quantize_groups == 1 and mp_size == 1 and B <= 8
+    """Gate for the fused manual serving loop. Any combination of
+    {bf16, int8} weights x {bf16, int8} KV cache is fused — the decode
+    kernels are dtype-agnostic on the weight path (the reference's
+    inference kernels are fp16-FIRST; quantization is an option, not a
+    prerequisite: csrc/transformer/inference/csrc/pt_binding.cpp)."""
+    return (quantize_bits in (0, 8) and kv_cache_bits in (0, 8)
+            and (quantize_bits == 0 or quantize_groups == 1)
+            and mp_size == 1 and B <= 8
             and cfg.n_embd % 128 == 0 and (4 * cfg.n_embd) % 128 == 0
             and cfg.scan_layers and cfg.moe_experts == 0
             and cfg.tie_word_embeddings)
 
 
-def _fast_decode_scan_fn(cfg: GPT2Config, max_out: int):
+def _fast_decode_scan_fn(cfg: GPT2Config, max_out: int,
+                         weights_q8: bool = True, cache_q8: bool = True):
     """Manual serving loop over STACKED weights/caches — the flax
     nn.scan path slices every stacked array per layer per tick (~60% of
     the decode token in slice/unslice copies, device trace r4c); here
     the layer loop carries the whole caches (one in-place row update
     each) and the Pallas kernels index the weight/cache stacks directly
-    via scalar-prefetched block maps (ops/pallas/decode.py *_stacked)."""
-    key = ("fast", cfg, max_out)
+    via scalar-prefetched block maps (ops/pallas/decode.py *_stacked).
+
+    ``weights_q8``/``cache_q8`` select int8 vs bf16 storage per side:
+    the weight kernels are dtype-agnostic (bf16 stacks run with
+    scale=1), the attention kernel has int8- and fp-cache variants, and
+    bf16 caches skip the kv-quant kernel entirely (3 Pallas calls per
+    layer instead of 4)."""
+    key = ("fast", cfg, max_out, weights_q8, cache_q8)
     if key in _STEP_CACHE:
         return _STEP_CACHE[key]
     from deepspeed_tpu.ops.pallas.decode import (
         ln_qkv_int8_stacked, kv_quant_int8, decode_attention_int8_stacked,
-        out_ffn_int8_stacked)
+        decode_attention_fp_stacked, out_ffn_int8_stacked)
     E, H = cfg.n_embd, cfg.n_head
     D = E // H
     Lyr = cfg.n_layer
@@ -319,33 +331,40 @@ def _fast_decode_scan_fn(cfg: GPT2Config, max_out: int):
         return (y * w.astype(jnp.float32)
                 + b.astype(jnp.float32)).astype(x.dtype)
 
-    @functools.partial(jax.jit, static_argnums=(7,),
-                       donate_argnums=(2, 3, 4, 5))
-    def fast_scan(p, blk, kc, ks, vc, vs, first_tok, steps, start, rngs,
+    wkey = "kernel_q" if weights_q8 else "kernel"
+
+    def _wscale(proj):
+        if weights_q8:
+            return proj["kernel_scale"].reshape(Lyr)
+        return jnp.ones((Lyr,), jnp.float32)
+
+    @functools.partial(jax.jit, static_argnums=(4,),
+                       donate_argnums=(2,))
+    def fast_scan(p, blk, caches, first_tok, steps, start, rngs,
                   temperature):
         wte = jnp.asarray(p["wte"]).astype(cfg.dtype)
         wpe = jnp.asarray(p["wpe"]).astype(cfg.dtype)
         lnf_w, lnf_b = p["ln_f"]["scale"], p["ln_f"]["bias"]
-        Wq = blk["attn_qkvw"]["kernel_q"]
-        Wp = blk["attn_ow"]["kernel_q"]
-        W1 = blk["inter_w"]["kernel_q"]
-        W2 = blk["output_w"]["kernel_q"]
+        Wq = blk["attn_qkvw"][wkey]
+        Wp = blk["attn_ow"][wkey]
+        W1 = blk["inter_w"][wkey]
+        W2 = blk["output_w"][wkey]
         xs = (jnp.arange(Lyr, dtype=jnp.int32),
               blk["attn_nw"]["scale"], blk["attn_nw"]["bias"],
-              blk["attn_qkvw"]["kernel_scale"].reshape(Lyr),
+              _wscale(blk["attn_qkvw"]),
               blk["attn_qkvw"]["bias"],
-              blk["attn_ow"]["kernel_scale"].reshape(Lyr),
+              _wscale(blk["attn_ow"]),
               blk["attn_ow"]["bias"],
               blk["norm_w"]["scale"], blk["norm_w"]["bias"],
-              blk["inter_w"]["kernel_scale"].reshape(Lyr),
+              _wscale(blk["inter_w"]),
               blk["inter_w"]["bias"],
-              blk["output_w"]["kernel_scale"].reshape(Lyr),
+              _wscale(blk["output_w"]),
               blk["output_w"]["bias"])
         B = first_tok.shape[0]
-        L_cache = kc.shape[3]
+        L_cache = caches[0].shape[3]
 
         def tick(carry, r):
-            (kc, ks, vc, vs), tok, offset = carry
+            caches, tok, offset = carry
             x = wte[tok] + wpe[offset][None]         # [B, E]
             # overflow: clamped row writes would silently serve stale
             # context — poison, same contract as the flax path
@@ -353,7 +372,7 @@ def _fast_decode_scan_fn(cfg: GPT2Config, max_out: int):
                           jnp.float32(jnp.nan).astype(x.dtype), x)
 
             def layer(car, inp):
-                x, kc, ks, vc, vs = car
+                x, caches = car
                 (l, lnw1, lnb1, sq, bq, sp_, bp, lnw2, lnb2, s1, b1,
                  s2, b2) = inp
                 qkv = ln_qkv_int8_stacked(x, lnw1, lnb1, Wq, sq, bq, l,
@@ -361,24 +380,40 @@ def _fast_decode_scan_fn(cfg: GPT2Config, max_out: int):
                 q = qkv[:, :E]
                 k3 = qkv[:, E:2 * E].reshape(B, H, D)
                 v3 = qkv[:, 2 * E:].reshape(B, H, D)
-                kq8, ksc, vq8, vsc = kv_quant_int8(k3, v3)
                 dus = jax.lax.dynamic_update_slice
-                kc = dus(kc, kq8[None, :, :, None, :], (l, 0, 0, offset, 0))
-                vc = dus(vc, vq8[None, :, :, None, :], (l, 0, 0, offset, 0))
-                ks = dus(ks, ksc.reshape(1, B, H, 1), (l, 0, 0, offset))
-                vs = dus(vs, vsc.reshape(1, B, H, 1), (l, 0, 0, offset))
                 qh = q.reshape(B, 1, H, D).transpose(0, 2, 1, 3)
-                ctx = decode_attention_int8_stacked(
-                    qh, kc, ks, vc, vs, offset, l, scale=1.0 / np.sqrt(D))
+                if cache_q8:
+                    kc, ks, vc, vs = caches
+                    kq8, ksc, vq8, vsc = kv_quant_int8(k3, v3)
+                    kc = dus(kc, kq8[None, :, :, None, :],
+                             (l, 0, 0, offset, 0))
+                    vc = dus(vc, vq8[None, :, :, None, :],
+                             (l, 0, 0, offset, 0))
+                    ks = dus(ks, ksc.reshape(1, B, H, 1),
+                             (l, 0, 0, offset))
+                    vs = dus(vs, vsc.reshape(1, B, H, 1),
+                             (l, 0, 0, offset))
+                    ctx = decode_attention_int8_stacked(
+                        qh, kc, ks, vc, vs, offset, l,
+                        scale=1.0 / np.sqrt(D))
+                    caches = (kc, ks, vc, vs)
+                else:
+                    kc, vc = caches
+                    kc = dus(kc, k3[None, :, :, None, :].astype(kc.dtype),
+                             (l, 0, 0, offset, 0))
+                    vc = dus(vc, v3[None, :, :, None, :].astype(vc.dtype),
+                             (l, 0, 0, offset, 0))
+                    ctx = decode_attention_fp_stacked(
+                        qh, kc, vc, offset, l, scale=1.0 / np.sqrt(D))
+                    caches = (kc, vc)
                 ctx2 = ctx.transpose(0, 2, 1, 3).reshape(B, E)
                 x = out_ffn_int8_stacked(
                     ctx2, x, Wp, sp_, bp, lnw2, lnb2, W1, s1, b1, W2,
                     s2, b2, l,
                     act="gelu_tanh", eps=eps)
-                return (x, kc, ks, vc, vs), None
+                return (x, caches), None
 
-            (x, kc, ks, vc, vs), _ = jax.lax.scan(
-                layer, (x, kc, ks, vc, vs), xs)
+            (x, caches), _ = jax.lax.scan(layer, (x, caches), xs)
             logits = jnp.einsum("be,ve->bv", _ln_f(x, lnf_w, lnf_b), wte)
             nxt = jax.lax.cond(
                 temperature > 0,
@@ -386,13 +421,12 @@ def _fast_decode_scan_fn(cfg: GPT2Config, max_out: int):
                     r, logits.astype(jnp.float32)
                     / jnp.maximum(temperature, 1e-6), axis=-1),
                 lambda: jnp.argmax(logits, axis=-1))
-            return ((kc, ks, vc, vs), nxt, offset + 1), tok
+            return (caches, nxt, offset + 1), tok
 
-        ((kc, ks, vc, vs), last, _), toks = jax.lax.scan(
-            tick, ((kc, ks, vc, vs), first_tok, start), rngs,
-            length=steps)
+        (caches, last, _), toks = jax.lax.scan(
+            tick, (caches, first_tok, start), rngs, length=steps)
         return (jnp.concatenate([toks.transpose(1, 0), last[:, None]],
-                                axis=1), kc, ks, vc, vs)
+                                axis=1), caches)
 
     _STEP_CACHE[key] = fast_scan
     return fast_scan
@@ -458,14 +492,19 @@ def generate(cfg: GPT2Config, params, input_ids, max_new_tokens=20,
         first = pick(logits, sub)
         if _supports_fast_decode(cfg, B, quantize_bits, quantize_groups,
                                  kv_cache_bits, mp_size):
-            fast = _fast_decode_scan_fn(cfg, max_out)
+            fast = _fast_decode_scan_fn(cfg, max_out,
+                                        weights_q8=quantize_bits == 8,
+                                        cache_q8=kv_cache_bits == 8)
             blk = iparams["h"]["blk"]
             cblk = cache["h"]["blk"]
-            new, *_ = fast(
+            if kv_cache_bits == 8:
+                caches = (cblk["cached_key_q8"], cblk["key_scale"],
+                          cblk["cached_value_q8"], cblk["value_scale"])
+            else:
+                caches = (cblk["cached_key"], cblk["cached_value"])
+            new, _ = fast(
                 {"wte": iparams["wte"], "wpe": iparams["wpe"],
-                 "ln_f": iparams["ln_f"]}, blk,
-                cblk["cached_key_q8"], cblk["key_scale"],
-                cblk["cached_value_q8"], cblk["value_scale"],
+                 "ln_f": iparams["ln_f"]}, blk, caches,
                 first, max_new_tokens - 1, jnp.asarray(S, jnp.int32),
                 jax.random.split(rng, max_new_tokens - 1),
                 jnp.float32(temperature or 0.0))

@@ -1,4 +1,4 @@
-"""Fused decode (S=1) kernels for int8-weight serving.
+"""Fused decode (S=1) kernels for int8- and bf16-weight serving.
 
 The scan-decode step at GPT-2-large b1/ctx2048 spends ~1.6 ms/token on
 weight+cache reads but ~5.2 ms/token wall — the rest is per-op fixed cost
@@ -20,6 +20,17 @@ fused decode GEMM+softmax CUDA kernels for exactly this regime.
 
 All kernels are bandwidth-bound at decode shapes; grids are sized so each
 program's working set fits VMEM with double-buffered DMA.
+
+The weight-consuming kernels are dtype-agnostic: the in-kernel
+``astype(compute)`` that dequantizes int8 codes is an identity cast for
+bf16 stacks, and the per-tensor scale multiply is harmless at 1.0 — so
+the SAME kernels serve plain bf16 weights (the reference's fp16-first
+inference kernels, csrc/transformer/inference/csrc/pt_binding.cpp) by
+passing the raw kernel stacks with scale=1. Only the cached-attention
+kernel needs a real variant (``decode_attention_fp_stacked``): its int8
+form reads per-(b,h,pos) scale ARRAYS, which have no fp counterpart.
+Block budgets are byte-based, so bf16 tiles automatically halve their
+column counts to stay inside VMEM.
 """
 
 import functools
@@ -73,7 +84,8 @@ def matvec_int8(x, wq, scale, bias, act=None, block_n=None, interpret=None):
     E2, N = wq.shape
     assert E == E2, (x.shape, wq.shape)
     if block_n is None:
-        block_n = _pick_block(N, budget_cols=(1 << 21) // max(E, 1))
+        block_n = _pick_block(
+            N, budget_cols=(1 << 21) // max(E * wq.dtype.itemsize, 1))
     assert N % block_n == 0, (N, block_n)
     scale = jnp.asarray(scale, jnp.float32).reshape(1, 1)
     bias2 = jnp.asarray(bias).reshape(1, N)     # 2-D: Mosaic tiles 1-D
@@ -240,7 +252,8 @@ def ln_qkv_int8(x, ln_w, ln_b, wq, s, b, eps=1e-5, block_n=None,
     N = 3 * E
     assert wq.shape == (E, N)
     if block_n is None:
-        block_n = _pick_block(N, budget_cols=(1 << 23) // max(E, 1))
+        block_n = _pick_block(
+            N, budget_cols=(1 << 23) // max(E * wq.dtype.itemsize, 1))
     assert N % block_n == 0
     s = jnp.asarray(s, jnp.float32).reshape(1, 1)
     out = pl.pallas_call(
@@ -354,7 +367,8 @@ def out_ffn_int8(ctx, x, wp, sp, bp, ln_w, ln_b, w1, s1, b1, w2, s2, b2,
     Ew, F = w1.shape
     assert Ew == E and w2.shape == (F, E) and wp.shape == (E, E)
     if block_f is None:
-        block_f = _pick_block(F, budget_cols=(1 << 21) // max(E, 1))
+        block_f = _pick_block(
+            F, budget_cols=(1 << 21) // max(E * w1.dtype.itemsize, 1))
     assert F % block_f == 0, (F, block_f)
     n_tiles = F // block_f
     scales = jnp.stack([jnp.asarray(v, jnp.float32).reshape(())
@@ -412,7 +426,8 @@ def ln_qkv_int8_stacked(x, ln_w, ln_b, wq_stack, s, b, layer, eps=1e-5,
     Lyr, Ew, N = wq_stack.shape
     assert Ew == E and N == 3 * E
     if block_n is None:
-        block_n = _pick_block(N, budget_cols=(1 << 23) // max(E, 1))
+        block_n = _pick_block(
+            N, budget_cols=(1 << 23) // max(E * wq_stack.dtype.itemsize, 1))
     assert N % block_n == 0
     s = jnp.asarray(s, jnp.float32).reshape(1, 1)
     layer = jnp.asarray(layer, jnp.int32).reshape(1)
@@ -472,9 +487,7 @@ def decode_attention_int8_stacked(q, k_stack, k_scale, v_stack, v_scale,
     L = k_stack.shape[3]
     scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
     if block_l is None:
-        block_l = min(L, 512)
-        while L % block_l:
-            block_l //= 2
+        block_l = _pick_block_l(L, H, D, k_stack.dtype.itemsize)
     assert L % block_l == 0, (L, block_l)
     ks5 = k_scale.reshape(Lyr, B, H, 1, L)
     vs5 = v_scale.reshape(Lyr, B, H, 1, L)
@@ -503,7 +516,7 @@ def decode_attention_int8_stacked(q, k_stack, k_scale, v_stack, v_scale,
     )
     out = pl.pallas_call(
         functools.partial(_decode_attn_stacked_kernel, scale=scale,
-                          block_l=block_l, seq_len=L),
+                          block_l=block_l, seq_len=L, quantized=True),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
         interpret=interpret,
@@ -511,9 +524,27 @@ def decode_attention_int8_stacked(q, k_stack, k_scale, v_stack, v_scale,
     return out.reshape(B, H, 1, D)
 
 
-def _decode_attn_stacked_kernel(sc_ref, q_ref, k_ref, ks_ref, v_ref,
-                                vs_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                                scale, block_l, seq_len):
+def _pick_block_l(L, H, D, itemsize, budget_bytes=1 << 21):
+    """Largest cache-row block (≤512, dividing L) whose [H, block, D]
+    tile stays inside the per-block VMEM byte budget — bf16 caches halve
+    their row count vs int8 automatically."""
+    blk = min(L, 512)
+    while blk > 128 and H * blk * D * itemsize > budget_bytes:
+        blk //= 2
+    while L % blk:
+        blk //= 2
+    return max(blk, 1)
+
+
+def _decode_attn_stacked_kernel(sc_ref, q_ref, *rest, scale, block_l,
+                                seq_len, quantized):
+    """One online-softmax body for BOTH cache storages: ``quantized``
+    (static) selects whether per-(b,h,pos) scale refs exist in the
+    operand list — the masking/rescale/finish logic stays single-copy."""
+    if quantized:
+        k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = rest
     lb = pl.program_id(1)
     nb = seq_len // block_l
     pos = sc_ref[1]
@@ -533,7 +564,9 @@ def _decode_attn_stacked_kernel(sc_ref, q_ref, k_ref, ks_ref, v_ref,
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
-        s = s * ks_ref[0, 0] * scale                # ks [H, 1, bl]
+        s = s * scale
+        if quantized:
+            s = s * ks_ref[0, 0]                    # ks [H, 1, bl]
         k_pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
         s = jnp.where(k_pos <= pos, s, -1e30)
         m_acc = m_ref[...]
@@ -543,7 +576,9 @@ def _decode_attn_stacked_kernel(sc_ref, q_ref, k_ref, ks_ref, v_ref,
         p = jnp.exp(s - m_new)
         l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=2,
                                                   keepdims=True)
-        pv = (p * vs_ref[0, 0]).astype(q.dtype)
+        if quantized:
+            p = p * vs_ref[0, 0]
+        pv = p.astype(q.dtype)
         v = v_ref[0, 0].astype(q.dtype)
         ctx = jax.lax.dot_general(
             pv, v, (((2,), (1,)), ((0,), (0,))),
@@ -565,9 +600,11 @@ def out_ffn_int8_stacked(ctx, x, wp_stack, sp, bp, ln_w, ln_b, w1_stack,
         interpret = _interpret_default()
     B, E = ctx.shape
     Lyr, Ew, F = w1_stack.shape
-    assert Ew == E and w2_stack.shape[1:] == (F, E)         and wp_stack.shape[1:] == (E, E)
+    assert Ew == E and w2_stack.shape[1:] == (F, E) \
+        and wp_stack.shape[1:] == (E, E)
     if block_f is None:
-        block_f = _pick_block(F, budget_cols=(1 << 21) // max(E, 1))
+        block_f = _pick_block(
+            F, budget_cols=(1 << 21) // max(E * w1_stack.dtype.itemsize, 1))
     assert F % block_f == 0, (F, block_f)
     n_tiles = F // block_f
     scales = jnp.stack([jnp.asarray(v, jnp.float32).reshape(())
@@ -607,6 +644,50 @@ def out_ffn_int8_stacked(ctx, x, wp_stack, sp, bp, ln_w, ln_b, w1_stack,
       jnp.asarray(b1).reshape(1, F), w2_stack,
       jnp.asarray(b2).reshape(1, E))
     return out
+
+
+def decode_attention_fp_stacked(q, k_stack, v_stack, pos, layer,
+                                scale=None, block_l=None, interpret=None):
+    """decode_attention over stacked FULL-PRECISION (bf16/fp32) caches:
+    k/v [L_layers, B, H, L, D] indexed at ``layer`` by the block maps.
+    Same online-softmax structure as the int8 variant minus the per-
+    (b, h, pos) scale arrays (which have no fp counterpart)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    B, H, S, D = q.shape
+    assert S == 1
+    L = k_stack.shape[3]
+    scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
+    if block_l is None:
+        block_l = _pick_block_l(L, H, D, k_stack.dtype.itemsize)
+    assert L % block_l == 0, (L, block_l)
+    scalars = jnp.stack([jnp.asarray(layer, jnp.int32).reshape(()),
+                         jnp.asarray(pos, jnp.int32).reshape(())])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, L // block_l),
+        in_specs=[
+            pl.BlockSpec((1, H, 1, D), lambda b, lb, sc: (b, 0, 0, 0)),
+            pl.BlockSpec((1, 1, H, block_l, D),
+                         lambda b, lb, sc: (sc[0], b, 0, lb, 0)),
+            pl.BlockSpec((1, 1, H, block_l, D),
+                         lambda b, lb, sc: (sc[0], b, 0, lb, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, lb, sc: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1, 1), jnp.float32),
+            pltpu.VMEM((H, 1, 1), jnp.float32),
+            pltpu.VMEM((H, 1, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_attn_stacked_kernel, scale=scale,
+                          block_l=block_l, seq_len=L, quantized=False),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        interpret=interpret,
+    )(scalars, q, k_stack, v_stack)
+    return out.reshape(B, H, 1, D)
 
 
 def _out_ffn_stacked_kernel(l_ref, ctx_ref, x_ref, wp_ref, lnw_ref,

@@ -140,3 +140,25 @@ def test_out_ffn_int8_matches_xla(rs):
     got = out_ffn_int8(ctx, x, wp, sp, bp, lw, lb, w1, s1, b1, w2, s2, b2,
                        block_f=256)
     np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_decode_attention_fp_stacked_multiblock(rs):
+    """Full-precision stacked cache variant with block_l below L — pins
+    the cross-block online-softmax carry (alpha rescale + m writeback)
+    of the shared kernel body on its quantized=False operand layout, and
+    the layer block-index maps."""
+    from deepspeed_tpu.ops.pallas.decode import decode_attention_fp_stacked
+    Lyr, B, H, D, L, pos, layer = 3, 2, 4, 64, 256, 150, 1
+    q = jnp.asarray(rs.randn(B, H, 1, D), jnp.float32) * 0.3
+    kc = jnp.asarray(rs.randn(Lyr, B, H, L, D), jnp.float32)
+    vc = jnp.asarray(rs.randn(Lyr, B, H, L, D), jnp.float32)
+    dn_qk = (((3,), (3,)), ((0, 1), (0, 1)))
+    scores = jax.lax.dot_general(q, kc[layer], dn_qk) * (1.0 / np.sqrt(D))
+    vis = jnp.arange(L)[None, None, None, :] <= pos
+    scores = jnp.where(vis, scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    ref = jax.lax.dot_general(p, vc[layer],
+                              (((3,), (2,)), ((0, 1), (0, 1))))
+    got = decode_attention_fp_stacked(q, kc, vc, pos, layer, block_l=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)

@@ -246,33 +246,51 @@ def test_fast_decode_scan_matches_flax_path():
     flax path slices every stacked array per layer per tick (~60% of the
     decode token in copies — device trace r4c), which is why the manual
     loop exists."""
+    _parity_case(quantize_bits=8, kv_cache_bits=8)              # greedy
+    _parity_case(quantize_bits=8, kv_cache_bits=8,              # sampled
+                 temperature=0.8, rng=jax.random.PRNGKey(11))
+
+
+def _parity_case(quantize_bits, kv_cache_bits, **gen_kw):
+    """Fused fast-decode loop vs the flax path for one storage combo
+    (optional generate kwargs, e.g. temperature/rng for sampled mode)."""
     import deepspeed_tpu.models.gpt2_inference as gi
     ctx = 192
     cfg = GPT2Config(vocab_size=512, n_positions=ctx, n_embd=256,
                      n_layer=3, n_head=4, dtype=jnp.float32,
                      param_dtype=jnp.float32, scan_layers=True)
-    rs = np.random.RandomState(7)
+    rs = np.random.RandomState(13)
     prompt = rs.randint(0, 512, size=(2, 40)).astype(np.int32)
     params = jax.jit(GPT2LMHeadModel(cfg).init)(
-        jax.random.PRNGKey(3), prompt[:, :8])["params"]
-    qparams = quantize_gpt2_inference_params(
-        convert_gpt2_params(params, cfg))
-    assert gi._supports_fast_decode(cfg, 2, 8, 1, 8, 1)
+        jax.random.PRNGKey(5), prompt[:, :8])["params"]
+    sparams = convert_gpt2_params(params, cfg)
+    if quantize_bits == 8:
+        sparams = quantize_gpt2_inference_params(sparams)
+    assert gi._supports_fast_decode(cfg, 2, quantize_bits, 1,
+                                    kv_cache_bits, 1)
+    kw = dict(max_new_tokens=8, max_out_tokens=ctx, scan_decode=True,
+              quantize_bits=quantize_bits, kv_cache_bits=kv_cache_bits,
+              **gen_kw)
+    t_fast = generate(cfg, sparams, prompt, **kw)
+    orig = gi._supports_fast_decode
+    gi._supports_fast_decode = lambda *a: False
+    try:
+        t_ref = generate(cfg, sparams, prompt, **kw)
+    finally:
+        gi._supports_fast_decode = orig
+    np.testing.assert_array_equal(np.asarray(t_fast), np.asarray(t_ref))
 
-    def both(**kw):
-        t_fast = generate(cfg, qparams, prompt, max_new_tokens=8,
-                          max_out_tokens=ctx, scan_decode=True,
-                          quantize_bits=8, kv_cache_bits=8, **kw)
-        orig = gi._supports_fast_decode
-        gi._supports_fast_decode = lambda *a: False
-        try:
-            t_ref = generate(cfg, qparams, prompt, max_new_tokens=8,
-                             max_out_tokens=ctx, scan_decode=True,
-                             quantize_bits=8, kv_cache_bits=8, **kw)
-        finally:
-            gi._supports_fast_decode = orig
-        np.testing.assert_array_equal(np.asarray(t_fast),
-                                      np.asarray(t_ref))
 
-    both()                                        # greedy
-    both(temperature=0.8, rng=jax.random.PRNGKey(11))   # sampled
+def test_fast_decode_bf16_weights_bf16_cache_parity():
+    """Plain full-precision serving must take the fused loop too — the
+    reference's inference kernels are fp16-first, quantization optional
+    (csrc/transformer/inference/csrc/pt_binding.cpp)."""
+    _parity_case(quantize_bits=0, kv_cache_bits=0)
+
+
+def test_fast_decode_bf16_weights_int8_cache_parity():
+    _parity_case(quantize_bits=0, kv_cache_bits=8)
+
+
+def test_fast_decode_int8_weights_bf16_cache_parity():
+    _parity_case(quantize_bits=8, kv_cache_bits=0)
