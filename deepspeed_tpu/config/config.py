@@ -47,11 +47,21 @@ class ZeroOffloadConfig:
         #   "device" — require the streamed path (error if unsupported)
         #   "host"   — force the numpy/SIMD host runner (reference shape)
         self.stream = str(get_scalar_param(d, C.OFFLOAD_STREAM, "auto"))
+        # TPU extension (offload_param only): >0 selects the ZeRO-Infinity
+        # segment-streamed engine (runtime/zero/infinity.py) — the model's
+        # scan-stacked layers split into this many segments whose params
+        # stream through HBM one at a time; master+moments rest in
+        # pinned_host, compute params rest on NVMe.
+        self.stream_segments = int(get_scalar_param(
+            d, C.OFFLOAD_STREAM_SEGMENTS, 0))
         if role != "optimizer":
             if C.OFFLOAD_STREAM in d:
                 raise DeepSpeedConfigError(
                     "'stream' applies to offload_optimizer only (the param "
                     "tier is pinned_host/NVMe residency, not a step mode)")
+        elif self.stream_segments:
+            raise DeepSpeedConfigError(
+                "'stream_segments' applies to offload_param only")
         elif self.stream not in ("auto", "device", "host"):
             raise DeepSpeedConfigError(
                 f"offload stream must be auto|device|host, got {self.stream!r}")

@@ -164,6 +164,7 @@ OFFLOAD_PIPELINE_WRITE = "pipeline_write"
 OFFLOAD_FAST_INIT = "fast_init"
 # TPU extension: how the offloaded optimizer step executes (offload_stream.py)
 OFFLOAD_STREAM = "stream"
+OFFLOAD_STREAM_SEGMENTS = "stream_segments"
 
 # stage-3 tuning knobs (reference zero/constants.py)
 ZERO_PREFETCH_BUCKET_SIZE = "stage3_prefetch_bucket_size"

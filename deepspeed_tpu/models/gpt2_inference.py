@@ -292,7 +292,7 @@ def _supports_fast_decode(cfg: GPT2Config, B, quantize_bits,
     prerequisite: csrc/transformer/inference/csrc/pt_binding.cpp)."""
     return (quantize_bits in (0, 8) and kv_cache_bits in (0, 8)
             and (quantize_bits == 0 or quantize_groups == 1)
-            and mp_size == 1 and B <= 8
+            and mp_size == 1 and B <= 64
             and cfg.n_embd % 128 == 0 and (4 * cfg.n_embd) % 128 == 0
             and cfg.scan_layers and cfg.moe_experts == 0
             and cfg.tie_word_embeddings)
@@ -349,19 +349,34 @@ def _fast_decode_scan_fn(cfg: GPT2Config, max_out: int,
         Wp = blk["attn_ow"][wkey]
         W1 = blk["inter_w"][wkey]
         W2 = blk["output_w"][wkey]
-        xs = (jnp.arange(Lyr, dtype=jnp.int32),
-              blk["attn_nw"]["scale"], blk["attn_nw"]["bias"],
-              _wscale(blk["attn_qkvw"]),
-              blk["attn_qkvw"]["bias"],
-              _wscale(blk["attn_ow"]),
-              blk["attn_ow"]["bias"],
-              blk["norm_w"]["scale"], blk["norm_w"]["bias"],
-              _wscale(blk["inter_w"]),
-              blk["inter_w"]["bias"],
-              _wscale(blk["output_w"]),
-              blk["output_w"]["bias"])
+        # every per-layer parameter stays STACKED — the kernels fetch
+        # their own layer's LN/bias tiles via layer-indexed block maps
+        # and read the per-tensor scales from SMEM prefetch vectors.
+        # (13 per-layer xs here cost ~15-20 us of slice/copy overhead
+        # EACH per layer on this target — r5 b32 device trace.)
+        # [Lyr, 1, cols] so the kernels' per-layer blocks are (1,1,cols)
+        # — reshaped ONCE here, not per layer call (layout copy)
+        r3 = lambda a: a.reshape(Lyr, 1, a.shape[-1])
+        ln1_w, ln1_b = r3(blk["attn_nw"]["scale"]), r3(blk["attn_nw"]["bias"])
+        ln2_w, ln2_b = r3(blk["norm_w"]["scale"]), r3(blk["norm_w"]["bias"])
+        bq = r3(blk["attn_qkvw"]["bias"])
+        bp = r3(blk["attn_ow"]["bias"])
+        b1 = r3(blk["inter_w"]["bias"])
+        b2 = r3(blk["output_w"]["bias"])
+        sq = _wscale(blk["attn_qkvw"])
+        sp_ = _wscale(blk["attn_ow"])
+        s1 = _wscale(blk["inter_w"])
+        s2 = _wscale(blk["output_w"])
         B = first_tok.shape[0]
         L_cache = caches[0].shape[3]
+        if cache_q8:
+            # scale arrays live lane-major [Lyr, B, H, 1, L] for the
+            # attention kernel's block maps; reshaping per layer call
+            # materializes a full-stack copy each time (tiled layouts
+            # differ), so do it ONCE here
+            kc, ks, vc, vs = caches
+            caches = (kc, ks.reshape(Lyr, B, H, 1, L_cache),
+                      vc, vs.reshape(Lyr, B, H, 1, L_cache))
 
         def tick(carry, r):
             caches, tok, offset = carry
@@ -371,11 +386,9 @@ def _fast_decode_scan_fn(cfg: GPT2Config, max_out: int,
             x = jnp.where(offset >= L_cache,
                           jnp.float32(jnp.nan).astype(x.dtype), x)
 
-            def layer(car, inp):
+            def layer(car, l):
                 x, caches = car
-                (l, lnw1, lnb1, sq, bq, sp_, bp, lnw2, lnb2, s1, b1,
-                 s2, b2) = inp
-                qkv = ln_qkv_int8_stacked(x, lnw1, lnb1, Wq, sq, bq, l,
+                qkv = ln_qkv_int8_stacked(x, ln1_w, ln1_b, Wq, sq, bq, l,
                                           eps=eps)
                 q = qkv[:, :E]
                 k3 = qkv[:, E:2 * E].reshape(B, H, D)
@@ -389,10 +402,10 @@ def _fast_decode_scan_fn(cfg: GPT2Config, max_out: int,
                              (l, 0, 0, offset, 0))
                     vc = dus(vc, vq8[None, :, :, None, :],
                              (l, 0, 0, offset, 0))
-                    ks = dus(ks, ksc.reshape(1, B, H, 1),
-                             (l, 0, 0, offset))
-                    vs = dus(vs, vsc.reshape(1, B, H, 1),
-                             (l, 0, 0, offset))
+                    ks = dus(ks, ksc.reshape(1, B, H, 1, 1),
+                             (l, 0, 0, 0, offset))
+                    vs = dus(vs, vsc.reshape(1, B, H, 1, 1),
+                             (l, 0, 0, 0, offset))
                     ctx = decode_attention_int8_stacked(
                         qh, kc, ks, vc, vs, offset, l,
                         scale=1.0 / np.sqrt(D))
@@ -408,12 +421,13 @@ def _fast_decode_scan_fn(cfg: GPT2Config, max_out: int,
                     caches = (kc, vc)
                 ctx2 = ctx.transpose(0, 2, 1, 3).reshape(B, E)
                 x = out_ffn_int8_stacked(
-                    ctx2, x, Wp, sp_, bp, lnw2, lnb2, W1, s1, b1, W2,
+                    ctx2, x, Wp, sp_, bp, ln2_w, ln2_b, W1, s1, b1, W2,
                     s2, b2, l,
                     act="gelu_tanh", eps=eps)
                 return (x, caches), None
 
-            (x, caches), _ = jax.lax.scan(layer, (x, caches), xs)
+            (x, caches), _ = jax.lax.scan(
+                layer, (x, caches), jnp.arange(Lyr, dtype=jnp.int32))
             logits = jnp.einsum("be,ve->bv", _ln_f(x, lnf_w, lnf_b), wte)
             nxt = jax.lax.cond(
                 temperature > 0,

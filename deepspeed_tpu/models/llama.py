@@ -120,7 +120,12 @@ class LlamaAttention(nn.Module):
         q = dense(H * D, "q_proj")(x)
         k = dense(Hkv * D, "k_proj")(x)
         v = dense(Hkv * D, "v_proj")(x)
+        # all three projections carry the 'qkv' tag so every GPT2Config
+        # remat_policy string (which saves 'qkv' residuals) works
+        # unchanged on this model
         q = checkpoint_name(q, "qkv")
+        k = checkpoint_name(k, "qkv")
+        v = checkpoint_name(v, "qkv")
         qh = q.reshape(B, S, H, D).transpose(0, 2, 1, 3)
         kh = k.reshape(B, S, Hkv, D).transpose(0, 2, 1, 3)
         vh = v.reshape(B, S, Hkv, D).transpose(0, 2, 1, 3)

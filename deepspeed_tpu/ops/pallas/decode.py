@@ -414,35 +414,49 @@ def out_ffn_int8(ctx, x, wp, sp, bp, ln_w, ln_b, w1, s1, b1, w2, s2, b2,
 # DMA exactly the tiles they need straight from the stacked HBM arrays.
 # The manual serving loop (models/gpt2_inference._fast_decode_scan) scans
 # layer INDICES and keeps the caches whole, updating one row in place.
+#
+# EVERY per-layer parameter is stacked — LN scales/biases and projection
+# biases ride [Lyr, ...] operands with layer-indexed block maps, and the
+# per-tensor weight scales ride SMEM as scalar-prefetch vectors indexed
+# at the layer id in-kernel. The r5 device trace showed the alternative
+# (per-layer xs through lax.scan) costs ~15-20 us of slice/copy fixed
+# overhead PER ARRAY PER LAYER on this target — ~2.5 ms/tick at 13 xs.
 
 def ln_qkv_int8_stacked(x, ln_w, ln_b, wq_stack, s, b, layer, eps=1e-5,
                         block_n=None, interpret=None):
-    """ln_qkv_int8 over stacked weights: wq_stack [L, E, 3E] int8 indexed
-    at ``layer`` by the block index map — no layer-slice copy. ln_w/ln_b/
-    s/b are the CURRENT layer's (small arrays travel fine as scan xs)."""
+    """ln_qkv_int8 over stacked weights: wq_stack [L, E, 3E] (int8 or
+    bf16) indexed at ``layer`` by the block index map — no layer-slice
+    copy. ln_w/ln_b [L, 1, E], b [L, 1, 3E] (the middle unit axis makes
+    the per-layer block (1, 1, cols), which the TPU block-shape rules
+    accept; serving loops pre-reshape ONCE outside the layer scan —
+    2-D [L, cols] is accepted here but reshapes per call, a layout
+    copy); s [L] fp32 per-tensor scales (SMEM-prefetched, indexed
+    in-kernel — pass ones for bf16 stacks)."""
     if interpret is None:
         interpret = _interpret_default()
     B, E = x.shape
     Lyr, Ew, N = wq_stack.shape
     assert Ew == E and N == 3 * E
+    ln_w = ln_w.reshape(Lyr, 1, E)
+    ln_b = ln_b.reshape(Lyr, 1, E)
+    b = jnp.asarray(b).reshape(Lyr, 1, N)
     if block_n is None:
         block_n = _pick_block(
             N, budget_cols=(1 << 23) // max(E * wq_stack.dtype.itemsize, 1))
     assert N % block_n == 0
-    s = jnp.asarray(s, jnp.float32).reshape(1, 1)
+    s = jnp.asarray(s, jnp.float32).reshape(Lyr)
     layer = jnp.asarray(layer, jnp.int32).reshape(1)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(N // block_n,),
         in_specs=[
-            pl.BlockSpec((B, E), lambda j, l: (0, 0)),
-            pl.BlockSpec((1, E), lambda j, l: (0, 0)),
-            pl.BlockSpec((1, E), lambda j, l: (0, 0)),
-            pl.BlockSpec((1, E, block_n), lambda j, l: (l[0], 0, j)),
-            pl.BlockSpec((1, 1), lambda j, l: (0, 0)),
-            pl.BlockSpec((1, block_n), lambda j, l: (0, j)),
+            pl.BlockSpec((B, E), lambda j, l, s: (0, 0)),
+            pl.BlockSpec((1, 1, E), lambda j, l, s: (l[0], 0, 0)),
+            pl.BlockSpec((1, 1, E), lambda j, l, s: (l[0], 0, 0)),
+            pl.BlockSpec((1, E, block_n), lambda j, l, s: (l[0], 0, j)),
+            pl.BlockSpec((1, 1, block_n), lambda j, l, s: (l[0], 0, j)),
         ],
-        out_specs=pl.BlockSpec((B, block_n), lambda j, l: (0, j)),
+        out_specs=pl.BlockSpec((B, block_n), lambda j, l, s: (0, j)),
         scratch_shapes=[pltpu.VMEM((B, E), x.dtype)],
     )
     out = pl.pallas_call(
@@ -450,35 +464,41 @@ def ln_qkv_int8_stacked(x, ln_w, ln_b, wq_stack, s, b, layer, eps=1e-5,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, N), x.dtype),
         interpret=interpret,
-    )(layer, x, ln_w.reshape(1, E), ln_b.reshape(1, E), wq_stack, s,
-      jnp.asarray(b).reshape(1, N))
+    )(layer, s, x, ln_w, ln_b, wq_stack, b)
     return out
 
 
-def _ln_qkv_stacked_kernel(l_ref, x_ref, lnw_ref, lnb_ref, w_ref, s_ref,
+def _ln_qkv_stacked_kernel(l_ref, s_ref, x_ref, lnw_ref, lnb_ref, w_ref,
                            b_ref, o_ref, u_ref, *, eps):
     j = pl.program_id(0)
     dt = x_ref.dtype
 
     @pl.when(j == 0)
     def _ln_pass():
-        u_ref[...] = _ln(x_ref[...], lnw_ref[...], lnb_ref[...],
+        u_ref[...] = _ln(x_ref[...], lnw_ref[0], lnb_ref[0],
                          eps).astype(dt)
 
     u = u_ref[...]
     w = w_ref[0].astype(dt)                        # [E, bn]
     y = jax.lax.dot(u, w, preferred_element_type=jnp.float32)
-    o_ref[...] = (y * s_ref[0, 0]
-                  + b_ref[...].astype(jnp.float32)).astype(dt)
+    o_ref[...] = (y * s_ref[l_ref[0]]
+                  + b_ref[0].astype(jnp.float32)).astype(dt)
 
 
 def decode_attention_int8_stacked(q, k_stack, k_scale, v_stack, v_scale,
                                   pos, layer, scale=None, block_l=None,
                                   interpret=None):
     """decode_attention_int8 over the stacked caches: k/v [L_layers, B,
-    H, L, D] int8 + scales [L_layers, B, H, L] fp32 indexed at ``layer``
-    by the block maps — the serving loop never slices a per-layer cache
-    out (which copied the full multi-MB cache each layer each tick)."""
+    H, L, D] int8 + scales [L_layers, B, H, 1, L] fp32 indexed at
+    ``layer`` by the block maps — the serving loop never slices a
+    per-layer cache out (which copied the full multi-MB cache each layer
+    each tick).
+
+    Scales must arrive ALREADY lane-major 5-D: a 4-D [Lyr, B, H, L]
+    array is accepted but reshaped here, and because the tiled layouts
+    differ (T(8,128) vs T(1,128)) XLA materializes that reshape as a
+    full-stack copy PER CALL — the r5 b32 trace measured it at 5.4
+    ms/tick. Serving loops reshape once outside the layer scan."""
     if interpret is None:
         interpret = _interpret_default()
     B, H, S, D = q.shape
@@ -595,38 +615,45 @@ def out_ffn_int8_stacked(ctx, x, wp_stack, sp, bp, ln_w, ln_b, w1_stack,
                          s1, b1, w2_stack, s2, b2, layer, act="gelu_tanh",
                          eps=1e-5, block_f=None, interpret=None):
     """out_ffn_int8 over stacked weights: wp [L,E,E], w1 [L,E,F],
-    w2 [L,F,E] int8 indexed at ``layer`` by the block maps."""
+    w2 [L,F,E] (int8 or bf16) indexed at ``layer`` by the block maps.
+    Per-layer params are stacked too: ln_w/ln_b/bp/b2 [L, 1, E],
+    b1 [L, 1, F] (2-D accepted, reshaped — see ln_qkv_int8_stacked);
+    sp/s1/s2 [L] fp32 scale vectors ride SMEM via scalar prefetch."""
     if interpret is None:
         interpret = _interpret_default()
     B, E = ctx.shape
     Lyr, Ew, F = w1_stack.shape
     assert Ew == E and w2_stack.shape[1:] == (F, E) \
         and wp_stack.shape[1:] == (E, E)
+    ln_w = ln_w.reshape(Lyr, 1, E)
+    ln_b = ln_b.reshape(Lyr, 1, E)
+    bp = jnp.asarray(bp).reshape(Lyr, 1, E)
+    b1 = jnp.asarray(b1).reshape(Lyr, 1, F)
+    b2 = jnp.asarray(b2).reshape(Lyr, 1, E)
     if block_f is None:
         block_f = _pick_block(
             F, budget_cols=(1 << 21) // max(E * w1_stack.dtype.itemsize, 1))
     assert F % block_f == 0, (F, block_f)
     n_tiles = F // block_f
-    scales = jnp.stack([jnp.asarray(v, jnp.float32).reshape(())
-                        for v in (sp, s1, s2)]).reshape(1, 3)
+    scales = jnp.stack([jnp.asarray(v, jnp.float32).reshape(Lyr)
+                        for v in (sp, s1, s2)], axis=1)        # [L, 3]
     layer = jnp.asarray(layer, jnp.int32).reshape(1)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(n_tiles,),
         in_specs=[
-            pl.BlockSpec((B, E), lambda j, l: (0, 0)),
-            pl.BlockSpec((B, E), lambda j, l: (0, 0)),
-            pl.BlockSpec((1, E, E), lambda j, l: (l[0], 0, 0)),
-            pl.BlockSpec((1, E), lambda j, l: (0, 0)),
-            pl.BlockSpec((1, E), lambda j, l: (0, 0)),
-            pl.BlockSpec((1, 3), lambda j, l: (0, 0)),
-            pl.BlockSpec((1, E), lambda j, l: (0, 0)),
-            pl.BlockSpec((1, E, block_f), lambda j, l: (l[0], 0, j)),
-            pl.BlockSpec((1, block_f), lambda j, l: (0, j)),
-            pl.BlockSpec((1, block_f, E), lambda j, l: (l[0], j, 0)),
-            pl.BlockSpec((1, E), lambda j, l: (0, 0)),
+            pl.BlockSpec((B, E), lambda j, l, s: (0, 0)),
+            pl.BlockSpec((B, E), lambda j, l, s: (0, 0)),
+            pl.BlockSpec((1, E, E), lambda j, l, s: (l[0], 0, 0)),
+            pl.BlockSpec((1, 1, E), lambda j, l, s: (l[0], 0, 0)),
+            pl.BlockSpec((1, 1, E), lambda j, l, s: (l[0], 0, 0)),
+            pl.BlockSpec((1, 1, E), lambda j, l, s: (l[0], 0, 0)),
+            pl.BlockSpec((1, E, block_f), lambda j, l, s: (l[0], 0, j)),
+            pl.BlockSpec((1, 1, block_f), lambda j, l, s: (l[0], 0, j)),
+            pl.BlockSpec((1, block_f, E), lambda j, l, s: (l[0], j, 0)),
+            pl.BlockSpec((1, 1, E), lambda j, l, s: (l[0], 0, 0)),
         ],
-        out_specs=pl.BlockSpec((B, E), lambda j, l: (0, 0)),
+        out_specs=pl.BlockSpec((B, E), lambda j, l, s: (0, 0)),
         scratch_shapes=[
             pltpu.VMEM((B, E), ctx.dtype),
             pltpu.VMEM((B, E), ctx.dtype),
@@ -639,10 +666,8 @@ def out_ffn_int8_stacked(ctx, x, wp_stack, sp, bp, ln_w, ln_b, w1_stack,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, E), ctx.dtype),
         interpret=interpret,
-    )(layer, ctx, x, wp_stack, ln_w.reshape(1, E), ln_b.reshape(1, E),
-      scales, jnp.asarray(bp).reshape(1, E), w1_stack,
-      jnp.asarray(b1).reshape(1, F), w2_stack,
-      jnp.asarray(b2).reshape(1, E))
+    )(layer, scales, ctx, x, wp_stack, ln_w, ln_b, bp, w1_stack, b1,
+      w2_stack, b2)
     return out
 
 
@@ -690,28 +715,29 @@ def decode_attention_fp_stacked(q, k_stack, v_stack, pos, layer,
     return out.reshape(B, H, 1, D)
 
 
-def _out_ffn_stacked_kernel(l_ref, ctx_ref, x_ref, wp_ref, lnw_ref,
-                            lnb_ref, sc_ref, bp_ref, w1_ref, b1_ref,
+def _out_ffn_stacked_kernel(l_ref, sc_ref, ctx_ref, x_ref, wp_ref,
+                            lnw_ref, lnb_ref, bp_ref, w1_ref, b1_ref,
                             w2_ref, b2_ref, o_ref, x1_ref, u_ref,
                             acc_ref, *, eps, act, n_tiles):
     j = pl.program_id(0)
     dt = ctx_ref.dtype
+    lidx = l_ref[0]
 
     @pl.when(j == 0)
     def _proj():
         ctx = ctx_ref[...]
         wp = wp_ref[0].astype(dt)
         t = jax.lax.dot(ctx, wp, preferred_element_type=jnp.float32)
-        t = t * sc_ref[0, 0] + bp_ref[...].astype(jnp.float32)
+        t = t * sc_ref[lidx, 0] + bp_ref[0].astype(jnp.float32)
         x1 = x_ref[...].astype(jnp.float32) + t
         x1_ref[...] = x1.astype(dt)
-        u_ref[...] = _ln(x1, lnw_ref[...], lnb_ref[...], eps).astype(dt)
+        u_ref[...] = _ln(x1, lnw_ref[0], lnb_ref[0], eps).astype(dt)
         acc_ref[...] = jnp.zeros_like(acc_ref[...])
 
     u = u_ref[...]
     w1 = w1_ref[0].astype(dt)
     h = jax.lax.dot(u, w1, preferred_element_type=jnp.float32)
-    h = h * sc_ref[0, 1] + b1_ref[...].astype(jnp.float32)
+    h = h * sc_ref[lidx, 1] + b1_ref[0].astype(jnp.float32)
     if act == "gelu_tanh":
         h = jax.nn.gelu(h, approximate=True)
     else:
@@ -723,5 +749,5 @@ def _out_ffn_stacked_kernel(l_ref, ctx_ref, x_ref, wp_ref, lnw_ref,
     @pl.when(j == n_tiles - 1)
     def _finish():
         o_ref[...] = (x1_ref[...].astype(jnp.float32)
-                      + acc_ref[...] * sc_ref[0, 2]
-                      + b2_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+                      + acc_ref[...] * sc_ref[lidx, 2]
+                      + b2_ref[0].astype(jnp.float32)).astype(o_ref.dtype)

@@ -47,8 +47,21 @@ def current_mesh() -> Optional[Mesh]:
     return _current_mesh
 
 
-_pins_disabled = 0
-_pin_mesh = None
+# pin scopes are PER-THREAD: two engines tracing concurrently from
+# different threads must not cross-contaminate each other's pin state
+# (the registries above stay process-global by design — a mesh is not
+# thread-scoped, a trace is)
+import threading
+
+_pin_state = threading.local()
+
+
+def _pins_disabled_count():
+    return getattr(_pin_state, "disabled", 0)
+
+
+def _get_pin_mesh():
+    return getattr(_pin_state, "mesh", None)
 
 
 class layout_pins:
@@ -67,23 +80,21 @@ class layout_pins:
         self._prev = None
 
     def __enter__(self):
-        global _pin_mesh
-        self._prev = _pin_mesh
-        _pin_mesh = self.mesh
+        self._prev = _get_pin_mesh()
+        _pin_state.mesh = self.mesh
         return self
 
     def __exit__(self, *exc):
-        global _pin_mesh
-        _pin_mesh = self._prev
+        _pin_state.mesh = self._prev
         return False
 
 
 def pinned_mesh():
     """Mesh for model layout pins, or None outside an engine-pinned
     trace (or when pins are disabled for explicit-comm programs)."""
-    if _pins_disabled > 0:
+    if _pins_disabled_count() > 0:
         return None
-    return _pin_mesh
+    return _get_pin_mesh()
 
 
 class no_layout_pins:
@@ -99,18 +110,16 @@ class no_layout_pins:
     authoritative source. Re-entrant."""
 
     def __enter__(self):
-        global _pins_disabled
-        _pins_disabled += 1
+        _pin_state.disabled = _pins_disabled_count() + 1
         return self
 
     def __exit__(self, *exc):
-        global _pins_disabled
-        _pins_disabled -= 1
+        _pin_state.disabled = _pins_disabled_count() - 1
         return False
 
 
 def layout_pins_disabled() -> bool:
-    return _pins_disabled > 0
+    return _pins_disabled_count() > 0
 
 
 def init_distributed(coordinator_address: Optional[str] = None,

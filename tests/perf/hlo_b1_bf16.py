@@ -1,0 +1,34 @@
+import re
+import numpy as np
+import jax
+import jax.numpy as jnp
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.models.gpt2_inference import (
+    convert_gpt2_params, _fast_decode_scan_fn)
+
+ctx = 2048
+cfg = GPT2Config(vocab_size=50304, n_positions=ctx, n_embd=1280,
+                 n_layer=36, n_head=20, dtype=jnp.bfloat16,
+                 param_dtype=jnp.bfloat16, scan_layers=True)
+prompt = np.zeros((1, 8), np.int32)
+params = jax.eval_shape(
+    lambda k: GPT2LMHeadModel(cfg).init(k, prompt),
+    jax.random.PRNGKey(0))["params"]
+params = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), params)
+iparams = convert_gpt2_params(params, cfg)
+model_p = {"wte": iparams["wte"], "wpe": iparams["wpe"],
+           "ln_f": iparams["ln_f"]}
+blk = iparams["h"]["blk"]
+B, H, D, Lyr = 1, 20, 64, 36
+kc = jnp.zeros((Lyr, B, H, ctx, D), jnp.bfloat16)
+vc = jnp.zeros((Lyr, B, H, ctx, D), jnp.bfloat16)
+fast = _fast_decode_scan_fn(cfg, ctx, weights_q8=False, cache_q8=False)
+lowered = fast.lower(model_p, blk, (kc, vc), jnp.zeros((B,), jnp.int32),
+                     35, jnp.asarray(400, jnp.int32),
+                     jax.random.split(jax.random.PRNGKey(0), 35),
+                     jnp.float32(0.0))
+txt = lowered.compile().as_text()
+open("/tmp/b1_hlo.txt", "w").write(txt)
+for pat in (r"%fusion\.1(19|20|21) = [^)]*", r"%copy\.(8|9|19|20) = [^)]*"):
+    for m in re.findall("(" + pat + ")", txt):
+        print(m[0][:220]); print()

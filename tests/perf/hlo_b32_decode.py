@@ -1,0 +1,46 @@
+"""Dump the optimized HLO of the b32 fast_scan to identify the per-tick
+copy.60/copy.64 and add_add_fusion.2 ops the trace surfaced."""
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.models.gpt2_inference import (
+    generate, convert_gpt2_params, _fast_decode_scan_fn)
+
+ctx = 512
+cfg = GPT2Config(vocab_size=50304, n_positions=ctx, n_embd=1280,
+                 n_layer=36, n_head=20, dtype=jnp.bfloat16,
+                 param_dtype=jnp.bfloat16, scan_layers=True)
+rng = np.random.RandomState(0)
+prompt = rng.randint(0, 50304, size=(32, ctx - 80)).astype(np.int32)
+params = jax.jit(GPT2LMHeadModel(cfg).init)(
+    jax.random.PRNGKey(0), prompt[:, :8])["params"]
+iparams = convert_gpt2_params(params, cfg)
+
+model_p = {"wte": iparams["wte"], "wpe": iparams["wpe"],
+           "ln_f": iparams["ln_f"]}
+blk = iparams["h"]["blk"]
+B, H, D, Lyr = 32, 20, 64, 36
+kc = jnp.zeros((Lyr, B, H, ctx, D), jnp.int8)
+ks = jnp.zeros((Lyr, B, H, ctx), jnp.float32)
+vc = jnp.zeros((Lyr, B, H, ctx), jnp.float32)  # placeholder fix below
+vc = jnp.zeros((Lyr, B, H, ctx, D), jnp.int8)
+vs = jnp.zeros((Lyr, B, H, ctx), jnp.float32)
+fast = _fast_decode_scan_fn(cfg, ctx, weights_q8=False, cache_q8=True)
+first = jnp.zeros((B,), jnp.int32)
+rngs = jax.random.split(jax.random.PRNGKey(0), 35)
+lowered = fast.lower(model_p, blk, (kc, ks, vc, vs), first, 35,
+                     jnp.asarray(400, jnp.int32), rngs,
+                     jnp.float32(0.0))
+txt = lowered.compile().as_text()
+with open("/tmp/b32_fastscan_hlo.txt", "w") as f:
+    f.write(txt)
+print("bytes:", len(txt))
+for pat in (r".*copy\.6[04].*", r".*add_add_fusion\.2\b.*",
+            r".*fusion\.11[89].*", r".*convolution_add_fusion\.4.*"):
+    for m in re.findall(pat, txt):
+        print(m.strip()[:240])
+    print("---")

@@ -1,0 +1,85 @@
+"""ZeRO-Infinity scale driver: train an N-billion-param GPT-2 on the one
+16 GB chip with segment-streamed params + pinned_host master/moments +
+NVMe at-rest files.
+
+Usage: python tests/perf/run_infinity.py [preset] [steps]
+presets: 1b (shakeout), 6b (the scale proof)
+"""
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.gpt2 import GPT2Config
+from deepspeed_tpu.runtime.zero.infinity import InfinityEngine
+
+
+def rss_mb():
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1]) / 1024
+    return 0.0
+
+
+from deepspeed_tpu.runtime.zero.infinity import gpt2_client_init \
+    as numpy_init  # noqa: E402
+
+PRESETS = {
+    "1b": dict(n_embd=2048, n_layer=20, n_head=16, segments=4, batch=4,
+               seq=1024),
+    "6b": dict(n_embd=4096, n_layer=30, n_head=32, segments=6, batch=4,
+               seq=1024),
+}
+
+
+def main():
+    preset = sys.argv[1] if len(sys.argv) > 1 else "1b"
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    p = PRESETS[preset]
+    cfg = GPT2Config(vocab_size=50304, n_positions=p["seq"],
+                     n_embd=p["n_embd"], n_layer=p["n_layer"],
+                     n_head=p["n_head"], dtype=jnp.bfloat16,
+                     param_dtype=jnp.bfloat16, scan_layers=True,
+                     remat=True, loss_chunk=2048)
+    nb = cfg.num_params() / 1e9
+    print(f"model: {nb:.3f}B params; preset {preset}", flush=True)
+    t0 = time.time()
+    params = numpy_init(cfg)
+    print(f"init: {time.time() - t0:.1f}s rss={rss_mb():.0f}MB",
+          flush=True)
+
+    nvme_dir = "/root/nvme_infinity"
+    os.makedirs(nvme_dir, exist_ok=True)
+    t0 = time.time()
+    eng = InfinityEngine(cfg, params, segments=p["segments"],
+                         nvme_path=nvme_dir, lr=1e-4)
+    del params
+    print(f"engine init (incl NVMe write + pinned placement): "
+          f"{time.time() - t0:.1f}s rss={rss_mb():.0f}MB", flush=True)
+    print(f"params_on_disk_mb: {eng.params_on_disk_bytes() / 2**20:.1f}",
+          flush=True)
+
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(
+        0, 50304, size=(p["batch"], p["seq"])).astype(np.int32)}
+    losses = []
+    rss_track = []
+    for i in range(steps):
+        t0 = time.time()
+        loss = eng.train_batch(batch)
+        dt = time.time() - t0
+        losses.append(loss)
+        rss_track.append(round(rss_mb(), 1))
+        print(f"step {i}: loss={loss:.4f} {dt:.1f}s rss={rss_track[-1]}MB",
+              flush=True)
+    print(f"losses: {losses}")
+    print(f"rss_track: {rss_track}")
+    print("OK" if losses[-1] < losses[0] else "LOSS NOT FALLING")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""block_l sweep for the b1 bf16 fused decode."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+import deepspeed_tpu.ops.pallas.decode as dk
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.models.gpt2_inference import generate, _STEP_CACHE
+
+ctx = 2048
+cfg = GPT2Config(vocab_size=50304, n_positions=ctx, n_embd=1280,
+                 n_layer=36, n_head=20, dtype=jnp.bfloat16,
+                 param_dtype=jnp.bfloat16, scan_layers=True)
+rng = np.random.RandomState(0)
+prompt = rng.randint(0, 50304, size=(1, ctx - 80)).astype(np.int32)
+params = jax.jit(GPT2LMHeadModel(cfg).init)(
+    jax.random.PRNGKey(0), prompt[:, :8])["params"]
+
+orig = dk._pick_block_l
+for blk in (512, 1024, 2048):
+    dk._pick_block_l = lambda L, H, D, it, **kw: min(blk, L)
+    _STEP_CACHE.clear()
+    jax.clear_caches()
+
+    def run(new):
+        toks = generate(cfg, params, prompt, max_new_tokens=new,
+                        max_out_tokens=ctx, scan_decode=True)
+        return float(jax.device_get(toks[0, -1]))
+
+    run(4); run(68)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter(); run(4); ts = time.perf_counter() - t0
+        t0 = time.perf_counter(); run(68); tl = time.perf_counter() - t0
+        best = min(best, tl - ts)
+    print(f"block_l={blk}: {64 / best:.1f} tok/s")
+dk._pick_block_l = orig

@@ -1,0 +1,36 @@
+"""Device-trace the b1/ctx2048 bf16 fused decode tick."""
+import glob, gzip, json, collections, shutil
+import numpy as np
+import jax
+import jax.numpy as jnp
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.models.gpt2_inference import generate
+
+ctx = 2048
+cfg = GPT2Config(vocab_size=50304, n_positions=ctx, n_embd=1280,
+                 n_layer=36, n_head=20, dtype=jnp.bfloat16,
+                 param_dtype=jnp.bfloat16, scan_layers=True)
+rng = np.random.RandomState(0)
+prompt = rng.randint(0, 50304, size=(1, ctx - 80)).astype(np.int32)
+params = jax.jit(GPT2LMHeadModel(cfg).init)(
+    jax.random.PRNGKey(0), prompt[:, :8])["params"]
+
+def run(new):
+    toks = generate(cfg, params, prompt, max_new_tokens=new,
+                    max_out_tokens=ctx, scan_decode=True)
+    return float(jax.device_get(toks[0, -1]))
+
+run(4); run(36)
+d = "/tmp/b1trace"
+shutil.rmtree(d, ignore_errors=True)
+with jax.profiler.trace(d):
+    run(36)
+
+agg = collections.Counter()
+for f in glob.glob(d + "/**/*.trace.json.gz", recursive=True):
+    for e in json.loads(gzip.open(f).read())["traceEvents"]:
+        if e.get("ph") == "X" and "dur" in e and not e["name"].startswith(
+                ("$", "jit_", "while", "np.", "PjitF", "Device")):
+            agg[e["name"]] += e["dur"]
+for name, us in agg.most_common(22):
+    print(f"{us / 35:9.1f} us/tick  {name[:100]}")

@@ -1,0 +1,60 @@
+"""Device-trace the b32/ctx512 int8-KV fused decode tick to find where
+the 17 ms goes (roofline says ~4-6)."""
+import glob
+import gzip
+import json
+import collections
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.models.gpt2_inference import generate
+
+ctx = 512
+cfg = GPT2Config(vocab_size=50304, n_positions=ctx, n_embd=1280,
+                 n_layer=36, n_head=20, dtype=jnp.bfloat16,
+                 param_dtype=jnp.bfloat16, scan_layers=True)
+rng = np.random.RandomState(0)
+prompt = rng.randint(0, 50304, size=(32, ctx - 80)).astype(np.int32)
+params = jax.jit(GPT2LMHeadModel(cfg).init)(
+    jax.random.PRNGKey(0), prompt[:, :8])["params"]
+
+
+def run(new):
+    toks = generate(cfg, params, prompt, max_new_tokens=new,
+                    max_out_tokens=ctx, scan_decode=True, kv_cache_bits=8)
+    return float(jax.device_get(toks[0, -1]))
+
+
+run(4)
+run(36)                                  # compile
+d = "/tmp/b32trace"
+with jax.profiler.trace(d):
+    run(36)
+
+agg = collections.Counter()
+for f in glob.glob(d + "/**/*.trace.json.gz", recursive=True):
+    ev = json.loads(gzip.open(f).read())["traceEvents"]
+    for e in ev:
+        if e.get("ph") == "X" and "dur" in e:
+            pid_name = e.get("pid")
+            agg[e["name"]] += e["dur"]
+total = sum(agg.values())
+print(f"total device us: {total}  (~{total / 35 / 1000:.2f} ms/tick over 35 ticks)")
+for name, us in agg.most_common(25):
+    print(f"{us / 35:10.1f} us/tick  {name[:110]}")
+
+print("\n--- device ops only ---")
+skip = ("$", "jit_", "while", "copy-start", "copy-done")
+dev = [(n, us) for n, us in agg.items()
+       if not any(n.startswith(s) or s in n for s in ("$",))
+       and not n.startswith(("jit_", "while", "copy-start"))
+       and "py" not in n[:2]]
+dev.sort(key=lambda t: -t[1])
+tot = 0.0
+for name, us in dev[:40]:
+    tot += us
+    print(f"{us / 35:10.1f} us/tick  {name[:110]}")
+print(f"listed sum: {tot / 35 / 1000:.2f} ms/tick")

@@ -1,0 +1,137 @@
+"""ZeRO-Infinity segment-streamed trainer (runtime/zero/infinity.py):
+the streamed step must reproduce plain full-resident training — same
+forward, same grads, same Adam — and the NVMe at-rest tier must
+round-trip the parameters.
+
+Reference role: the reference validates stage3/ZeRO-Infinity against
+plain torch training the same way (tests/unit/test_zero.py)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.runtime.zero.infinity import InfinityEngine
+
+
+def _tiny_cfg(**kw):
+    return GPT2Config(vocab_size=512, n_positions=64, n_embd=64,
+                      n_layer=4, n_head=2, dtype=jnp.float32,
+                      param_dtype=jnp.float32, scan_layers=True, **kw)
+
+
+def _ref_adam_loop(model, params, batch, steps, lr, betas, eps):
+    """Full-resident reference: value_and_grad + textbook Adam in fp32."""
+    beta1, beta2 = betas
+
+    def loss_fn(p):
+        return model.apply({"params": p}, batch["input_ids"],
+                           labels=batch["input_ids"])
+
+    m = jax.tree.map(lambda l: jnp.zeros_like(l, jnp.float32), params)
+    v = jax.tree.map(lambda l: jnp.zeros_like(l, jnp.float32), params)
+    losses = []
+    p = jax.tree.map(lambda l: l.astype(jnp.float32), params)
+    for t in range(1, steps + 1):
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        losses.append(float(loss))
+        bc1 = 1.0 - beta1 ** t
+        bc2 = 1.0 - beta2 ** t
+        m = jax.tree.map(lambda mm, gg: beta1 * mm + (1 - beta1)
+                         * gg.astype(jnp.float32), m, g)
+        v = jax.tree.map(lambda vv, gg: beta2 * vv + (1 - beta2)
+                         * (gg.astype(jnp.float32) ** 2), v, g)
+        p = jax.tree.map(
+            lambda pp, mm, vv: pp - lr * (mm / bc1)
+            / (jnp.sqrt(vv / bc2) + eps), p, m, v)
+    return losses
+
+
+def test_streamed_step_matches_full_resident_training():
+    cfg = _tiny_cfg()
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, 512, size=(2, 32))
+             .astype(np.int32)}
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 batch["input_ids"])["params"]
+    lr, betas, eps = 1e-3, (0.9, 0.999), 1e-8
+    ref_losses = _ref_adam_loop(model, params, batch, 4, lr, betas, eps)
+
+    eng = InfinityEngine(cfg, params, segments=2, lr=lr, betas=betas,
+                         eps=eps, moment_dtype=jnp.float32)
+    got = [eng.train_batch(batch) for _ in range(4)]
+    np.testing.assert_allclose(got, ref_losses, rtol=2e-4, atol=2e-5)
+    assert got[-1] < got[0], got
+
+
+def test_streamed_segment_counts_equivalent():
+    """K=1, K=2, K=4 must produce the same trajectory — segmentation is
+    a memory plan, not a numerics change."""
+    cfg = _tiny_cfg()
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.RandomState(1)
+    batch = {"input_ids": rng.randint(0, 512, size=(2, 24))
+             .astype(np.int32)}
+    params = jax.jit(model.init)(jax.random.PRNGKey(1),
+                                 batch["input_ids"])["params"]
+    runs = {}
+    for k in (1, 2, 4):
+        eng = InfinityEngine(cfg, params, segments=k,
+                             moment_dtype=jnp.float32)
+        runs[k] = [eng.train_batch(batch) for _ in range(3)]
+    np.testing.assert_allclose(runs[1], runs[2], rtol=1e-5)
+    np.testing.assert_allclose(runs[1], runs[4], rtol=1e-5)
+
+
+def test_nvme_at_rest_roundtrip(tmp_path):
+    """Params rest on NVMe from step zero; park_to_nvme refreshes the
+    files after training and restore_from_nvme rebuilds the masters —
+    a fresh engine restored from disk continues with the same loss."""
+    cfg = _tiny_cfg()
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.RandomState(2)
+    batch = {"input_ids": rng.randint(0, 512, size=(2, 24))
+             .astype(np.int32)}
+    params = jax.jit(model.init)(jax.random.PRNGKey(2),
+                                 batch["input_ids"])["params"]
+    eng = InfinityEngine(cfg, params, segments=2, nvme_path=str(tmp_path),
+                         moment_dtype=jnp.float32,
+                         park_threshold_bytes=0)   # no per-step park
+    assert eng.params_on_disk_bytes() > 0
+    losses = [eng.train_batch(batch) for _ in range(3)]
+    eng.park_to_nvme()
+
+    eng2 = InfinityEngine(cfg, params, segments=2,
+                          nvme_path=str(tmp_path + "" if False
+                                        else str(tmp_path / "fresh")),
+                          moment_dtype=jnp.float32,
+                          park_threshold_bytes=0)
+    # steal the parked files: restore from the FIRST engine's swapper
+    eng2._swapper = eng._swapper
+    eng2.restore_from_nvme()
+    l_next = eng2.train_batch(batch)
+    # moments reset on cold start, so the next loss continues from the
+    # parked params (well below the from-scratch first loss)
+    assert l_next < losses[0], (l_next, losses)
+
+
+def test_per_step_park_under_threshold(tmp_path):
+    """Small models keep the r4 semantics: params re-park to disk after
+    every step (files mtime advances)."""
+    import os
+    cfg = _tiny_cfg()
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.RandomState(3)
+    batch = {"input_ids": rng.randint(0, 512, size=(2, 16))
+             .astype(np.int32)}
+    params = jax.jit(model.init)(jax.random.PRNGKey(3),
+                                 batch["input_ids"])["params"]
+    eng = InfinityEngine(cfg, params, segments=2, nvme_path=str(tmp_path),
+                         moment_dtype=jnp.float32)
+    assert eng.param_bytes <= eng._park_threshold
+    p0 = eng._swapper._path(0)
+    t0 = os.path.getmtime(p0)
+    eng.train_batch(batch)
+    assert os.path.getmtime(p0) > t0
