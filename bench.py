@@ -238,6 +238,18 @@ def main():
     sparse = bench_sparse_attention(jnp)
     jax.clear_caches()
     decode = bench_decode(jnp)
+    jax.clear_caches()
+    for bs in (1, 8):
+        try:
+            decode[f"llama7b_b{bs}_int8"] = bench_llama_decode(jnp, bs=bs)
+        except Exception as e:
+            decode[f"llama7b_b{bs}_int8"] = {"skipped": str(e)[:200]}
+        jax.clear_caches()
+    jax.clear_caches()
+    try:
+        moe = bench_moe(dstpu, make_mesh, MeshConfig, dev)
+    except Exception as e:
+        moe = {"skipped": str(e)[:200]}
 
     # NVMe/disk tier throughput (reference's aio perf harness role,
     # csrc/aio/py_test): 128 MB write+read through the async-IO library,
@@ -262,7 +274,13 @@ def main():
             "step_time_ms": round(dt * 1000, 2),
             "achieved_tflops": round(achieved / 1e12, 2),
             "device": getattr(dev, "device_kind", str(dev)),
+            # loss after ~92 optimizer steps on ONE repeated batch — a
+            # memorization sanity value, not a convergence claim. It
+            # moved 6.16 (r3) -> 0.49 (r4) because the timing windows
+            # grew 12 -> 30 iters (r4 fence amortization), tripling the
+            # repeated-batch steps before this read — same definition.
             "loss": final_loss,
+            "loss_note": "after ~92 steps on one repeated batch",
             # SURVEY §7 memory evidence: exact XLA buffer assignment of
             # the train step (device.memory_stats is unavailable through
             # tunneled backends). True peak is BELOW the sum of these two
@@ -307,6 +325,9 @@ def main():
             # crosses the ~35 MB/s tunnel, so the step time measures the
             # tunnel; on a TPU-VM the same path is PCIe-fed.
             "nvme_param_tier": nvme_param,
+            # expert-parallel MoE training throughput (beyond-reference
+            # component; routing einsums regress invisibly without it)
+            "moe": moe,
         },
     }
     def short(r):
@@ -317,9 +338,17 @@ def main():
         return json.dumps({k: r[k] for k in
                            ("metric", "value", "unit", "vs_baseline")})
 
-    # insurance line: the XL case below can take ~35 min; if the harness
-    # kills us mid-way, the LAST complete JSON line still carries every
-    # other number. The final (authoritative) line replaces it on success.
+    # insurance line: the 6B + XL cases below can take many minutes; if
+    # the harness kills us mid-way, the LAST complete JSON line still
+    # carries every other number. Later (authoritative) lines replace it.
+    print(json.dumps(result), flush=True)
+    print(short(result), flush=True)
+
+    # the max-params-per-chip scale proof (ZeRO-Infinity, ≥6B on 16 GB)
+    inf6b = bench_infinity_6b(dstpu, dev)
+    result["detail"]["infinity_6b"] = inf6b
+    result["detail"]["max_params_per_chip_b"] = \
+        inf6b.get("params_b", 1.558)   # gpt2_xl's 1.558B is the floor
     print(json.dumps(result), flush=True)
     print(short(result), flush=True)
 
@@ -458,6 +487,208 @@ def bench_decode(jnp):
         del params, run   # run's closure pins params otherwise
         jax.clear_caches()
     return out
+
+
+def bench_llama_decode(jnp, bs=1, ctx=2048):
+    """LLaMA-7B int8 serving through the fused RMS/SwiGLU/stacked-kernel
+    loop (models/llama_inference.py). Weights are random int8 codes —
+    decode reads exactly the bytes a converted checkpoint would, without
+    materializing 13.5 GB of bf16 first. ROOFLINE: 6.74B int8 params =
+    6.7 GB of weight reads per tick, so b1 is bounded at ~120 tok/s on
+    an 819 GB/s chip no matter the software; batching shares the weight
+    read across rows (the b8 case)."""
+    import time
+    import jax
+    from deepspeed_tpu.models.llama import llama_7b
+    from deepspeed_tpu.models.llama_inference import llama_fast_generate
+    cfg = llama_7b(dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+                   max_seq_len=ctx)
+    rs = np.random.RandomState(0)
+    E, H, Hkv, D, F, L, V = (cfg.hidden_size, cfg.n_heads, cfg.kv_heads,
+                             cfg.head_dim, cfg.intermediate_size,
+                             cfg.n_layers, cfg.vocab_size)
+
+    def q8(shape):
+        return {"kernel_q": jnp.asarray(
+            rs.randint(-80, 80, size=shape), jnp.int8),
+            "kernel_scale": jnp.full((shape[0],), 2e-3, jnp.float32)}
+
+    sparams = {
+        "embed": jnp.asarray(rs.randn(V, E) * 0.01, jnp.bfloat16),
+        "head": jnp.asarray(rs.randn(V, E) * 0.01, jnp.bfloat16),
+        "norm_scale": jnp.ones((E,), jnp.float32),
+        "blk": {
+            "qkv_w": q8((L, E, (H + 2 * Hkv) * D)),
+            "o_w": q8((L, H * D, E)),
+            "gate_w": q8((L, E, F)),
+            "up_w": q8((L, E, F)),
+            "down_w": q8((L, F, E)),
+            "norm1": jnp.ones((L, E), jnp.float32),
+            "norm2": jnp.ones((L, E), jnp.float32),
+        },
+    }
+    prompt = rs.randint(0, V, size=(bs, ctx - 80)).astype(np.int32)
+
+    def run(new):
+        toks = llama_fast_generate(cfg, sparams, prompt,
+                                   max_new_tokens=new,
+                                   max_out_tokens=ctx, kv_cache_bits=8)
+        return float(jax.device_get(toks[0, -1]))
+
+    run(4)
+    run(68)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run(4)
+        t_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        run(68)
+        t_l = time.perf_counter() - t0
+        best = min(best, t_l - t_s)
+    return {"decode_tokens_per_sec": round(bs * 64 / best, 1),
+            "params_b": round(cfg.num_params() / 1e9, 2),
+            "weight_read_bound_tok_s_b1": 122}
+
+
+def bench_moe(dstpu, make_mesh, MeshConfig, dev, batch_size=8, seq=512):
+    """Expert-parallel MoE GPT-2 training throughput on one chip —
+    8 experts, top-1 routing (the beyond-reference MoE subsystem's only
+    perf line; regressions in the routing einsums show here)."""
+    import time
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    cfg_m = GPT2Config(vocab_size=50304, n_positions=seq, n_embd=512,
+                       n_layer=8, n_head=8, dtype=jnp.bfloat16,
+                       scan_layers=True, moe_experts=8, moe_k=1)
+    cfg = {
+        "train_batch_size": batch_size,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = dstpu.initialize(
+        config=cfg, model=GPT2LMHeadModel(cfg_m),
+        mesh=make_mesh(MeshConfig(data=1), devices=[dev]))
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(
+        0, 50304, size=(batch_size, seq)).astype(np.int32)}
+    for _ in range(2):
+        loss = engine.train_batch(batch)
+    float(jax.device_get(loss))
+    iters = 8
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = engine.train_batch(batch)
+    final = float(jax.device_get(loss))
+    dt = (time.perf_counter() - t0) / iters
+    return {"samples_per_sec": round(batch_size / dt, 1),
+            "tokens_per_sec": round(batch_size * seq / dt, 1),
+            "experts": 8, "loss": round(final, 3)}
+
+
+def bench_infinity_6b(dstpu, dev, steps=3):
+    """THE scale proof: a 6.25B-param GPT-2 trains on this one 16 GB
+    chip (ZeRO-Infinity, runtime/zero/infinity.py) — 11.9 GB of compute
+    params resting on NVMe, 61 GB of fp32 master + Adam moments in
+    pinned_host, per-segment streamed fwd/bwd/update. Reference claim
+    this answers: 40B on a 32 GB V100 (ZeRO-Infinity blog, 1.25 B/GB);
+    this is 0.39 B/GB — the single-chip first rung.
+
+    Init is TILED-random (every layer shares one random block): the
+    bench measures the streaming engine, not 6.25 s of gaussians per GB
+    on a 1-core host; loss still falls because gradients differ per
+    layer from step one."""
+    import shutil
+    import time
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    def rss_mb():
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS"):
+                    return int(line.split()[1]) / 1024
+        return 0.0
+
+    cfg_m = GPT2Config(vocab_size=50304, n_positions=1024, n_embd=4096,
+                       n_layer=30, n_head=32, dtype=jnp.bfloat16,
+                       param_dtype=jnp.bfloat16, scan_layers=True,
+                       remat=True, loss_chunk=2048)
+    shapes = jax.eval_shape(
+        GPT2LMHeadModel(cfg_m).init, jax.random.PRNGKey(0),
+        np.zeros((1, 8), np.int32))["params"]
+    rs = np.random.RandomState(0)
+
+    def leaf(path, s):
+        names = [str(getattr(p, "key", p)) for p in path]
+        if s.ndim == 3:          # scan-stacked [L, ...]: tile one layer
+            one = (rs.standard_normal(s.shape[1:]).astype(np.float32)
+                   / np.sqrt(max(s.shape[-2], 1))
+                   if names[-1] == "kernel"
+                   else np.zeros(s.shape[1:], np.float32))
+            a = np.broadcast_to(one, s.shape)
+        elif names[-1] in ("wte", "wpe"):
+            a = rs.standard_normal(s.shape).astype(np.float32) * 0.02
+        elif names[-1] == "scale":
+            a = np.ones(s.shape, np.float32)
+        else:
+            a = np.zeros(s.shape, np.float32)
+        return a.astype(np.dtype(s.dtype))
+    t0 = time.time()
+    params = jax.tree_util.tree_map_with_path(leaf, shapes)
+    init_s = time.time() - t0
+
+    nvme = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".bench_nvme_6b")
+    shutil.rmtree(nvme, ignore_errors=True)
+    os.makedirs(nvme, exist_ok=True)
+    try:
+        t0 = time.time()
+        engine, _, _, _ = dstpu.initialize(
+            config={
+                "train_batch_size": 4,
+                "zero_optimization": {
+                    "stage": 3,
+                    "offload_param": {"device": "nvme", "nvme_path": nvme,
+                                      "stream_segments": 6},
+                    "offload_optimizer": {"device": "cpu"}},
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            },
+            model=GPT2LMHeadModel(cfg_m), model_parameters=params)
+        del params
+        setup_s = time.time() - t0
+        rng = np.random.RandomState(0)
+        batch = {"input_ids": rng.randint(
+            0, 50304, size=(4, 1024)).astype(np.int32)}
+        t0 = time.time()
+        l0 = engine.train_batch(batch)
+        compile_step_s = time.time() - t0
+        rss0 = rss_mb()
+        ts, losses = [], [l0]
+        for _ in range(steps):
+            t0 = time.time()
+            losses.append(engine.train_batch(batch))
+            ts.append(time.time() - t0)
+        return {
+            "params_b": round(cfg_m.num_params() / 1e9, 3),
+            "params_on_disk_mb": round(
+                engine.params_on_disk_bytes() / 2**20, 1),
+            "steady_step_s": round(min(ts), 2),
+            "first_loss": round(losses[0], 3),
+            "last_loss": round(losses[-1], 3),
+            "host_rss_growth_mb_over_steps": round(rss_mb() - rss0, 1),
+            "init_s": round(init_s, 1), "setup_s": round(setup_s, 1),
+            "first_step_incl_compile_s": round(compile_step_s, 1),
+            "hbm_gb": 16, "params_per_hbm_gb": round(
+                cfg_m.num_params() / 1e9 / 16, 3),
+        }
+    except Exception as e:
+        return {"skipped": str(e)[:300]}
+    finally:
+        shutil.rmtree(nvme, ignore_errors=True)
 
 
 def bench_nvme_param_tier(dstpu, make_mesh, MeshConfig, dev):

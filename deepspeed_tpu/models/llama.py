@@ -13,10 +13,13 @@ live mesh seq axis (ring or Ulysses).
 
 GQA: ``n_kv_heads < n_heads`` stores/computes K/V (and their decode
 caches) at the reduced head count; the projections, optimizer state and
-cache memory all shrink by H/Hkv. The head-repeat feeding the attention
-kernel DOES materialize full-head K/V operands (pallas_call operands
-are opaque to XLA fusion) — a GQA-aware kernel index map is the
-remaining optimization.
+cache memory all shrink by H/Hkv. The flash FORWARD (training and
+prefill) consumes the reduced-head K/V directly — Hkv-aware block index
+maps fold each query head onto its KV head, so full-head K/V is never
+materialized in HBM on the forward path. The flash BACKWARD still
+repeats K/V transiently (bwd-only) and sums dk/dv over the rep query
+heads; a dk/dv-accumulating GQA backward kernel is the remaining
+optimization. SP backends (ring/Ulysses) rotate K/V at full head count.
 """
 
 import dataclasses
@@ -181,15 +184,16 @@ class LlamaAttention(nn.Module):
             out = ctx.transpose(0, 2, 1, 3).reshape(B, S, H * D)
             return dense(E, "o_proj")(out)
 
-        if Hkv != H:
-            rep = H // Hkv
-            kh = jnp.repeat(kh, rep, axis=1)
-            vh = jnp.repeat(vh, rep, axis=1)
-
         from deepspeed_tpu.parallel import mesh as mesh_lib
         mesh = mesh_lib.current_mesh()
         if mesh is not None and mesh.shape.get(mesh_lib.SEQ_AXIS, 1) > 1 \
                 and S % mesh.shape[mesh_lib.SEQ_AXIS] == 0:
+            # the SP backends shard/rotate K/V across the seq axis at
+            # full head count — repeat for them only
+            if Hkv != H:
+                rep = H // Hkv
+                kh = jnp.repeat(kh, rep, axis=1)
+                vh = jnp.repeat(vh, rep, axis=1)
             sp = mesh.shape[mesh_lib.SEQ_AXIS]
             if cfg.sp_backend == "ulysses" and H % sp == 0:
                 from deepspeed_tpu.parallel.ulysses import ulysses_attention
@@ -199,6 +203,9 @@ class LlamaAttention(nn.Module):
                     ring_attention
                 out = ring_attention(qh, kh, vh, mesh, causal=True)
         else:
+            # GQA K/V go in at Hkv heads: the flash kernel's Hkv-aware
+            # block maps stream the reduced cache — no full-head
+            # materialization in the forward (module docstring promise)
             out = dot_product_attention(qh, kh, vh, causal=True,
                                         use_flash=cfg.use_flash)
         out = out.transpose(0, 2, 1, 3).reshape(B, S, H * D)

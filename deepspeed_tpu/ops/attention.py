@@ -17,8 +17,13 @@ def reference_attention(q, k, v, causal=False, bias=None, scale=None,
                         segment_ids=None):
     """Pure-XLA attention on [B, H, S, D] tensors. Numerically the ground
     truth for the Pallas kernels (the test methodology of the reference's
-    test_cuda_forward.py, SURVEY §4)."""
+    test_cuda_forward.py, SURVEY §4). K/V may carry Hkv < H heads
+    (grouped-query); the reference repeats them (the kernels do not)."""
     B, H, S, D = q.shape
+    if k.shape[1] != H:
+        rep = H // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     scale = scale if scale is not None else 1.0 / np.sqrt(D)
     scores = jnp.einsum("bhsd,bhtd->bhst", q, k).astype(jnp.float32) * scale
     if bias is not None:
@@ -42,7 +47,10 @@ def _on_tpu():
 def dot_product_attention(q, k, v, causal=False, bias=None, scale=None,
                           segment_ids=None, use_flash=None):
     """[B, H, S, D] attention. ``use_flash=None`` auto-selects the Pallas
-    flash kernel on TPU for flash-compatible shapes."""
+    flash kernel on TPU for flash-compatible shapes. K/V may carry
+    Hkv < H heads (grouped-query): the flash kernel streams the reduced
+    cache directly via Hkv-aware block maps — full-head K/V is never
+    materialized in the forward."""
     if use_flash is None:
         use_flash = _on_tpu() and bias is None and segment_ids is None
     if use_flash:

@@ -59,6 +59,41 @@ def _layout_tables(layout):
     return counts, cols, max(max_nnz, 1)
 
 
+def _grouped_tables(layout, R):
+    """Fuse R consecutive q-block rows per grid step: per group the
+    UNION of the rows' active k-blocks + an R-bit membership mask per
+    union entry (bit i = row g*R+i attends to this k-block). Adjacent
+    BigBird/longformer rows share their window blocks, so the union is
+    far smaller than R separate lists — the DMA-issue amortization the
+    kernel is bound by (docs/perf_tuning.md r4: ~1.4 us per tile)."""
+    H, nb, _ = layout.shape
+    ng = nb // R
+    counts = np.zeros((H, ng), np.int32)
+    cols_l, bits_l = [], []
+    for h in range(H):
+        hc, hb = [], []
+        for g in range(ng):
+            rows = layout[h, g * R:(g + 1) * R]          # [R, nb]
+            union = np.nonzero(rows.any(axis=0))[0]
+            counts[h, g] = len(union)
+            bits = np.zeros(len(union), np.int32)
+            for i in range(R):
+                bits |= (rows[i, union].astype(np.int32) << i)
+            hc.append(union)
+            hb.append(bits)
+        cols_l.append(hc)
+        bits_l.append(hb)
+    mx = max(1, int(counts.max()) if counts.size else 1)
+    cols = np.zeros((H, ng, mx), np.int32)
+    bits = np.zeros((H, ng, mx), np.int32)
+    for h in range(H):
+        for g in range(ng):
+            n = counts[h, g]
+            cols[h, g, :n] = cols_l[h][g]
+            bits[h, g, :n] = bits_l[h][g]
+    return counts, cols, bits, mx
+
+
 # ---------------------------------------------------------------- forward
 
 def _kv_copy(hbm, buf, sem, b, kb, slot, block):
@@ -69,17 +104,30 @@ def _kv_copy(hbm, buf, sem, b, kb, slot, block):
     return pltpu.make_async_copy(hbm.at[b, kb], buf.at[slot], sem.at[slot])
 
 
-def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
-                   k_buf, v_buf, k_sem, v_sem, *, scale, block, d_head,
-                   num_heads, table_heads):
-    """One grid step = one q-block ROW: loop over exactly this row's nnz
-    active k-blocks (no max_nnz padding — a BigBird global row costs nb
-    steps, a window row costs ~4), double-buffering the K/V tile DMAs
-    against the online-softmax update."""
+def _bs_fwd_kernel(counts_ref, cols_ref, *rest, scale, block, d_head,
+                   num_heads, table_heads, rgroup=1):
+    """One grid step = one q-block ROW (or a GROUP of ``rgroup``
+    consecutive rows): loop over the row/group's nnz active k-blocks (no
+    max_nnz padding — a BigBird global row costs nb steps, a window row
+    costs ~4), double-buffering the K/V tile DMAs against the
+    online-softmax update. Grouped mode streams each UNION k-block once
+    for all rgroup rows and masks non-member row-blocks via the R-bit
+    membership table — the probability of a masked entry is ZEROED
+    (where(act, p, 0)), not just -1e30'd: NEG_INF is finite, so
+    exp(s - m) at a fully-masked row would otherwise be exp(0)."""
+    if rgroup > 1:
+        (bits_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
+         k_buf, v_buf, k_sem, v_sem) = rest
+    else:
+        (q_ref, k_hbm, v_hbm, o_ref, lse_ref,
+         k_buf, v_buf, k_sem, v_sem) = rest
+        bits_ref = None
     b, r = pl.program_id(0), pl.program_id(1)
     h = (b % num_heads) if table_heads > 1 else 0
     nnz = counts_ref[h, r]
     q = q_ref[0].astype(jnp.float32) * scale
+    rows = rgroup * block
+    row_blk = jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0) // block
 
     def copies(j, slot):
         kb = cols_ref[h, r, j]
@@ -110,17 +158,22 @@ def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
         v = v_buf[slot, :, :d_head].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        if bits_ref is not None:
+            act = ((bits_ref[h, r, j] >> row_blk) & 1) == 1   # [rows, 1]
+            s = jnp.where(act, s, NEG_INF)
         m_new = jnp.maximum(m_acc, jnp.max(s, axis=1))
         alpha = jnp.exp(m_acc - m_new)
         p = jnp.exp(s - m_new[:, None])
+        if bits_ref is not None:
+            p = jnp.where(act, p, 0.0)
         l_new = l_acc * alpha + jnp.sum(p, axis=1)
         o_new = o_acc * alpha[:, None] + jax.lax.dot(
             p, v, preferred_element_type=jnp.float32)
         return o_new, m_new, l_new
 
-    o0 = jnp.zeros((block, q.shape[1]), jnp.float32)
-    m0 = jnp.full((block,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block,), jnp.float32)
+    o0 = jnp.zeros((rows, q.shape[1]), jnp.float32)
+    m0 = jnp.full((rows,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((rows,), jnp.float32)
     o, m, l = jax.lax.fori_loop(0, nnz, body, (o0, m0, l0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = jnp.where((l > 0)[:, None], o / l_safe[:, None],
@@ -131,9 +184,15 @@ def _bs_fwd_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
 
 # ---------------------------------------------------------------- backward
 
-def _bs_dq_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, do_ref, lse_ref,
-                  delta_ref, dq_ref, k_buf, v_buf, k_sem, v_sem, *, scale,
-                  block, d_head, num_heads, table_heads):
+def _bs_dq_kernel(counts_ref, cols_ref, *rest, scale, block, d_head,
+                  num_heads, table_heads, rgroup=1):
+    if rgroup > 1:
+        (bits_ref, q_ref, k_hbm, v_hbm, do_ref, lse_ref, delta_ref,
+         dq_ref, k_buf, v_buf, k_sem, v_sem) = rest
+    else:
+        (q_ref, k_hbm, v_hbm, do_ref, lse_ref, delta_ref, dq_ref,
+         k_buf, v_buf, k_sem, v_sem) = rest
+        bits_ref = None
     b, r = pl.program_id(0), pl.program_id(1)
     h = (b % num_heads) if table_heads > 1 else 0
     nnz = counts_ref[h, r]
@@ -141,6 +200,8 @@ def _bs_dq_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, do_ref, lse_ref,
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
+    rows = rgroup * block
+    row_blk = jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0) // block
 
     def copies(j, slot):
         kb = cols_ref[h, r, j]
@@ -170,6 +231,11 @@ def _bs_dq_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, do_ref, lse_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         p = jnp.exp(s - lse[:, None])
+        if bits_ref is not None:
+            # zero non-member row-blocks: their contribution belongs to
+            # a different k-block's grid step (or none)
+            act = ((bits_ref[h, r, j] >> row_blk) & 1) == 1
+            p = jnp.where(act, p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None])
@@ -177,7 +243,7 @@ def _bs_dq_kernel(counts_ref, cols_ref, q_ref, k_hbm, v_hbm, do_ref, lse_ref,
                                     preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, nnz, body,
-                           jnp.zeros((block, q.shape[1]), jnp.float32))
+                           jnp.zeros((rows, q.shape[1]), jnp.float32))
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
@@ -257,23 +323,29 @@ def _block_major(x, nb, block, Dp):
 
 
 def _bs_fwd(qf, kf, vf, tables, scale, block, interpret):
-    (counts_bh, cols_bh, max_nnz, _, _, _, H, TH) = tables
+    (counts_bh, cols_bh, max_nnz, _, _, _, H, TH, grouped, R) = tables
     BH, S, D = qf.shape
     nb = S // block
+    rows = R * block
     Dp = ((D + 127) // 128) * 128    # lane-pad streamed tiles to 128
     kernel = functools.partial(_bs_fwd_kernel, scale=scale, block=block,
-                               d_head=D, num_heads=H, table_heads=TH)
+                               d_head=D, num_heads=H, table_heads=TH,
+                               rgroup=R)
+    if grouped is not None:
+        prefetch = (grouped[0], grouped[1], grouped[2])
+    else:
+        prefetch = (counts_bh, cols_bh)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(BH, nb),
+        num_scalar_prefetch=len(prefetch),
+        grid=(BH, nb // R),
         in_specs=[
-            pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+            pl.BlockSpec((1, rows, D), lambda b, i, *_: (b, i, 0)),
             pl.BlockSpec(memory_space=pl.ANY),   # k stays in HBM; DMA'd
             pl.BlockSpec(memory_space=pl.ANY),   # v stays in HBM; DMA'd
         ],
         out_specs=[
-            pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
-            pl.BlockSpec((1, block, 1), lambda b, i, *_: (b, i, 0)),
+            pl.BlockSpec((1, rows, D), lambda b, i, *_: (b, i, 0)),
+            pl.BlockSpec((1, rows, 1), lambda b, i, *_: (b, i, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((2, block, Dp), kf.dtype),
@@ -295,34 +367,40 @@ def _bs_fwd(qf, kf, vf, tables, scale, block, interpret):
             jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(counts_bh, cols_bh, qf, kb4, vb4)
+    )(*prefetch, qf, kb4, vb4)
     return o, lse
 
 
 def _bs_bwd(qf, kf, vf, o, lse, do, tables, scale, block, interpret):
     (counts_bh, cols_bh, max_nnz,
-     countsT_bh, rows_bh, max_nnzT, H, TH) = tables
+     countsT_bh, rows_bh, max_nnzT, H, TH, grouped, R) = tables
     BH, S, D = qf.shape
     nb = S // block
+    rows = R * block
     Dp = ((D + 127) // 128) * 128
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)[:, :, None]
 
+    if grouped is not None:
+        prefetch = (grouped[0], grouped[1], grouped[2])
+    else:
+        prefetch = (counts_bh, cols_bh)
     dq = pl.pallas_call(
         functools.partial(_bs_dq_kernel, scale=scale, block=block,
-                          d_head=D, num_heads=H, table_heads=TH),
+                          d_head=D, num_heads=H, table_heads=TH,
+                          rgroup=R),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=(BH, nb),
+            num_scalar_prefetch=len(prefetch),
+            grid=(BH, nb // R),
             in_specs=[
-                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, rows, D), lambda b, i, *_: (b, i, 0)),
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec((1, block, D), lambda b, i, *_: (b, i, 0)),
-                pl.BlockSpec((1, block, 1), lambda b, i, *_: (b, i, 0)),
-                pl.BlockSpec((1, block, 1), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, rows, D), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, rows, 1), lambda b, i, *_: (b, i, 0)),
+                pl.BlockSpec((1, rows, 1), lambda b, i, *_: (b, i, 0)),
             ],
-            out_specs=pl.BlockSpec((1, block, D),
+            out_specs=pl.BlockSpec((1, rows, D),
                                    lambda b, i, *_: (b, i, 0)),
             scratch_shapes=[
                 pltpu.VMEM((2, block, Dp), kf.dtype),
@@ -333,7 +411,7 @@ def _bs_bwd(qf, kf, vf, o, lse, do, tables, scale, block, interpret):
         ),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
         interpret=interpret,
-    )(counts_bh, cols_bh, qf, _block_major(kf, nb, block, Dp),
+    )(*prefetch, qf, _block_major(kf, nb, block, Dp),
       _block_major(vf, nb, block, Dp), do, lse, delta)
 
     # transpose pass: per K-block column, stream its attending q-blocks
@@ -416,7 +494,23 @@ def blocksparse_attention(q, k, v, layout, block, scale=None,
         table_layout = layout
     counts, cols, max_nnz = _layout_tables(table_layout)
     countsT, rows, max_nnzT = _layout_tables(table_layout.transpose(0, 2, 1))
-    smem_bytes = 4 * (counts.size + cols.size + countsT.size + rows.size)
+    # q-row fusion: R consecutive rows share each union k-block's DMA
+    # (and grid step) — the kernel is DMA-ISSUE bound, so fewer, fatter
+    # steps win. Cap fused rows at 1024 (VMEM: fp32 q/o/acc rows) and
+    # the bitmask at 32 rows.
+    R = 1
+    cand = min(max(1024 // block, 1), 32, nb)
+    while cand > 1 and nb % cand:
+        cand //= 2
+    grouped = None
+    if cand > 1:
+        R = cand
+        gc, gcol, gbits, _ = _grouped_tables(table_layout, R)
+        grouped = (jnp.asarray(gc), jnp.asarray(gcol), jnp.asarray(gbits))
+    g_size = 0 if grouped is None else 4 * (
+        grouped[0].size + grouped[1].size + grouped[2].size)
+    smem_bytes = 4 * (counts.size + cols.size + countsT.size
+                      + rows.size) + g_size
     if smem_bytes > 900_000:
         raise NotImplementedError(
             f"layout tables need ~{smem_bytes} B of SMEM (>1 MB budget): "
@@ -425,7 +519,7 @@ def blocksparse_attention(q, k, v, layout, block, scale=None,
             f"different_layout_per_head or the global-column count")
     tables = (jnp.asarray(counts), jnp.asarray(cols), max_nnz,
               jnp.asarray(countsT), jnp.asarray(rows), max_nnzT, H,
-              table_layout.shape[0])
+              table_layout.shape[0], grouped, R)
 
     qf = q.reshape(B * H, S, D)
     kf = k.reshape(B * H, S, D)

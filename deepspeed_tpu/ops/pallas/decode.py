@@ -422,27 +422,118 @@ def out_ffn_int8(ctx, x, wp, sp, bp, ln_w, ln_b, w1, s1, b1, w2, s2, b2,
 # (per-layer xs through lax.scan) costs ~15-20 us of slice/copy fixed
 # overhead PER ARRAY PER LAYER on this target — ~2.5 ms/tick at 13 xs.
 
+def _rms(x, w, eps):
+    xf = x.astype(jnp.float32)
+    n = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True)
+                           + eps)
+    return n * w.astype(jnp.float32)
+
+
 def ln_qkv_int8_stacked(x, ln_w, ln_b, wq_stack, s, b, layer, eps=1e-5,
-                        block_n=None, interpret=None):
-    """ln_qkv_int8 over stacked weights: wq_stack [L, E, 3E] (int8 or
-    bf16) indexed at ``layer`` by the block index map — no layer-slice
-    copy. ln_w/ln_b [L, 1, E], b [L, 1, 3E] (the middle unit axis makes
-    the per-layer block (1, 1, cols), which the TPU block-shape rules
-    accept; serving loops pre-reshape ONCE outside the layer scan —
-    2-D [L, cols] is accepted here but reshapes per call, a layout
-    copy); s [L] fp32 per-tensor scales (SMEM-prefetched, indexed
-    in-kernel — pass ones for bf16 stacks)."""
+                        block_n=None, interpret=None, norm="layer"):
+    """Fused norm + packed qkv projection over stacked weights: wq_stack
+    [L, E, N] (int8 or bf16) indexed at ``layer`` by the block index map
+    — no layer-slice copy. ln_w/ln_b [L, 1, E], b [L, 1, N] (the middle
+    unit axis makes the per-layer block (1, 1, cols), which the TPU
+    block-shape rules accept; serving loops pre-reshape ONCE outside the
+    layer scan — 2-D [L, cols] is accepted here but reshapes per call, a
+    layout copy); s [L] fp32 per-tensor scales (SMEM-prefetched, indexed
+    in-kernel — pass ones for bf16 stacks).
+
+    ``norm='rms'`` selects RMSNorm (LLaMA): ``ln_b`` is unused and the
+    projection is bias-free — pass ``None`` for both. N may be any
+    lane-aligned packed width (GPT-2 packs 3E; LLaMA packs
+    (H + 2*Hkv) * head_dim at reduced-KV widths)."""
     if interpret is None:
         interpret = _interpret_default()
     B, E = x.shape
     Lyr, Ew, N = wq_stack.shape
-    assert Ew == E and N == 3 * E
+    assert Ew == E
+    use_bias = norm != "rms"
     ln_w = ln_w.reshape(Lyr, 1, E)
-    ln_b = ln_b.reshape(Lyr, 1, E)
-    b = jnp.asarray(b).reshape(Lyr, 1, N)
+    if block_n is None:
+        # 7 MiB per weight block: 2x (double-buffered DMA) + the x/u
+        # scratch must stay under the 16 MiB scoped-VMEM limit — 8 MiB
+        # blocks hit it exactly and overflow by the scratch bytes at
+        # LLaMA-7B widths (E=4096, N=12288)
+        block_n = _pick_block(
+            N, budget_cols=(7 << 20) // max(E * wq_stack.dtype.itemsize, 1))
+    assert N % block_n == 0
+    s = jnp.asarray(s, jnp.float32).reshape(Lyr)
+    layer = jnp.asarray(layer, jnp.int32).reshape(1)
+    in_specs = [
+        pl.BlockSpec((B, E), lambda j, l, s: (0, 0)),
+        pl.BlockSpec((1, 1, E), lambda j, l, s: (l[0], 0, 0)),
+    ]
+    operands = [x, ln_w]
+    if use_bias:
+        in_specs.append(pl.BlockSpec((1, 1, E),
+                                     lambda j, l, s: (l[0], 0, 0)))
+        operands.append(ln_b.reshape(Lyr, 1, E))
+    in_specs.append(pl.BlockSpec((1, E, block_n),
+                                 lambda j, l, s: (l[0], 0, j)))
+    operands.append(wq_stack)
+    if use_bias:
+        in_specs.append(pl.BlockSpec((1, 1, block_n),
+                                     lambda j, l, s: (l[0], 0, j)))
+        operands.append(jnp.asarray(b).reshape(Lyr, 1, N))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(N // block_n,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((B, block_n), lambda j, l, s: (0, j)),
+        scratch_shapes=[pltpu.VMEM((B, E), x.dtype)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_ln_qkv_stacked_kernel, eps=eps, norm=norm),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, N), x.dtype),
+        interpret=interpret,
+    )(layer, s, *operands)
+    return out
+
+
+def _ln_qkv_stacked_kernel(l_ref, s_ref, x_ref, lnw_ref, *rest, eps,
+                           norm):
+    if norm == "rms":
+        w_ref, o_ref, u_ref = rest
+        lnb_ref = b_ref = None
+    else:
+        lnb_ref, w_ref, b_ref, o_ref, u_ref = rest
+    j = pl.program_id(0)
+    dt = x_ref.dtype
+
+    @pl.when(j == 0)
+    def _norm_pass():
+        if norm == "rms":
+            u_ref[...] = _rms(x_ref[...], lnw_ref[0], eps).astype(dt)
+        else:
+            u_ref[...] = _ln(x_ref[...], lnw_ref[0], lnb_ref[0],
+                             eps).astype(dt)
+
+    u = u_ref[...]
+    w = w_ref[0].astype(dt)                        # [E, bn]
+    y = jax.lax.dot(u, w, preferred_element_type=jnp.float32)
+    y = y * s_ref[l_ref[0]]
+    if b_ref is not None:
+        y = y + b_ref[0].astype(jnp.float32)
+    o_ref[...] = y.astype(dt)
+
+
+def matvec_int8_stacked(x, w_stack, s, layer, block_n=None,
+                        interpret=None):
+    """x [B, E] @ stacked (int8 or bf16) w [L, E, N] · s[layer] → [B, N],
+    bias-free, layer-indexed block maps — the large-E o_proj path where
+    fusing the whole [E, E] matrix into the ffn kernel's first grid step
+    would blow scoped VMEM (LLaMA-7B: 16.7 MB at E=4096)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    B, E = x.shape
+    Lyr, Ew, N = w_stack.shape
+    assert Ew == E
     if block_n is None:
         block_n = _pick_block(
-            N, budget_cols=(1 << 23) // max(E * wq_stack.dtype.itemsize, 1))
+            N, budget_cols=(7 << 20) // max(E * w_stack.dtype.itemsize, 1))
     assert N % block_n == 0
     s = jnp.asarray(s, jnp.float32).reshape(Lyr)
     layer = jnp.asarray(layer, jnp.int32).reshape(1)
@@ -451,38 +542,24 @@ def ln_qkv_int8_stacked(x, ln_w, ln_b, wq_stack, s, b, layer, eps=1e-5,
         grid=(N // block_n,),
         in_specs=[
             pl.BlockSpec((B, E), lambda j, l, s: (0, 0)),
-            pl.BlockSpec((1, 1, E), lambda j, l, s: (l[0], 0, 0)),
-            pl.BlockSpec((1, 1, E), lambda j, l, s: (l[0], 0, 0)),
             pl.BlockSpec((1, E, block_n), lambda j, l, s: (l[0], 0, j)),
-            pl.BlockSpec((1, 1, block_n), lambda j, l, s: (l[0], 0, j)),
         ],
         out_specs=pl.BlockSpec((B, block_n), lambda j, l, s: (0, j)),
-        scratch_shapes=[pltpu.VMEM((B, E), x.dtype)],
     )
     out = pl.pallas_call(
-        functools.partial(_ln_qkv_stacked_kernel, eps=eps),
+        _matvec_stacked_kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, N), x.dtype),
         interpret=interpret,
-    )(layer, s, x, ln_w, ln_b, wq_stack, b)
+    )(layer, s, x, w_stack)
     return out
 
 
-def _ln_qkv_stacked_kernel(l_ref, s_ref, x_ref, lnw_ref, lnb_ref, w_ref,
-                           b_ref, o_ref, u_ref, *, eps):
-    j = pl.program_id(0)
-    dt = x_ref.dtype
-
-    @pl.when(j == 0)
-    def _ln_pass():
-        u_ref[...] = _ln(x_ref[...], lnw_ref[0], lnb_ref[0],
-                         eps).astype(dt)
-
-    u = u_ref[...]
-    w = w_ref[0].astype(dt)                        # [E, bn]
-    y = jax.lax.dot(u, w, preferred_element_type=jnp.float32)
-    o_ref[...] = (y * s_ref[l_ref[0]]
-                  + b_ref[0].astype(jnp.float32)).astype(dt)
+def _matvec_stacked_kernel(l_ref, s_ref, x_ref, w_ref, o_ref):
+    x = x_ref[...]
+    w = w_ref[0].astype(x.dtype)
+    y = jax.lax.dot(x, w, preferred_element_type=jnp.float32)
+    o_ref[...] = (y * s_ref[l_ref[0]]).astype(x.dtype)
 
 
 def decode_attention_int8_stacked(q, k_stack, k_scale, v_stack, v_scale,
@@ -498,13 +575,19 @@ def decode_attention_int8_stacked(q, k_stack, k_scale, v_stack, v_scale,
     array is accepted but reshaped here, and because the tiled layouts
     differ (T(8,128) vs T(1,128)) XLA materializes that reshape as a
     full-stack copy PER CALL — the r5 b32 trace measured it at 5.4
-    ms/tick. Serving loops reshape once outside the layer scan."""
+    ms/tick. Serving loops reshape once outside the layer scan.
+
+    Grouped-query attention: q may carry R > 1 query rows per cache
+    head ([B, Hkv, R, D] — the rep = H/Hkv query heads sharing each KV
+    head fold into the row dim, consecutive-grouping as in the LLaMA
+    layout). All R rows share the decode position, so the mask/softmax
+    state just grows a row axis; the cache is read ONCE for all R."""
     if interpret is None:
         interpret = _interpret_default()
-    B, H, S, D = q.shape
-    assert S == 1
+    B, H, R, D = q.shape
     Lyr = k_stack.shape[0]
     L = k_stack.shape[3]
+    assert k_stack.shape[2] == H, (q.shape, k_stack.shape)
     scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
     if block_l is None:
         block_l = _pick_block_l(L, H, D, k_stack.dtype.itemsize)
@@ -517,7 +600,7 @@ def decode_attention_int8_stacked(q, k_stack, k_scale, v_stack, v_scale,
         num_scalar_prefetch=1,
         grid=(B, L // block_l),
         in_specs=[
-            pl.BlockSpec((1, H, 1, D), lambda b, lb, sc: (b, 0, 0, 0)),
+            pl.BlockSpec((1, H, R, D), lambda b, lb, sc: (b, 0, 0, 0)),
             pl.BlockSpec((1, 1, H, block_l, D),
                          lambda b, lb, sc: (sc[0], b, 0, lb, 0)),
             pl.BlockSpec((1, 1, H, 1, block_l),
@@ -527,21 +610,22 @@ def decode_attention_int8_stacked(q, k_stack, k_scale, v_stack, v_scale,
             pl.BlockSpec((1, 1, H, 1, block_l),
                          lambda b, lb, sc: (sc[0], b, 0, 0, lb)),
         ],
-        out_specs=pl.BlockSpec((1, H, D), lambda b, lb, sc: (b, 0, 0)),
+        out_specs=pl.BlockSpec((1, H, R, D),
+                               lambda b, lb, sc: (b, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((H, 1, 1), jnp.float32),
-            pltpu.VMEM((H, 1, 1), jnp.float32),
-            pltpu.VMEM((H, 1, D), jnp.float32),
+            pltpu.VMEM((H, R, 1), jnp.float32),
+            pltpu.VMEM((H, R, 1), jnp.float32),
+            pltpu.VMEM((H, R, D), jnp.float32),
         ],
     )
     out = pl.pallas_call(
         functools.partial(_decode_attn_stacked_kernel, scale=scale,
                           block_l=block_l, seq_len=L, quantized=True),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, R, D), q.dtype),
         interpret=interpret,
     )(scalars, q, k_stack, ks5, v_stack, vs5)
-    return out.reshape(B, H, 1, D)
+    return out
 
 
 def _pick_block_l(L, H, D, itemsize, budget_bytes=1 << 21):
@@ -579,11 +663,11 @@ def _decode_attn_stacked_kernel(sc_ref, q_ref, *rest, scale, block_l,
 
     @pl.when(base <= pos)
     def _block():
-        q = q_ref[0]                                # [H, 1, D]
+        q = q_ref[0]                                # [H, R, D]
         k = k_ref[0, 0].astype(q.dtype)             # [H, bl, D]
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32)     # [H, R, bl]
         s = s * scale
         if quantized:
             s = s * ks_ref[0, 0]                    # ks [H, 1, bl]
@@ -602,72 +686,108 @@ def _decode_attn_stacked_kernel(sc_ref, q_ref, *rest, scale, block_l,
         v = v_ref[0, 0].astype(q.dtype)
         ctx = jax.lax.dot_general(
             pv, v, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32)     # [H, R, D]
         acc_ref[...] = acc_ref[...] * alpha + ctx
 
     @pl.when(lb == nb - 1)
     def _finish():
         l_safe = jnp.maximum(l_ref[...], 1e-30)
-        o_ref[0] = (acc_ref[...] / l_safe)[:, 0, :].astype(o_ref.dtype)
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
 
 
 def out_ffn_int8_stacked(ctx, x, wp_stack, sp, bp, ln_w, ln_b, w1_stack,
                          s1, b1, w2_stack, s2, b2, layer, act="gelu_tanh",
-                         eps=1e-5, block_f=None, interpret=None):
+                         eps=1e-5, block_f=None, interpret=None,
+                         norm="layer", w1b_stack=None, s1b=None,
+                         fuse_proj=True):
     """out_ffn_int8 over stacked weights: wp [L,E,E], w1 [L,E,F],
     w2 [L,F,E] (int8 or bf16) indexed at ``layer`` by the block maps.
     Per-layer params are stacked too: ln_w/ln_b/bp/b2 [L, 1, E],
     b1 [L, 1, F] (2-D accepted, reshaped — see ln_qkv_int8_stacked);
-    sp/s1/s2 [L] fp32 scale vectors ride SMEM via scalar prefetch."""
+    sp/s1/s2 [L] fp32 scale vectors ride SMEM via scalar prefetch.
+
+    ``norm='rms'`` (LLaMA) drops ln_b and ALL projection biases (pass
+    None); ``act='swiglu'`` takes the gate stack as ``w1_stack`` and
+    the up stack as ``w1b_stack`` (scales ``s1``/``s1b``) — each tile
+    computes silu(u@Wg)*(u@Wu) @ W2-tile with both [E, block_f] tiles
+    streamed together.
+
+    ``fuse_proj=False`` drops the attention-output projection phase:
+    ``x`` must arrive as the POST-residual x1 (caller runs o_proj via
+    matvec_int8_stacked + an XLA add) and ``ctx``/``wp_stack``/``sp``/
+    ``bp`` are ignored — the large-E escape where a whole [E, E] proj
+    block would blow scoped VMEM."""
     if interpret is None:
         interpret = _interpret_default()
-    B, E = ctx.shape
+    B, E = x.shape
     Lyr, Ew, F = w1_stack.shape
-    assert Ew == E and w2_stack.shape[1:] == (F, E) \
-        and wp_stack.shape[1:] == (E, E)
+    assert Ew == E and w2_stack.shape[1:] == (F, E)
+    assert (not fuse_proj) or wp_stack.shape[1:] == (E, E)
+    use_bias = norm != "rms"
+    assert (act == "swiglu") == (w1b_stack is not None), \
+        "act='swiglu' takes the up-projection stack via w1b_stack"
     ln_w = ln_w.reshape(Lyr, 1, E)
-    ln_b = ln_b.reshape(Lyr, 1, E)
-    bp = jnp.asarray(bp).reshape(Lyr, 1, E)
-    b1 = jnp.asarray(b1).reshape(Lyr, 1, F)
-    b2 = jnp.asarray(b2).reshape(Lyr, 1, E)
     if block_f is None:
         block_f = _pick_block(
             F, budget_cols=(1 << 21) // max(E * w1_stack.dtype.itemsize, 1))
     assert F % block_f == 0, (F, block_f)
     n_tiles = F // block_f
+    if not fuse_proj:
+        sp = jnp.ones((Lyr,), jnp.float32)   # keep the scale layout
+    svecs = [sp, s1, s2] + ([s1b] if act == "swiglu" else [])
     scales = jnp.stack([jnp.asarray(v, jnp.float32).reshape(Lyr)
-                        for v in (sp, s1, s2)], axis=1)        # [L, 3]
+                        for v in svecs], axis=1)        # [L, 3 or 4]
     layer = jnp.asarray(layer, jnp.int32).reshape(1)
+    spec_be = pl.BlockSpec((B, E), lambda j, l, s: (0, 0))
+    spec_e = pl.BlockSpec((1, 1, E), lambda j, l, s: (l[0], 0, 0))
+    spec_w1 = pl.BlockSpec((1, E, block_f),
+                           lambda j, l, s: (l[0], 0, j))
+    if fuse_proj:
+        in_specs = [spec_be, spec_be,
+                    pl.BlockSpec((1, E, E), lambda j, l, s: (l[0], 0, 0)),
+                    spec_e]
+        operands = [ctx, x, wp_stack, ln_w]
+    else:
+        in_specs = [spec_be, spec_e]
+        operands = [x, ln_w]
+    if use_bias:
+        in_specs += [spec_e, spec_e]
+        operands += [ln_b.reshape(Lyr, 1, E),
+                     jnp.asarray(bp).reshape(Lyr, 1, E)]
+    in_specs.append(spec_w1)
+    operands.append(w1_stack)
+    if act == "swiglu":
+        in_specs.append(spec_w1)
+        operands.append(w1b_stack)
+    if use_bias:
+        in_specs.append(pl.BlockSpec((1, 1, block_f),
+                                     lambda j, l, s: (l[0], 0, j)))
+        operands.append(jnp.asarray(b1).reshape(Lyr, 1, F))
+    in_specs.append(pl.BlockSpec((1, block_f, E),
+                                 lambda j, l, s: (l[0], j, 0)))
+    operands.append(w2_stack)
+    if use_bias:
+        in_specs.append(spec_e)
+        operands.append(jnp.asarray(b2).reshape(Lyr, 1, E))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(n_tiles,),
-        in_specs=[
-            pl.BlockSpec((B, E), lambda j, l, s: (0, 0)),
-            pl.BlockSpec((B, E), lambda j, l, s: (0, 0)),
-            pl.BlockSpec((1, E, E), lambda j, l, s: (l[0], 0, 0)),
-            pl.BlockSpec((1, 1, E), lambda j, l, s: (l[0], 0, 0)),
-            pl.BlockSpec((1, 1, E), lambda j, l, s: (l[0], 0, 0)),
-            pl.BlockSpec((1, 1, E), lambda j, l, s: (l[0], 0, 0)),
-            pl.BlockSpec((1, E, block_f), lambda j, l, s: (l[0], 0, j)),
-            pl.BlockSpec((1, 1, block_f), lambda j, l, s: (l[0], 0, j)),
-            pl.BlockSpec((1, block_f, E), lambda j, l, s: (l[0], j, 0)),
-            pl.BlockSpec((1, 1, E), lambda j, l, s: (l[0], 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((B, E), lambda j, l, s: (0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((B, E), ctx.dtype),
-            pltpu.VMEM((B, E), ctx.dtype),
+            pltpu.VMEM((B, E), x.dtype),
+            pltpu.VMEM((B, E), x.dtype),
             pltpu.VMEM((B, E), jnp.float32),
         ],
     )
     out = pl.pallas_call(
         functools.partial(_out_ffn_stacked_kernel, eps=eps, act=act,
-                          n_tiles=n_tiles),
+                          n_tiles=n_tiles, norm=norm,
+                          fuse_proj=fuse_proj),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, E), ctx.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, E), x.dtype),
         interpret=interpret,
-    )(layer, scales, ctx, x, wp_stack, ln_w, ln_b, bp, w1_stack, b1,
-      w2_stack, b2)
+    )(layer, scales, *operands)
     return out
 
 
@@ -676,12 +796,13 @@ def decode_attention_fp_stacked(q, k_stack, v_stack, pos, layer,
     """decode_attention over stacked FULL-PRECISION (bf16/fp32) caches:
     k/v [L_layers, B, H, L, D] indexed at ``layer`` by the block maps.
     Same online-softmax structure as the int8 variant minus the per-
-    (b, h, pos) scale arrays (which have no fp counterpart)."""
+    (b, h, pos) scale arrays (which have no fp counterpart). Supports
+    grouped-query rows R > 1 like the int8 variant."""
     if interpret is None:
         interpret = _interpret_default()
-    B, H, S, D = q.shape
-    assert S == 1
+    B, H, R, D = q.shape
     L = k_stack.shape[3]
+    assert k_stack.shape[2] == H, (q.shape, k_stack.shape)
     scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
     if block_l is None:
         block_l = _pick_block_l(L, H, D, k_stack.dtype.itemsize)
@@ -692,53 +813,83 @@ def decode_attention_fp_stacked(q, k_stack, v_stack, pos, layer,
         num_scalar_prefetch=1,
         grid=(B, L // block_l),
         in_specs=[
-            pl.BlockSpec((1, H, 1, D), lambda b, lb, sc: (b, 0, 0, 0)),
+            pl.BlockSpec((1, H, R, D), lambda b, lb, sc: (b, 0, 0, 0)),
             pl.BlockSpec((1, 1, H, block_l, D),
                          lambda b, lb, sc: (sc[0], b, 0, lb, 0)),
             pl.BlockSpec((1, 1, H, block_l, D),
                          lambda b, lb, sc: (sc[0], b, 0, lb, 0)),
         ],
-        out_specs=pl.BlockSpec((1, H, D), lambda b, lb, sc: (b, 0, 0)),
+        out_specs=pl.BlockSpec((1, H, R, D),
+                               lambda b, lb, sc: (b, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((H, 1, 1), jnp.float32),
-            pltpu.VMEM((H, 1, 1), jnp.float32),
-            pltpu.VMEM((H, 1, D), jnp.float32),
+            pltpu.VMEM((H, R, 1), jnp.float32),
+            pltpu.VMEM((H, R, 1), jnp.float32),
+            pltpu.VMEM((H, R, D), jnp.float32),
         ],
     )
     out = pl.pallas_call(
         functools.partial(_decode_attn_stacked_kernel, scale=scale,
                           block_l=block_l, seq_len=L, quantized=False),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, R, D), q.dtype),
         interpret=interpret,
     )(scalars, q, k_stack, v_stack)
-    return out.reshape(B, H, 1, D)
+    return out
 
 
-def _out_ffn_stacked_kernel(l_ref, sc_ref, ctx_ref, x_ref, wp_ref,
-                            lnw_ref, lnb_ref, bp_ref, w1_ref, b1_ref,
-                            w2_ref, b2_ref, o_ref, x1_ref, u_ref,
-                            acc_ref, *, eps, act, n_tiles):
+def _out_ffn_stacked_kernel(l_ref, sc_ref, *args, eps, act, n_tiles,
+                            norm, fuse_proj=True):
+    if fuse_proj:
+        ctx_ref, x_ref, wp_ref, lnw_ref, *rest = args
+    else:
+        x_ref, lnw_ref, *rest = args
+        ctx_ref = wp_ref = None
+    if norm == "rms":
+        if act == "swiglu":
+            w1_ref, w1b_ref, w2_ref, o_ref, x1_ref, u_ref, acc_ref = rest
+        else:
+            w1_ref, w2_ref, o_ref, x1_ref, u_ref, acc_ref = rest
+            w1b_ref = None
+        lnb_ref = bp_ref = b1_ref = b2_ref = None
+    else:
+        assert act != "swiglu", "swiglu implies the bias-free rms layout"
+        (lnb_ref, bp_ref, w1_ref, b1_ref, w2_ref, b2_ref, o_ref,
+         x1_ref, u_ref, acc_ref) = rest
+        w1b_ref = None
     j = pl.program_id(0)
-    dt = ctx_ref.dtype
+    dt = x_ref.dtype
     lidx = l_ref[0]
 
     @pl.when(j == 0)
     def _proj():
-        ctx = ctx_ref[...]
-        wp = wp_ref[0].astype(dt)
-        t = jax.lax.dot(ctx, wp, preferred_element_type=jnp.float32)
-        t = t * sc_ref[lidx, 0] + bp_ref[0].astype(jnp.float32)
-        x1 = x_ref[...].astype(jnp.float32) + t
+        if fuse_proj:
+            ctx = ctx_ref[...]
+            wp = wp_ref[0].astype(dt)
+            t = jax.lax.dot(ctx, wp, preferred_element_type=jnp.float32)
+            t = t * sc_ref[lidx, 0]
+            if bp_ref is not None:
+                t = t + bp_ref[0].astype(jnp.float32)
+            x1 = x_ref[...].astype(jnp.float32) + t
+        else:
+            x1 = x_ref[...].astype(jnp.float32)
         x1_ref[...] = x1.astype(dt)
-        u_ref[...] = _ln(x1, lnw_ref[0], lnb_ref[0], eps).astype(dt)
+        if norm == "rms":
+            u_ref[...] = _rms(x1, lnw_ref[0], eps).astype(dt)
+        else:
+            u_ref[...] = _ln(x1, lnw_ref[0], lnb_ref[0], eps).astype(dt)
         acc_ref[...] = jnp.zeros_like(acc_ref[...])
 
     u = u_ref[...]
     w1 = w1_ref[0].astype(dt)
     h = jax.lax.dot(u, w1, preferred_element_type=jnp.float32)
-    h = h * sc_ref[lidx, 1] + b1_ref[0].astype(jnp.float32)
-    if act == "gelu_tanh":
+    h = h * sc_ref[lidx, 1]
+    if b1_ref is not None:
+        h = h + b1_ref[0].astype(jnp.float32)
+    if act == "swiglu":
+        up = jax.lax.dot(u, w1b_ref[0].astype(dt),
+                         preferred_element_type=jnp.float32)
+        h = jax.nn.silu(h) * (up * sc_ref[lidx, 3])
+    elif act == "gelu_tanh":
         h = jax.nn.gelu(h, approximate=True)
     else:
         h = jax.nn.gelu(h, approximate=False)
@@ -748,6 +899,7 @@ def _out_ffn_stacked_kernel(l_ref, sc_ref, ctx_ref, x_ref, wp_ref,
 
     @pl.when(j == n_tiles - 1)
     def _finish():
-        o_ref[...] = (x1_ref[...].astype(jnp.float32)
-                      + acc_ref[...] * sc_ref[lidx, 2]
-                      + b2_ref[0].astype(jnp.float32)).astype(o_ref.dtype)
+        y = x1_ref[...].astype(jnp.float32) + acc_ref[...] * sc_ref[lidx, 2]
+        if b2_ref is not None:
+            y = y + b2_ref[0].astype(jnp.float32)
+        o_ref[...] = y.astype(o_ref.dtype)

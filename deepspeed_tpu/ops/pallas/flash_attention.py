@@ -146,9 +146,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[0, :, 0] = m + jnp.log(l_safe)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+               heads=0, kv_heads=0):
+    """``heads``/``kv_heads`` > 0 enable grouped-query K/V: q is
+    [B*heads, S, D] while k/v stay [B*kv_heads, S, D] — the K/V block
+    index maps fold the q head onto its KV head, so the reduced-head
+    cache streams once per rep q heads and the full-head K/V is NEVER
+    materialized in HBM (the GQA memory promise, models/llama.py)."""
     BH, S, D = q.shape
     grid = (BH, S // block_q)
+    if heads and kv_heads and heads != kv_heads:
+        rep = heads // kv_heads
+        H = heads
+
+        def kv_map(b, i):
+            return ((b // H) * kv_heads + (b % H) // rep, 0, 0)
+    else:
+        def kv_map(b, i):
+            return (b, 0, 0)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k, seq_len=S)
     o, lse = pl.pallas_call(
@@ -156,8 +171,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), kv_map),
+            pl.BlockSpec((1, S, D), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
@@ -493,25 +508,29 @@ def _flash_bwd_chunked(q, k, v, o, lse, do, scale, causal, block_q, block_k,
 # ---------------------------------------------------------------- public op
 
 def _dispatch_fwd(q, k, v, scale, causal, block_q, block_k, chunk,
-                  interpret):
+                  interpret, heads=0, kv_heads=0):
     if chunk:
+        assert not (heads and kv_heads and heads != kv_heads), \
+            "GQA rides the unchunked kernel (caller repeats for chunked)"
         return _flash_fwd_chunked(q, k, v, scale, causal, block_q, block_k,
                                   chunk, interpret)
-    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                      heads=heads, kv_heads=kv_heads)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _flash_attention(q, k, v, scale, causal, block_q, block_k, chunk,
-                     interpret):
+                     interpret, heads=0, kv_heads=0):
     o, _ = _dispatch_fwd(q, k, v, scale, causal, block_q, block_k, chunk,
-                         interpret)
+                         interpret, heads, kv_heads)
     return o
 
 
 def _flash_attention_fwd(q, k, v, scale, causal, block_q, block_k, chunk,
-                         interpret):
+                         interpret, heads=0, kv_heads=0):
     o, lse = _dispatch_fwd(q, k, v, scale, causal, block_q, block_k, chunk,
-                           interpret)
+                           interpret, heads, kv_heads)
     # name the residuals so remat policies can elect to keep them: saving
     # o (+tiny lse) lets the backward kernels run without re-executing the
     # forward kernel under rematerialization (models/gpt2.py "dots_flash")
@@ -522,14 +541,36 @@ def _flash_attention_fwd(q, k, v, scale, causal, block_q, block_k, chunk,
 
 
 def _flash_attention_bwd(scale, causal, block_q, block_k, chunk, interpret,
-                         residuals, do):
+                         heads, kv_heads, residuals, do):
     q, k, v, o, lse = residuals
+    gqa = bool(heads and kv_heads and heads != kv_heads)
+    if gqa:
+        # backward still runs the full-head kernels: K/V repeat to
+        # [B*H, S, D] HERE (transient, bwd-only) and dk/dv sum back over
+        # the rep query heads sharing each KV head. A dk/dv-accumulating
+        # GQA backward kernel would remove this transient — the forward
+        # and prefill (the steady-state memory) no longer materialize it.
+        B = q.shape[0] // heads
+        rep = heads // kv_heads
+        S, D = k.shape[1], k.shape[2]
+
+        def rep_kv(t):
+            return jnp.repeat(t.reshape(B, kv_heads, S, D), rep,
+                              axis=1).reshape(B * heads, S, D)
+        k = rep_kv(k)
+        v = rep_kv(v)
     if chunk:
         dq, dk, dv = _flash_bwd_chunked(q, k, v, o, lse, do, scale, causal,
                                         block_q, block_k, chunk, interpret)
     else:
         dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, scale, causal,
                                 block_q, block_k, interpret)
+    if gqa:
+        def sum_rep(t):
+            return t.reshape(B, kv_heads, rep, S, D).sum(axis=2) \
+                .astype(t.dtype).reshape(B * kv_heads, S, D)
+        dk = sum_rep(dk)
+        dv = sum_rep(dv)
     return dq, dk, dv
 
 
@@ -563,8 +604,12 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
         return S if S <= top else 0
     block_q = pick_block(block_q)
     block_k = pick_block(block_k)
+    Hkv = k.shape[1]
+    assert v.shape[1] == Hkv and H % Hkv == 0, (q.shape, k.shape)
+
     if not block_q or not block_k or S % block_q or S % block_k:
         from deepspeed_tpu.ops.attention import reference_attention
+        # reference_attention repeats reduced-head K/V itself
         return reference_attention(q, k, v, causal=causal, scale=scale)
     if chunk is not None:
         if S % chunk or chunk % block_q or chunk % block_k:
@@ -582,11 +627,19 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
                 break
         else:
             from deepspeed_tpu.ops.attention import reference_attention
-            return reference_attention(q, k, v, causal=causal, scale=scale)
+            return reference_attention(q, k, v, causal=causal,
+                                       scale=scale)
 
     qf = q.reshape(B * H, S, D)
-    kf = k.reshape(B * H, S, D)
-    vf = v.reshape(B * H, S, D)
+    if chunk and Hkv != H:
+        # the chunked kernels keep full-head maps; GQA rides the
+        # unchunked kernel — repeat here for the long-S streaming path
+        k = jnp.repeat(k, H // Hkv, axis=1)
+        v = jnp.repeat(v, H // Hkv, axis=1)
+        Hkv = H
+    kf = k.reshape(B * k.shape[1], S, D)
+    vf = v.reshape(B * v.shape[1], S, D)
     o = _flash_attention(qf, kf, vf, scale, causal, block_q, block_k,
-                         int(chunk) if chunk else 0, bool(interpret))
+                         int(chunk) if chunk else 0, bool(interpret),
+                         H, Hkv)
     return o.reshape(B, H, S, D)

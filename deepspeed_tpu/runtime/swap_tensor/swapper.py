@@ -154,17 +154,45 @@ class PartitionedParamSwapper:
     count fixed at the double-buffer minimum.
     """
 
-    def __init__(self, nvme_path, aio_config=None):
-        self.dir = os.path.join(nvme_path, f"param_swap_{os.getpid()}")
+    def __init__(self, nvme_path, aio_config=None, sub_dir=None,
+                 durable=False):
+        """``sub_dir``/``durable``: by default the swap files are
+        pid-scoped SCRATCH (reclaimed on GC/exit). A durable tier (the
+        ZeRO-Infinity at-rest files, runtime/zero/infinity.py) passes a
+        stable sub_dir and durable=True: files survive the process and
+        carry a meta.json sidecar so a fresh process can restore."""
+        self.dir = os.path.join(
+            nvme_path, sub_dir or f"param_swap_{os.getpid()}")
         os.makedirs(self.dir, exist_ok=True)
         self.handle = _make_aio_handle(aio_config)
         self.meta = {}            # leaf idx -> (shape, numpy dtype)
         self._staging = [None, None]
-        self._finalizer = weakref.finalize(
-            self, shutil.rmtree, self.dir, ignore_errors=True)
+        self._durable = durable
+        if not durable:
+            self._finalizer = weakref.finalize(
+                self, shutil.rmtree, self.dir, ignore_errors=True)
 
     def _path(self, i):
         return os.path.join(self.dir, f"param_{i}.swp")
+
+    def _meta_path(self):
+        return os.path.join(self.dir, "meta.json")
+
+    def save_meta(self):
+        import json
+        with open(self._meta_path(), "w") as f:
+            json.dump({str(i): [list(s), str(np.dtype(d))]
+                       for i, (s, d) in self.meta.items()}, f)
+
+    def load_meta(self):
+        """Restore leaf metadata written by a previous process's
+        write_all (durable tiers only)."""
+        import json
+        with open(self._meta_path()) as f:
+            raw = json.load(f)
+        self.meta = {int(i): (tuple(s), np.dtype(d))
+                     for i, (s, d) in raw.items()}
+        return self.meta
 
     def _stage(self, i, nbytes):
         buf = self._staging[i % 2]
@@ -184,6 +212,8 @@ class PartitionedParamSwapper:
             arr = np.ascontiguousarray(np.asarray(leaf))
             self.meta[i] = (arr.shape, arr.dtype)
             self.handle.sync_pwrite(self._as_bytes(arr), self._path(i))
+        if self._durable:
+            self.save_meta()
 
     def swap_in_device(self, shardings):
         """disk → device params; returns the list of device leaves."""

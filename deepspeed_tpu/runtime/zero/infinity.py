@@ -129,7 +129,7 @@ class InfinityEngine:
                  weight_decay: float = 0.0, adam_w: bool = True,
                  moment_dtype=jnp.bfloat16,
                  park_threshold_bytes: int = 256 * 1024 * 1024,
-                 lr_fn=None):
+                 lr_fn=None, restore_params: bool = False):
         cfg = model_cfg
         assert cfg.scan_layers and cfg.tie_word_embeddings, \
             "InfinityEngine streams the scan-stacked tied-embedding family"
@@ -191,6 +191,7 @@ class InfinityEngine:
         self.emb_m, self.emb_v = list(emz[0::2]), list(emz[1::2])
 
         # ---- NVMe at-rest tier
+        self._fns = {}            # jit cache (restore uses place_row)
         self._swapper = None
         self._park_threshold = park_threshold_bytes
         self.param_bytes = sum(
@@ -201,16 +202,23 @@ class InfinityEngine:
         if nvme_path:
             from deepspeed_tpu.runtime.swap_tensor import (
                 PartitionedParamSwapper)
-            self._swapper = PartitionedParamSwapper(nvme_path)
-            # written host-side (numpy in, no d2h) — params rest on disk
-            # from step zero
-            self._swapper.write_all(
-                [np.asarray(l).astype(self._np_pdtype())
-                 for l in self._emb_leaves] +
-                [np.asarray(l).astype(self._np_pdtype())
-                 for l in self._blk_leaves])
+            # DURABLE at-rest tier: stable sub-dir + meta sidecar, no
+            # pid scoping, survives the process — a fresh engine with
+            # restore_params=True cold-starts from these files
+            self._swapper = PartitionedParamSwapper(
+                nvme_path, sub_dir="infinity_params", durable=True)
+            if restore_params:
+                self._swapper.load_meta()
+                self.restore_from_nvme()
+            else:
+                # written host-side (numpy in, no d2h) — params rest on
+                # disk from step zero
+                self._swapper.write_all(
+                    [np.asarray(l).astype(self._np_pdtype())
+                     for l in self._emb_leaves] +
+                    [np.asarray(l).astype(self._np_pdtype())
+                     for l in self._blk_leaves])
 
-        self._fns = {}
         logger.info(
             f"InfinityEngine: {cfg.n_layer} layers in {segments} segments "
             f"of {self.rows}; {self.param_bytes / 2**30:.2f} GiB compute "

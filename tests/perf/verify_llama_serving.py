@@ -1,0 +1,130 @@
+"""Round-5 verify: LLaMA fused serving on the real chip.
+
+1. Mosaic lowering + parity for the RMS/SwiGLU/GQA kernel modes
+   (tiny model, fp and int8).
+2. GQA flash forward on-chip (Hkv-aware index maps must lower).
+3. llama_7b b1/ctx2048 int8 decode tok/s (bench difference method) +
+   the honest roofline note: 6.7 GB of int8 weights per token bounds
+   b1 at ~120 tok/s on an 819 GB/s chip.
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.llama import (llama_tiny, llama_7b,
+                                        LlamaForCausalLM, llama_generate)
+from deepspeed_tpu.models.llama_inference import (
+    convert_llama_serving_params, quantize_llama_serving_params,
+    llama_fast_generate)
+
+
+def parity():
+    cfg = llama_tiny(hidden_size=128, intermediate_size=256, n_layers=3,
+                     n_heads=4, n_kv_heads=2, max_seq_len=192,
+                     dtype=jnp.bfloat16, param_dtype=jnp.bfloat16)
+    rs = np.random.RandomState(11)
+    prompt = rs.randint(0, 512, size=(2, 40)).astype(np.int32)
+    params = jax.jit(LlamaForCausalLM(cfg).init)(
+        jax.random.PRNGKey(7), prompt[:, :8])["params"]
+    ref = llama_generate(cfg, params, prompt, max_new_tokens=8,
+                         max_out_tokens=cfg.max_seq_len)
+    sparams = convert_llama_serving_params(params, cfg)
+    fp = llama_fast_generate(cfg, sparams, prompt, max_new_tokens=8,
+                             max_out_tokens=cfg.max_seq_len)
+    same = (np.asarray(fp) == np.asarray(ref)).mean()
+    print(f"llama fp fast vs flax: {same * 100:.1f}% tokens equal")
+    assert same == 1.0, (np.asarray(fp), np.asarray(ref))
+    q = llama_fast_generate(cfg, quantize_llama_serving_params(sparams),
+                            prompt, max_new_tokens=8,
+                            max_out_tokens=cfg.max_seq_len,
+                            kv_cache_bits=8)
+    same_q = (np.asarray(q) == np.asarray(fp)).mean()
+    print(f"llama int8 fast vs fp fast: {same_q * 100:.1f}% tokens equal")
+    assert same_q > 0.8
+
+
+def gqa_flash_chip():
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+    from deepspeed_tpu.ops.attention import reference_attention
+    B, H, Hkv, S, D = 2, 8, 2, 512, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, H, S, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, Hkv, S, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, Hkv, S, D), jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    err = float(jnp.mean(jnp.abs(out.astype(jnp.float32)
+                                 - ref.astype(jnp.float32))))
+    print(f"GQA flash on-chip mean abs err vs reference: {err:.5f}")
+    assert err < 0.01
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True)
+                       .astype(jnp.float32) ** 2)
+    g = jax.jit(jax.grad(loss, argnums=(1,)))(q, k, v)[0]
+    assert g.shape == (B, Hkv, S, D)
+    print("GQA flash backward on-chip OK (reduced dk shape)")
+
+
+def perf7b(bs=1, ctx=2048):
+    cfg = llama_7b(dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+                   max_seq_len=ctx)
+    print(f"llama_7b: {cfg.num_params() / 1e9:.2f}B params")
+    # int8 params built directly (random codes — decode reads the same
+    # bytes as a converted checkpoint; avoids materializing 13.5 GB bf16)
+    rs = np.random.RandomState(0)
+    E, H, Hkv, D, F, L, V = (cfg.hidden_size, cfg.n_heads, cfg.kv_heads,
+                             cfg.head_dim, cfg.intermediate_size,
+                             cfg.n_layers, cfg.vocab_size)
+
+    def q8(shape):
+        return {"kernel_q": jnp.asarray(
+            rs.randint(-80, 80, size=shape), jnp.int8),
+            "kernel_scale": jnp.full((shape[0],), 2e-3, jnp.float32)}
+
+    sparams = {
+        "embed": jnp.asarray(rs.randn(V, E) * 0.01, jnp.bfloat16),
+        "head": jnp.asarray(rs.randn(V, E) * 0.01, jnp.bfloat16),
+        "norm_scale": jnp.ones((E,), jnp.float32),
+        "blk": {
+            "qkv_w": q8((L, E, (H + 2 * Hkv) * D)),
+            "o_w": q8((L, H * D, E)),
+            "gate_w": q8((L, E, F)),
+            "up_w": q8((L, E, F)),
+            "down_w": q8((L, F, E)),
+            "norm1": jnp.ones((L, E), jnp.float32),
+            "norm2": jnp.ones((L, E), jnp.float32),
+        },
+    }
+    prompt = rs.randint(0, V, size=(bs, ctx - 80)).astype(np.int32)
+
+    def run(new):
+        toks = llama_fast_generate(cfg, sparams, prompt,
+                                   max_new_tokens=new,
+                                   max_out_tokens=ctx, kv_cache_bits=8)
+        return float(jax.device_get(toks[0, -1]))
+
+    run(4)
+    run(68)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter(); run(4)
+        ts = time.perf_counter() - t0
+        t0 = time.perf_counter(); run(68)
+        tl = time.perf_counter() - t0
+        best = min(best, tl - ts)
+    tps = bs * 64 / best
+    print(f"llama7b b{bs}/ctx{ctx} int8: {tps:.1f} tok/s "
+          f"({best * 1000 / 64:.2f} ms/tick)")
+    return tps
+
+
+if __name__ == "__main__":
+    print("devices:", jax.devices())
+    parity()
+    gqa_flash_chip()
+    perf7b(1)
+    perf7b(8)
+    print("OK")

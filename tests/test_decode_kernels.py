@@ -162,3 +162,84 @@ def test_decode_attention_fp_stacked_multiblock(rs):
     got = decode_attention_fp_stacked(q, kc, vc, pos, layer, block_l=64)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_rms_qkv_stacked_matches_xla(rs):
+    """norm='rms' mode: RMSNorm + bias-free packed projection over an
+    int8 stack — pins the LLaMA qkv kernel math."""
+    from deepspeed_tpu.ops.pallas.decode import ln_qkv_int8_stacked
+    Lyr, B, E, N, layer = 3, 2, 128, 256, 1
+    x = jnp.asarray(rs.randn(B, E), jnp.float32) * 0.5
+    lw = jnp.asarray(1.0 + 0.1 * rs.randn(Lyr, E), jnp.float32)
+    wq = jnp.asarray(rs.randint(-127, 128, (Lyr, E, N)), jnp.int8)
+    s = jnp.full((Lyr,), 0.002, jnp.float32)
+    xf = np.asarray(x)
+    u = xf / np.sqrt((xf ** 2).mean(-1, keepdims=True) + 1e-5) \
+        * np.asarray(lw[layer])
+    ref = u @ (np.asarray(wq[layer], np.float32) * 0.002)
+    got = ln_qkv_int8_stacked(x, lw, None, wq, s, None, layer,
+                              norm="rms")
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_swiglu_out_ffn_stacked_matches_xla(rs):
+    """norm='rms' + act='swiglu': o_proj + residual + RMSNorm + gated
+    FFN + residual, bias-free — pins the LLaMA ffn kernel math."""
+    from deepspeed_tpu.ops.pallas.decode import out_ffn_int8_stacked
+    Lyr, B, E, F, layer = 2, 2, 128, 256, 1
+    ctx = jnp.asarray(rs.randn(B, E), jnp.float32) * 0.3
+    x = jnp.asarray(rs.randn(B, E), jnp.float32) * 0.3
+    wo = jnp.asarray(rs.randint(-127, 128, (Lyr, E, E)), jnp.int8)
+    wg = jnp.asarray(rs.randint(-127, 128, (Lyr, E, F)), jnp.int8)
+    wu = jnp.asarray(rs.randint(-127, 128, (Lyr, E, F)), jnp.int8)
+    wd = jnp.asarray(rs.randint(-127, 128, (Lyr, F, E)), jnp.int8)
+    nw = jnp.asarray(1.0 + 0.1 * rs.randn(Lyr, E), jnp.float32)
+    so, sg, su, sd = (jnp.full((Lyr,), v, jnp.float32)
+                      for v in (0.002, 0.001, 0.0015, 0.001))
+    x1 = np.asarray(x) + np.asarray(ctx) @ (
+        np.asarray(wo[layer], np.float32) * 0.002)
+    u = x1 / np.sqrt((x1 ** 2).mean(-1, keepdims=True) + 1e-5) \
+        * np.asarray(nw[layer])
+    g = u @ (np.asarray(wg[layer], np.float32) * 0.001)
+    up = u @ (np.asarray(wu[layer], np.float32) * 0.0015)
+    h = np.asarray(jax.nn.silu(jnp.asarray(g))) * up
+    ref = x1 + h @ (np.asarray(wd[layer], np.float32) * 0.001)
+    got = out_ffn_int8_stacked(
+        ctx, x, wo, so, None, nw, None, wg, sg, None, wd, sd, None,
+        layer, act="swiglu", norm="rms", w1b_stack=wu, s1b=su,
+        block_f=128)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=3e-4,
+                               atol=3e-4)
+
+
+def test_decode_attention_stacked_gqa_rows(rs):
+    """R > 1 grouped-query rows: the R = H/Hkv query heads sharing each
+    KV head ride the row axis; the cache is read once. Must equal the
+    per-row XLA reference (multi-block path via block_l < L)."""
+    from deepspeed_tpu.ops.pallas.decode import (
+        decode_attention_int8_stacked)
+    Lyr, B, Hkv, R, D, L, pos, layer = 2, 2, 2, 4, 64, 256, 130, 1
+    q = jnp.asarray(rs.randn(B, Hkv, R, D), jnp.float32) * 0.3
+    kc = jnp.asarray(rs.randint(-127, 128, (Lyr, B, Hkv, L, D)),
+                     jnp.int8)
+    vc = jnp.asarray(rs.randint(-127, 128, (Lyr, B, Hkv, L, D)),
+                     jnp.int8)
+    ks = jnp.asarray(np.abs(rs.randn(Lyr, B, Hkv, L)),
+                     jnp.float32) * 0.01 + 1e-3
+    vs = jnp.asarray(np.abs(rs.randn(Lyr, B, Hkv, L)),
+                     jnp.float32) * 0.01 + 1e-3
+    dn_qk = (((3,), (3,)), ((0, 1), (0, 1)))
+    scores = jax.lax.dot_general(q, kc[layer].astype(q.dtype), dn_qk)
+    scores = scores * ks[layer][:, :, None, :] * (1.0 / np.sqrt(D))
+    vis = jnp.arange(L)[None, None, None, :] <= pos
+    scores = jnp.where(vis, scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1) * vs[layer][:, :, None, :]
+    ref = jax.lax.dot_general(p.astype(q.dtype),
+                              vc[layer].astype(q.dtype),
+                              (((3,), (2,)), ((0, 1), (0, 1))))
+    got = decode_attention_int8_stacked(
+        q, kc, ks.reshape(Lyr, B, Hkv, 1, L), vc,
+        vs.reshape(Lyr, B, Hkv, 1, L), pos, layer, block_l=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)

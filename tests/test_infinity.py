@@ -102,18 +102,17 @@ def test_nvme_at_rest_roundtrip(tmp_path):
     assert eng.params_on_disk_bytes() > 0
     losses = [eng.train_batch(batch) for _ in range(3)]
     eng.park_to_nvme()
+    del eng
 
+    # a FRESH engine cold-starts from the durable files (stable sub-dir
+    # + meta sidecar — the cross-process restart path): its next loss
+    # continues from the parked params, well below the from-scratch
+    # first loss (moments reset on cold start)
     eng2 = InfinityEngine(cfg, params, segments=2,
-                          nvme_path=str(tmp_path + "" if False
-                                        else str(tmp_path / "fresh")),
+                          nvme_path=str(tmp_path),
                           moment_dtype=jnp.float32,
-                          park_threshold_bytes=0)
-    # steal the parked files: restore from the FIRST engine's swapper
-    eng2._swapper = eng._swapper
-    eng2.restore_from_nvme()
+                          park_threshold_bytes=0, restore_params=True)
     l_next = eng2.train_batch(batch)
-    # moments reset on cold start, so the next loss continues from the
-    # parked params (well below the from-scratch first loss)
     assert l_next < losses[0], (l_next, losses)
 
 
