@@ -199,12 +199,20 @@ def main():
             loss = engine.train_batch(batch)
         float(jax.device_get(loss))
         best = min(best, (time.perf_counter() - t0) / iters)
-    # the residual fence share still inside the window, measured on a
-    # scalar this process has NOT read yet (a re-read of `loss` would hit
-    # the client-side npy cache and measure ~0 instead of the tunnel RTT)
-    t0 = time.perf_counter()
-    int(jax.device_get(engine.state.global_step))
-    fence_s = time.perf_counter() - t0
+    # the residual fence share still inside the window, measured on
+    # scalars this process has NOT read yet (a re-read of `loss` would
+    # hit the client-side npy cache and measure ~0 instead of the
+    # tunnel RTT). MINIMUM of three samples: the fence is a pure-RTT
+    # floor, and a single sample can absorb a host descheduling blip —
+    # one polluted 2.4 s sample inflated an r5 reading by +10 MFU
+    # points before this guard.
+    fences = []
+    for probe in (engine.state.global_step, engine.state.skipped_steps,
+                  engine.state.global_step + 0):
+        t0 = time.perf_counter()
+        int(jax.device_get(probe))
+        fences.append(time.perf_counter() - t0)
+    fence_s = min(fences)
     dt = best - fence_s / iters
 
     tokens_per_step = batch_size * seq
@@ -345,6 +353,9 @@ def main():
     print(short(result), flush=True)
 
     # the max-params-per-chip scale proof (ZeRO-Infinity, ≥6B on 16 GB)
+    # — free every earlier section's device state first; the 6B case
+    # needs nearly the whole chip
+    jax.clear_caches()
     inf6b = bench_infinity_6b(dstpu, dev)
     result["detail"]["infinity_6b"] = inf6b
     result["detail"]["max_params_per_chip_b"] = \
@@ -362,11 +373,11 @@ def bench_sparse_attention(jnp):
     sparse-attention headline: up to 6.1x on GPT-2 and 10x longer
     sequences, 2020-09-09 blog). BigBird (1 random + 3 window + 1 global
     block) at each sequence's measured-best layout block size — the
-    kernel is DMA-ISSUE bound (~1.4 us per tile copy; compute is ~2% of
-    runtime, docs/perf_tuning.md r4), so larger blocks trade density for
-    a quadratically smaller issue count. The r4 block sweep
-    (tests/perf/blocksparse_sweep.py): S=4096 -> 0.82x/0.92x/1.25x at
-    block 128/256/512; S=16384 -> 2.04x/2.78x/2.36x. Near-dense layouts
+    kernel is DMA-ISSUE bound (~1.4 us per tile copy) with the r5
+    grouped-row fusion amortizing the issue cost across R fused q-block
+    rows per union tile. r5 sweep (tests/perf/bs_sweep_r5.py, grouped):
+    S=4096 -> 1.08x/0.93x/1.36x at block 128/256/512; S=16384 ->
+    2.30x/2.62x/2.75x — both cases run block 512. Near-dense layouts
     auto-fall back to the masked-dense path (the calibrated crossover in
     sparse_self_attention._kernel_beats_dense)."""
     import time
@@ -377,7 +388,7 @@ def bench_sparse_attention(jnp):
 
     out = {}
     H, D = 16, 64
-    for S, B, block in ((4096, 4, 512), (16384, 1, 256)):
+    for S, B, block in ((4096, 4, 512), (16384, 1, 512)):
         cfg = BigBirdSparsityConfig(num_heads=1, block=block,
                                     num_random_blocks=1,
                                     num_sliding_window_blocks=3,
@@ -500,34 +511,14 @@ def bench_llama_decode(jnp, bs=1, ctx=2048):
     import time
     import jax
     from deepspeed_tpu.models.llama import llama_7b
-    from deepspeed_tpu.models.llama_inference import llama_fast_generate
+    from deepspeed_tpu.models.llama_inference import (
+        llama_fast_generate, random_int8_serving_params)
     cfg = llama_7b(dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
                    max_seq_len=ctx)
+    sparams = random_int8_serving_params(cfg)
     rs = np.random.RandomState(0)
-    E, H, Hkv, D, F, L, V = (cfg.hidden_size, cfg.n_heads, cfg.kv_heads,
-                             cfg.head_dim, cfg.intermediate_size,
-                             cfg.n_layers, cfg.vocab_size)
-
-    def q8(shape):
-        return {"kernel_q": jnp.asarray(
-            rs.randint(-80, 80, size=shape), jnp.int8),
-            "kernel_scale": jnp.full((shape[0],), 2e-3, jnp.float32)}
-
-    sparams = {
-        "embed": jnp.asarray(rs.randn(V, E) * 0.01, jnp.bfloat16),
-        "head": jnp.asarray(rs.randn(V, E) * 0.01, jnp.bfloat16),
-        "norm_scale": jnp.ones((E,), jnp.float32),
-        "blk": {
-            "qkv_w": q8((L, E, (H + 2 * Hkv) * D)),
-            "o_w": q8((L, H * D, E)),
-            "gate_w": q8((L, E, F)),
-            "up_w": q8((L, E, F)),
-            "down_w": q8((L, F, E)),
-            "norm1": jnp.ones((L, E), jnp.float32),
-            "norm2": jnp.ones((L, E), jnp.float32),
-        },
-    }
-    prompt = rs.randint(0, V, size=(bs, ctx - 80)).astype(np.int32)
+    prompt = rs.randint(0, cfg.vocab_size,
+                        size=(bs, ctx - 80)).astype(np.int32)
 
     def run(new):
         toks = llama_fast_generate(cfg, sparams, prompt,
@@ -749,6 +740,15 @@ def bench_nvme_param_tier(dstpu, make_mesh, MeshConfig, dev):
             "params_parked_between_steps": bool(parked),
             "steady_step_s": round(dt, 2),
             "host_rss_growth_mb_over_steps": round(rss_mb() - rss0, 1),
+            # r5 root cause: the growth is param_bytes x steps retained
+            # by the TUNNEL CLIENT's h2d staging (reproduced with bare
+            # jax.device_put of a reused numpy buffer — no framework
+            # code; d2h and remote-side streaming are flat). On a
+            # TPU-VM there is no per-step client transfer at all. See
+            # docs/perf_tuning.md r5e + tests/perf/h2d_cache_probe.py
+            "rss_growth_note": "= param_bytes/step of axon-client h2d "
+                               "staging; harness property, not a "
+                               "framework leak (perf_tuning r5e)",
             "first_loss": l0, "last_loss": l1,
         }
     except Exception as e:

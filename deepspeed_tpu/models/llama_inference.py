@@ -84,6 +84,36 @@ def quantize_llama_serving_params(sparams):
     return out
 
 
+def random_int8_serving_params(cfg: LlamaConfig, seed=0):
+    """Random int8 packed serving tree — bench/verify harnesses read
+    exactly the bytes a converted checkpoint would without
+    materializing the bf16 model first (13.5 GB at 7B)."""
+    rs = np.random.RandomState(seed)
+    E, H, Hkv, D = (cfg.hidden_size, cfg.n_heads, cfg.kv_heads,
+                    cfg.head_dim)
+    F, L, V = cfg.intermediate_size, cfg.n_layers, cfg.vocab_size
+
+    def q8(shape):
+        return {"kernel_q": jnp.asarray(
+            rs.randint(-80, 80, size=shape), jnp.int8),
+            "kernel_scale": jnp.full((shape[0],), 2e-3, jnp.float32)}
+
+    return {
+        "embed": jnp.asarray(rs.randn(V, E) * 0.01, jnp.bfloat16),
+        "head": jnp.asarray(rs.randn(V, E) * 0.01, jnp.bfloat16),
+        "norm_scale": jnp.ones((E,), jnp.float32),
+        "blk": {
+            "qkv_w": q8((L, E, (H + 2 * Hkv) * D)),
+            "o_w": q8((L, H * D, E)),
+            "gate_w": q8((L, E, F)),
+            "up_w": q8((L, E, F)),
+            "down_w": q8((L, F, E)),
+            "norm1": jnp.ones((L, E), jnp.float32),
+            "norm2": jnp.ones((L, E), jnp.float32),
+        },
+    }
+
+
 def _weights(blk, name, Lyr):
     """(stack, scale_vec) for either storage."""
     sub = blk[name]

@@ -507,10 +507,14 @@ def blocksparse_attention(q, k, v, layout, block, scale=None,
         R = cand
         gc, gcol, gbits, _ = _grouped_tables(table_layout, R)
         grouped = (jnp.asarray(gc), jnp.asarray(gcol), jnp.asarray(gbits))
-    g_size = 0 if grouped is None else 4 * (
-        grouped[0].size + grouped[1].size + grouped[2].size)
-    smem_bytes = 4 * (counts.size + cols.size + countsT.size
-                      + rows.size) + g_size
+    # budget counts what actually ships to SMEM: grouping REPLACES the
+    # ungrouped row tables in the fwd/dq passes (dkv keeps countsT/rows)
+    if grouped is not None:
+        smem_bytes = 4 * (countsT.size + rows.size + grouped[0].size
+                          + grouped[1].size + grouped[2].size)
+    else:
+        smem_bytes = 4 * (counts.size + cols.size + countsT.size
+                          + rows.size)
     if smem_bytes > 900_000:
         raise NotImplementedError(
             f"layout tables need ~{smem_bytes} B of SMEM (>1 MB budget): "

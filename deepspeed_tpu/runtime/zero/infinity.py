@@ -204,7 +204,10 @@ class InfinityEngine:
                 PartitionedParamSwapper)
             # DURABLE at-rest tier: stable sub-dir + meta sidecar, no
             # pid scoping, survives the process — a fresh engine with
-            # restore_params=True cold-starts from these files
+            # restore_params=True cold-starts from these files.
+            # CONTRACT: nvme_path identifies ONE training run's at-rest
+            # state (like a checkpoint dir) — two engines sharing it
+            # overwrite each other; call release() to reclaim the disk
             self._swapper = PartitionedParamSwapper(
                 nvme_path, sub_dir="infinity_params", durable=True)
             if restore_params:
@@ -530,6 +533,12 @@ class InfinityEngine:
             return 0
         return sum(os.path.getsize(self._swapper._path(i))
                    for i in range(len(self._swapper.meta)))
+
+    def release(self):
+        """Reclaim the durable NVMe files (they intentionally survive
+        the process otherwise — see the at-rest contract in __init__)."""
+        if self._swapper is not None:
+            self._swapper.release()
 
     # ------------------------------------------------------- engine parity
     @classmethod

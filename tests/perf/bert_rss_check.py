@@ -1,0 +1,55 @@
+"""(a) Re-measure the BERT headline twice to pin the r3->r4 swing.
+(b) Instrument the small NVMe-park case RSS over 12 steps to classify
+the r4 197.7 MB growth (leak vs warm-up plateau)."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+import sys
+sys.path.insert(0, "/root/repo")
+import bench
+
+dev = jax.devices()[0]
+for i in range(2):
+    sps = bench.bench_bert(dstpu, make_mesh, MeshConfig, dev)
+    print(f"bert run {i}: {sps} samples/s", flush=True)
+    jax.clear_caches()
+
+# small nvme-park case, 12 steps with per-step RSS
+import tempfile
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+def rss_mb():
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1]) / 1024
+
+tmp = tempfile.mkdtemp(prefix="dstpu_nvme_rss_")
+cfg_m = GPT2Config(vocab_size=8192, n_positions=256, n_embd=512,
+                   n_layer=8, n_head=8, dtype=jnp.bfloat16,
+                   scan_layers=True)
+engine, _, _, _ = dstpu.initialize(
+    config={
+        "train_batch_size": 4,
+        "zero_optimization": {
+            "stage": 2,
+            "offload_param": {"device": "nvme", "nvme_path": tmp},
+            "offload_optimizer": {"device": "cpu"}},
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "steps_per_print": 1000,
+    },
+    model=GPT2LMHeadModel(cfg_m),
+    mesh=make_mesh(MeshConfig(data=1), devices=[dev]))
+rng = np.random.RandomState(0)
+batch = {"input_ids": rng.randint(0, 8192, size=(4, 256))
+         .astype(np.int32)}
+track = []
+for i in range(12):
+    engine.train_batch(batch)
+    track.append(round(rss_mb(), 1))
+    print(f"step {i}: rss={track[-1]}", flush=True)
+print("rss deltas:", [round(b - a, 1) for a, b in zip(track, track[1:])])

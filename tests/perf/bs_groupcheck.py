@@ -1,0 +1,8 @@
+"""Measure grouped-row block-sparse vs dense flash on the real chip."""
+import sys
+sys.path.insert(0, "/root/repo")
+import bench
+import jax.numpy as jnp
+out = bench.bench_sparse_attention(jnp)
+import json
+print(json.dumps(out, indent=1))

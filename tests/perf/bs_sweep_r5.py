@@ -1,0 +1,39 @@
+"""Grouped-row block-sparse sweep: S=16384 BigBird across block sizes."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from deepspeed_tpu.ops.sparse_attention import BigBirdSparsityConfig
+from deepspeed_tpu.ops.pallas.blocksparse import blocksparse_attention
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+S, B, H, D = int(__import__("os").environ.get("BS_S", 16384)), 1, 16, 64
+rng = jax.random.PRNGKey(0)
+q, k, v = (jax.random.normal(jax.random.fold_in(rng, i),
+                             (B, H, S, D), jnp.bfloat16) * 0.3
+           for i in range(3))
+
+def timed(fn):
+    g = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
+    r = g(q, k, v)
+    float(jax.device_get(r[0].astype(jnp.float32).sum()))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        r = g(q, k, v)
+    float(jax.device_get(r[0].astype(jnp.float32).sum()))
+    return (time.perf_counter() - t0) / 5
+
+dn = timed(lambda a, b, c: jnp.sum(flash_attention(
+    a, b, c, causal=False).astype(jnp.float32) ** 2))
+print(f"dense flash: {dn * 1000:.2f} ms")
+for block in (128, 256, 512):
+    cfg = BigBirdSparsityConfig(num_heads=1, block=block,
+                                num_random_blocks=1,
+                                num_sliding_window_blocks=3,
+                                num_global_blocks=1)
+    np.random.seed(0)
+    layout = cfg.make_layout(S)
+    sp = timed(lambda a, b, c: jnp.sum(blocksparse_attention(
+        a, b, c, layout, block).astype(jnp.float32) ** 2))
+    print(f"block {block}: density {float(layout[0].mean()):.3f} "
+          f"sparse {sp * 1000:.2f} ms speedup {dn / sp:.2f}x")

@@ -1,0 +1,25 @@
+"""Does the axon client retain d2h results per device buffer?"""
+import gc
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+def rss():
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1]) / 1024
+
+make = jax.jit(lambda k: jax.random.normal(k, (14 << 20,), jnp.float32))
+print("start", rss())
+for i in range(6):
+    x = make(jax.random.PRNGKey(i))          # fresh 56 MB device buffer
+    a = np.asarray(x)                         # d2h
+    del a
+    x.delete()
+    del x
+    gc.collect()
+    print(f"iter {i}: rss={rss():.0f}", flush=True)
+jax.clear_caches()
+gc.collect()
+print("after clear_caches:", f"{rss():.0f}")

@@ -1,0 +1,31 @@
+import gc
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+def rss():
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1]) / 1024
+
+print("start", rss())
+base = np.empty(56 << 20, np.uint8)         # reused staging buffer
+for i in range(6):
+    base[:4] = i
+    view = base.view(np.float32)
+    x = jax.device_put(view)                 # h2d from the same buffer
+    x.block_until_ready()
+    x.delete()
+    del x
+    gc.collect()
+    print(f"iter {i} (reused buf): rss={rss():.0f}", flush=True)
+for i in range(6):
+    fresh = np.random.RandomState(i).randint(0, 255, 56 << 20) \
+        .astype(np.uint8).view(np.float32)
+    x = jax.device_put(fresh)
+    x.block_until_ready()
+    x.delete()
+    del x, fresh
+    gc.collect()
+    print(f"iter {i} (fresh buf): rss={rss():.0f}", flush=True)

@@ -1,0 +1,22 @@
+import gc
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+def rss():
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1]) / 1024
+
+ident = jax.jit(lambda a: a + 0)
+print("start", rss())
+for i in range(6):
+    fresh = np.random.RandomState(i).randint(0, 255, 56 << 20) \
+        .astype(np.uint8).view(np.float32)
+    x = ident(fresh)
+    x.block_until_ready()
+    x.delete()
+    del x, fresh
+    gc.collect()
+    print(f"iter {i} (jit arg): rss={rss():.0f}", flush=True)

@@ -1,0 +1,66 @@
+"""Headline 774M ZeRO-3 step time vs scan_unroll."""
+import sys
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+
+unroll = int(sys.argv[1]) if len(sys.argv) > 1 else 1
+dev = jax.devices()[0]
+mesh = make_mesh(MeshConfig(data=1), devices=[dev])
+import os as _os2
+SEQ = int(_os2.environ.get("SEQ", 1024))
+BS = 8192 // SEQ
+model_cfg = GPT2Config(vocab_size=50304, n_positions=SEQ, n_embd=1280,
+                       n_layer=36, n_head=20, dtype=jnp.bfloat16,
+                       scan_layers=True, remat=True,
+                       remat_policy=__import__("os").environ.get("RP", "dots_flash_fc_lean"),
+                       loss_chunk=int(__import__("os").environ.get("LC", 1024)), scan_unroll=unroll)
+cfg = {
+    "train_batch_size": BS,
+    "zero_optimization": {"stage": 3},
+    "bf16": {"enabled": True},
+    "data_types": {"grad_dtype": "bf16"},
+    "gradient_clipping": 1.0,
+    "optimizer": {"type": "AdamW",
+                  "params": {"lr": 1e-4, "weight_decay": 0.01,
+                             "moment_dtype": "bf16"}},
+    "steps_per_print": 1000,
+}
+import os as _os
+if _os.environ.get("FBQ"):
+    import functools as _ft
+    import importlib
+    _fa = importlib.import_module(
+        "deepspeed_tpu.ops.pallas.flash_attention")
+    _orig = _fa.flash_attention
+    _fa.flash_attention = _ft.partial(
+        _orig, block_q=int(_os.environ["FBQ"]),
+        block_k=int(_os.environ["FBK"]))
+engine, _, _, _ = dstpu.initialize(config=cfg,
+                                   model=GPT2LMHeadModel(model_cfg),
+                                   mesh=mesh)
+rng = np.random.RandomState(0)
+batch = {"input_ids": rng.randint(0, 50304, size=(BS, SEQ))
+         .astype(np.int32)}
+for _ in range(2):
+    loss = engine.train_batch(batch)
+float(jax.device_get(loss))
+iters = 30
+best = float("inf")
+for _ in range(3):
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = engine.train_batch(batch)
+    float(jax.device_get(loss))
+    best = min(best, (time.perf_counter() - t0) / iters)
+t0 = time.perf_counter()
+int(jax.device_get(engine.state.global_step))
+fence = time.perf_counter() - t0
+dt = best - fence / iters
+from bench import model_flops_per_token, peak_flops
+mfu = model_flops_per_token(model_cfg) * 8192 / dt / peak_flops(dev)
+print(f"unroll={unroll}: step {dt * 1000:.1f} ms  MFU {mfu * 100:.2f}%")

@@ -17,7 +17,7 @@ from deepspeed_tpu.models.llama import (llama_tiny, llama_7b,
                                         LlamaForCausalLM, llama_generate)
 from deepspeed_tpu.models.llama_inference import (
     convert_llama_serving_params, quantize_llama_serving_params,
-    llama_fast_generate)
+    llama_fast_generate, random_int8_serving_params)
 
 
 def parity():
@@ -72,33 +72,10 @@ def perf7b(bs=1, ctx=2048):
     cfg = llama_7b(dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
                    max_seq_len=ctx)
     print(f"llama_7b: {cfg.num_params() / 1e9:.2f}B params")
-    # int8 params built directly (random codes — decode reads the same
-    # bytes as a converted checkpoint; avoids materializing 13.5 GB bf16)
+    sparams = random_int8_serving_params(cfg)
     rs = np.random.RandomState(0)
-    E, H, Hkv, D, F, L, V = (cfg.hidden_size, cfg.n_heads, cfg.kv_heads,
-                             cfg.head_dim, cfg.intermediate_size,
-                             cfg.n_layers, cfg.vocab_size)
-
-    def q8(shape):
-        return {"kernel_q": jnp.asarray(
-            rs.randint(-80, 80, size=shape), jnp.int8),
-            "kernel_scale": jnp.full((shape[0],), 2e-3, jnp.float32)}
-
-    sparams = {
-        "embed": jnp.asarray(rs.randn(V, E) * 0.01, jnp.bfloat16),
-        "head": jnp.asarray(rs.randn(V, E) * 0.01, jnp.bfloat16),
-        "norm_scale": jnp.ones((E,), jnp.float32),
-        "blk": {
-            "qkv_w": q8((L, E, (H + 2 * Hkv) * D)),
-            "o_w": q8((L, H * D, E)),
-            "gate_w": q8((L, E, F)),
-            "up_w": q8((L, E, F)),
-            "down_w": q8((L, F, E)),
-            "norm1": jnp.ones((L, E), jnp.float32),
-            "norm2": jnp.ones((L, E), jnp.float32),
-        },
-    }
-    prompt = rs.randint(0, V, size=(bs, ctx - 80)).astype(np.int32)
+    prompt = rs.randint(0, cfg.vocab_size,
+                        size=(bs, ctx - 80)).astype(np.int32)
 
     def run(new):
         toks = llama_fast_generate(cfg, sparams, prompt,
