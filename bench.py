@@ -579,13 +579,54 @@ def bench_moe(dstpu, make_mesh, MeshConfig, dev, batch_size=8, seq=512):
             "experts": 8, "loss": round(final, 3)}
 
 
+INF9B_WARM_SENTINEL = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".jax_cache",
+    "inf9b_warmed")
+
+
+def tiled_gpt2_init(cfg, seed=0):
+    """Fast tiled-random GPT-2 init: every stacked layer shares one
+    random block (the canonical copy — bench + tests/perf harnesses
+    import this). Loss still falls because per-layer gradients differ
+    from step one; avoids minutes of gaussians per GB on 1-core hosts."""
+    import jax
+    from deepspeed_tpu.models.gpt2 import GPT2LMHeadModel
+    shapes = jax.eval_shape(
+        GPT2LMHeadModel(cfg).init, jax.random.PRNGKey(0),
+        np.zeros((1, 8), np.int32))["params"]
+    rs = np.random.RandomState(seed)
+
+    def leaf(path, s):
+        names = [str(getattr(p, "key", p)) for p in path]
+        if s.ndim == 3:          # scan-stacked [L, ...]: tile one layer
+            one = (rs.standard_normal(s.shape[1:]).astype(np.float32)
+                   / np.sqrt(max(s.shape[-2], 1))
+                   if names[-1] == "kernel"
+                   else np.zeros(s.shape[1:], np.float32))
+            a = np.broadcast_to(one, s.shape)
+        elif names[-1] in ("wte", "wpe"):
+            a = rs.standard_normal(s.shape).astype(np.float32) * 0.02
+        elif names[-1] == "scale":
+            a = np.ones(s.shape, np.float32)
+        else:
+            a = np.zeros(s.shape, np.float32)
+        return a.astype(np.dtype(s.dtype))
+    import jax.tree_util as jtu
+    return jtu.tree_map_with_path(leaf, shapes)
+
+
 def bench_infinity_6b(dstpu, dev, steps=3):
-    """THE scale proof: a 6.25B-param GPT-2 trains on this one 16 GB
-    chip (ZeRO-Infinity, runtime/zero/infinity.py) — 11.9 GB of compute
-    params resting on NVMe, 61 GB of fp32 master + Adam moments in
-    pinned_host, per-segment streamed fwd/bwd/update. Reference claim
-    this answers: 40B on a 32 GB V100 (ZeRO-Infinity blog, 1.25 B/GB);
-    this is 0.39 B/GB — the single-chip first rung.
+    """THE scale proof: a multi-billion-param GPT-2 trains on this one
+    16 GB chip (ZeRO-Infinity, runtime/zero/infinity.py) — compute
+    params resting on NVMe, fp32 master + Adam moments in pinned_host,
+    per-segment streamed fwd/bwd/update. Reference claim this answers:
+    40B on a 32 GB V100 (ZeRO-Infinity blog, 1.25 B/GB).
+
+    Two proven sizes: 6.25B (61 GB pinned state, 0.39 B/GB) and 9.41B
+    (94 GB pinned, 0.59 B/GB — measured: loss 11.77 -> 10.06, 18.6 s
+    steps, flat RSS). The 9.4B config runs when its compile cache is
+    warm (sentinel, same pattern as the XL case) so a cold driver run
+    isn't charged its ~19-minute first compile; otherwise 6.25B.
 
     Init is TILED-random (every layer shares one random block): the
     bench measures the streaming engine, not 6.25 s of gaussians per GB
@@ -604,32 +645,16 @@ def bench_infinity_6b(dstpu, dev, steps=3):
                     return int(line.split()[1]) / 1024
         return 0.0
 
-    cfg_m = GPT2Config(vocab_size=50304, n_positions=1024, n_embd=4096,
-                       n_layer=30, n_head=32, dtype=jnp.bfloat16,
+    big = os.path.exists(INF9B_WARM_SENTINEL) \
+        or os.environ.get("DSTPU_BENCH_FORCE_9B")
+    E, L, H = (4608, 36, 36) if big else (4096, 30, 32)
+    cfg_m = GPT2Config(vocab_size=50304, n_positions=1024, n_embd=E,
+                       n_layer=L, n_head=H, dtype=jnp.bfloat16,
                        param_dtype=jnp.bfloat16, scan_layers=True,
                        remat=True, loss_chunk=2048)
-    shapes = jax.eval_shape(
-        GPT2LMHeadModel(cfg_m).init, jax.random.PRNGKey(0),
-        np.zeros((1, 8), np.int32))["params"]
-    rs = np.random.RandomState(0)
-
-    def leaf(path, s):
-        names = [str(getattr(p, "key", p)) for p in path]
-        if s.ndim == 3:          # scan-stacked [L, ...]: tile one layer
-            one = (rs.standard_normal(s.shape[1:]).astype(np.float32)
-                   / np.sqrt(max(s.shape[-2], 1))
-                   if names[-1] == "kernel"
-                   else np.zeros(s.shape[1:], np.float32))
-            a = np.broadcast_to(one, s.shape)
-        elif names[-1] in ("wte", "wpe"):
-            a = rs.standard_normal(s.shape).astype(np.float32) * 0.02
-        elif names[-1] == "scale":
-            a = np.ones(s.shape, np.float32)
-        else:
-            a = np.zeros(s.shape, np.float32)
-        return a.astype(np.dtype(s.dtype))
+    segments = 6
     t0 = time.time()
-    params = jax.tree_util.tree_map_with_path(leaf, shapes)
+    params = tiled_gpt2_init(cfg_m)
     init_s = time.time() - t0
 
     nvme = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -644,7 +669,7 @@ def bench_infinity_6b(dstpu, dev, steps=3):
                 "zero_optimization": {
                     "stage": 3,
                     "offload_param": {"device": "nvme", "nvme_path": nvme,
-                                      "stream_segments": 6},
+                                      "stream_segments": segments},
                     "offload_optimizer": {"device": "cpu"}},
                 "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
             },
@@ -680,6 +705,25 @@ def bench_infinity_6b(dstpu, dev, steps=3):
         return {"skipped": str(e)[:300]}
     finally:
         shutil.rmtree(nvme, ignore_errors=True)
+
+
+def warm_infinity_9b():
+    """One bench-path 9.4B run to warm its compile cache; the sentinel
+    is written ONLY after the run succeeds (an interrupted warm must
+    not leave later bench runs selecting the 9.4B config against a
+    cold cache — the config is forced via env during warming)."""
+    import jax
+    import deepspeed_tpu as dstpu
+    _enable_compile_cache()
+    os.environ["DSTPU_BENCH_FORCE_9B"] = "1"
+    try:
+        out = bench_infinity_6b(dstpu, jax.devices()[0], steps=2)
+    finally:
+        os.environ.pop("DSTPU_BENCH_FORCE_9B", None)
+    if "skipped" not in out:
+        open(INF9B_WARM_SENTINEL, "w").write(json.dumps(out))
+    print(json.dumps(out))
+    return out
 
 
 def bench_nvme_param_tier(dstpu, make_mesh, MeshConfig, dev):

@@ -165,7 +165,38 @@ class InfinityEngine:
         # host placement via in-body device_put, NOT out_shardings: the
         # AOT compile path rejects host-memory entry outputs declared
         # through out_shardings ("layout for this output is not set to
-        # host memory"), while the device_put form is the r4-proven one
+        # host memory"), while the device_put form is the r4-proven one.
+        # Placement is BATCHED PER LEAF (one h2d of the whole [L, ...]
+        # stack, split into pinned rows inside one jit): per-ROW
+        # placement was 13 x n_layer dispatches whose per-call tunnel
+        # latency dominated — ~500 s of a 640 s setup at 9.4B.
+        def place_leaf_stack(leaf):
+            def f(x):
+                xf = x.astype(jnp.float32)
+                rows = tuple(
+                    jax.device_put(xf[r], self._host_sh)
+                    for r in range(x.shape[0]))
+                zm = tuple(jax.device_put(
+                    jnp.zeros(x.shape[1:], self._mdtype), self._host_sh)
+                    for _ in range(x.shape[0]))
+                zv = tuple(jax.device_put(
+                    jnp.zeros(x.shape[1:], jnp.float32), self._host_sh)
+                    for _ in range(x.shape[0]))
+                return rows, zm, zv
+            return jax.jit(f)(np.asarray(leaf))
+
+        self.master: List[List] = [[None] * len(self._blk_leaves)
+                                   for _ in range(cfg.n_layer)]
+        self.m: List[List] = [[None] * len(self._blk_leaves)
+                              for _ in range(cfg.n_layer)]
+        self.v: List[List] = [[None] * len(self._blk_leaves)
+                              for _ in range(cfg.n_layer)]
+        for i, leaf in enumerate(self._blk_leaves):
+            rows, zm, zv = place_leaf_stack(leaf)
+            for r in range(cfg.n_layer):
+                self.master[r][i] = rows[r]
+                self.m[r][i] = zm[r]
+                self.v[r][i] = zv[r]
         place_row = jax.jit(
             lambda *ls: tuple(
                 jax.device_put(jnp.asarray(l).astype(jnp.float32),
@@ -175,16 +206,6 @@ class InfinityEngine:
                 jax.device_put(x, self._host_sh) for l in ls
                 for x in (jnp.zeros(l.shape, self._mdtype),
                           jnp.zeros(l.shape, jnp.float32))))
-        self.master: List[List] = []   # [row][leaf] pinned fp32
-        self.m: List[List] = []
-        self.v: List[List] = []
-        for r in range(cfg.n_layer):
-            rows = [np.asarray(l[r]) for l in self._blk_leaves]
-            placed = place_row(*rows)
-            mz = zeros_row(*placed)
-            self.master.append(list(placed))
-            self.m.append(list(mz[0::2]))
-            self.v.append(list(mz[1::2]))
         self.emb_master = list(place_row(*[np.asarray(l)
                                            for l in self._emb_leaves]))
         emz = zeros_row(*self.emb_master)

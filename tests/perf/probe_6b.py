@@ -12,10 +12,10 @@ mesh = Mesh(np.array([dev]), ("d",))
 host_sh = NamedSharding(mesh, PartitionSpec(), memory_kind="pinned_host")
 dev_sh = NamedSharding(mesh, PartitionSpec(), memory_kind="device")
 
-# pinned_host capacity: allocate 4 GB chunks up to 72 GB
+# pinned_host capacity: allocate 4 GB chunks up to 120 GB
 held = []
 try:
-    for i in range(18):
+    for i in range(30):
         a = jax.jit(lambda: jnp.zeros((1 << 30,), jnp.float32),
                     out_shardings=host_sh)()
         a.block_until_ready()

@@ -33,7 +33,21 @@ PRESETS = {
                seq=1024),
     "6b": dict(n_embd=4096, n_layer=30, n_head=32, segments=6, batch=4,
                seq=1024),
+    # ~7.9B: 79 GB of pinned state (fp32 master + bf16 m + fp32 v)
+    "8b": dict(n_embd=4096, n_layer=40, n_head=32, segments=5,
+               batch=4, seq=1024, tiled=True),
+    # ~9.4B: ~94 GB of pinned state
+    "9b": dict(n_embd=4608, n_layer=36, n_head=36, segments=6, batch=4,
+               seq=1024, tiled=True),
 }
+
+
+def tiled_init(cfg, seed=0):
+    """Canonical copy lives in bench.py (tiled_gpt2_init)."""
+    import sys
+    sys.path.insert(0, "/root/repo")
+    from bench import tiled_gpt2_init
+    return tiled_gpt2_init(cfg, seed)
 
 
 def main():
@@ -45,10 +59,13 @@ def main():
                      n_head=p["n_head"], dtype=jnp.bfloat16,
                      param_dtype=jnp.bfloat16, scan_layers=True,
                      remat=True, loss_chunk=2048)
+    if p.get("segments") is None:
+        p["segments"] = next(s for s in (6, 5, 4, 3, 2)
+                             if cfg.n_layer % s == 0)
     nb = cfg.num_params() / 1e9
     print(f"model: {nb:.3f}B params; preset {preset}", flush=True)
     t0 = time.time()
-    params = numpy_init(cfg)
+    params = (tiled_init(cfg) if p.get("tiled") else numpy_init(cfg))
     print(f"init: {time.time() - t0:.1f}s rss={rss_mb():.0f}MB",
           flush=True)
 

@@ -88,3 +88,14 @@ def test_fast_generate_sampled_deterministic():
     a = llama_fast_generate(cfg, sparams, prompt, **kw)
     b = llama_fast_generate(cfg, sparams, prompt, **kw)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fast_generate_rejects_unsupported_config():
+    """Outside the fused envelope the loop must raise the gate's clean
+    error, not an opaque kernel assert (B cap here)."""
+    cfg, params, _ = _setup()
+    sparams = convert_llama_serving_params(params, cfg)
+    big_prompt = np.zeros((128, 8), np.int32)   # B=128 > the 64 cap
+    with pytest.raises(ValueError, match="fast-decode envelope"):
+        llama_fast_generate(cfg, sparams, big_prompt, max_new_tokens=4,
+                            max_out_tokens=cfg.max_seq_len)
