@@ -166,24 +166,32 @@ class InfinityEngine:
         # AOT compile path rejects host-memory entry outputs declared
         # through out_shardings ("layout for this output is not set to
         # host memory"), while the device_put form is the r4-proven one.
-        # Placement is BATCHED PER LEAF (one h2d of the whole [L, ...]
-        # stack, split into pinned rows inside one jit): per-ROW
-        # placement was 13 x n_layer dispatches whose per-call tunnel
-        # latency dominated — ~500 s of a 640 s setup at 9.4B.
-        def place_leaf_stack(leaf):
-            def f(x):
-                xf = x.astype(jnp.float32)
-                rows = tuple(
-                    jax.device_put(xf[r], self._host_sh)
-                    for r in range(x.shape[0]))
-                zm = tuple(jax.device_put(
-                    jnp.zeros(x.shape[1:], self._mdtype), self._host_sh)
-                    for _ in range(x.shape[0]))
-                zv = tuple(jax.device_put(
-                    jnp.zeros(x.shape[1:], jnp.float32), self._host_sh)
-                    for _ in range(x.shape[0]))
-                return rows, zm, zv
-            return jax.jit(f)(np.asarray(leaf))
+        # Placement is BATCHED over ROW-CHUNKS of each stacked leaf
+        # (~1 GiB of rows per jit call, split into pinned rows inside
+        # the jit): per-ROW placement was 13 x n_layer dispatches whose
+        # per-call tunnel latency dominated (~500 s of a 640 s setup at
+        # 9.4B), while one-jit-per-WHOLE-leaf crashed the remote AOT
+        # compile helper at multi-GB leaf stacks (HTTP 500) — chunking
+        # keeps both failure modes out.
+        place_fns = {}
+
+        def place_chunk(chunk):
+            key = chunk.shape
+            f = place_fns.get(key)
+            if f is None:
+                def body(x):
+                    xf = x.astype(jnp.float32)
+                    rows = tuple(jax.device_put(xf[r], self._host_sh)
+                                 for r in range(x.shape[0]))
+                    zm = tuple(jax.device_put(
+                        jnp.zeros(x.shape[1:], self._mdtype),
+                        self._host_sh) for _ in range(x.shape[0]))
+                    zv = tuple(jax.device_put(
+                        jnp.zeros(x.shape[1:], jnp.float32),
+                        self._host_sh) for _ in range(x.shape[0]))
+                    return rows, zm, zv
+                f = place_fns[key] = jax.jit(body)
+            return f(chunk)
 
         self.master: List[List] = [[None] * len(self._blk_leaves)
                                    for _ in range(cfg.n_layer)]
@@ -192,11 +200,20 @@ class InfinityEngine:
         self.v: List[List] = [[None] * len(self._blk_leaves)
                               for _ in range(cfg.n_layer)]
         for i, leaf in enumerate(self._blk_leaves):
-            rows, zm, zv = place_leaf_stack(leaf)
-            for r in range(cfg.n_layer):
-                self.master[r][i] = rows[r]
-                self.m[r][i] = zm[r]
-                self.v[r][i] = zv[r]
+            arr = np.asarray(leaf)
+            # budget against the IN-JIT footprint (fp32 master rows +
+            # fp32/bf16 zero moments ≈ 5x the bf16 source bytes), not
+            # the source bytes — the AOT helper's multi-GB-per-program
+            # crash is what chunking exists to avoid
+            row_bytes = max(arr[0].size * 10, 1)
+            step = max(1, int((1 << 30) // row_bytes))
+            for s in range(0, cfg.n_layer, step):
+                rows, zm, zv = place_chunk(arr[s:s + step])
+                for j, r in enumerate(range(s, min(s + step,
+                                                   cfg.n_layer))):
+                    self.master[r][i] = rows[j]
+                    self.m[r][i] = zm[j]
+                    self.v[r][i] = zv[j]
         place_row = jax.jit(
             lambda *ls: tuple(
                 jax.device_put(jnp.asarray(l).astype(jnp.float32),
