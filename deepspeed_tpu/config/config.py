@@ -91,6 +91,13 @@ class DeepSpeedZeroConfig:
                              C.ZERO_ALLGATHER_BUCKET_SIZE_DEFAULT))
         self.overlap_comm = bool(
             get_scalar_param(zero_dict, C.ZERO_OVERLAP_COMM, C.ZERO_OVERLAP_COMM_DEFAULT))
+        self.overlap_reduce = str(
+            get_scalar_param(zero_dict, C.ZERO_OVERLAP_REDUCE,
+                             C.ZERO_OVERLAP_REDUCE_DEFAULT))
+        if self.overlap_reduce not in ("ring", "fused"):
+            raise DeepSpeedConfigError(
+                f"zero_optimization.{C.ZERO_OVERLAP_REDUCE} must be 'ring' "
+                f"or 'fused', got {self.overlap_reduce!r}")
         self.reduce_scatter = bool(
             get_scalar_param(zero_dict, C.ZERO_REDUCE_SCATTER, C.ZERO_REDUCE_SCATTER_DEFAULT))
         self.contiguous_gradients = bool(
@@ -119,6 +126,17 @@ class DeepSpeedZeroConfig:
             self.offload_optimizer.device = C.OFFLOAD_CPU_DEVICE
         if cpu_offload_params and not self.offload_param.enabled:
             self.offload_param.device = C.OFFLOAD_CPU_DEVICE
+
+        # only validated where the knob is consumed — the overlap scheduler's
+        # bucket budget. With optimizer offload, overlap_comm keeps its
+        # reference d2h-streaming meaning and never reads the bucket size;
+        # plain parity configs keep accepting any value.
+        if self.overlap_comm and not self.offload_optimizer.enabled \
+                and self.reduce_bucket_size <= 0:
+            raise DeepSpeedConfigError(
+                f"zero_optimization.{C.ZERO_REDUCE_BUCKET_SIZE} must be "
+                f"positive when {C.ZERO_OVERLAP_COMM} is on, got "
+                f"{self.reduce_bucket_size}")
 
         # stage-3 tuning knobs
         self.prefetch_bucket_size = int(
@@ -150,6 +168,7 @@ class DeepSpeedZeroConfig:
             "reduce_bucket_size": self.reduce_bucket_size,
             "allgather_bucket_size": self.allgather_bucket_size,
             "overlap_comm": self.overlap_comm,
+            "overlap_reduce": self.overlap_reduce,
             "reduce_scatter": self.reduce_scatter,
             "offload_param": self.offload_param.repr_dict(),
             "offload_optimizer": self.offload_optimizer.repr_dict(),

@@ -134,6 +134,12 @@ ZERO_ALLGATHER_BUCKET_SIZE = "allgather_bucket_size"
 ZERO_ALLGATHER_BUCKET_SIZE_DEFAULT = 5e8
 ZERO_OVERLAP_COMM = "overlap_comm"
 ZERO_OVERLAP_COMM_DEFAULT = False
+# TPU extension: collective implementation for the overlap_comm bucket
+# stream — "ring" (explicit lax.ppermute ring reduce-scatter + all-gather
+# per bucket, maximum scheduling freedom) or "fused" (one lax.psum per
+# bucket; XLA picks the algorithm). See parallel/overlap.py.
+ZERO_OVERLAP_REDUCE = "overlap_reduce"
+ZERO_OVERLAP_REDUCE_DEFAULT = "ring"
 ZERO_REDUCE_SCATTER = "reduce_scatter"
 ZERO_REDUCE_SCATTER_DEFAULT = True
 ZERO_CONTIGUOUS_GRADIENTS = "contiguous_gradients"

@@ -46,12 +46,10 @@ def _gspmd_mesh():
     at transpose time); the Manual axis check additionally catches
     direct shard_map use of the model."""
     from deepspeed_tpu.parallel import mesh as mesh_lib
-    from jax.sharding import get_abstract_mesh, AxisType
     mesh = mesh_lib.pinned_mesh()
     if mesh is None:
         return None
-    am = get_abstract_mesh()
-    if any(t == AxisType.Manual for t in getattr(am, "axis_types", ())):
+    if mesh_lib.in_manual_region():
         return None
     return mesh
 

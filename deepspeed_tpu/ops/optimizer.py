@@ -28,6 +28,13 @@ class TpuOptimizer:
     #: uses this to extend param shardings onto the optimizer state.
     param_like_state_fields = ()
 
+    #: True when ``step`` is purely elementwise over each leaf (no
+    #: per-tensor statistics like LAMB's trust ratio), i.e. updating a
+    #: slice of a leaf with the matching moment slice is exact. The
+    #: overlap_comm train path relies on this to run the per-shard ZeRO
+    #: update inside shard_map (engine._build_overlap_train_fn).
+    elementwise_update = False
+
     def init(self, params) -> Dict[str, Any]:
         raise NotImplementedError
 

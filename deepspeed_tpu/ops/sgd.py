@@ -22,6 +22,7 @@ class SGD(TpuOptimizer):
     nesterov: bool = False
 
     param_like_state_fields = ("momentum_buffer",)
+    elementwise_update = True
 
     def init(self, params):
         return {

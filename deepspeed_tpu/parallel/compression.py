@@ -33,6 +33,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
 
 _BIT_WEIGHTS = 2 ** np.arange(8, dtype=np.uint8)  # LSB-first packing
 
@@ -67,7 +69,7 @@ def compressed_allreduce(buf, worker_error, server_error, axis_name):
     Returns (result, new_worker_error, new_server_error): ``result`` is the
     approximate mean of ``buf`` over the axis, identical on all devices.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = mesh_lib.axis_size(axis_name)
     numel = buf.size
     assert numel % (8 * n) == 0, (
         f"1-bit buffer numel {numel} must divide by 8*axis={8 * n}")
@@ -113,7 +115,7 @@ def tree_compressed_allreduce(tree, worker_errors, server_errors, axis_name):
     whole momentum into one flat buffer per tensor, onebit/adam.py:191).
     Leaves are padded to the 8*axis_size quantum; error states carry the
     padded length."""
-    n = jax.lax.axis_size(axis_name)
+    n = mesh_lib.axis_size(axis_name)
 
     def one(leaf, we, se):
         flat = leaf.reshape(-1).astype(jnp.float32)

@@ -23,7 +23,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+
+from deepspeed_tpu.parallel.mesh import shard_map
 
 
 @jax.tree_util.register_pytree_node_class

@@ -162,6 +162,31 @@ class ZeroPartitioner:
                     lambda _: NamedSharding(self.mesh, PartitionSpec()), sub)
         return out
 
+    def explicit_shard_plan(self, params):
+        """Per-leaf update ownership for the explicit-comm (shard_map)
+        overlap train path: a list aligned with ``tree_leaves(params)`` of
+        ``(dim, shard_size)`` — the data-axis dim the stage>=1 optimizer
+        state shards over and the per-device extent — or ``None`` for
+        leaves whose moments stay replicated (every device runs their full
+        update redundantly, which is exact). Inside shard_map the owner
+        device updates params[dim slice] with its local moment shard and
+        the slices all-gather back (the stage-1/2 updated-param all-gather,
+        stage2.py:~1470, made explicit)."""
+        leaves = jax.tree_util.tree_leaves(params)
+        specs = jax.tree_util.tree_leaves(
+            self.opt_param_like_specs(params),
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
+        plan = []
+        for leaf, spec in zip(leaves, specs):
+            entry = None
+            for d, ax in enumerate(spec):
+                axes = ax if isinstance(ax, tuple) else (ax,)
+                if mesh_lib.DATA_AXIS in axes:
+                    entry = (d, leaf.shape[d] // self.dp)
+                    break
+            plan.append(entry)
+        return plan
+
     def constrain_grads(self, grads):
         """Apply the stage>=2 reduce-scatter constraint inside the train step."""
         if self.stage < 2:

@@ -25,6 +25,7 @@ def main(numel=8_388_608):
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
     from deepspeed_tpu.parallel import compression as comp
+    from deepspeed_tpu.parallel.mesh import shard_map
 
     n = len(jax.devices())
     mesh = Mesh(np.asarray(jax.devices()), ("data",))
@@ -33,7 +34,7 @@ def main(numel=8_388_608):
     se = jnp.zeros((n, numel // n), jnp.float32)
 
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(shard_map, mesh=mesh,
                        in_specs=(P("data"),) * 3,
                        out_specs=(P("data"),) * 3)
     def run(b, w, s):

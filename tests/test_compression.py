@@ -11,6 +11,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.parallel import compression as comp
+from deepspeed_tpu.parallel.mesh import shard_map
 
 
 def _mesh(n):
@@ -43,7 +44,7 @@ def _run_allreduce(mesh, bufs, wes, ses):
     key = id(mesh)
     if key not in _RUN_CACHE:
         @jax.jit
-        @functools.partial(jax.shard_map, mesh=mesh,
+        @functools.partial(shard_map, mesh=mesh,
                            in_specs=(P("data"), P("data"), P("data")),
                            out_specs=(P("data"), P("data"), P("data")))
         def run(buf, we, se):
@@ -110,7 +111,7 @@ def test_tree_allreduce_shapes_and_padding():
     assert ses["b"].shape == (comp.padded_numel(2, n) // n,)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(), P(), P()), out_specs=(P(), P("data"), P("data")),
         check_vma=False)
     def run(tree, wes, ses):

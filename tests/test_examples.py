@@ -54,6 +54,7 @@ def test_example_gpt2_pretrain_zero2():
     assert final < first, (first, final)
 
 
+@pytest.mark.slow
 def test_example_gpt2_pipeline():
     out = _run_example("gpt2_pipeline.py", "--steps", "8", "--pipe", "2",
                        "--data", "2", devices=4)

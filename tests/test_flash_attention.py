@@ -203,8 +203,9 @@ def test_flash_gqa_forward_never_materializes_full_head_kv():
     producers = {e.primitive.name for e in walk(jaxpr.jaxpr)
                  for ov in e.outvars
                  if tuple(ov.aval.shape) == full_shape}
-    assert producers <= {"reshape", "custom_vjp_call", "pallas_call"}, \
-        producers
+    # (custom_vjp_call spells itself custom_vjp_call_jaxpr on jax <= 0.4.x)
+    assert producers <= {"reshape", "custom_vjp_call",
+                         "custom_vjp_call_jaxpr", "pallas_call"}, producers
 
 
 def test_flash_gqa_backward_matches_reference():

@@ -3,6 +3,7 @@ decode correctness (the reference's inference-kernel equivalence tests,
 transformer_inference vs the training model)."""
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -44,6 +45,7 @@ def test_injected_logits_match_unrolled_layout():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_greedy_cache_decode_equals_full_reforward():
     """The KV-cache incremental decode must reproduce greedy generation done
     the slow way (full forward per emitted token on the training model)."""
@@ -136,6 +138,7 @@ def test_step_loop_decode_matches_scan_decode():
     np.testing.assert_array_equal(np.asarray(scan), np.asarray(loop))
 
 
+@pytest.mark.slow
 def test_moe_gpt2_serves_through_inference_stack():
     """MoE GPT-2 decode: the fused inference layer routes each token
     through the expert bank. Exact equality with training-model
@@ -206,6 +209,7 @@ def test_kv_cache_bits_validation():
         DeepSpeedInferenceConfig(hidden_size=32, heads=2, kv_cache_bits=4)
 
 
+@pytest.mark.slow
 def test_tp_sharded_decode_matches_single_device(devices8):
     """mp_size serving (reference module_inject's mp_size sharding): a
     model-axis-sharded generate must produce the single-device tokens
@@ -238,6 +242,7 @@ def test_tp_sharded_decode_matches_single_device(devices8):
     np.testing.assert_array_equal(np.asarray(t_q), np.asarray(t_q_tp))
 
 
+@pytest.mark.slow
 def test_fast_decode_scan_matches_flax_path():
     """The stacked-weight manual serving loop (_fast_decode_scan_fn —
     kernels index whole weight/cache stacks via scalar-prefetch, caches

@@ -48,6 +48,7 @@ def _ref_adam_loop(model, params, batch, steps, lr, betas, eps):
     return losses
 
 
+@pytest.mark.slow
 def test_streamed_step_matches_full_resident_training():
     cfg = _tiny_cfg()
     model = GPT2LMHeadModel(cfg)

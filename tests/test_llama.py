@@ -37,6 +37,7 @@ def test_llama_trains_loss_falls():
     assert losses[-1] < losses[0] - 0.5, losses
 
 
+@pytest.mark.slow
 def test_llama_zero3_matches_stage0():
     """ZeRO-3 sharded llama training must match unsharded numerics —
     the generic partitioner has to handle the scan-stacked GQA tree."""
@@ -139,6 +140,7 @@ def test_llama_matches_hf_logits():
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_llama_cached_decode_matches_reforward():
     """Greedy KV-cache generation must equal argmax over full re-forwards
     (the gpt2_inference serving contract; RoPE positions are absolute so

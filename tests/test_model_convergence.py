@@ -63,6 +63,7 @@ def test_bf16_grad_accum_matches_fp32():
     np.testing.assert_allclose(got, base, rtol=3e-2, atol=3e-2)
 
 
+@pytest.mark.slow
 def test_bf16_grad_dtype_matches_fp32():
     """grad_dtype=bf16 (params cast once inside the differentiated fn, all
     cotangents bf16) tracks the fp32-grad trajectory within rounding."""
@@ -87,6 +88,7 @@ def test_bf16_grad_dtype_matches_fp32():
     np.testing.assert_allclose(got, base, rtol=3e-2, atol=3e-2)
 
 
+@pytest.mark.slow
 def test_bf16_moment_dtype_converges():
     """moment_dtype=bf16 (half-storage Adam moments) still converges and
     tracks fp32 moments closely over a short horizon."""
@@ -142,6 +144,7 @@ def test_chunked_lm_loss_matches_full():
             err_msg=k)
 
 
+@pytest.mark.slow
 def test_zero_stages_match_single_device():
     base = _train(MeshConfig(data=1), zero_stage=0)
     for stage in (1, 2, 3):
@@ -168,6 +171,7 @@ def test_dp_zero_matches_single_device():
                                    err_msg=f"dp=4 stage {stage}")
 
 
+@pytest.mark.slow
 def test_tp_matches_single_device():
     if len(jax.devices()) < 4:
         pytest.skip("need 4 devices")
@@ -177,6 +181,7 @@ def test_tp_matches_single_device():
     np.testing.assert_allclose(got, base, rtol=2e-2, atol=2e-2)
 
 
+@pytest.mark.slow
 def test_bert_tp_matches_single_device():
     """BERT gets Megatron specs from the sharding registry (VERDICT: TP
     derivation must not be GPT-2-only) — tp run matches single-device."""
@@ -246,6 +251,7 @@ def test_tp_without_rules_warns():
                for r in records), [r.getMessage() for r in records]
 
 
+@pytest.mark.slow
 def test_elastic_checkpoint_across_mesh_resize(tmp_path):
     """Save under one parallel layout, restore under another, training must
     continue identically — the reference's elastic-checkpoint contract
@@ -341,6 +347,7 @@ def _matrix_train(dtype, stage, offload):
 @pytest.mark.parametrize("dtype", ["bf16", "fp16"])
 @pytest.mark.parametrize("stage", [0, 2, 3])
 @pytest.mark.parametrize("offload", [False, True])
+@pytest.mark.slow
 def test_flagship_loss_matrix(dtype, stage, offload):
     """VERDICT r3 item 10: every {stage} x {dtype} x {offload} cell of the
     flagship config reproduces its pinned 5-step trajectory, and ZeRO

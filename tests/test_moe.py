@@ -111,6 +111,7 @@ def test_moe_trains_expert_parallel():
     assert l1 < l0
 
 
+@pytest.mark.slow
 def test_gpt2_moe_trains():
     """GPT-2 with MoE FFNs (moe_experts>0) trains end to end, expert
     parallel over the data axis."""

@@ -27,6 +27,7 @@ _WORKER = textwrap.dedent("""
     import numpy as np
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
+    from deepspeed_tpu.parallel.mesh import shard_map
 
     devs = jax.devices()             # global device list across processes
     mesh = Mesh(np.asarray(devs), ("data",))
@@ -34,7 +35,7 @@ _WORKER = textwrap.dedent("""
 
     import functools
     @jax.jit
-    @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("data"),
+    @functools.partial(shard_map, mesh=mesh, in_specs=P("data"),
                        out_specs=P())
     def total(x):
         return jax.lax.psum(jnp.sum(x), "data")
@@ -60,6 +61,7 @@ def _free_port():
 
 
 @pytest.mark.parametrize("world", [2])
+@pytest.mark.slow
 def test_two_process_psum_over_launcher_contract(tmp_path, world):
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)
@@ -123,6 +125,7 @@ _ENGINE_WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow
 def test_engine_trains_across_two_processes(tmp_path):
     """Full engine training over a 2-process global mesh (dp=8, ZeRO-2):
     the true multi-host path — rendezvous, global batch feeding, GSPMD
@@ -223,6 +226,7 @@ _CKPT_WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow
 def test_sharded_checkpoint_two_processes_and_resize(tmp_path):
     """ZeRO-3 sharded save across 2 real processes: each rank writes only
     its own shard windows (no full-tree gather), restore reproduces the

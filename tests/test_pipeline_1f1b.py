@@ -250,6 +250,7 @@ def test_pipeline_infer_matches_sequential(pp, micro):
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_multistage_decode_matches_single_device():
     """Multi-stage greedy decode through the InferenceSchedule program
     produces the same tokens and logits as the single-device model

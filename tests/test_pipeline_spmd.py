@@ -103,6 +103,7 @@ def test_gpt2_pipe_model_matches_plain_gpt2():
                                np.asarray(logits_pipe), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_gpt2_pipe_trains_under_engine():
     from deepspeed_tpu.models.gpt2 import gpt2_tiny
     from deepspeed_tpu.models.gpt2_pipe import GPT2PipeModel

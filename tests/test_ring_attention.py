@@ -27,6 +27,7 @@ def test_ring_matches_reference(causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_ring_gradients_match(causal):
     mesh = make_mesh(MeshConfig(seq=4, data=2))
     q, k, v = _qkv(shape=(1, 2, 32, 8), seed=1)

@@ -68,6 +68,7 @@ def test_ulysses_rejects_indivisible_heads():
         ulysses_attention(q, q, q, mesh, causal=False)
 
 
+@pytest.mark.slow
 def test_gpt2_trains_with_ulysses_sp():
     """End-to-end: GPT-2 with sp_backend='ulysses' trains on a seq-sharded
     mesh and matches the single-device trajectory."""
