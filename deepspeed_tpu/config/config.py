@@ -339,6 +339,50 @@ class PipelineConfig:
             d.get(C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL, 0))
 
 
+class ServingConfig:
+    """tpu-native ``serving`` block: the continuous-batching engine with
+    a paged KV cache (deepspeed_tpu/serving). Presence of the block
+    enables it; geometry maps 1:1 onto PagedCacheSpec."""
+
+    def __init__(self, param_dict):
+        d = param_dict.get(C.SERVING, None)
+        self.enabled = d is not None and bool(
+            d.get(C.SERVING_ENABLED, C.SERVING_ENABLED_DEFAULT))
+        d = d or {}
+        self.slots = int(d.get(C.SERVING_SLOTS, C.SERVING_SLOTS_DEFAULT))
+        self.page_size = int(d.get(C.SERVING_PAGE_SIZE,
+                                   C.SERVING_PAGE_SIZE_DEFAULT))
+        self.max_pages_per_slot = int(
+            d.get(C.SERVING_MAX_PAGES_PER_SLOT,
+                  C.SERVING_MAX_PAGES_PER_SLOT_DEFAULT))
+        self.num_blocks = int(d.get(C.SERVING_NUM_BLOCKS,
+                                    C.SERVING_NUM_BLOCKS_DEFAULT))
+        self.kv_cache_bits = int(d.get(C.SERVING_KV_CACHE_BITS,
+                                       C.SERVING_KV_CACHE_BITS_DEFAULT))
+        self.quantize_bits = int(d.get(C.SERVING_QUANTIZE_BITS,
+                                       C.SERVING_QUANTIZE_BITS_DEFAULT))
+        if self.kv_cache_bits not in (0, 8):
+            raise DeepSpeedConfigError(
+                f"serving.kv_cache_bits must be 0 or 8, got "
+                f"{self.kv_cache_bits}")
+        if self.quantize_bits not in (0, 8):
+            raise DeepSpeedConfigError(
+                f"serving.quantize_bits must be 0 or 8, got "
+                f"{self.quantize_bits}")
+        if self.slots < 1 or self.page_size < 1 \
+                or self.max_pages_per_slot < 1:
+            raise DeepSpeedConfigError(
+                "serving.slots / page_size / max_pages_per_slot must be "
+                f"positive, got {self.slots}/{self.page_size}/"
+                f"{self.max_pages_per_slot}")
+        min_blocks = self.slots * self.max_pages_per_slot + 1
+        if self.num_blocks and self.num_blocks < self.slots + 1:
+            raise DeepSpeedConfigError(
+                f"serving.num_blocks {self.num_blocks} cannot even hold "
+                f"one page per slot (+1 reserved trash block); need >= "
+                f"{self.slots + 1} (fully-provisioned: {min_blocks})")
+
+
 class MeshConfigSection:
     """tpu-native: logical mesh axis sizes. -1 on the data axis means
     "whatever is left" after the explicit axes divide the device count."""
@@ -463,6 +507,7 @@ class DeepSpeedConfig:
         self.sparse_attention_config = SparseAttentionConfig(pd)
         self.pipeline_config = PipelineConfig(pd)
         self.mesh_config = MeshConfigSection(pd)
+        self.serving_config = ServingConfig(pd)
 
         self.gradient_clipping = pd.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
 

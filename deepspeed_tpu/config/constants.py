@@ -371,3 +371,22 @@ AIO_SINGLE_SUBMIT = "single_submit"
 AIO_SINGLE_SUBMIT_DEFAULT = False
 AIO_OVERLAP_EVENTS = "overlap_events"
 AIO_OVERLAP_EVENTS_DEFAULT = True
+
+#############################################
+# Serving (continuous batching + paged KV cache) [tpu]
+#############################################
+SERVING = "serving"
+SERVING_ENABLED = "enabled"
+SERVING_ENABLED_DEFAULT = True        # presence of the block enables it
+SERVING_SLOTS = "slots"
+SERVING_SLOTS_DEFAULT = 8
+SERVING_PAGE_SIZE = "page_size"
+SERVING_PAGE_SIZE_DEFAULT = 128
+SERVING_MAX_PAGES_PER_SLOT = "max_pages_per_slot"
+SERVING_MAX_PAGES_PER_SLOT_DEFAULT = 16
+SERVING_NUM_BLOCKS = "num_blocks"
+SERVING_NUM_BLOCKS_DEFAULT = 0        # 0 → slots * max_pages + 1 (trash)
+SERVING_KV_CACHE_BITS = "kv_cache_bits"
+SERVING_KV_CACHE_BITS_DEFAULT = 0
+SERVING_QUANTIZE_BITS = "quantize_bits"
+SERVING_QUANTIZE_BITS_DEFAULT = 0

@@ -837,6 +837,141 @@ def decode_attention_fp_stacked(q, k_stack, v_stack, pos, layer,
     return out
 
 
+# ------------------------------------------------- paged decode attention
+#
+# The serving engine (deepspeed_tpu/serving) stores the KV cache as a POOL
+# of fixed-size blocks [Lyr, NB, H, page, D] plus a per-slot page table
+# [B, MAXP] int32; a slot's cache rows for positions [p*page, (p+1)*page)
+# live in pool block page_table[b, p]. The kernel grid is (B, MAXP) and the
+# K/V block index maps GATHER through the scalar-prefetched page table —
+# same online-softmax body as the dense stacked kernel, but the slot's
+# pages can live anywhere in the pool, so slots are admitted/freed without
+# reshaping anyone else's cache. Per-slot ``pos`` (a VECTOR, unlike the
+# dense kernels' scalar) masks each slot independently: slots decode at
+# different sequence lengths in the same program, and pos[b] < 0 marks an
+# idle slot (every page skipped, output rows zero).
+
+def decode_attention_paged(q, k_pool, v_pool, pos, page_table, layer,
+                           k_scale=None, v_scale=None, scale=None,
+                           interpret=None):
+    """S=1 cached attention through a paged KV pool.
+
+    q [B, H, R, D] (R = grouped-query rows per KV head, 1 for MHA);
+    k_pool/v_pool [Lyr, NB, H, page, D] int8 or bf16/fp32 blocks;
+    k_scale/v_scale [Lyr, NB, H, 1, page] fp32 per-(block, head, row)
+    absmax scales — pass None for full-precision pools (both or neither);
+    pos [B] int32 — per-slot index of the newest valid cache row (< 0 →
+    idle slot, output zeros); page_table [B, MAXP] int32 — pool block ids
+    per slot page; entries past the slot's live pages must still be VALID
+    pool indices (the engine points them at the reserved trash block 0).
+    layer: scalar int32. Returns [B, H, R, D] in q.dtype."""
+    if interpret is None:
+        interpret = _interpret_default()
+    quantized = k_scale is not None
+    assert (v_scale is not None) == quantized
+    B, H, R, D = q.shape
+    Lyr, NB, Hp, page, Dp = k_pool.shape
+    assert (Hp, Dp) == (H, D), (q.shape, k_pool.shape)
+    MAXP = page_table.shape[1]
+    assert page_table.shape == (B, MAXP), (page_table.shape, B)
+    scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
+    layer = jnp.asarray(layer, jnp.int32).reshape(1)
+    pos = jnp.asarray(pos, jnp.int32).reshape(B)
+    page_table = jnp.asarray(page_table, jnp.int32)
+    kv_spec = pl.BlockSpec(
+        (1, 1, H, page, D),
+        lambda b, pb, lr, pr, pt: (lr[0], pt[b, pb], 0, 0, 0))
+    sc_spec = pl.BlockSpec(
+        (1, 1, H, 1, page),
+        lambda b, pb, lr, pr, pt: (lr[0], pt[b, pb], 0, 0, 0))
+    in_specs = [pl.BlockSpec((1, H, R, D),
+                             lambda b, pb, lr, pr, pt: (b, 0, 0, 0))]
+    operands = [q]
+    if quantized:
+        in_specs += [kv_spec, sc_spec, kv_spec, sc_spec]
+        operands += [k_pool, k_scale, v_pool, v_scale]
+    else:
+        in_specs += [kv_spec, kv_spec]
+        operands += [k_pool, v_pool]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, MAXP),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, H, R, D),
+                               lambda b, pb, lr, pr, pt: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, R, 1), jnp.float32),
+            pltpu.VMEM((H, R, 1), jnp.float32),
+            pltpu.VMEM((H, R, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_attn_paged_kernel, scale=scale,
+                          page=page, quantized=quantized),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, R, D), q.dtype),
+        interpret=interpret,
+    )(layer, pos, page_table, *operands)
+    return out
+
+
+def _decode_attn_paged_kernel(lyr_ref, pos_ref, pt_ref, q_ref, *rest,
+                              scale, page, quantized):
+    """grid=(B, MAXP): same online-softmax state machine as the dense
+    stacked kernel, but the block index maps already gathered this
+    program's K/V page through the page table, and ``pos`` is read per
+    slot so every batch row masks at its own length."""
+    if quantized:
+        k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, d_ref, acc_ref = rest
+    else:
+        k_ref, v_ref, o_ref, m_ref, d_ref, acc_ref = rest
+    b = pl.program_id(0)
+    pb = pl.program_id(1)
+    npg = pl.num_programs(1)
+    pos = pos_ref[b]
+
+    @pl.when(pb == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref[...], -1e30)
+        d_ref[...] = jnp.zeros_like(d_ref[...])
+        acc_ref[...] = jnp.zeros_like(acc_ref[...])
+
+    base = pb * page
+
+    @pl.when(base <= pos)
+    def _block():
+        q = q_ref[0]                                # [H, R, D]
+        k = k_ref[0, 0].astype(q.dtype)             # [H, page, D]
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)     # [H, R, page]
+        s = s * scale
+        if quantized:
+            s = s * ks_ref[0, 0]                    # [H, 1, page]
+        k_pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(k_pos <= pos, s, -1e30)
+        m_acc = m_ref[...]
+        m_new = jnp.maximum(m_acc, jnp.max(s, axis=2, keepdims=True))
+        m_ref[...] = m_new
+        alpha = jnp.exp(m_acc - m_new)
+        p = jnp.exp(s - m_new)
+        d_ref[...] = d_ref[...] * alpha + jnp.sum(p, axis=2,
+                                                  keepdims=True)
+        if quantized:
+            p = p * vs_ref[0, 0]
+        pv = p.astype(q.dtype)
+        v = v_ref[0, 0].astype(q.dtype)
+        ctx = jax.lax.dot_general(
+            pv, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)     # [H, R, D]
+        acc_ref[...] = acc_ref[...] * alpha + ctx
+
+    @pl.when(pb == npg - 1)
+    def _finish():
+        d_safe = jnp.maximum(d_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / d_safe).astype(o_ref.dtype)
+
+
 def _out_ffn_stacked_kernel(l_ref, sc_ref, *args, eps, act, n_tiles,
                             norm, fuse_proj=True):
     if fuse_proj:

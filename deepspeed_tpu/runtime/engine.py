@@ -494,16 +494,19 @@ class DeepSpeedEngine:
             and not isinstance(self.optimizer, FusedLamb)
         if want_stream:
             from deepspeed_tpu.runtime.zero.offload_stream import (
-                StreamedOffloadOptimizer, backend_supports_pinned_host)
-            if backend_supports_pinned_host(self.mesh.devices.flat[0]):
+                StreamedOffloadOptimizer, backend_supports_offload_stream)
+            if backend_supports_offload_stream(self.mesh.devices.flat[0]):
+                # TPU: state rests in pinned_host; CPU: memory spaces are
+                # collapsed (unpinned_host only) so the moves are no-ops
+                # but the tier runs with identical semantics
                 return StreamedOffloadOptimizer(
                     params, self.optimizer, self.mesh, self.zero)
             if cfg.stream == "device":
                 raise ValueError(
                     "offload_optimizer stream='device' requires a backend "
-                    "with a pinned_host memory space")
-            logger.warning("offload: no pinned_host memory space on this "
-                           "backend; using the host runner")
+                    "with an addressable host memory space")
+            logger.warning("offload: backend reports no addressable "
+                           "memories; using the host runner")
         elif cfg.stream == "device":
             raise ValueError(
                 "offload_optimizer stream='device' supports device='cpu' "

@@ -47,12 +47,36 @@ from jax.sharding import NamedSharding, PartitionSpec
 from deepspeed_tpu.utils.logging import logger
 
 
-def backend_supports_pinned_host(device=None) -> bool:
+def host_memory_kind(device=None):
+    """The backend's host-side memory kind for resting optimizer state:
+    ``pinned_host`` where the platform has a distinct DMA-able host space
+    (TPU), else the backend's default kind (the XLA CPU backend collapses
+    memory spaces — host IS device memory, exposed only as
+    ``unpinned_host`` — so the streamed tier runs there with no-op moves
+    and identical semantics). None when the backend reports nothing."""
     try:
         dev = device or jax.devices()[0]
-        return "pinned_host" in {m.kind for m in dev.addressable_memories()}
+        kinds = {m.kind for m in dev.addressable_memories()}
     except Exception:
-        return False
+        return None
+    if "pinned_host" in kinds:
+        return "pinned_host"
+    return _default_memory_kind(device) or next(iter(sorted(kinds)), None)
+
+
+def _default_memory_kind(device=None):
+    try:
+        dev = device or jax.devices()[0]
+        return dev.default_memory().kind
+    except Exception:
+        return None
+
+
+def backend_supports_offload_stream(device=None) -> bool:
+    """True when the streamed tier can place its state somewhere the
+    backend names — every current backend; kept as a guard for exotic
+    PJRT plugins that report no memories at all."""
+    return host_memory_kind(device) is not None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -91,6 +115,13 @@ class StreamedOffloadOptimizer:
         self.mesh = mesh
         self.zero = partitioner
         self.step_count = 0
+        dev0 = mesh.devices.flat[0]
+        self.host_memory_kind = host_memory_kind(dev0)
+        self.device_memory_kind = _default_memory_kind(dev0) or "device"
+        if self.host_memory_kind is None:
+            raise ValueError(
+                "streamed offload: backend reports no addressable "
+                "memories; use the host runner (stream='host')")
         self._mdtype = jnp.bfloat16 \
             if getattr(optimizer, "moment_dtype", "fp32") == "bf16" \
             else jnp.float32
@@ -109,7 +140,8 @@ class StreamedOffloadOptimizer:
         self.param_specs = jax.tree_util.tree_leaves(
             param_spec_tree, is_leaf=lambda x: isinstance(x, PartitionSpec))
         assert len(self.opt_specs) == n and len(self.param_specs) == n
-        self.param_memory_kind = partitioner.param_memory_kind or "device"
+        self.param_memory_kind = partitioner.param_memory_kind \
+            or self.device_memory_kind
 
         # split big leaves along dim0 into units of <= unit_bytes fp32
         self.units: List[_Unit] = []
@@ -177,7 +209,7 @@ class StreamedOffloadOptimizer:
             f"StreamedOffloadOptimizer: {n} leaves -> {len(self.units)} "
             f"stream units in {len(self.groups)} programs; moments "
             f"{'bf16' if self._mdtype == jnp.bfloat16 else 'fp32'} + fp32 "
-            f"master resident in pinned_host")
+            f"master resident in {self.host_memory_kind}")
 
     # -- unit geometry -----------------------------------------------------
     @staticmethod
@@ -202,11 +234,11 @@ class StreamedOffloadOptimizer:
 
     def _host_sh(self, u: _Unit):
         return NamedSharding(self.mesh, self.opt_specs[u.leaf],
-                             memory_kind="pinned_host")
+                             memory_kind=self.host_memory_kind)
 
     def _stage_sh(self, u: _Unit):
         return NamedSharding(self.mesh, self.opt_specs[u.leaf],
-                             memory_kind="device")
+                             memory_kind=self.device_memory_kind)
 
     # -- the step ----------------------------------------------------------
     def _build_group_fn(self, gi, out_dtype):
@@ -266,14 +298,14 @@ class StreamedOffloadOptimizer:
         the resting param sharding (spec move in device space, memory-kind
         move as a same-spec DMA when the pinned-host param tier is on)."""
         dev_sh = NamedSharding(self.mesh, self.param_specs[leaf_idx],
-                               memory_kind="device")
+                               memory_kind=self.device_memory_kind)
         key = (leaf_idx, jnp.dtype(out_dtype).name, len(chunks))
         fn = self._group_fns.get(("asm", key))
         if fn is None:
             def assemble(*cs):
                 x = cs[0] if len(cs) == 1 else jnp.concatenate(cs, axis=0)
                 x = jax.lax.with_sharding_constraint(x, dev_sh)
-                if self.param_memory_kind != "device":
+                if self.param_memory_kind != self.device_memory_kind:
                     x = jax.device_put(x, NamedSharding(
                         self.mesh, self.param_specs[leaf_idx],
                         memory_kind=self.param_memory_kind))

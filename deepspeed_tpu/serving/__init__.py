@@ -1,0 +1,96 @@
+"""Continuous-batching serving engine with a paged KV cache.
+
+Entry point for both supported model families::
+
+    import deepspeed_tpu.serving as serving
+
+    engine = serving.build_engine(
+        family="gpt2", model_config=gpt2_cfg, params=params,
+        config={"serving": {"slots": 8, "page_size": 128,
+                            "kv_cache_bits": 8}})
+    results = engine.serve([serving.Request(0, prompt_ids,
+                                            max_new_tokens=64)])
+
+``config`` is the standard DeepSpeed-style dict/json whose ``serving``
+block (docs/CONFIG.md) sizes the engine; keyword overrides win over the
+block. See docs/serving.md for the scheduler model and tuning notes.
+"""
+
+from deepspeed_tpu.serving.paged_cache import (   # noqa: F401
+    PagedCacheSpec, PagedKVCache, TRASH_BLOCK)
+from deepspeed_tpu.serving.engine import (        # noqa: F401
+    ContinuousBatcher, Request)
+from deepspeed_tpu.serving.adapters import (      # noqa: F401
+    GPT2ServingAdapter, LlamaServingAdapter)
+
+
+def _serving_section(config):
+    from deepspeed_tpu.config.config import DeepSpeedConfig, ServingConfig
+    if config is None:
+        return ServingConfig({})
+    pd = DeepSpeedConfig.load_param_dict(config)
+    return ServingConfig(pd)
+
+
+def cache_spec_from_config(model_config, family: str, config=None,
+                           **overrides) -> PagedCacheSpec:
+    """Resolve a PagedCacheSpec from a model config + the ``serving``
+    config block (+ keyword overrides: slots, page_size,
+    max_pages_per_slot, num_blocks, kv_cache_bits)."""
+    sc = _serving_section(config)
+    known = ("slots", "page_size", "max_pages_per_slot", "num_blocks",
+             "kv_cache_bits")
+    unknown = set(overrides) - set(known) - {"quantize_bits"}
+    if unknown:
+        raise TypeError(f"unknown serving override(s) {sorted(unknown)}; "
+                        f"valid: {list(known) + ['quantize_bits']}")
+    fields = {k: overrides.get(k, getattr(sc, k)) for k in known}
+    if family == "gpt2":
+        geom = dict(n_layers=model_config.n_layer,
+                    kv_heads=model_config.n_head,
+                    head_dim=model_config.n_embd // model_config.n_head,
+                    dtype=model_config.dtype)
+    elif family == "llama":
+        geom = dict(n_layers=model_config.n_layers,
+                    kv_heads=model_config.kv_heads,
+                    head_dim=model_config.head_dim,
+                    dtype=model_config.dtype)
+    else:
+        raise ValueError(f"unknown serving family {family!r} "
+                         "(expected 'gpt2' or 'llama')")
+    return PagedCacheSpec(**geom, **fields)
+
+
+def build_engine(family: str, model_config, params, config=None,
+                 rng=None, **overrides) -> ContinuousBatcher:
+    """Build a ContinuousBatcher for ``family``:
+
+    - ``"gpt2"``: ``params`` is either the training ``GPT2LMHeadModel``
+      tree or the converted (optionally int8-quantized) inference tree;
+    - ``"llama"``: ``params`` is the PACKED serving tree
+      (models.llama_inference.convert_llama_serving_params /
+      quantize_llama_serving_params / random_int8_serving_params).
+    """
+    if config is not None:
+        from deepspeed_tpu.config.config import DeepSpeedConfig
+        from deepspeed_tpu.config import constants as C
+        pd = DeepSpeedConfig.load_param_dict(config)
+        if C.SERVING in pd and not _serving_section(config).enabled:
+            raise ValueError(
+                "the config's serving block sets enabled: false — "
+                "drop the block (or flip the flag) to build a serving "
+                "engine from it")
+    spec = cache_spec_from_config(model_config, family, config,
+                                  **overrides)
+    # serving.quantize_bits = 8 quantizes full-precision param trees to
+    # the int8 serving storage at build time; trees that already carry
+    # int8 codes ("kernel_q") serve as-is either way
+    qb = overrides.get("quantize_bits",
+                       _serving_section(config).quantize_bits)
+    if family == "gpt2":
+        adapter = GPT2ServingAdapter(model_config, params, spec,
+                                     quantize_bits=qb)
+    else:
+        adapter = LlamaServingAdapter(model_config, params, spec,
+                                      quantize_bits=qb)
+    return ContinuousBatcher(adapter, rng=rng)

@@ -1,0 +1,581 @@
+"""Model adapters for the continuous-batching serving engine.
+
+Each adapter compiles exactly TWO kinds of programs per model/storage
+combination, so arbitrary request arrival patterns replay a small fixed
+set of executables instead of retracing per request:
+
+- ``tick``: ONE decode step over the whole slot set — [B_slots] tokens
+  at per-slot positions, paged-attention reads through the page table,
+  donated pool, idle slots masked by ``pos[b] < 0``. Compiled once per
+  engine.
+- ``prefill``: one request's prompt pass at a BUCKETED padded length
+  (pages rounded up to the next power of two), writing K/V straight
+  into the slot's assigned pool pages and returning last-position
+  logits. Compiled once per bucket — log2(max_pages) programs total.
+
+The decode tick reuses the stacked fused kernels the dense fast path
+serves through (ops/pallas/decode.py): ``ln_qkv_int8_stacked`` /
+``out_ffn_int8_stacked`` for the projections (dtype-agnostic — bf16
+stacks run with scale 1) and ``decode_attention_paged`` for the
+cached-attention read. Appends are XLA scatters into the donated pool:
+row ``pos[b] % page`` of block ``page_table[b, pos[b] // page]``.
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.serving.paged_cache import PagedCacheSpec, PagedKVCache
+
+
+# ----------------------------------------------------------- pool append
+
+def _append_rows(pool, cache_q8, l, blk_ids, rows, k3, v3):
+    """Scatter one new K/V row per slot into the paged pool at layer
+    ``l``: (block blk_ids[b], row rows[b]). Idle slots arrive pointed at
+    the trash block, so the scatter is always legal."""
+    from deepspeed_tpu.ops.pallas.decode import kv_quant_int8
+    if cache_q8:
+        kc, ks, vc, vs = pool
+        kq8, ksc, vq8, vsc = kv_quant_int8(k3, v3)
+        kc = kc.at[l, blk_ids, :, rows, :].set(kq8)
+        vc = vc.at[l, blk_ids, :, rows, :].set(vq8)
+        ks = ks.at[l, blk_ids, :, 0, rows].set(ksc[..., 0])
+        vs = vs.at[l, blk_ids, :, 0, rows].set(vsc[..., 0])
+        return (kc, ks, vc, vs)
+    kc, vc = pool
+    kc = kc.at[l, blk_ids, :, rows, :].set(k3.astype(kc.dtype))
+    vc = vc.at[l, blk_ids, :, rows, :].set(v3.astype(vc.dtype))
+    return (kc, vc)
+
+
+def _quant_prompt_rows(t):
+    """Per-(.., head, pos) symmetric int8 over the trailing D axis."""
+    tf = t.astype(jnp.float32)
+    sc = jnp.maximum(jnp.max(jnp.abs(tf), axis=-1) / 127.0, 1e-12)
+    codes = jnp.clip(jnp.round(tf / sc[..., None]), -127,
+                     127).astype(jnp.int8)
+    return codes, sc
+
+
+def _write_prompt_pages(pool, cache_q8, k, v, pages, page):
+    """Blockify a prompt's K/V ([Lyr, H, Sp, D], Sp = len(pages)*page)
+    and scatter the blocks into the pool at ``pages``. Page-table tails
+    past the slot's allocation arrive as the trash block — duplicate
+    trash writes are harmless by construction."""
+    Lyr, H, Sp, D = k.shape
+    npg = pages.shape[0]
+    assert npg * page == Sp, (Sp, npg, page)
+
+    def to_blocks(t):                       # → [Lyr, npg, H, page, D]
+        return t.reshape(Lyr, H, npg, page, D).transpose(0, 2, 1, 3, 4)
+
+    def to_scale_blocks(sc):                # [Lyr, H, Sp] → [Lyr,npg,H,1,page]
+        return sc.reshape(Lyr, H, npg, page).transpose(0, 2, 1, 3)[
+            :, :, :, None, :]
+
+    if cache_q8:
+        kc, ks, vc, vs = pool
+        kq, ksc = _quant_prompt_rows(k)
+        vq, vsc = _quant_prompt_rows(v)
+        kc = kc.at[:, pages].set(to_blocks(kq))
+        vc = vc.at[:, pages].set(to_blocks(vq))
+        ks = ks.at[:, pages].set(to_scale_blocks(ksc))
+        vs = vs.at[:, pages].set(to_scale_blocks(vsc))
+        return (kc, ks, vc, vs)
+    kc, vc = pool
+    kc = kc.at[:, pages].set(to_blocks(k).astype(kc.dtype))
+    vc = vc.at[:, pages].set(to_blocks(v).astype(vc.dtype))
+    return (kc, vc)
+
+
+def _pick_next(logits, r, temps):
+    """Greedy/per-slot-temperature sampling; the Gumbel pass only runs
+    when some slot actually asked for it (same cond-not-where rule as
+    the dense decode loops)."""
+    logits32 = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits32, axis=-1)
+
+    def _sampled():
+        t = jnp.maximum(temps, 1e-6)[:, None]
+        s = jax.random.categorical(r, logits32 / t, axis=-1)
+        return jnp.where(temps > 0, s, greedy)
+
+    return jax.lax.cond(jnp.max(temps) > 0.0, _sampled, lambda: greedy), \
+        logits32
+
+
+def _gather_blocks(pt, pos, page):
+    """(block ids, row offsets) for appending each slot's next row.
+    Idle slots (pos < 0) resolve inside their all-trash table rows."""
+    maxp = pt.shape[1]
+    idx = jnp.clip(pos // page, 0, maxp - 1)
+    blk_ids = jnp.take_along_axis(pt, idx[:, None], axis=1)[:, 0]
+    rows = pos % page
+    return blk_ids, rows
+
+
+# ------------------------------------------------------------- GPT-2
+
+class GPT2ServingAdapter:
+    """Paged serving over converted (optionally int8) GPT-2 inference
+    params — the scan-stacked tree `convert_gpt2_params` produces."""
+
+    def __init__(self, cfg, params, spec: PagedCacheSpec,
+                 quantize_bits: int = 0):
+        from deepspeed_tpu.models.gpt2_inference import (
+            convert_gpt2_params, quantize_gpt2_inference_params)
+        assert cfg.tie_word_embeddings, \
+            "paged GPT-2 serving assumes the tied-embedding LM head"
+        assert cfg.n_embd % cfg.n_head == 0
+        converted = "h" in params and "blk" in params.get("h", {}) and \
+            "attn_qkvw" in params["h"]["blk"]
+        self.iparams = params if converted \
+            else convert_gpt2_params(params, cfg)
+        if quantize_bits == 8 \
+                and "kernel_q" not in self.iparams["h"]["blk"]["attn_qkvw"]:
+            # serving.quantize_bits: quantize a full-precision tree to
+            # the int8 serving storage at build time
+            self.iparams = quantize_gpt2_inference_params(self.iparams)
+        self.cfg = cfg
+        self.spec = spec
+        self.weights_q8 = "kernel_q" in self.iparams["h"]["blk"]["attn_qkvw"]
+        self.cache_q8 = spec.kv_cache_bits == 8
+        assert spec.n_layers == cfg.n_layer
+        assert spec.kv_heads == cfg.n_head
+        assert spec.head_dim == cfg.n_embd // cfg.n_head
+        self._p = {"wte": self.iparams["wte"], "wpe": self.iparams["wpe"],
+                   "ln_f": self.iparams["ln_f"]}
+        self._blk = self.iparams["h"]["blk"]
+        # per-ADAPTER compiled-fn cache: the closures capture the params
+        # tree, so a module-global cache would pin every model's weights
+        # for process lifetime; here they free with the engine
+        self._fns = {}
+
+    @property
+    def eos_default(self):
+        return None
+
+    def make_cache(self) -> PagedKVCache:
+        return PagedKVCache(self.spec)
+
+    def max_prompt_len(self):
+        return self.cfg.n_positions
+
+    # -- compiled programs -------------------------------------------------
+
+    def _tick_fn(self, steps: int = 1):
+        cfg, spec = self.cfg, self.spec
+        key = ("tick", steps)
+        if key in self._fns:
+            return self._fns[key]
+        from deepspeed_tpu.ops.pallas.decode import (
+            ln_qkv_int8_stacked, decode_attention_paged,
+            out_ffn_int8_stacked)
+        E, H = cfg.n_embd, cfg.n_head
+        D = E // H
+        Lyr = cfg.n_layer
+        P = spec.page_size
+        eps = cfg.layer_norm_epsilon
+        cache_q8 = self.cache_q8
+        wkey = "kernel_q" if self.weights_q8 else "kernel"
+
+        def _wscale(proj):
+            if self.weights_q8:
+                return proj["kernel_scale"].reshape(Lyr)
+            return jnp.ones((Lyr,), jnp.float32)
+
+        def _ln_f(x, w, b):
+            xf = x.astype(jnp.float32)
+            mu = jnp.mean(xf, axis=-1, keepdims=True)
+            var = jnp.mean((xf - mu) ** 2, axis=-1, keepdims=True)
+            y = (xf - mu) * jax.lax.rsqrt(var + eps)
+            return (y * w.astype(jnp.float32)
+                    + b.astype(jnp.float32)).astype(x.dtype)
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def tick(p, blk, pool, toks, pos, pt, r, temps):
+            wte = jnp.asarray(p["wte"]).astype(cfg.dtype)
+            wpe = jnp.asarray(p["wpe"]).astype(cfg.dtype)
+            Wq, Wp = blk["attn_qkvw"][wkey], blk["attn_ow"][wkey]
+            W1, W2 = blk["inter_w"][wkey], blk["output_w"][wkey]
+            r3 = lambda a: a.reshape(Lyr, 1, a.shape[-1])  # noqa: E731
+            ln1_w = r3(blk["attn_nw"]["scale"])
+            ln1_b = r3(blk["attn_nw"]["bias"])
+            ln2_w = r3(blk["norm_w"]["scale"])
+            ln2_b = r3(blk["norm_w"]["bias"])
+            bq = r3(blk["attn_qkvw"]["bias"])
+            bp = r3(blk["attn_ow"]["bias"])
+            b1 = r3(blk["inter_w"]["bias"])
+            b2 = r3(blk["output_w"]["bias"])
+            sq, sp_ = _wscale(blk["attn_qkvw"]), _wscale(blk["attn_ow"])
+            s1, s2 = _wscale(blk["inter_w"]), _wscale(blk["output_w"])
+            B = toks.shape[0]
+
+            def one(carry, rk):
+                pool, toks, pos, _ = carry
+                x = wte[toks] + wpe[jnp.clip(pos, 0,
+                                             cfg.n_positions - 1)]
+                blk_ids, rows = _gather_blocks(pt, pos, P)
+
+                def layer(car, l):
+                    x, pool = car
+                    qkv = ln_qkv_int8_stacked(x, ln1_w, ln1_b, Wq, sq,
+                                              bq, l, eps=eps)
+                    qh = qkv[:, :E].reshape(B, H, 1, D)
+                    k3 = qkv[:, E:2 * E].reshape(B, H, D)
+                    v3 = qkv[:, 2 * E:].reshape(B, H, D)
+                    pool = _append_rows(pool, cache_q8, l, blk_ids,
+                                        rows, k3, v3)
+                    if cache_q8:
+                        kc, ks, vc, vs = pool
+                        ctx = decode_attention_paged(
+                            qh, kc, vc, pos, pt, l, k_scale=ks,
+                            v_scale=vs, scale=1.0 / np.sqrt(D))
+                    else:
+                        kc, vc = pool
+                        ctx = decode_attention_paged(
+                            qh, kc, vc, pos, pt, l,
+                            scale=1.0 / np.sqrt(D))
+                    ctx2 = ctx.reshape(B, E)
+                    x = out_ffn_int8_stacked(
+                        ctx2, x, Wp, sp_, bp, ln2_w, ln2_b, W1, s1, b1,
+                        W2, s2, b2, l, act="gelu_tanh", eps=eps)
+                    return (x, pool), None
+
+                (x, pool), _ = jax.lax.scan(
+                    layer, (x, pool), jnp.arange(Lyr, dtype=jnp.int32))
+                logits = jnp.einsum(
+                    "be,ve->bv",
+                    _ln_f(x, p["ln_f"]["scale"], p["ln_f"]["bias"]), wte)
+                nxt, logits32 = _pick_next(logits, rk, temps)
+                return (pool, nxt, pos + 1, logits32), nxt
+
+            logits0 = jnp.zeros((B, cfg.vocab_size), jnp.float32)
+            (pool, _, _, logits32), toks_seq = jax.lax.scan(
+                one, (pool, toks, pos, logits0),
+                jax.random.split(r, steps))
+            return pool, toks_seq, logits32
+
+        self._fns[key] = tick
+        return tick
+
+    def _prefill_fn(self, n_pages: int):
+        cfg, spec = self.cfg, self.spec
+        key = ("prefill", n_pages)
+        if key in self._fns:
+            return self._fns[key]
+        from deepspeed_tpu.ops.attention import dot_product_attention
+        E, H = cfg.n_embd, cfg.n_head
+        D = E // H
+        Lyr = cfg.n_layer
+        P = spec.page_size
+        Sp = n_pages * P
+        assert Sp <= cfg.n_positions, (
+            f"prefill bucket {Sp} exceeds n_positions {cfg.n_positions}")
+        eps = cfg.layer_norm_epsilon
+        cache_q8 = self.cache_q8
+        wkey = "kernel_q" if self.weights_q8 else "kernel"
+
+        def deq(sub, l):
+            w = sub[wkey][l]
+            if self.weights_q8:
+                s = sub["kernel_scale"].reshape(Lyr)[l]
+                return (w.astype(jnp.float32) * s).astype(cfg.dtype)
+            return w.astype(cfg.dtype)
+
+        def _ln(x, w, b):
+            xf = x.astype(jnp.float32)
+            mu = jnp.mean(xf, axis=-1, keepdims=True)
+            var = jnp.mean((xf - mu) ** 2, axis=-1, keepdims=True)
+            y = (xf - mu) * jax.lax.rsqrt(var + eps)
+            return (y * w.astype(jnp.float32)
+                    + b.astype(jnp.float32)).astype(x.dtype)
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def prefill(p, blk, pool, ids, length, pages):
+            wte = jnp.asarray(p["wte"]).astype(cfg.dtype)
+            wpe = jnp.asarray(p["wpe"]).astype(cfg.dtype)
+            x = wte[ids] + wpe[:Sp][None]            # [1, Sp, E]
+
+            def layer(x, l):
+                u = _ln(x, blk["attn_nw"]["scale"][l],
+                        blk["attn_nw"]["bias"][l])
+                qkv = u @ deq(blk["attn_qkvw"], l) \
+                    + blk["attn_qkvw"]["bias"][l].astype(cfg.dtype)
+                q = qkv[..., :E].reshape(1, Sp, H, D).transpose(0, 2, 1, 3)
+                k = qkv[..., E:2 * E].reshape(1, Sp, H, D) \
+                    .transpose(0, 2, 1, 3)
+                v = qkv[..., 2 * E:].reshape(1, Sp, H, D) \
+                    .transpose(0, 2, 1, 3)
+                ctx = dot_product_attention(q, k, v, causal=True)
+                ctx = ctx.transpose(0, 2, 1, 3).reshape(1, Sp, E)
+                x = x + ctx @ deq(blk["attn_ow"], l) \
+                    + blk["attn_ow"]["bias"][l].astype(cfg.dtype)
+                u2 = _ln(x, blk["norm_w"]["scale"][l],
+                         blk["norm_w"]["bias"][l])
+                h = jax.nn.gelu(
+                    u2 @ deq(blk["inter_w"], l)
+                    + blk["inter_w"]["bias"][l].astype(cfg.dtype),
+                    approximate=True)
+                x = x + h @ deq(blk["output_w"], l) \
+                    + blk["output_w"]["bias"][l].astype(cfg.dtype)
+                return x, (k[0], v[0])
+
+            x, (ks, vs) = jax.lax.scan(
+                layer, x, jnp.arange(Lyr, dtype=jnp.int32))
+            pool = _write_prompt_pages(pool, cache_q8, ks, vs, pages, P)
+            xl = x[0, length - 1]
+            xf = xl.astype(jnp.float32)
+            mu = jnp.mean(xf, keepdims=True)
+            var = jnp.mean((xf - mu) ** 2, keepdims=True)
+            y = (xf - mu) * jax.lax.rsqrt(var + eps)
+            y = y * p["ln_f"]["scale"].astype(jnp.float32) \
+                + p["ln_f"]["bias"].astype(jnp.float32)
+            logits = y.astype(cfg.dtype) @ wte.T
+            return pool, logits.astype(jnp.float32)
+
+        self._fns[key] = prefill
+        return prefill
+
+    # -- engine-facing calls -----------------------------------------------
+
+    def tick(self, pool, toks, pos, pt, rng, temps, steps=1):
+        """Run ``steps`` decode steps in ONE dispatch. Returns
+        (pool, tokens [steps, B], last-step logits [B, V])."""
+        return self._tick_fn(steps)(self._p, self._blk, pool, toks, pos,
+                                    pt, rng, temps)
+
+    def prefill(self, pool, ids, length, pages):
+        return self._prefill_fn(ids.shape[1] // self.spec.page_size)(
+            self._p, self._blk, pool, ids, length, pages)
+
+
+# ------------------------------------------------------------- LLaMA
+
+def _rope_rows(x, pos, theta):
+    """RoPE on [B, Hx, D] rows at PER-SLOT positions ``pos`` [B] (the
+    dense fast loop's _rope_one takes one shared scalar position —
+    continuous batching decodes every slot at its own offset)."""
+    B, H, D = x.shape
+    inv = 1.0 / (theta ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+    ang = pos.astype(jnp.float32)[:, None] * inv[None]    # [B, D//2]
+    cos = jnp.cos(ang)[:, None].astype(x.dtype)           # [B, 1, D//2]
+    sin = jnp.sin(ang)[:, None].astype(x.dtype)
+    half = D // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x2 * cos + x1 * sin], axis=-1)
+
+
+class LlamaServingAdapter:
+    """Paged serving over PACKED LLaMA serving params (the tree
+    convert_llama_serving_params / quantize_llama_serving_params /
+    random_int8_serving_params produce). GQA: the pool holds Hkv heads;
+    the paged attention kernel takes rep = H/Hkv query rows per head."""
+
+    def __init__(self, cfg, sparams, spec: PagedCacheSpec,
+                 quantize_bits: int = 0):
+        if quantize_bits == 8 \
+                and "kernel_q" not in sparams["blk"]["qkv_w"]:
+            from deepspeed_tpu.models.llama_inference import \
+                quantize_llama_serving_params
+            sparams = quantize_llama_serving_params(sparams)
+        self.cfg = cfg
+        self.sparams = sparams
+        self.spec = spec
+        self.weights_q8 = "kernel_q" in sparams["blk"]["qkv_w"]
+        self.cache_q8 = spec.kv_cache_bits == 8
+        assert spec.n_layers == cfg.n_layers
+        assert spec.kv_heads == cfg.kv_heads
+        assert spec.head_dim == cfg.head_dim
+        self._p = {k: v for k, v in sparams.items() if k != "blk"}
+        self._blk = sparams["blk"]
+        self._fns = {}    # per-adapter compiled-fn cache (see GPT-2)
+
+    @property
+    def eos_default(self):
+        return None
+
+    def make_cache(self) -> PagedKVCache:
+        return PagedKVCache(self.spec)
+
+    def max_prompt_len(self):
+        return self.cfg.max_seq_len
+
+    def _tick_fn(self, steps: int = 1):
+        cfg, spec = self.cfg, self.spec
+        key = ("tick", steps)
+        if key in self._fns:
+            return self._fns[key]
+        from deepspeed_tpu.ops.pallas.decode import (
+            ln_qkv_int8_stacked, decode_attention_paged,
+            out_ffn_int8_stacked, matvec_int8_stacked)
+        from deepspeed_tpu.models.llama_inference import _weights
+        E, H, Hkv, D = (cfg.hidden_size, cfg.n_heads, cfg.kv_heads,
+                        cfg.head_dim)
+        Lyr = cfg.n_layers
+        rep = H // Hkv
+        P = spec.page_size
+        eps = cfg.rms_eps
+        cache_q8 = self.cache_q8
+
+        def _rms(x, w):
+            xf = x.astype(jnp.float32)
+            n = xf * jax.lax.rsqrt(
+                jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+            return (n * w.astype(jnp.float32)).astype(x.dtype)
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def tick(p, blk, pool, toks, pos, pt, r, temps):
+            embed = p["embed"].astype(cfg.dtype)
+            head = p["head"].astype(cfg.dtype)
+            Wq, sq = _weights(blk, "qkv_w", Lyr)
+            Wo, so = _weights(blk, "o_w", Lyr)
+            Wg, sg = _weights(blk, "gate_w", Lyr)
+            Wu, su = _weights(blk, "up_w", Lyr)
+            Wd, sd = _weights(blk, "down_w", Lyr)
+            n1 = blk["norm1"].reshape(Lyr, 1, E)
+            n2 = blk["norm2"].reshape(Lyr, 1, E)
+            B = toks.shape[0]
+
+            def one(carry, rk):
+                pool, toks, pos, _ = carry
+                x = embed[toks]
+                blk_ids, rows = _gather_blocks(pt, pos, P)
+
+                def layer(car, l):
+                    x, pool = car
+                    qkv = ln_qkv_int8_stacked(x, n1, None, Wq, sq, None,
+                                              l, eps=eps, norm="rms")
+                    q3 = qkv[:, :H * D].reshape(B, H, D)
+                    k3 = qkv[:, H * D:(H + Hkv) * D].reshape(B, Hkv, D)
+                    v3 = qkv[:, (H + Hkv) * D:].reshape(B, Hkv, D)
+                    q3 = _rope_rows(q3, pos, cfg.rope_theta)
+                    k3 = _rope_rows(k3, pos, cfg.rope_theta)
+                    qg = q3.reshape(B, Hkv, rep, D)
+                    pool = _append_rows(pool, cache_q8, l, blk_ids,
+                                        rows, k3, v3)
+                    if cache_q8:
+                        kc, ks, vc, vs = pool
+                        ctx = decode_attention_paged(
+                            qg, kc, vc, pos, pt, l, k_scale=ks,
+                            v_scale=vs, scale=1.0 / np.sqrt(D))
+                    else:
+                        kc, vc = pool
+                        ctx = decode_attention_paged(
+                            qg, kc, vc, pos, pt, l,
+                            scale=1.0 / np.sqrt(D))
+                    ctx2 = ctx.reshape(B, H * D)
+                    if E * E * Wo.dtype.itemsize <= (6 << 20):
+                        x = out_ffn_int8_stacked(
+                            ctx2, x, Wo, so, None, n2, None, Wg, sg,
+                            None, Wd, sd, None, l, act="swiglu",
+                            eps=eps, norm="rms", w1b_stack=Wu, s1b=su)
+                    else:
+                        x1 = x + matvec_int8_stacked(ctx2, Wo, so, l)
+                        x = out_ffn_int8_stacked(
+                            None, x1, None, None, None, n2, None, Wg,
+                            sg, None, Wd, sd, None, l, act="swiglu",
+                            eps=eps, norm="rms", w1b_stack=Wu, s1b=su,
+                            fuse_proj=False)
+                    return (x, pool), None
+
+                (x, pool), _ = jax.lax.scan(
+                    layer, (x, pool), jnp.arange(Lyr, dtype=jnp.int32))
+                logits = jnp.einsum("be,ve->bv",
+                                    _rms(x, p["norm_scale"]), head)
+                nxt, logits32 = _pick_next(logits, rk, temps)
+                return (pool, nxt, pos + 1, logits32), nxt
+
+            logits0 = jnp.zeros((B, cfg.vocab_size), jnp.float32)
+            (pool, _, _, logits32), toks_seq = jax.lax.scan(
+                one, (pool, toks, pos, logits0),
+                jax.random.split(r, steps))
+            return pool, toks_seq, logits32
+
+        self._fns[key] = tick
+        return tick
+
+    def _prefill_fn(self, n_pages: int):
+        cfg, spec = self.cfg, self.spec
+        key = ("prefill", n_pages)
+        if key in self._fns:
+            return self._fns[key]
+        from deepspeed_tpu.ops.attention import dot_product_attention
+        from deepspeed_tpu.models.llama import rope_angles, apply_rope
+        from deepspeed_tpu.models.llama_inference import _weights
+        E, H, Hkv, D = (cfg.hidden_size, cfg.n_heads, cfg.kv_heads,
+                        cfg.head_dim)
+        Lyr = cfg.n_layers
+        P = spec.page_size
+        Sp = n_pages * P
+        eps = cfg.rms_eps
+        cache_q8 = self.cache_q8
+
+        def _rms(x, w):
+            xf = x.astype(jnp.float32)
+            n = xf * jax.lax.rsqrt(
+                jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+            return (n * w.astype(jnp.float32)).astype(x.dtype)
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def prefill(p, blk, pool, ids, length, pages):
+            x = p["embed"][ids].astype(cfg.dtype)    # [1, Sp, E]
+            positions = jnp.arange(Sp)
+            cos, sin = rope_angles(positions, D, cfg.rope_theta)
+            Wq, sq = _weights(blk, "qkv_w", Lyr)
+            Wo, so = _weights(blk, "o_w", Lyr)
+            Wg, sg = _weights(blk, "gate_w", Lyr)
+            Wu, su = _weights(blk, "up_w", Lyr)
+            Wd, sd = _weights(blk, "down_w", Lyr)
+
+            def deq(stack, scale, l):
+                w = stack[l]
+                if stack.dtype == jnp.int8:
+                    return (w.astype(jnp.float32)
+                            * scale[l]).astype(cfg.dtype)
+                return w.astype(cfg.dtype)
+
+            def layer(x, l):
+                u = _rms(x, blk["norm1"][l])
+                qkv = u @ deq(Wq, sq, l)
+                q = qkv[..., :H * D].reshape(1, Sp, H, D) \
+                    .transpose(0, 2, 1, 3)
+                k = qkv[..., H * D:(H + Hkv) * D] \
+                    .reshape(1, Sp, Hkv, D).transpose(0, 2, 1, 3)
+                v = qkv[..., (H + Hkv) * D:] \
+                    .reshape(1, Sp, Hkv, D).transpose(0, 2, 1, 3)
+                q = apply_rope(q, cos, sin)
+                k = apply_rope(k, cos, sin)
+                ctx = dot_product_attention(q, k, v, causal=True)
+                ctx = ctx.transpose(0, 2, 1, 3).reshape(1, Sp, H * D)
+                x = x + ctx @ deq(Wo, so, l)
+                u2 = _rms(x, blk["norm2"][l])
+                h = jax.nn.silu(u2 @ deq(Wg, sg, l)) \
+                    * (u2 @ deq(Wu, su, l))
+                x = x + h @ deq(Wd, sd, l)
+                return x, (k[0], v[0])
+
+            x, (ks, vs) = jax.lax.scan(
+                layer, x, jnp.arange(Lyr, dtype=jnp.int32))
+            pool = _write_prompt_pages(pool, cache_q8, ks, vs, pages, P)
+            xl = x[0, length - 1]
+            logits = _rms(xl, p["norm_scale"]) \
+                @ p["head"].astype(cfg.dtype).T
+            return pool, logits.astype(jnp.float32)
+
+        self._fns[key] = prefill
+        return prefill
+
+    def tick(self, pool, toks, pos, pt, rng, temps, steps=1):
+        """Run ``steps`` decode steps in ONE dispatch. Returns
+        (pool, tokens [steps, B], last-step logits [B, V])."""
+        return self._tick_fn(steps)(self._p, self._blk, pool, toks, pos,
+                                    pt, rng, temps)
+
+    def prefill(self, pool, ids, length, pages):
+        return self._prefill_fn(ids.shape[1] // self.spec.page_size)(
+            self._p, self._blk, pool, ids, length, pages)

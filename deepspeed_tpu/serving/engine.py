@@ -1,0 +1,312 @@
+"""Continuous-batching scheduler over the paged KV cache.
+
+The static serving path (`models/gpt2_inference.generate`) runs one
+batch per call: every request shares the prompt pass, pads to the
+longest sequence, and the whole batch drains before any new request
+starts. Here the batch is a set of SLOTS that requests flow through
+independently:
+
+- a request is admitted into any free slot the moment enough pool pages
+  are free for ``prompt + max_new_tokens``; its prompt prefills into its
+  own pages while other slots keep decoding;
+- every scheduler step runs ONE compiled decode tick over all slots
+  (idle slots masked by pos < 0); a slot that hits EOS/max_new frees its
+  pages immediately and the next queued request takes it on the same
+  step — the chip never waits for the slowest request in a gang.
+
+The device work per step is one fixed-shape donated-pool program (plus
+one bucketed prefill per admission), so any arrival pattern replays a
+small fixed set of executables — the restructuring that turns mixed
+traffic from serialized batches into interleaved independent work (the
+fused computation-collective argument applied to prefill/decode).
+"""
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.serving.paged_cache import PagedKVCache, TRASH_BLOCK
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``arrival_time`` is seconds relative to
+    the serve() clock (0 = already queued); requests become admissible
+    only once arrived."""
+    rid: Any
+    prompt: Any                       # [S] int array-like
+    max_new_tokens: int = 16
+    eos_token_id: Optional[int] = None
+    temperature: float = 0.0
+    arrival_time: float = 0.0
+    # filled by the engine:
+    generated: List[int] = dataclasses.field(default_factory=list)
+    finish_reason: Optional[str] = None
+
+    def tokens(self) -> np.ndarray:
+        return np.concatenate([np.asarray(self.prompt, np.int32),
+                               np.asarray(self.generated, np.int32)])
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Optional[Request] = None
+    pos: int = -1                     # rows already in cache; -1 = idle
+    last_tok: int = 0                 # token to feed on the next tick
+
+    @property
+    def active(self) -> bool:
+        return self.request is not None
+
+
+class ContinuousBatcher:
+    """Host-side slot scheduler around one adapter's compiled programs.
+
+    Usage::
+
+        engine = serving.build_engine(family="gpt2", model_config=cfg,
+                                      params=params, config=ds_config)
+        results = engine.serve([Request(0, prompt, max_new_tokens=32)])
+
+    or incrementally: ``submit()`` then ``step()`` until it returns
+    everything (each call runs at most one admission sweep + one tick).
+    """
+
+    def __init__(self, adapter, rng: Optional[jax.Array] = None):
+        self.adapter = adapter
+        self.spec = adapter.spec
+        self.cache: PagedKVCache = adapter.make_cache()
+        self.slots = [_Slot() for _ in range(self.spec.slots)]
+        self.queue: deque = deque()
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._host_rng = np.random.RandomState(0)
+        self.last_logits = None       # [slots, V] of the latest tick
+        self.stats = {"ticks": 0, "tick_steps": 0, "decode_tokens": 0,
+                      "prefills": 0, "prefill_tokens": 0}
+
+    # ------------------------------------------------------------- queue
+
+    def submit(self, request: Request) -> None:
+        S = int(np.asarray(request.prompt).shape[0])
+        assert S >= 1, "empty prompt"
+        # prefill unconditionally samples the first token, so a zero
+        # budget would still emit one — reject instead of over-serving
+        assert request.max_new_tokens >= 1, (
+            f"max_new_tokens must be >= 1, got {request.max_new_tokens}")
+        total = S + request.max_new_tokens
+        # every decoded position needs a real learned position — past
+        # the model budget the wpe gather would clamp and silently
+        # corrupt (same contract as the dense generate() paths)
+        assert total <= self.adapter.max_prompt_len(), (
+            f"prompt {S} + max_new_tokens {request.max_new_tokens} "
+            f"exceeds the model's position budget "
+            f"{self.adapter.max_prompt_len()}")
+        cap = self.spec.max_tokens_per_slot()
+        assert total <= cap, (
+            f"prompt {S} + max_new_tokens {request.max_new_tokens} "
+            f"exceeds the per-slot page capacity {cap} "
+            f"(max_pages_per_slot {self.spec.max_pages_per_slot} x "
+            f"page_size {self.spec.page_size})")
+        # an oversubscribed pool (num_blocks set low) must still be able
+        # to hold this request once everything else drains — otherwise
+        # FIFO admission would wait on it forever
+        assert self.cache.pages_needed(total) <= self.cache.num_blocks - 1, (
+            f"request needs {self.cache.pages_needed(total)} pages but "
+            f"the whole pool has {self.cache.num_blocks - 1} allocatable "
+            f"blocks (serving.num_blocks)")
+        # the prefill bucket pads the prompt to WHOLE pages, so the
+        # prompt must fit the model's position budget in page units —
+        # with a page size that doesn't divide it, the last partial
+        # page is unusable for prompts (admission would otherwise
+        # allocate pages and then crash inside prefill)
+        max_prompt_pages = self.adapter.max_prompt_len() \
+            // self.spec.page_size
+        assert self.cache.pages_needed(S) <= max_prompt_pages, (
+            f"prompt {S} needs {self.cache.pages_needed(S)} pages but "
+            f"only {max_prompt_pages} whole pages of "
+            f"{self.spec.page_size} fit the model's "
+            f"{self.adapter.max_prompt_len()}-position budget")
+        self.queue.append(request)
+
+    @property
+    def pending(self) -> int:
+        return len(self.queue) + sum(s.active for s in self.slots)
+
+    # --------------------------------------------------------- admission
+
+    def _bucket_pages(self, S: int) -> int:
+        """Prompt pad bucket in PAGES, next power of two — so prefill
+        compiles O(log max_pages) programs, not one per prompt length.
+        Never past the position budget: submit() guarantees the prompt
+        itself fits in whole pages, so the clamp only trims pad."""
+        need = self.cache.pages_needed(S)
+        b = 1
+        while b < need:
+            b *= 2
+        max_pages = self.adapter.max_prompt_len() // self.spec.page_size
+        return min(b, max_pages)
+
+    def _pick_token(self, logits: np.ndarray, temperature: float) -> int:
+        if temperature and temperature > 0:
+            z = logits.astype(np.float64) / max(temperature, 1e-6)
+            z -= z.max()
+            p = np.exp(z)
+            p /= p.sum()
+            return int(self._host_rng.choice(p.shape[0], p=p))
+        return int(np.argmax(logits))
+
+    def _admit(self, now: Optional[float]) -> List[Request]:
+        finished = []
+        free = [i for i, s in enumerate(self.slots) if not s.active]
+        while free and self.queue:
+            req = self.queue[0]
+            if now is not None and req.arrival_time > now:
+                break                 # FIFO: don't skip ahead of arrivals
+            S = int(np.asarray(req.prompt).shape[0])
+            slot_id = free[0]
+            pages = self.cache.admit(slot_id, S + req.max_new_tokens)
+            if pages is None:
+                break                 # pool exhausted; retry next step
+            self.queue.popleft()
+            free.pop(0)
+            n_pages = self._bucket_pages(S)
+            P = self.spec.page_size
+            ids = np.zeros((1, n_pages * P), np.int32)
+            ids[0, :S] = np.asarray(req.prompt, np.int32)
+            page_vec = np.full((n_pages,), TRASH_BLOCK, np.int32)
+            k = min(n_pages, len(pages))
+            page_vec[:k] = pages[:k]
+            pool, logits = self.adapter.prefill(
+                self.cache.pool, jnp.asarray(ids),
+                jnp.asarray(S, jnp.int32), jnp.asarray(page_vec))
+            self.cache.pool = pool
+            self.stats["prefills"] += 1
+            self.stats["prefill_tokens"] += S
+            tok = self._pick_token(np.asarray(logits, np.float32),
+                                   req.temperature)
+            req.generated.append(tok)
+            slot = self.slots[slot_id]
+            slot.request, slot.pos, slot.last_tok = req, S, tok
+            done = self._maybe_finish(slot_id)
+            if done is not None:      # max_new_tokens == 1 / instant EOS
+                finished.append(done)
+                free.insert(0, slot_id)
+        return finished
+
+    # -------------------------------------------------------------- tick
+
+    def _maybe_finish(self, slot_id: int) -> Optional[Request]:
+        slot = self.slots[slot_id]
+        req = slot.request
+        if req is None:
+            return None
+        if req.eos_token_id is not None \
+                and req.generated[-1] == req.eos_token_id:
+            req.finish_reason = "eos"
+        elif len(req.generated) >= req.max_new_tokens:
+            req.finish_reason = "length"
+        else:
+            return None
+        self.cache.release(slot_id)
+        slot.request, slot.pos, slot.last_tok = None, -1, 0
+        return req
+
+    # multi-step dispatch caps: a tick of K steps amortizes the host
+    # dispatch over K tokens. K = min remaining budget is LOSSLESS (no
+    # slot can finish or free pages before that many steps anyway);
+    # EOS-capable requests cap K low so an early stop wastes at most
+    # max_eos_tick_steps - 1 speculative steps (the appends stay inside
+    # the slot's own admitted pages either way).
+    max_tick_steps = 32
+    max_eos_tick_steps = 4
+
+    def _pick_tick_steps(self) -> int:
+        if self.queue and any(not s.active for s in self.slots):
+            return 1                  # admission pending — stay responsive
+        active = [s.request for s in self.slots if s.active]
+        rem = min(r.max_new_tokens - len(r.generated) for r in active)
+        cap = self.max_eos_tick_steps if any(
+            r.eos_token_id is not None for r in active) \
+            else self.max_tick_steps
+        k = 1
+        while k * 2 <= min(rem, cap):  # pow2 bucket → few compiles
+            k *= 2
+        return k
+
+    def _tick(self) -> List[Request]:
+        steps = self._pick_tick_steps()
+        toks = np.array([s.last_tok for s in self.slots], np.int32)
+        pos = np.array([s.pos if s.active else -1 for s in self.slots],
+                       np.int32)
+        temps = np.array(
+            [s.request.temperature if s.active else 0.0
+             for s in self.slots], np.float32)
+        self._rng, sub = jax.random.split(self._rng)
+        pool, toks_seq, logits = self.adapter.tick(
+            self.cache.pool, jnp.asarray(toks), jnp.asarray(pos),
+            jnp.asarray(self.cache.page_table), sub, jnp.asarray(temps),
+            steps=steps)
+        self.cache.pool = pool
+        self.last_logits = logits
+        toks_seq = np.asarray(toks_seq)           # [steps, slots]
+        self.stats["ticks"] += 1
+        self.stats["tick_steps"] += steps
+        finished = []
+        for i, slot in enumerate(self.slots):
+            if not slot.active:
+                continue
+            for t in range(steps):
+                self.stats["decode_tokens"] += 1
+                tok = int(toks_seq[t, i])
+                slot.request.generated.append(tok)
+                slot.pos += 1
+                slot.last_tok = tok
+                done = self._maybe_finish(i)
+                if done is not None:
+                    # steps past an EOS were speculative; their appends
+                    # landed in pages this slot owned until right now
+                    finished.append(done)
+                    break
+        return finished
+
+    def step(self, now: Optional[float] = None) -> List[Request]:
+        """One scheduler iteration: admit whatever fits, then one decode
+        tick over the active slots. Returns requests finished this step
+        (including any that finished at prefill with max_new_tokens=1)."""
+        finished = self._admit(now)
+        if any(s.active for s in self.slots):
+            finished.extend(self._tick())
+        return finished
+
+    # ------------------------------------------------------------- serve
+
+    def serve(self, requests: Sequence[Request],
+              respect_arrival_times: bool = False) -> Dict[Any, Request]:
+        """Run the scheduler until every request completes. With
+        ``respect_arrival_times`` the queue honours each request's
+        ``arrival_time`` against a wall clock started on entry —
+        the Poisson-workload mode the serving bench drives."""
+        for r in sorted(requests, key=lambda r: r.arrival_time):
+            self.submit(r)
+        done: Dict[Any, Request] = {}
+        t0 = time.monotonic()
+        while self.pending:
+            now = (time.monotonic() - t0) if respect_arrival_times \
+                else None
+            if respect_arrival_times and not any(
+                    s.active for s in self.slots) and self.queue:
+                wait = self.queue[0].arrival_time - (
+                    time.monotonic() - t0)
+                if wait > 0:
+                    time.sleep(min(wait, 0.05))
+                    continue
+            for req in self.step(now):
+                done[req.rid] = req
+        # requests that finished at admission time (max_new_tokens == 1
+        # or instant EOS) are collected by step(); nothing else pending
+        return done

@@ -1,0 +1,127 @@
+"""Paged KV cache — a pooled block store + host-side page accounting.
+
+The static serving path reserves ``max_batch x max_ctx`` cache rows up
+front and every request in a batch pays the longest request's length.
+Here the cache is a POOL of fixed-size blocks shared by all slots:
+
+- device side: ``[Lyr, num_blocks, H, page_size, D]`` K/V block arrays
+  (int8 codes + ``[Lyr, num_blocks, H, 1, page_size]`` lane-major fp32
+  absmax scales — the quantized-cache layout of
+  ops/transformer/inference.py — or plain bf16/fp32 blocks), donated
+  through every prefill/tick so appends update in place;
+- host side: a free list of block ids and per-slot page tables
+  ``[slots, max_pages_per_slot]`` int32. A request's pages are allocated
+  on admission (enough for prompt + max_new_tokens) and returned to the
+  free list the moment it finishes — no other slot's cache moves.
+
+Block 0 is RESERVED as the trash block: idle slots' page-table entries
+(and the pad tail of shorter tables) point at it, so the decode tick's
+append scatter always has a legal target and idle slots can never
+corrupt a live block.
+"""
+
+import dataclasses
+from typing import Any, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+TRASH_BLOCK = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedCacheSpec:
+    """Geometry of a paged pool (see ServingConfig for the config block
+    that produces one)."""
+    n_layers: int
+    kv_heads: int
+    head_dim: int
+    page_size: int = 128
+    num_blocks: int = 0          # 0 → slots * max_pages_per_slot + 1
+    max_pages_per_slot: int = 16
+    slots: int = 8
+    kv_cache_bits: int = 0       # 0 = dtype storage; 8 = int8 + scales
+    dtype: Any = jnp.bfloat16
+
+    def resolved_num_blocks(self) -> int:
+        if self.num_blocks > 0:
+            return self.num_blocks
+        return self.slots * self.max_pages_per_slot + 1  # +1: trash
+
+    def max_tokens_per_slot(self) -> int:
+        return self.max_pages_per_slot * self.page_size
+
+
+class PagedKVCache:
+    """Device block pool + host page allocator for one model's caches.
+
+    ``pool`` is a tuple of device arrays — ``(k, v)`` for full-precision
+    storage or ``(k_codes, k_scale, v_codes, v_scale)`` for int8 — that
+    the engine threads through its donated prefill/tick programs and
+    reassigns after each call.
+    """
+
+    def __init__(self, spec: PagedCacheSpec):
+        self.spec = spec
+        nb = spec.resolved_num_blocks()
+        assert nb >= 2, "need at least one allocatable block past trash"
+        Lyr, H, P, D = (spec.n_layers, spec.kv_heads, spec.page_size,
+                        spec.head_dim)
+        if spec.kv_cache_bits == 8:
+            self.pool = (
+                jnp.zeros((Lyr, nb, H, P, D), jnp.int8),
+                jnp.full((Lyr, nb, H, 1, P), 1e-12, jnp.float32),
+                jnp.zeros((Lyr, nb, H, P, D), jnp.int8),
+                jnp.full((Lyr, nb, H, 1, P), 1e-12, jnp.float32),
+            )
+        elif spec.kv_cache_bits == 0:
+            self.pool = (jnp.zeros((Lyr, nb, H, P, D), spec.dtype),
+                         jnp.zeros((Lyr, nb, H, P, D), spec.dtype))
+        else:
+            raise ValueError(f"kv_cache_bits must be 0 or 8, got "
+                             f"{spec.kv_cache_bits}")
+        self.num_blocks = nb
+        # LIFO free list: recently-freed blocks are re-used first, which
+        # is what the slot-reuse tests lean on to catch stale reads
+        self._free: List[int] = list(range(nb - 1, TRASH_BLOCK, -1))
+        # per-slot page tables; unused entries point at the trash block
+        self.page_table = np.full((spec.slots, spec.max_pages_per_slot),
+                                  TRASH_BLOCK, np.int32)
+        self._slot_pages: List[List[int]] = [[] for _ in range(spec.slots)]
+
+    # ---------------------------------------------------- host accounting
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_needed(self, total_tokens: int) -> int:
+        return -(-total_tokens // self.spec.page_size)
+
+    def admit(self, slot: int, total_tokens: int) -> Optional[List[int]]:
+        """Allocate pages covering ``total_tokens`` rows into ``slot``'s
+        page table. Returns the page list, or None (nothing allocated)
+        when the pool can't cover it."""
+        n = self.pages_needed(total_tokens)
+        assert n <= self.spec.max_pages_per_slot, (
+            f"request needs {n} pages > max_pages_per_slot "
+            f"{self.spec.max_pages_per_slot} (page_size "
+            f"{self.spec.page_size})")
+        assert not self._slot_pages[slot], f"slot {slot} already admitted"
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._slot_pages[slot] = pages
+        row = self.page_table[slot]
+        row[:] = TRASH_BLOCK
+        row[:n] = pages
+        return pages
+
+    def release(self, slot: int) -> None:
+        """Return ``slot``'s pages to the free list (on EOS/finish)."""
+        self._free.extend(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        self.page_table[slot, :] = TRASH_BLOCK
+
+    def slot_pages(self, slot: int) -> List[int]:
+        return list(self._slot_pages[slot])

@@ -1,0 +1,174 @@
+"""Continuous batching vs static-batch serving throughput.
+
+Workload: N requests with MIXED prompt lengths and mixed decode budgets,
+arriving on a Poisson clock (exponential interarrivals at a rate that
+keeps the queue saturated — the benchmark measures throughput, not an
+idle arrival tail). Both systems serve the identical request trace:
+
+- **continuous** (deepspeed_tpu/serving): slot scheduler + paged KV
+  cache; a request admits the moment a slot and pages free up, so the
+  chip never decodes padding for a finished request.
+- **static baseline** (`models/gpt2_inference.generate`): requests gang
+  into batches of ``slots`` in arrival order; every gang pads its
+  prompts to the longest member and decodes the gang-max new-token
+  budget before ANY member of the next gang starts — the cost model of
+  the one-static-batch-per-call path. (Its outputs for the shorter
+  members would additionally be wrong — right-padded prompts shift
+  logits, the static path has no left-pad masking — so the baseline is
+  charged only for its TIME, which is generous to it.)
+
+Speedup = continuous requests/sec over static requests/sec; the mixed
+decode budgets are where static batching bleeds (every short request
+pays the gang's longest budget).
+
+Run: ``python tests/perf/serving_bench.py`` (CPU ok; prints JSON).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+
+def _workload(rs, n_requests, prompt_lens, new_tokens, rate):
+    """Poisson arrival trace over mixed lengths/budgets."""
+    lens = rs.choice(prompt_lens, size=n_requests)
+    news = rs.choice(new_tokens, size=n_requests)
+    arrivals = np.cumsum(rs.exponential(1.0 / rate, size=n_requests))
+    arrivals -= arrivals[0]            # first request is already queued
+    return lens, news, arrivals
+
+
+def run_serving_bench(n_requests=32, slots=4, seed=0,
+                      prompt_lens=(8, 16, 32, 48),
+                      new_tokens=(2, 4, 8, 96), rate=400.0,
+                      page_size=32, max_pages_per_slot=5,
+                      kv_cache_bits=0, model_cfg=None, params=None,
+                      warm=True):
+    """Returns {continuous: {...}, static: {...}, speedup_requests_per_sec}.
+
+    ``model_cfg``/``params`` default to a small fp32 GPT-2 sized for CPU
+    runs; pass a real config + converted params to measure on-chip."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.models.gpt2_inference import generate
+    import deepspeed_tpu.serving as serving
+
+    rs = np.random.RandomState(seed)
+    if model_cfg is None:
+        # big enough that per-step MODEL compute (not interpret-mode /
+        # dispatch constants) is what both systems spend their time on —
+        # the regime the comparison is about
+        model_cfg = GPT2Config(
+            vocab_size=2048, n_positions=512, n_embd=256, n_layer=6,
+            n_head=8, dtype=jnp.float32, param_dtype=jnp.float32,
+            scan_layers=True)
+    if params is None:
+        params = jax.jit(GPT2LMHeadModel(model_cfg).init)(
+            jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))["params"]
+
+    lens, news, arrivals = _workload(rs, n_requests, prompt_lens,
+                                     new_tokens, rate)
+    prompts = [rs.randint(0, model_cfg.vocab_size,
+                          size=(s,)).astype(np.int32) for s in lens]
+    total_new = int(news.sum())
+
+    def make_requests():
+        return [serving.Request(i, prompts[i], max_new_tokens=int(news[i]),
+                                arrival_time=float(arrivals[i]))
+                for i in range(n_requests)]
+
+    # ONE adapter for every window: compiled tick/prefill programs live
+    # on the adapter, so fresh engines per window (clean scheduler/pool
+    # state) still replay warm executables — a long-lived server's
+    # steady state, which is what the benchmark measures
+    shared = serving.build_engine(
+        "gpt2", model_cfg, params,
+        config={"serving": {"slots": slots, "page_size": page_size,
+                            "max_pages_per_slot": max_pages_per_slot,
+                            "kv_cache_bits": kv_cache_bits}})
+
+    def run_continuous():
+        eng = serving.ContinuousBatcher(shared.adapter)
+        t0 = time.monotonic()
+        res = eng.serve(make_requests(), respect_arrival_times=True)
+        dt = time.monotonic() - t0
+        assert len(res) == n_requests
+        return dt, eng.stats
+
+    # one cache length for every static gang → one compiled decode_scan
+    max_out = int(np.max(lens)) + int(news.max())
+    max_out = min(model_cfg.n_positions, -(-max_out // 64) * 64)
+
+    def run_static():
+        # gangs in arrival order; a gang launches once its LAST member
+        # has arrived (static batching gathers a full batch first)
+        order = np.argsort(arrivals, kind="stable")
+        t0 = time.monotonic()
+        for g in range(0, n_requests, slots):
+            gang = order[g:g + slots]
+            gate = float(arrivals[gang].max())
+            while time.monotonic() - t0 < gate:
+                time.sleep(min(gate - (time.monotonic() - t0), 0.02))
+            S = int(max(lens[i] for i in gang))
+            batch = np.zeros((len(gang), S), np.int32)
+            for row, i in enumerate(gang):
+                batch[row, :lens[i]] = prompts[i]      # right-pad: the
+                # static path's only option — and part of why it loses
+            steps = int(max(news[i] for i in gang))
+            toks = generate(model_cfg, params, batch, max_new_tokens=steps,
+                            max_out_tokens=max_out)
+            float(jax.device_get(toks[0, -1]))         # fence
+
+        return time.monotonic() - t0
+
+    if warm:
+        # compile both systems outside the timed windows
+        run_continuous()
+        run_static()
+    # best of three INTERLEAVED window pairs: the CPU/tunnel shows ±15%
+    # run-to-run noise and the comparison should report the scheduler,
+    # not which system a descheduling blip landed on (same rule as
+    # bench.py's 3-window MFU)
+    dt_c, stats = run_continuous()
+    dt_s = run_static()
+    for _ in range(2):
+        dt_c2, stats2 = run_continuous()
+        if dt_c2 < dt_c:
+            dt_c, stats = dt_c2, stats2
+        dt_s = min(dt_s, run_static())
+
+    out = {
+        "workload": {
+            "n_requests": n_requests, "slots": slots,
+            "prompt_lens": list(map(int, prompt_lens)),
+            "new_tokens": list(map(int, new_tokens)),
+            "total_decode_tokens": total_new,
+            "poisson_rate_per_s": rate,
+        },
+        "continuous": {
+            "requests_per_sec": round(n_requests / dt_c, 2),
+            "decode_tokens_per_sec": round(total_new / dt_c, 1),
+            "wall_s": round(dt_c, 3),
+            "tick_dispatches": stats["ticks"],
+            "tick_steps": stats["tick_steps"],
+            "mean_slot_occupancy": round(
+                stats["decode_tokens"] / max(stats["tick_steps"], 1), 2),
+        },
+        "static": {
+            "requests_per_sec": round(n_requests / dt_s, 2),
+            "decode_tokens_per_sec": round(total_new / dt_s, 1),
+            "wall_s": round(dt_s, 3),
+        },
+        "speedup_requests_per_sec": round(dt_s / dt_c, 2),
+    }
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(run_serving_bench(), indent=1))

@@ -1,31 +1,78 @@
-"""CI-style guard: the whole suite must COLLECT cleanly.
+"""CI-style guards on suite collection.
 
-A single bad import (e.g. the `from jax import shard_map` that broke
-tests/test_csr.py on the pinned jax 0.4.37) silently gates every test in
-the affected module; with `--continue-on-collection-errors` in the tier-1
-runner the suite still "passes" while whole files never run. This test
-re-collects the suite in a subprocess and fails loudly on any collection
-error, so a future incompatible import cannot hide."""
+1. The whole suite must COLLECT cleanly: a single bad import (e.g. the
+   `from jax import shard_map` that broke tests/test_csr.py on the
+   pinned jax 0.4.37) silently gates every test in the affected module;
+   with `--continue-on-collection-errors` in the tier-1 runner the suite
+   still "passes" while whole files never run.
+2. Every test FILE that slow-marks anything must still collect at least
+   one fast (non-slow) test: the tier-1 runner deselects `-m 'not
+   slow'`, so a file whose tests all drift behind @pytest.mark.slow
+   drops out of tier-1 entirely — coverage evaporating one decorator at
+   a time, with the suite still green.
 
+Both guards read ONE subprocess collection (`--collect-only -q -m 'not
+slow'`): it fails loudly on any collection error, reports the total
+collected count (before deselection), and lists the surviving fast node
+ids per file.
+"""
+
+import functools
 import os
 import re
 import subprocess
 import sys
 
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS_DIR)
 
-def test_suite_collects_without_errors():
+
+@functools.lru_cache(maxsize=1)
+def _collect_fast():
+    """(total_collected, {file -> fast node count}) from one subprocess
+    collection — shared by both guards (a full re-collect costs ~35 s of
+    suite imports, and the tier-1 wall is a real budget)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    tests_dir = os.path.dirname(os.path.abspath(__file__))
-    repo = os.path.dirname(tests_dir)
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "--collect-only", "-q",
-         "-p", "no:cacheprovider", "tests/"],
-        cwd=repo, env=env, capture_output=True, text=True, timeout=600)
+         "-m", "not slow", "-p", "no:cacheprovider", "-p", "no:xdist",
+         "-p", "no:randomly", "tests/"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
     out = proc.stdout + proc.stderr
     # without --continue-on-collection-errors any collection error → rc != 0
     assert proc.returncode == 0, \
         f"collection failed (rc={proc.returncode}):\n{out[-4000:]}"
-    m = re.search(r"(\d+) tests collected", out)
+    # "N/M tests collected (X deselected)" with -m; "M tests collected"
+    # without any deselection
+    m = re.search(r"(?:(\d+)/)?(\d+) tests collected", out)
     assert m, out[-2000:]
-    assert int(m.group(1)) >= 438, out[-2000:]
+    total = int(m.group(2))
+    fast_per_file = {}
+    for line in proc.stdout.splitlines():
+        if "::" in line:
+            fname = line.split("::", 1)[0].split("/")[-1]
+            fast_per_file[fname] = fast_per_file.get(fname, 0) + 1
+    return total, fast_per_file
+
+
+def test_suite_collects_without_errors():
+    total, _ = _collect_fast()
+    assert total >= 438, total
+
+
+def test_slow_marked_files_keep_fast_coverage():
+    _, fast_per_file = _collect_fast()
+    slow_files = []
+    for name in sorted(os.listdir(TESTS_DIR)):
+        if not (name.startswith("test_") and name.endswith(".py")):
+            continue
+        with open(os.path.join(TESTS_DIR, name)) as f:
+            if "pytest.mark.slow" in f.read():
+                slow_files.append(name)
+    assert slow_files, "expected at least one slow-marked file in tests/"
+    orphaned = [f for f in slow_files if not fast_per_file.get(f)]
+    assert not orphaned, (
+        f"these files slow-mark tests and no longer collect ANY fast "
+        f"test — tier-1 lost them entirely: {orphaned}. Keep (or add) a "
+        f"fast sibling test per file, or un-mark something.")

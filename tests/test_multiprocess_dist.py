@@ -60,6 +60,50 @@ def _free_port():
     return port
 
 
+def test_rendezvous_env_contract_discovery():
+    """Fast tier-1 coverage of the launcher env contract the slow
+    multi-process tests rendezvous through: discover_rendezvous is pure
+    over an environ dict, so the precedence and parsing rules pin here
+    without forking processes."""
+    from deepspeed_tpu.utils.distributed import discover_rendezvous
+
+    # the DSTPU_* contract (what launcher/launch.py exports)
+    addr, num, pid, ids = discover_rendezvous({
+        "DSTPU_COORDINATOR_ADDR": "10.0.0.1",
+        "DSTPU_COORDINATOR_PORT": "1234",
+        "DSTPU_NUM_PROCESSES": "4",
+        "DSTPU_PROCESS_ID": "2",
+        "DSTPU_LOCAL_DEVICE_IDS": "0,1",
+    })
+    assert (addr, num, pid) == ("10.0.0.1:1234", 4, 2)
+    assert list(ids) == [0, 1]
+    # default port fills in; missing device ids stay None
+    addr, num, pid, ids = discover_rendezvous(
+        {"DSTPU_COORDINATOR_ADDR": "h", "DSTPU_NUM_PROCESSES": "2",
+         "DSTPU_PROCESS_ID": "0"})
+    assert addr == "h:8476" and ids is None
+    # generic COORDINATOR_ADDRESS fallback
+    addr, num, pid, _ = discover_rendezvous(
+        {"COORDINATOR_ADDRESS": "c:99", "NUM_PROCESSES": "8",
+         "PROCESS_ID": "7"})
+    assert (addr, num, pid) == ("c:99", 8, 7)
+    # MPI discovery requires MASTER_ADDR (no localhost guessing — every
+    # rank dialing its own loopback would hang, not fail)
+    addr, num, pid, _ = discover_rendezvous(
+        {"OMPI_COMM_WORLD_SIZE": "2", "OMPI_COMM_WORLD_RANK": "1"})
+    assert addr is None and (num, pid) == (2, 1)
+    addr, _, _, _ = discover_rendezvous(
+        {"OMPI_COMM_WORLD_SIZE": "2", "OMPI_COMM_WORLD_RANK": "1",
+         "MASTER_ADDR": "m"})
+    assert addr == "m:8476"
+    # MPI auto-discovery can be disabled
+    addr, num, _, _ = discover_rendezvous(
+        {"OMPI_COMM_WORLD_SIZE": "2"}, auto_mpi_discovery=False)
+    assert addr is None and num is None
+    # empty environment resolves nothing
+    assert discover_rendezvous({}) == (None, None, None, None)
+
+
 @pytest.mark.parametrize("world", [2])
 @pytest.mark.slow
 def test_two_process_psum_over_launcher_contract(tmp_path, world):

@@ -1,0 +1,122 @@
+"""Involuntary-rematerialization pins for the dryrun detector.
+
+``__graft_entry__.dryrun_multichip`` fails the whole dryrun when XLA's
+SPMD partitioner reports "Involuntary full rematerialization" (a
+sharding-spec mismatch that compiles into a replicate-then-reshard —
+a full-tensor broadcast per step on a real ICI mesh). These tests pin
+WHICH programs are clean vs. still tripping, so regressions (and the
+eventual fix) are individually visible:
+
+- the 1F1B pipe-only shard_map program (PR 1's known follow-up) is now
+  CLEAN — its per-leaf pipe specs no longer force a reshard — and must
+  stay that way;
+- the expert-parallel MoE train step (dp x ep x tp) is the remaining
+  tripper: the token->expert regroup's sharding constraint flips the
+  layer-scan carry between batch- and expert-major layouts. Pinned as
+  strict xfail: fixing the specs turns it into an XPASS error, which is
+  the signal to drop the mark (tracking note in CHANGES.md, PR 2).
+
+The C++ partitioner logs to stderr (not python logging), so each probe
+compiles its program in a subprocess and greps captured stderr — the
+same channel the dryrun detector reads.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REMAT_MSG = "Involuntary full rematerialization"
+
+
+def _compile_probe(body: str, n_devices: int) -> str:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_devices}")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", body], env=env,
+                          cwd=REPO_ROOT, capture_output=True, text=True,
+                          timeout=420)
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-3000:]
+    return proc.stdout + proc.stderr
+
+
+def test_pipeline_1f1b_pipe_only_shard_map_remat_clean():
+    """The pipeline-perf sweep's grad program (pipe-only shard_map,
+    S=4) must compile with NO involuntary remat — pins PR 1's spec fix
+    so it can't silently regress."""
+    out = _compile_probe(textwrap.dedent("""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+        from deepspeed_tpu.parallel.pipeline_1f1b import pipeline_1f1b
+
+        S, layers, d, mb = 4, 2, 64, 8
+        devices = jax.devices()[:S]
+        mesh = make_mesh(MeshConfig(pipe=S, data=1), devices=devices)
+        rng = jax.random.PRNGKey(0)
+        w = jax.random.normal(rng, (S, layers, d, d)) * 0.2
+
+        def stage_fn(sp, x):
+            def layer(h, wi):
+                return jnp.tanh(h @ wi), None
+            y, _ = jax.lax.scan(layer, x, sp)
+            return y
+
+        M = 4 * S
+
+        def loss(p, xx):
+            return jnp.mean(pipeline_1f1b(stage_fn, p, xx, mesh) ** 2)
+
+        x = jax.random.normal(rng, (M, mb, d))
+        jax.block_until_ready(jax.jit(jax.grad(loss))(w, x))
+        print("COMPILED_OK")
+    """), n_devices=4)
+    assert "COMPILED_OK" in out
+    assert REMAT_MSG not in out, out[-3000:]
+
+
+@pytest.mark.slow
+@pytest.mark.xfail(
+    strict=True,
+    reason="MoE expert-parallel step still reshards its layer-scan carry "
+           "between batch- and expert-major layouts (the dryrun "
+           "detector's remaining tripper) — see CHANGES.md PR 2 note; "
+           "an XPASS here means the specs got fixed: delete this mark")
+def test_moe_expert_parallel_step_remat_clean():
+    """The dp2 x ep2 x tp2 MoE train step (dryrun_multichip's third
+    config) compiled without involuntary remat — currently it does NOT:
+    strict xfail pins today's detector output so the fix is verifiable."""
+    out = _compile_probe(textwrap.dedent("""
+        import numpy as np
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import deepspeed_tpu as dstpu
+        from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+        from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+        devices = jax.devices()[:8]
+        mesh = make_mesh(MeshConfig(data=2, expert=2, model=2),
+                         devices=devices)
+        moe_cfg = GPT2Config(vocab_size=512, n_positions=128, n_embd=128,
+                             n_layer=2, n_head=4, dtype=jnp.bfloat16,
+                             scan_layers=True, moe_experts=4)
+        cfg = {"train_batch_size": 4,
+               "zero_optimization": {"stage": 1},
+               "bf16": {"enabled": True},
+               "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}}}
+        engine, _, _, _ = dstpu.initialize(
+            config=cfg, model=GPT2LMHeadModel(moe_cfg), mesh=mesh)
+        batch = {"input_ids": np.random.RandomState(2).randint(
+            0, 512, size=(4, 128)).astype(np.int32)}
+        float(jax.device_get(engine.train_batch(batch)))
+        print("COMPILED_OK")
+    """), n_devices=8)
+    assert "COMPILED_OK" in out
+    assert REMAT_MSG not in out, out[-3000:]
