@@ -68,7 +68,8 @@ class SectionRunner:
 
 
 BENCH_SECTIONS = ("bert", "train", "sparse", "decode", "llama7b", "moe",
-                  "aio", "nvme_param", "serving", "infinity6b", "xl")
+                  "zero3_prefetch", "aio", "nvme_param", "serving",
+                  "infinity6b", "xl")
 
 
 def _enable_compile_cache():
@@ -244,6 +245,9 @@ def main(argv=None):
     moe = runner.run(
         "moe", lambda: bench_moe(dstpu, make_mesh, MeshConfig, dev),
         est_s=180)
+    zero3_prefetch = runner.run("zero3_prefetch", bench_zero3_prefetch,
+                                est_s=300)
+    jax.clear_caches()
 
     # NVMe/disk tier throughput (reference's aio perf harness role,
     # csrc/aio/py_test): 128 MB write+read through the async-IO library,
@@ -297,6 +301,11 @@ def main(argv=None):
             # expert-parallel MoE training throughput (beyond-reference
             # component; routing einsums regress invisibly without it)
             "moe": moe,
+            # ZeRO-3 layer-wise gather prefetch on vs off (ISSUE 3): on
+            # a single-chip harness this is the 8-virtual-device CPU
+            # step-time proxy (see bench_zero3_prefetch); on a slice it
+            # measures the real ICI overlap behind the headline MFU
+            "zero3_prefetch": zero3_prefetch,
             "sections_skipped": runner.skipped,
         },
     }
@@ -479,6 +488,43 @@ def bench_train_gpt2(dstpu, make_mesh, MeshConfig, dev, jnp):
         "phase_breakdown_ms": phase_ms,
         "tunnel_fence_ms_per_readback": round(fence_s * 1000, 1),
     }
+
+
+def bench_zero3_prefetch():
+    """``stage3_prefetch`` on vs off (tests/perf/prefetch_bench.py).
+
+    The prefetch pipeline needs a >1-device data axis. On a multi-chip
+    claim it runs in-process against the real mesh; on the usual
+    single-chip harness it spawns the 8-virtual-device CPU proxy in a
+    subprocess (XLA_FLAGS is read at interpreter start, so the parent
+    process cannot widen its own device count) — a step-time proxy that
+    exercises the exact train program, honestly labeled."""
+    import subprocess
+    import jax
+    here = os.path.dirname(os.path.abspath(__file__))
+    if len(jax.devices()) > 1:
+        from tests.perf.prefetch_bench import run_prefetch_bench
+        return {"mesh": "real", **run_prefetch_bench()}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "tests", "perf",
+                                      "prefetch_bench.py")],
+        env=env, cwd=here, capture_output=True, text=True, timeout=900)
+    if proc.returncode != 0:
+        return {"skipped": f"proxy subprocess rc={proc.returncode}: "
+                           f"{(proc.stderr or '')[-200:]}"}
+    lines = (proc.stdout or "").splitlines()
+    try:
+        # the bench prints one indented JSON object; log lines may
+        # precede it, so parse from the last bare "{" line onward
+        start = max(i for i, l in enumerate(lines) if l.strip() == "{")
+        out = json.loads("\n".join(lines[start:]))
+    except (ValueError, json.JSONDecodeError) as e:
+        return {"skipped": f"proxy output unparseable: {e}"}
+    return {"mesh": "cpu_virtual_8dev_step_time_proxy", **out}
 
 
 def bench_serving():

@@ -142,6 +142,21 @@ class DeepSpeedZeroConfig:
         self.prefetch_bucket_size = int(
             get_scalar_param(zero_dict, C.ZERO_PREFETCH_BUCKET_SIZE,
                              C.ZERO_PREFETCH_BUCKET_SIZE_DEFAULT))
+        self.stage3_prefetch = bool(
+            get_scalar_param(zero_dict, C.ZERO_STAGE3_PREFETCH,
+                             C.ZERO_STAGE3_PREFETCH_DEFAULT))
+        self.stage3_prefetch_gather = str(
+            get_scalar_param(zero_dict, C.ZERO_STAGE3_PREFETCH_GATHER,
+                             C.ZERO_STAGE3_PREFETCH_GATHER_DEFAULT))
+        if self.stage3_prefetch_gather not in ("ring", "fused"):
+            raise DeepSpeedConfigError(
+                f"zero_optimization.{C.ZERO_STAGE3_PREFETCH_GATHER} must "
+                f"be 'ring' or 'fused', got "
+                f"{self.stage3_prefetch_gather!r}")
+        if self.stage3_prefetch and self.stage != 3:
+            raise DeepSpeedConfigError(
+                f"zero_optimization.{C.ZERO_STAGE3_PREFETCH} requires "
+                f"stage 3, got stage {self.stage}")
         self.param_persistence_threshold = int(
             get_scalar_param(zero_dict, C.ZERO_PARAM_PERSISTENCE_THRESHOLD,
                              C.ZERO_PARAM_PERSISTENCE_THRESHOLD_DEFAULT))
@@ -169,6 +184,8 @@ class DeepSpeedZeroConfig:
             "allgather_bucket_size": self.allgather_bucket_size,
             "overlap_comm": self.overlap_comm,
             "overlap_reduce": self.overlap_reduce,
+            "stage3_prefetch": self.stage3_prefetch,
+            "stage3_prefetch_gather": self.stage3_prefetch_gather,
             "reduce_scatter": self.reduce_scatter,
             "offload_param": self.offload_param.repr_dict(),
             "offload_optimizer": self.offload_optimizer.repr_dict(),

@@ -175,6 +175,20 @@ OFFLOAD_STREAM_SEGMENTS = "stream_segments"
 # stage-3 tuning knobs (reference zero/constants.py)
 ZERO_PREFETCH_BUCKET_SIZE = "stage3_prefetch_bucket_size"
 ZERO_PREFETCH_BUCKET_SIZE_DEFAULT = 5e7
+# TPU extension: explicit layer-wise parameter-gather prefetch pipeline
+# (parallel/prefetch.py) — the train step becomes a shard_map program
+# whose per-layer param all-gather issues ONE LAYER AHEAD of use
+# (double-buffered, forward and backward), bounding live full params to
+# ~2 layers; the reference's stage3_prefetch_bucket_size /
+# PartitionedParameterCoordinator behavior made structural.
+ZERO_STAGE3_PREFETCH = "stage3_prefetch"
+ZERO_STAGE3_PREFETCH_DEFAULT = False
+# collective implementation for the prefetch gathers and the backward
+# grad reduce-scatter: "ring" (explicit lax.ppermute hops, maximum
+# scheduling freedom) or "fused" (lax.all_gather/psum_scatter per
+# layer; XLA picks the algorithm) — the stage-3 twin of overlap_reduce.
+ZERO_STAGE3_PREFETCH_GATHER = "stage3_prefetch_gather"
+ZERO_STAGE3_PREFETCH_GATHER_DEFAULT = "ring"
 ZERO_PARAM_PERSISTENCE_THRESHOLD = "stage3_param_persistence_threshold"
 ZERO_PARAM_PERSISTENCE_THRESHOLD_DEFAULT = 1e5
 ZERO_MAX_LIVE_PARAMETERS = "stage3_max_live_parameters"

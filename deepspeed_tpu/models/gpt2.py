@@ -97,6 +97,59 @@ def _embed_lookup(wte, ids):
                             jnp.dtype(wte.dtype).name)(wte, ids)
 
 
+def _expert_mesh_batch_pin(t):
+    """Batch-layout constraint applied only under a live EXPERT mesh
+    axis. Tiling the batch dim over the ('data','expert') axis pair
+    yields a device order XLA's partitioner cannot convert to/from the
+    model-axis tilings it picks inside the layer scan — the conversion
+    degenerates to involuntary full rematerialization (a whole-tensor
+    broadcast per step; the dryrun detector's dp×ep×tp tripper, clean
+    on dp×sp×tp and dp×tp meshes). Anchoring the tensor to the batch
+    layout keeps every reshard on a convertible path. No-op outside an
+    engine-pinned GSPMD trace or when no expert axis is live."""
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    mesh = _gspmd_mesh()
+    if mesh is None or mesh.shape.get(mesh_lib.EXPERT_AXIS, 1) <= 1:
+        return t
+    return jax.lax.with_sharding_constraint(
+        t, mesh_lib.batch_sharding(mesh))
+
+
+@_functools.lru_cache(maxsize=None)
+def _carry_pin_fn():
+    """Identity whose primal AND cotangent pin to the batch layout on
+    expert meshes (the layer-scan carry spec enrichment): the backward
+    scan otherwise carries the residual-stream cotangent model-major
+    and remats flipping it back to batch-major."""
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return _expert_mesh_batch_pin(x), None
+
+    def bwd(_, g):
+        # the engine's layout_pins context is Python-call-scoped, so it
+        # is live however/whenever jax re-traces this backward
+        return (_expert_mesh_batch_pin(g),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _carry_pin(x):
+    # trace-time gate: the engine's layout_pins context is live for the
+    # whole trace, so whether an expert axis exists is a stable Python
+    # fact — skip inserting the custom_vjp entirely on non-expert
+    # meshes (the overwhelmingly common case; keeps those traces and
+    # compiles free of dead identity nodes)
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    mesh = _gspmd_mesh()
+    if mesh is None or mesh.shape.get(mesh_lib.EXPERT_AXIS, 1) <= 1:
+        return x
+    return _carry_pin_fn()(x)
+
+
 @dataclasses.dataclass(frozen=True)
 class GPT2Config:
     vocab_size: int = 50257
@@ -227,6 +280,7 @@ class Block(nn.Module):
         ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                            param_dtype=cfg.param_dtype, name="ln_1")(x)
         x = x + keep * SelfAttention(cfg, name="attn")(ln1, deterministic)
+        x = _carry_pin(x)
         ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                            param_dtype=cfg.param_dtype, name="ln_2")(x)
         if cfg.moe_experts:
@@ -241,7 +295,7 @@ class Block(nn.Module):
         else:
             ffn_out = MLP(cfg, name="mlp")(ln2, deterministic)
         x = x + keep * ffn_out
-        return x
+        return _carry_pin(x)
 
 
 def _remat_policy(name):
@@ -322,6 +376,60 @@ class GPT2LMHeadModel(nn.Module):
     config: GPT2Config
 
     @property
+    def prefetch_layer_subtree(self):
+        """Name of the layer-stacked params subtree the engine's
+        stage3_prefetch pipeline may drive layer-by-layer, or None when
+        the model can't offer one (unrolled layers have per-layer
+        subtrees; MoE sows aux losses the functional twin doesn't
+        collect; dropout needs per-layer rng plumbing)."""
+        cfg = self.config
+        if cfg.scan_layers and not cfg.moe_experts and cfg.dropout == 0:
+            return "h"
+        return None
+
+    @nn.nowrap
+    def prefetch_apply(self, params, input_ids, layer_scan,
+                       deterministic=True, keep_prob=1.0, labels=None):
+        """Functional twin of ``__call__`` (scan_layers path) where the
+        transformer stack runs through ``layer_scan(body, x,
+        params["h"])`` — the engine passes the double-buffered
+        parameter-gather scan (parallel/prefetch.py) so each layer's
+        shards gather one layer ahead of use. ``body(x, layer_params)``
+        applies ONE block from an (unstacked) per-layer param tree.
+        Numerics are pinned to ``__call__`` by tests/test_prefetch.py."""
+        cfg = self.config
+        S = input_ids.shape[1]
+        x = _embed_lookup(params["wte"], input_ids).astype(cfg.dtype) \
+            + params["wpe"][:S].astype(cfg.dtype)[None]
+
+        scan_body = ScanBody(cfg)
+
+        def body(xc, layer_params):
+            y, _ = scan_body.apply({"params": layer_params}, xc,
+                                   deterministic, keep_prob)
+            return y
+
+        x = layer_scan(body, x, params["h"])
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype).apply(
+            {"params": params["ln_f"]}, x)
+        if labels is not None and cfg.loss_chunk > 0 \
+                and cfg.tie_word_embeddings:
+            return chunked_lm_loss(x, params["wte"].astype(cfg.dtype),
+                                   labels, cfg.loss_chunk)
+        if cfg.tie_word_embeddings:
+            logits = jnp.einsum("bse,ve->bsv", x,
+                                params["wte"].astype(cfg.dtype))
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                              dtype=cfg.dtype,
+                              param_dtype=cfg.param_dtype).apply(
+                {"params": params["lm_head"]}, x)
+        if labels is not None:
+            return lm_loss(logits, labels)
+        return logits
+
+    @property
     def sparse_grad_params(self):
         """Leaves eligible for the engine's row-sparse gradient exchange
         (sparse_gradients config). Only the UNTIED input embedding
@@ -351,8 +459,22 @@ class GPT2LMHeadModel(nn.Module):
             from jax.sharding import NamedSharding, PartitionSpec
             pos = jax.lax.with_sharding_constraint(
                 pos, NamedSharding(mesh, PartitionSpec()))
-        x = _embed_lookup(wte, input_ids).astype(cfg.dtype) \
-            + pos.astype(cfg.dtype)[None]
+        posb = pos.astype(cfg.dtype)[None]
+        from deepspeed_tpu.parallel import mesh as mesh_lib
+        if mesh is not None and \
+                mesh.shape.get(mesh_lib.EXPERT_AXIS, 1) > 1:
+            # the broadcast's size-1 leading dim otherwise inherits the
+            # batch sharding; on expert meshes that degenerate
+            # ('data','expert')-pair tiling is unconvertible to the wpe
+            # gradient's model-axis layout and remats (same family as
+            # the fp32 pin above — this one anchors the POST-cast/
+            # broadcast edge both directions; other meshes convert fine
+            # and skip the extra node)
+            from jax.sharding import NamedSharding, PartitionSpec
+            posb = jax.lax.with_sharding_constraint(
+                posb, NamedSharding(mesh, PartitionSpec()))
+        x = _embed_lookup(wte, input_ids).astype(cfg.dtype) + posb
+        x = _carry_pin(x)
 
         if cfg.scan_layers:
             scanned = nn.scan(ScanBody,

@@ -29,8 +29,37 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.parallel import mesh as mesh_lib
+
+
+def _expert_mesh_pin(t, spec):
+    """Sharding anchor applied only under an engine-pinned mesh with a
+    live dedicated expert axis. The token→expert regroup flips tensors
+    between batch-major (dim 0 tiled over ('data','expert')) and
+    expert-major layouts; on dp×ep×tp meshes those two device orders
+    are unconvertible for XLA's partitioner and every unanchored edge
+    risks degenerating into involuntary full rematerialization (the
+    dryrun detector's tripper). Pinning each regroup tensor to ONE
+    declared layout keeps all reshards on convertible paths. No-op
+    outside engine-pinned GSPMD traces (mesh_lib.layout_pins) and
+    inside explicit-comm regions."""
+    mesh = mesh_lib.pinned_mesh()
+    if mesh is None or mesh_lib.in_manual_region():
+        return t
+    if mesh_lib.mesh_axis_size(mesh, mesh_lib.EXPERT_AXIS) <= 1:
+        return t
+    if isinstance(spec, NamedSharding):
+        return jax.lax.with_sharding_constraint(t, spec)
+    return jax.lax.with_sharding_constraint(t, NamedSharding(mesh, spec))
+
+
+def _batch_pin(t):
+    mesh = mesh_lib.pinned_mesh()
+    if mesh is None:
+        return t
+    return _expert_mesh_pin(t, mesh_lib.batch_sharding(mesh))
 
 
 def load_balance_loss(gate_probs, expert_mask):
@@ -115,11 +144,15 @@ class MoEMLP(nn.Module):
                         (E, H, self.d_ff), self.param_dtype)
         wo = self.param("wo", nn.initializers.normal(self.out_init_std),
                         (E, self.d_ff, H), self.param_dtype)
+        eaxis = _expert_axis(mesh_lib.pinned_mesh())
         h = jnp.einsum("ech,ehf->ecf", xe, wi.astype(self.dtype))
         h = self.activation(h)
+        if eaxis:
+            h = _expert_mesh_pin(h, P(eaxis))
         if self.dropout > 0:
             h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
-        return jnp.einsum("ecf,efh->ech", h, wo.astype(self.dtype))
+        y = jnp.einsum("ecf,efh->ech", h, wo.astype(self.dtype))
+        return _expert_mesh_pin(y, P(eaxis)) if eaxis else y
 
 
 class MoE(nn.Module):
@@ -148,19 +181,45 @@ class MoE(nn.Module):
             E, k=self.k, capacity_factor=self.capacity_factor,
             param_dtype=self.param_dtype, name="gate")
         dispatch, combine, aux = gate(x)          # [B,S,E,C], aux [B]
+        # the regroup masks are consumed from BOTH layouts (batch-major
+        # x on one side of each einsum, expert-major xe/ye on the
+        # other). Tiled either way, the partitioner must convert them
+        # across the (data×expert)-iota ↔ expert-transposed device
+        # orders — unconvertible, degenerating to involuntary full
+        # rematerialization INSIDE the layer loop. Pinning them
+        # REPLICATED declares the broadcast once at a convertible edge
+        # (any tiling → replicated is an all-gather); the masks are the
+        # small [B,S,E,C] one-hots, not activations.
+        # pin the CASTED masks — the exact tensors the einsums consume;
+        # pinning before the cast leaves a free convert node between the
+        # anchor and the einsum for the partitioner to re-tile
+        dispatch = _expert_mesh_pin(dispatch.astype(self.dtype), P())
+        combine = _expert_mesh_pin(combine.astype(self.dtype), P())
         aux = aux.mean()
 
         C = dispatch.shape[-1]
         # [B,S,H] → [E, B*C, H]: the token→expert regroup (GSPMD lowers
         # this to the EP all_to_all when experts are sharded)
-        xe = jnp.einsum("bsec,bsh->ebch", dispatch.astype(self.dtype), x)
-        xe = xe.reshape(E, B * C, H)
         mesh = mesh_lib.current_mesh()
         eaxis = _expert_axis(mesh)
         ep = eaxis is not None and \
             E % mesh_lib.mesh_axis_size(mesh, eaxis) == 0
-        if ep:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+        xe = jnp.einsum("bsec,bsh->ebch", dispatch, x)
+        xe = xe.reshape(E, B * C, H)
+        pinned = mesh_lib.pinned_mesh()
+        dedicated_ep = pinned is not None and \
+            mesh_lib.mesh_axis_size(pinned, mesh_lib.EXPERT_AXIS) > 1
+        if dedicated_ep:
+            # dedicated-expert meshes: the batch-major ↔ expert-major
+            # flip must route THROUGH replicated — direct tiled↔tiled
+            # conversion between the (data×expert)-iota and
+            # expert-transposed device orders is unconvertible and
+            # degenerates to involuntary remat inside the layer loop.
+            # The regroup buffers replicate at declared edges; only the
+            # expert MLP's internals stay expert-tiled (driven by its
+            # expert-sharded weights — a convertible slice).
+            xe = _expert_mesh_pin(xe, P())
+        elif ep:
             xe = jax.lax.with_sharding_constraint(
                 xe, NamedSharding(mesh, P(eaxis)))
         ye = MoEMLP(E, H, self.d_ff, dropout=self.dropout,
@@ -168,7 +227,10 @@ class MoE(nn.Module):
                     param_dtype=self.param_dtype,
                     name="experts")(xe, deterministic)
         ye = ye.reshape(E, B, C, H)
-        y = jnp.einsum("bsec,ebch->bsh", combine.astype(self.dtype), ye)
+        if dedicated_ep:
+            ye = _expert_mesh_pin(ye, P())   # see xe: flip via replicated
+        y = jnp.einsum("bsec,ebch->bsh", combine, ye)
+        y = _batch_pin(y)
 
         if self.is_mutable_collection("losses"):
             self.sow("losses", "moe_aux", aux)
@@ -196,7 +258,6 @@ def expert_shardings(params, mesh):
     'data'); router + everything else replicated. Kernels whose expert
     count does not divide the axis stay replicated (matching the guard
     MoE.__call__ applies)."""
-    from jax.sharding import PartitionSpec as P
     eaxis = _expert_axis(mesh)
     axis = mesh_lib.mesh_axis_size(mesh, eaxis) if eaxis else 0
 

@@ -1,0 +1,398 @@
+"""ZeRO-3 layer-wise parameter-gather prefetch pipeline.
+
+The fused GSPMD stage-3 path expresses every per-layer parameter
+all-gather implicitly (a sharding constraint at rest, the gathers
+materialize wherever XLA schedules them) — which leaves XLA free to
+serialize the whole gather stream before compute. The reference
+DeepSpeed instead prefetches: the PartitionedParameterCoordinator
+(stage3.py:287-447) gathers the NEXT submodule's partitions while the
+current one computes, bounded by ``stage3_max_live_parameters``. This
+module is the TPU-native rebuild of that coordinator as an explicit
+shard_map program:
+
+  * layer-stacked parameter shards (leading dim = layer) pack into ONE
+    flat buffer per layer (the prefetch "bucket" — like the IPG buckets
+    of parallel/overlap.py, but for params);
+  * the forward is a ``lax.scan`` whose carry holds the IN-FLIGHT
+    gathered buffer: iteration *i* issues the ring all-gather of layer
+    *i+1*'s shards and computes layer *i* from the buffer gathered one
+    iteration earlier (double buffering). The gather has no data
+    dependency on the compute, so XLA's latency-hiding scheduler floats
+    the hops over the layer's matmuls;
+  * gathered params DROP at the end of their iteration: live full
+    parameters are bounded at ~2 layers (+ the small persistent
+    remainder) — the TPU-native ``stage3_max_live_parameters``;
+  * the backward (a ``jax.custom_vjp``) re-gathers each layer in
+    REVERSE order with the same double buffering, and reduce-scatters
+    each layer's parameter gradient (the PR-1 ring of
+    parallel/overlap.py) inside the same iteration — the ring is busy
+    in both directions while the layer's VJP computes. Layer inputs are
+    the only saved residuals, so each layer's forward rematerializes in
+    backward (full-remat semantics, same memory shape as the
+    reference's post-backward partition release).
+
+Everything here is pure, jit-able, and must run INSIDE ``shard_map``
+binding ``axis_name`` (the engine's ``stage3_prefetch`` train path).
+Gradients of sharded leaves come back reduce-scattered as SUMS over the
+axis (the caller normalizes to a mean); gradients of replicated leaves
+come back LOCAL (the caller runs them through
+``overlap.bucketed_allreduce``, composing with ``overlap_comm``).
+"""
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.parallel import overlap as overlap_lib
+
+
+# ---------------------------------------------------------------------------
+# plan (host-side, static)
+# ---------------------------------------------------------------------------
+
+def plan_from_specs(leaves, specs, axis_name: str, n: int):
+    """Per-leaf shard plan from a PartitionSpec tree: ``(dim, shard_size)``
+    where ``dim`` (in the leaf's own coordinates) carries ``axis_name``,
+    or None for leaves the spec leaves replicated over the axis — the
+    same contract as ``ZeroPartitioner.explicit_shard_plan``, usable on
+    any params subtree."""
+    plan = []
+    for leaf, spec in zip(leaves, specs):
+        entry = None
+        for d, ax in enumerate(spec):
+            axes = ax if isinstance(ax, tuple) else (ax,)
+            if axis_name in axes:
+                entry = (d, leaf.shape[d] // n)
+                break
+        plan.append(entry)
+    return plan
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerPlan:
+    """Static packing plan for one layer-stacked params subtree.
+
+    ``plan`` entries are in PER-LAYER leaf coordinates (the stacked
+    leaf's dim minus the leading layer dim); sharded leaves group by
+    dtype into packed flat buffers (one ring gather per group per
+    layer), replicated leaves ride the scan as sliced inputs."""
+    plan: Tuple[Optional[Tuple[int, int]], ...]
+    # (dtype, leaf_ids) per packed group — leaf order within a group is
+    # the flattened-tree order, offsets implied by cumulative sizes
+    groups: Tuple[Tuple[Any, Tuple[int, ...]], ...]
+    n: int
+
+    @property
+    def sharded_ids(self):
+        return tuple(i for g in self.groups for i in g[1])
+
+
+def build_layer_plan(shard_leaves, plan, n: int) -> LayerPlan:
+    """``shard_leaves``: per-device stacked shards ([L, ...]);
+    ``plan``: entries in STACKED coordinates (dim 0 is the layer dim and
+    must never be sharded — the partitioner's ``layer_stacked_prefixes``
+    guarantees it)."""
+    per_layer = []
+    groups = {}
+    for i, (leaf, entry) in enumerate(zip(shard_leaves, plan)):
+        if entry is None:
+            per_layer.append(None)
+            continue
+        d, sz = entry
+        assert d >= 1, (
+            f"layer-stacked leaf {i} sharded on its layer dim (shape "
+            f"{leaf.shape}); exclude dim 0 via layer_stacked_prefixes")
+        per_layer.append((d - 1, sz))
+        groups.setdefault(jnp.dtype(leaf.dtype), []).append(i)
+    return LayerPlan(plan=tuple(per_layer),
+                     groups=tuple((dt, tuple(ids))
+                                  for dt, ids in groups.items()),
+                     n=n)
+
+
+# ---------------------------------------------------------------------------
+# chunk-major leaf <-> flat packing (per-device; inside shard_map)
+# ---------------------------------------------------------------------------
+
+def _full_from_chunks(chunks, d):
+    """[n, *shard_shape] (chunk j = device j's slice of dim ``d``) → full
+    leaf with dim ``d`` of size n*shard."""
+    full = jnp.moveaxis(chunks, 0, d)          # [..., n, shard, ...]
+    shape = list(full.shape)
+    shape[d:d + 2] = [shape[d] * shape[d + 1]]
+    return full.reshape(shape)
+
+
+def _chunks_from_full(full, d, n):
+    """Inverse of ``_full_from_chunks``: full leaf → [n, *shard_shape]."""
+    shape = list(full.shape)
+    shape[d:d + 1] = [n, shape[d] // n]
+    return jnp.moveaxis(full.reshape(shape), d, 0)
+
+
+def gather_leaf(shard, entry, axis_name: str, n: int, mode: str = "ring"):
+    """All-gather one sharded leaf ((dim, size) entry) to its full shape.
+    mode="ring": explicit ppermute ring (overlap.ring_all_gather);
+    mode="fused": one ``lax.all_gather`` (XLA picks the algorithm)."""
+    if entry is None or n == 1:
+        return shard
+    d, _ = entry
+    if mode == "fused":
+        return jax.lax.all_gather(shard, axis_name, axis=d, tiled=True)
+    flat = overlap_lib.ring_all_gather(shard.reshape(-1), axis_name, n)
+    return _full_from_chunks(flat.reshape((n,) + shard.shape), d)
+
+
+def scatter_grad(grad_full, entry, axis_name: str, n: int,
+                 mode: str = "ring"):
+    """Reduce-scatter one full-leaf gradient back to this device's shard
+    (SUM over the axis), in fp32 — the transpose of ``gather_leaf``."""
+    if entry is None or n == 1:
+        return grad_full
+    d, _ = entry
+    chunks = _chunks_from_full(grad_full.astype(jnp.float32), d, n)
+    if mode == "fused":
+        return jax.lax.psum_scatter(chunks.reshape(-1), axis_name,
+                                    scatter_dimension=0, tiled=True) \
+            .reshape(chunks.shape[1:])
+    return overlap_lib.ring_reduce_scatter(
+        chunks.reshape(-1), axis_name, n).reshape(chunks.shape[1:])
+
+
+def _gather_groups(group_bufs, axis_name, n, mode):
+    """Per-group packed shard [K_g] → gathered [n, K_g] (row j = device
+    j's shard) — ONE collective per group per layer."""
+    out = []
+    for buf in group_bufs:
+        if mode == "fused":
+            out.append(jax.lax.all_gather(buf, axis_name))
+        else:
+            out.append(overlap_lib.ring_all_gather(buf, axis_name, n)
+                       .reshape(n, buf.size))
+    return tuple(out)
+
+
+def _unpack_layer_full(gathered, shard_leaves, layer_plan: LayerPlan):
+    """Per-group gathered [n, K_g] buffers → full per-layer leaves (dict
+    id → array)."""
+    out = {}
+    for (_, ids), buf in zip(layer_plan.groups, gathered):
+        off = 0
+        for i in ids:
+            shard_shape = shard_leaves[i].shape[1:]
+            m = int(np.prod(shard_shape or (1,)))
+            d, _ = layer_plan.plan[i]
+            chunks = jax.lax.dynamic_slice_in_dim(buf, off, m, 1) \
+                .reshape((layer_plan.n,) + shard_shape)
+            out[i] = _full_from_chunks(chunks, d)
+            off += m
+    return out
+
+
+def _scatter_layer_grads(grads_by_id, shard_leaves, layer_plan: LayerPlan,
+                         axis_name, n, mode):
+    """Full per-layer grad leaves → per-leaf fp32 shard grads (dict id →
+    array), SUM over the axis, packed so each layer costs one
+    reduce-scatter per dtype group."""
+    out = {}
+    for _, ids in layer_plan.groups:
+        parts = []
+        for i in ids:
+            d, _ = layer_plan.plan[i]
+            parts.append(_chunks_from_full(
+                grads_by_id[i].astype(jnp.float32), d, n)
+                .reshape(n, -1))
+        flat = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+        if mode == "fused":
+            shard = jax.lax.psum_scatter(flat.reshape(-1), axis_name,
+                                         scatter_dimension=0, tiled=True)
+        else:
+            shard = overlap_lib.ring_reduce_scatter(
+                flat.reshape(-1), axis_name, n)
+        off = 0
+        for i in ids:
+            shard_shape = shard_leaves[i].shape[1:]
+            m = int(np.prod(shard_shape or (1,)))
+            out[i] = jax.lax.dynamic_slice_in_dim(shard, off, m, 0) \
+                .reshape(shard_shape)
+            off += m
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the prefetched layer scan (inside shard_map)
+# ---------------------------------------------------------------------------
+
+def make_prefetched_scan(body: Callable, plan: Sequence, axis_name: str,
+                         n: int, mode: str = "ring"):
+    """Build ``scan_fn(x, layer_shards_tree) -> y`` running ``body(x,
+    layer_params_tree)`` over the leading layer dim of
+    ``layer_shards_tree`` with double-buffered parameter gathers.
+
+    ``plan`` is aligned with ``tree_leaves(layer_shards_tree)`` in
+    STACKED coordinates ((dim, shard_size), dim >= 1, or None for
+    replicated leaves). ``body`` receives FULL (gathered) per-layer
+    leaves and must be rng-free (the engine gates dropout off).
+
+    Custom VJP: the backward scan runs in reverse, re-gathering layer
+    i-1 while layer i's VJP computes and reduce-scattering layer i's
+    parameter gradients in the same iteration. Returns gradients for
+    sharded leaves as fp32 SHARDS summed over the axis; replicated
+    leaves' gradients are LOCAL (caller reduces them).
+    """
+    if mode not in ("ring", "fused"):
+        raise ValueError(f"mode must be 'ring' or 'fused', got {mode!r}")
+    plan = tuple(tuple(e) if e is not None else None for e in plan)
+
+    def _prep(layer_shards):
+        leaves, tdef = jax.tree_util.tree_flatten(layer_shards)
+        lp = build_layer_plan(leaves, plan, n)
+        return leaves, tdef, lp
+
+    def _layer_tree(tdef, lp, leaves, full_by_id, repl_sliced):
+        per_layer: List[Any] = [None] * len(leaves)
+        for i in lp.sharded_ids:
+            per_layer[i] = full_by_id[i]
+        for i, leaf in zip(
+                (j for j, e in enumerate(lp.plan) if e is None), repl_sliced):
+            per_layer[i] = leaf
+        return jax.tree_util.tree_unflatten(tdef, per_layer)
+
+    @jax.custom_vjp
+    def scan_fn(x, layer_shards):
+        y, _ = _forward(x, layer_shards)
+        return y
+
+    def _forward(x, layer_shards):
+        leaves, tdef, lp = _prep(layer_shards)
+        L = leaves[0].shape[0]
+        repl_ids = [j for j, e in enumerate(lp.plan) if e is None]
+        repl_stack = tuple(leaves[j] for j in repl_ids)
+        if not lp.sharded_ids:
+            # nothing sharded (persistence threshold kept every leaf
+            # replicated): a plain scan, no gathers
+            def step0(carry, inp):
+                lt = _layer_tree(tdef, lp, leaves, {}, inp)
+                return body(carry, lt), carry
+            y, xs_saved = jax.lax.scan(step0, x, repl_stack, length=L)
+            return y, (xs_saved, layer_shards)
+
+        # stacked packed buffers: [L, K_g] per dtype group
+        packed_groups = tuple(
+            jnp.concatenate([leaves[i].reshape(L, -1) for i in ids], axis=1)
+            if len(ids) > 1 else leaves[ids[0]].reshape(L, -1)
+            for _, ids in lp.groups)
+        g0 = _gather_groups(tuple(pg[0] for pg in packed_groups),
+                            axis_name, n, mode)
+        # iteration i's scan input carries layer i+1's shards (the last
+        # iteration re-gathers layer 0 — one redundant gather that
+        # overlaps the final layer's compute and keeps the scan uniform)
+        nxt = tuple(jnp.roll(pg, -1, axis=0) for pg in packed_groups)
+
+        def step(carry, inp):
+            xc, g_cur = carry
+            nxt_bufs, repl_i = inp
+            g_nxt = _gather_groups(nxt_bufs, axis_name, n, mode)
+            full = _unpack_layer_full(g_cur, leaves, lp)
+            lt = _layer_tree(tdef, lp, leaves, full, repl_i)
+            y = body(xc, lt)
+            return (y, g_nxt), xc
+
+        (y, _), xs_saved = jax.lax.scan(step, (x, g0), (nxt, repl_stack))
+        return y, (xs_saved, layer_shards)
+
+    def _fwd(x, layer_shards):
+        y, res = _forward(x, layer_shards)
+        return y, res
+
+    def _bwd(res, dy):
+        xs_saved, layer_shards = res
+        leaves, tdef, lp = _prep(layer_shards)
+        L = leaves[0].shape[0]
+        repl_ids = [j for j, e in enumerate(lp.plan) if e is None]
+        repl_stack = tuple(leaves[j] for j in repl_ids)
+
+        def layer_vjp(x_i, lt, dx):
+            _, vjp = jax.vjp(lambda xx, pp: body(xx, pp), x_i, lt)
+            return vjp(dx)
+
+        if not lp.sharded_ids:
+            def bstep0(dx, inp):
+                x_i, repl_i = inp
+                lt = _layer_tree(tdef, lp, leaves, {}, repl_i)
+                dxi, dlt = layer_vjp(x_i, lt, dx)
+                return dxi, tuple(jax.tree_util.tree_leaves(dlt))
+            dx0, dleaves = jax.lax.scan(bstep0, dy, (xs_saved, repl_stack),
+                                        reverse=True)
+            dtree = jax.tree_util.tree_unflatten(tdef, list(dleaves))
+            return dx0, dtree
+
+        packed_groups = tuple(
+            jnp.concatenate([leaves[i].reshape(L, -1) for i in ids], axis=1)
+            if len(ids) > 1 else leaves[ids[0]].reshape(L, -1)
+            for _, ids in lp.groups)
+        gL = _gather_groups(tuple(pg[-1] for pg in packed_groups),
+                            axis_name, n, mode)
+        # backward iteration i consumes layer i's gathered buffer (in the
+        # carry) and prefetches layer i-1's (the NEXT backward step);
+        # iteration 0 redundantly re-gathers layer L-1, mirroring forward
+        prev = tuple(jnp.roll(pg, 1, axis=0) for pg in packed_groups)
+
+        def bstep(carry, inp):
+            dx, g_cur = carry
+            x_i, prev_bufs, repl_i = inp
+            g_prev = _gather_groups(prev_bufs, axis_name, n, mode)
+            full = _unpack_layer_full(g_cur, leaves, lp)
+            lt = _layer_tree(tdef, lp, leaves, full, repl_i)
+            dxi, dlt = layer_vjp(x_i, lt, dx)
+            d_leaves = jax.tree_util.tree_leaves(dlt)
+            d_by_id = {i: d_leaves[i] for i in lp.sharded_ids}
+            # layer i's param-grad reduce-scatter rides the same ring the
+            # re-gather of layer i-1 just seeded — both directions busy
+            d_shards = _scatter_layer_grads(d_by_id, leaves, lp,
+                                            axis_name, n, mode)
+            ys = (tuple(d_shards[i] for i in lp.sharded_ids),
+                  tuple(d_leaves[j] for j in repl_ids))
+            return (dxi, g_prev), ys
+
+        (dx0, _), (dshard_stack, drepl_stack) = jax.lax.scan(
+            bstep, (dy, gL), (xs_saved, prev, repl_stack), reverse=True)
+
+        out: List[Any] = [None] * len(leaves)
+        for k, i in enumerate(lp.sharded_ids):
+            out[i] = dshard_stack[k]
+        for k, j in enumerate(repl_ids):
+            out[j] = drepl_stack[k]
+        return dx0, jax.tree_util.tree_unflatten(tdef, out)
+
+    scan_fn.defvjp(_fwd, _bwd)
+    return scan_fn
+
+
+# ---------------------------------------------------------------------------
+# outer (non-layer) sharded params
+# ---------------------------------------------------------------------------
+
+def make_gathered_param(entry, axis_name: str, n: int, mode: str = "ring"):
+    """``g(shard) -> full`` for one non-layer sharded leaf (wte/wpe/...),
+    with a custom VJP whose backward reduce-scatters the cotangent (SUM
+    over the axis, fp32) instead of relying on transpose rules the
+    legacy shard_map lowering lacks. Gathered once per step — these
+    leaves are live for the whole step (embedding at the entry, head at
+    the exit), like the reference's persistent parameters."""
+
+    @jax.custom_vjp
+    def g(shard):
+        return gather_leaf(shard, entry, axis_name, n, mode)
+
+    def fwd(shard):
+        return g(shard), None
+
+    def bwd(_, cot):
+        return (scatter_grad(cot, entry, axis_name, n, mode),)
+
+    g.defvjp(fwd, bwd)
+    return g

@@ -308,6 +308,13 @@ class DeepSpeedEngine:
                 raise ValueError(
                     "offload_optimizer device=nvme requires nvme_path")
 
+        # stage3_prefetch decides BEFORE state init: the partitioner must
+        # exclude the layer dim from stacked-leaf sharding so the prefetch
+        # scan (parallel/prefetch.py) can slice whole layers device-locally
+        if self._prefetch_active():
+            self.zero.layer_stacked_prefixes = (
+                self.module.prefetch_layer_subtree,)
+
         self._rng = rng if rng is not None else jax.random.PRNGKey(self._config.seed)
         self.state: Optional[TrainState] = None
         self.state_shardings = None
@@ -409,10 +416,8 @@ class DeepSpeedEngine:
         dp = mesh_lib.mesh_axis_size(self.mesh, mesh_lib.DATA_AXIS)
         if dp <= 1:
             return False
-        pure_dp = (self.zero_optimization_stage() == 0 and all(
-            mesh_lib.mesh_axis_size(self.mesh, a) == 1
-            for a in (mesh_lib.PIPE_AXIS, mesh_lib.SEQ_AXIS,
-                      mesh_lib.MODEL_AXIS, mesh_lib.EXPERT_AXIS)))
+        pure_dp = (self.zero_optimization_stage() == 0
+                   and self._pure_dp_mesh())
         if not pure_dp:
             logger.warning(
                 "1-bit optimizer requested with ZeRO stage "
@@ -420,6 +425,15 @@ class DeepSpeedEngine:
                 "compressed communication disabled (exact-comm fallback)")
             return False
         return True
+
+    def _pure_dp_mesh(self):
+        """True when only the data axis is live — the explicit-comm
+        train paths shard_map the data axis alone, so every other mesh
+        axis must be trivial (the one shared gate of the 1-bit / CSR /
+        overlap / prefetch dispatch)."""
+        return all(mesh_lib.mesh_axis_size(self.mesh, a) == 1
+                   for a in (mesh_lib.PIPE_AXIS, mesh_lib.SEQ_AXIS,
+                             mesh_lib.MODEL_AXIS, mesh_lib.EXPERT_AXIS))
 
     # ------------------------------------------------------------------
     # state init
@@ -623,7 +637,8 @@ class DeepSpeedEngine:
                     stage=self.zero_optimization_stage(),
                     tp_specs=self._param_tp_specs,
                     param_persistence_threshold=(
-                        self._config.zero_config.param_persistence_threshold))
+                        self._config.zero_config.param_persistence_threshold),
+                    layer_stacked_prefixes=self.zero.layer_stacked_prefixes)
                 return params
             except Exception as e:
                 logger.warning(f"sharded init unavailable ({e}); "
@@ -914,8 +929,16 @@ class DeepSpeedEngine:
             self._jit_train_batch = self._build_compressed_train_fn(loss_fn)
         elif self._sparse_grad_active():
             self._jit_train_batch = self._build_sparse_train_fn(loss_fn)
+        elif self._prefetch_active():
+            self._jit_train_batch = self._build_prefetch_train_fn()
         elif self._overlap_comm_active():
             self._jit_train_batch = self._build_overlap_train_fn(loss_fn)
+        if not self._prefetch_active():
+            # the live-gathered registry describes the most recently
+            # BUILT train path; a non-prefetch engine must not inherit
+            # a previous engine's prefetch window in see_memory_usage
+            from deepspeed_tpu.utils import memory as memory_lib
+            memory_lib.record_live_gathered_param_bytes(None)
 
         try:
             accepts_det = "deterministic" in inspect.signature(
@@ -1127,11 +1150,7 @@ class DeepSpeedEngine:
             return False
         if mesh_lib.mesh_axis_size(self.mesh, mesh_lib.DATA_AXIS) <= 1:
             return False
-        pure_dp = all(
-            mesh_lib.mesh_axis_size(self.mesh, a) == 1
-            for a in (mesh_lib.PIPE_AXIS, mesh_lib.SEQ_AXIS,
-                      mesh_lib.MODEL_AXIS, mesh_lib.EXPERT_AXIS))
-        if not pure_dp:
+        if not self._pure_dp_mesh():
             log_dist("overlap_comm: non-data mesh axes are live — the "
                      "explicit bucket scheduler shard_maps the data axis "
                      "only; falling back to the fused GSPMD exchange",
@@ -1140,8 +1159,9 @@ class DeepSpeedEngine:
         if self.zero_optimization_stage() >= 3:
             log_dist("overlap_comm supports ZeRO stages 0-2 (stage 3 "
                      "shards params at rest, which the explicit path does "
-                     "not re-gather); falling back to the fused GSPMD "
-                     "exchange", ranks=[0])
+                     "not re-gather) — for the stage-3 explicit path set "
+                     "zero_optimization.stage3_prefetch; falling back to "
+                     "the fused GSPMD exchange", ranks=[0])
             return False
         if not getattr(self.optimizer, "elementwise_update", False):
             log_dist(f"overlap_comm needs an elementwise optimizer "
@@ -1266,6 +1286,381 @@ class DeepSpeedEngine:
 
         return self._jit_explicit_comm(train_fn)
 
+    def _prefetch_active(self):
+        """True when the train step should run the ZeRO-3 layer-wise
+        parameter-gather prefetch pipeline (parallel/prefetch.py): the
+        explicit-comm stage-3 train path that all-gathers each layer's
+        param shards ONE LAYER AHEAD of use (double-buffered, forward
+        and backward) and reduce-scatters each layer's param grads
+        inside the backward scan — the reference's
+        PartitionedParameterCoordinator prefetch (stage3.py:287-447)
+        made structural. Requires a multi-device pure-DP data axis, an
+        elementwise optimizer, and a model exposing the layered-apply
+        contract (prefetch_apply + prefetch_layer_subtree)."""
+        cached = getattr(self, "_prefetch_cached", None)
+        if cached is None:
+            cached = self._prefetch_cached = self._compute_prefetch()
+        return cached
+
+    def _compute_prefetch(self):
+        zc = self._config.zero_config
+        if not zc.stage3_prefetch:
+            return False
+        if self._offload_cfg.enabled or self._param_offload_host or \
+                self._param_offload_nvme:
+            log_dist("stage3_prefetch: offload tiers stream params/state "
+                     "through host memory on their own schedule; falling "
+                     "back to the fused GSPMD stage-3 exchange", ranks=[0])
+            return False
+        if self._compressed_comm_active() or self._sparse_grad_active():
+            return False
+        if mesh_lib.mesh_axis_size(self.mesh, mesh_lib.DATA_AXIS) <= 1:
+            log_dist("stage3_prefetch: single-device data axis — nothing "
+                     "is sharded, the fused path is the whole program",
+                     ranks=[0])
+            return False
+        if not self._pure_dp_mesh():
+            log_dist("stage3_prefetch: non-data mesh axes are live — the "
+                     "prefetch pipeline shard_maps the data axis only; "
+                     "falling back to the fused GSPMD exchange", ranks=[0])
+            return False
+        sub = getattr(self.module, "prefetch_layer_subtree", None)
+        if not sub or not hasattr(self.module, "prefetch_apply"):
+            log_dist(f"stage3_prefetch: {type(self.module).__name__} does "
+                     f"not expose the layered-apply contract "
+                     f"(prefetch_apply + a non-None prefetch_layer_subtree "
+                     f"— scanned layers, no MoE, no dropout); falling back "
+                     f"to the fused GSPMD exchange", ranks=[0])
+            return False
+        if self._loss_fn_user is not None:
+            log_dist("stage3_prefetch: a custom loss_fn drives model.apply "
+                     "itself, which the layered pipeline cannot intercept; "
+                     "falling back to the fused GSPMD exchange", ranks=[0])
+            return False
+        if not getattr(self.optimizer, "elementwise_update", False):
+            log_dist(f"stage3_prefetch needs an elementwise optimizer "
+                     f"(Adam/AdamW/SGD) — the per-shard ZeRO-3 update "
+                     f"slices tensors, which breaks per-tensor statistics "
+                     f"of {type(self.optimizer).__name__}; falling back to "
+                     f"the fused GSPMD exchange", ranks=[0])
+            return False
+        return True
+
+    def prefetch_live_param_stats(self):
+        """Static live-parameter accounting of the prefetch pipeline
+        (populated when the stage3_prefetch train path is built): peak
+        gathered-full-parameter elements/bytes — two layers (current +
+        in-flight) plus the step-persistent outer gathers — the
+        observable behind ``stage3_max_live_parameters``. None when the
+        prefetch path is not active/built."""
+        return getattr(self, "_prefetch_stats", None)
+
+    def _build_prefetch_train_fn(self):
+        """shard_map train step for ZeRO-3 with layer-wise gather
+        prefetch: params/moments stay SHARDED through the whole step
+        (in_specs = out_specs = the stage-3 resting specs — no
+        gather-at-entry, no re-shard at exit). The forward/backward run
+        through parallel/prefetch.make_prefetched_scan (double-buffered
+        per-layer gathers; backward interleaves each layer's re-gather
+        with its grad reduce-scatter); outer leaves (embeddings, final
+        LN, head) gather once per step via gathered-param custom VJPs;
+        below-threshold replicated leaves exchange through the PR-1
+        bucketed allreduce (overlap_comm's machinery) — composing both
+        explicit schedulers in one program."""
+        from deepspeed_tpu.parallel import overlap as overlap_lib
+        from deepspeed_tpu.parallel import prefetch as prefetch_lib
+        mesh = self.mesh
+        axis = mesh_lib.DATA_AXIS
+        cfg = self._config
+        zc = cfg.zero_config
+        n = mesh_lib.mesh_axis_size(mesh, axis)
+        lr_fn = self._lr_fn()
+        opt = self.optimizer
+        precision = self.precision
+        model = self.module
+        subtree = model.prefetch_layer_subtree
+        mode = zc.stage3_prefetch_gather
+        cast_bf16 = cfg.grad_dtype == "bf16"
+        bucket_elems = int(zc.prefetch_bucket_size)
+        spec_like = lambda tree, s: jax.tree_util.tree_map(  # noqa: E731
+            lambda _: s, tree)
+        tm = jax.tree_util.tree_map
+
+        params = self.state.params
+        param_spec_tree = self.zero.param_specs(params)
+        full_plan = self.zero.explicit_shard_plan(params,
+                                                  specs=param_spec_tree)
+        layer_plan = self.zero.explicit_shard_plan(
+            params[subtree], specs=param_spec_tree[subtree])
+        outer_keys = [k for k in params if k != subtree]
+        outer_plans = {k: self.zero.explicit_shard_plan(
+            params[k], specs=param_spec_tree[k]) for k in outer_keys}
+
+        self._record_prefetch_stats(params, subtree, layer_plan,
+                                    outer_plans, cast_bf16)
+
+        def gather_outer(p):
+            out = {}
+            for k in outer_keys:
+                leaves, tdef = jax.tree_util.tree_flatten(p[k])
+                gathered = [
+                    prefetch_lib.make_gathered_param(e, axis, n, mode)(x)
+                    if e is not None else x
+                    for x, e in zip(leaves, outer_plans[k])]
+                out[k] = jax.tree_util.tree_unflatten(tdef, gathered)
+            return out
+
+        def micro_loss(p_view, micro, keep_prob):
+            # the model builds the per-layer body (it closes over
+            # keep_prob) and hands it in through the layer_scan hook
+            def run_layers(body, x, h_shards):
+                return prefetch_lib.make_prefetched_scan(
+                    body, layer_plan, axis, n, mode=mode)(x, h_shards)
+            if isinstance(micro, dict) and "input_ids" in micro:
+                ids = micro["input_ids"]
+                labels = micro.get("labels", micro["input_ids"])
+            else:
+                ids = micro
+                labels = micro
+            return model.prefetch_apply(p_view, ids, run_layers,
+                                        deterministic=True,
+                                        keep_prob=keep_prob, labels=labels)
+
+        gas = self.gradient_accumulation_steps()
+        keep_fn = self._keep_prob_fn()
+
+        def cast_params(p):
+            if not cast_bf16:
+                return p
+            return tm(lambda x: x.astype(jnp.bfloat16)
+                      if x.dtype == jnp.float32 else x, p)
+
+        def accumulate(state, batch, rng):
+            """Prefetch-path twin of _local_grad_accumulator. Dropout
+            is gated off, so no per-micro rng plumbing; grads come back
+            fp32 (sharded leaves as SUMS over the axis), loss locally
+            averaged.
+
+            gas == 1 differentiates straight through the gather custom
+            VJPs. gas > 1 hoists the OUTER gathers above the microbatch
+            scan — wte/wpe/head gather once per STEP — and runs the
+            per-micro ``jax.grad`` with the gathered view as an
+            EXPLICIT argument (grad-inside-scan: a custom-VJP call on a
+            tracer closed over INTO a differentiated scan would need
+            the unsupported custom_vjp transpose). Outer cotangents
+            accumulate in gathered space and reduce-scatter ONCE at the
+            end; only the per-layer pipeline (whose per-micro exchange
+            is the point) communicates inside the scan."""
+            del rng
+            scale = state.scaler["loss_scale"]
+            keep_prob = keep_fn(state.global_step)
+
+            if gas == 1:
+                def total(p_shard):
+                    p = cast_params(p_shard)
+                    p_view = gather_outer(p)
+                    p_view[subtree] = p[subtree]
+                    loss = micro_loss(p_view, batch, keep_prob)
+                    return (loss * scale).astype(jnp.float32), loss
+                grads, loss = jax.grad(total, has_aux=True)(state.params)
+                return tm(lambda g: g.astype(jnp.float32), grads), loss
+
+            p = cast_params(state.params)
+            outer_view = {}
+            for k in outer_keys:
+                leaves, tdef = jax.tree_util.tree_flatten(p[k])
+                outer_view[k] = jax.tree_util.tree_unflatten(tdef, [
+                    prefetch_lib.gather_leaf(x, e, axis, n, mode)
+                    for x, e in zip(leaves, outer_plans[k])])
+            h_shards = p[subtree]
+
+            def micro_grads(view, hs, micro):
+                def f(v, h):
+                    pv = dict(v)
+                    pv[subtree] = h
+                    loss = micro_loss(pv, micro, keep_prob)
+                    return (loss * scale).astype(jnp.float32), loss
+                return jax.grad(f, argnums=(0, 1), has_aux=True)(view, hs)
+
+            chunked = tm(lambda x: x.reshape(
+                (gas, x.shape[0] // gas) + x.shape[1:]), batch)
+
+            def body(acc, micro):
+                acc_v, acc_h, acc_l = acc
+                (gv, gh), loss = micro_grads(outer_view, h_shards, micro)
+                add = lambda a, g: a + g.astype(jnp.float32) / gas  # noqa: E731
+                return (tm(add, acc_v, gv), tm(add, acc_h, gh),
+                        acc_l + loss / gas), None
+
+            zeros = lambda t: tm(  # noqa: E731
+                lambda x: jnp.zeros(x.shape, jnp.float32), t)
+            (g_view, g_h, loss), _ = jax.lax.scan(
+                body, (zeros(outer_view), zeros(h_shards),
+                       jnp.float32(0.0)), chunked)
+
+            # manual outer backward: the accumulated gathered-space
+            # cotangents reduce-scatter once (SUM over the axis, like
+            # the gas==1 custom-VJP path); replicated leaves stay local
+            grads = {subtree: g_h}
+            for k in outer_keys:
+                leaves, tdef = jax.tree_util.tree_flatten(g_view[k])
+                grads[k] = jax.tree_util.tree_unflatten(tdef, [
+                    prefetch_lib.scatter_grad(x, e, axis, n, mode)
+                    for x, e in zip(leaves, outer_plans[k])])
+            return grads, loss
+
+        opt_specs = {
+            k: param_spec_tree
+            if k in getattr(opt, "param_like_state_fields", ())
+            else spec_like(v, PartitionSpec())
+            for k, v in self.state.opt_state.items()}
+        state_specs = TrainState(
+            params=param_spec_tree,
+            opt_state=opt_specs,
+            scaler=spec_like(self.state.scaler, PartitionSpec()),
+            global_step=PartitionSpec(),
+            skipped_steps=PartitionSpec())
+        takes_gscale = "grad_scale" in inspect.signature(opt.step).parameters
+        inv_n = np.float32(1.0 / n)
+
+        def train_fn(state, batch, rng):
+            batch_specs = spec_like(batch, PartitionSpec(axis))
+
+            @functools.partial(
+                mesh_lib.shard_map, mesh=mesh,
+                in_specs=(state_specs, batch_specs, PartitionSpec()),
+                out_specs=(state_specs, spec_like(
+                    {"loss": 0, "grad_norm": 0, "lr": 0, "overflow": 0,
+                     "loss_scale": 0}, PartitionSpec())),
+                check_vma=False)
+            def inner(state, batch, rng):
+                grads, loss = accumulate(state, batch, rng)
+                loss = jax.lax.pmean(loss, axis)
+                # sharded-leaf grads came back reduce-scattered as SUMS
+                # over the axis (the custom VJPs); scale to the mean.
+                # Replicated (below-threshold) leaves are LOCAL — they
+                # mean-exchange through the PR-1 bucket stream.
+                g_leaves, g_tdef = jax.tree_util.tree_flatten(grads)
+                g_leaves = [g * inv_n if e is not None else g
+                            for g, e in zip(g_leaves, full_plan)]
+                repl_ids = [i for i, e in enumerate(full_plan)
+                            if e is None]
+                if repl_ids:
+                    red = overlap_lib.bucketed_allreduce(
+                        [g_leaves[i] for i in repl_ids], axis, n,
+                        bucket_elems, mode=mode, mean=True)
+                    for i, g in zip(repl_ids, red):
+                        g_leaves[i] = g
+                grads = jax.tree_util.tree_unflatten(g_tdef, g_leaves)
+
+                scale = state.scaler["loss_scale"]
+                inv = 1.0 / scale
+                local_finite = prec.grads_finite(grads) if precision.fp16 \
+                    else jnp.asarray(True)
+                finite = jax.lax.pmin(
+                    local_finite.astype(jnp.int32), axis) > 0
+                # exact global norm: sharded leaves partition the full
+                # tensor across the axis (psum of shard norms covers each
+                # element once); replicated grads are identical everywhere
+                shard_sq = sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g, e in zip(g_leaves, full_plan) if e is not None)
+                repl_sq = sum(
+                    jnp.sum(jnp.square(g_leaves[i].astype(jnp.float32)))
+                    for i in repl_ids)
+                grad_norm = jnp.sqrt(
+                    jax.lax.psum(jnp.float32(shard_sq), axis)
+                    + jnp.float32(repl_sq))
+                gscale = inv
+                if cfg.gradient_clipping and cfg.gradient_clipping > 0:
+                    gscale = inv * jnp.minimum(
+                        1.0, cfg.gradient_clipping /
+                        (grad_norm * inv + 1e-6))
+                lr = lr_fn(state.global_step)
+
+                # ZeRO-3 update runs entirely on local shards: params and
+                # moments already rest in the shard layout — no slicing,
+                # no post-update gather (params stay sharded at rest)
+                if takes_gscale:
+                    new_params, new_opt = opt.step(
+                        state.params, grads, state.opt_state, lr,
+                        grad_scale=gscale)
+                else:
+                    grads = tm(lambda g: g * gscale, grads)
+                    new_params, new_opt = opt.step(state.params, grads,
+                                                   state.opt_state, lr)
+                new_state = self._finish_explicit_state(
+                    state, new_params, new_opt, finite, precision)
+                return new_state, {
+                    "loss": loss, "grad_norm": grad_norm * inv, "lr": lr,
+                    "overflow": ~finite,
+                    "loss_scale": new_state.scaler["loss_scale"]}
+
+            return inner(state, batch, rng)
+
+        return self._jit_explicit_comm(train_fn)
+
+    def _record_prefetch_stats(self, params, subtree, layer_plan,
+                               outer_plans, cast_bf16):
+        """Static live-gathered-parameter accounting (the
+        ``stage3_max_live_parameters`` observable, utils/memory.py)."""
+        from deepspeed_tpu.utils import memory as memory_lib
+
+        def leaf_bytes_per_el(leaf):
+            return 2 if (cast_bf16 and leaf.dtype == jnp.float32) \
+                else jnp.dtype(leaf.dtype).itemsize
+
+        layer_leaves = jax.tree_util.tree_leaves(params[subtree])
+        per_layer_elems = per_layer_bytes = 0
+        persistent_elems = persistent_bytes = 0
+        for leaf, e in zip(layer_leaves, layer_plan):
+            full = int(np.prod(leaf.shape[1:] or (1,)))
+            if e is None:
+                # below-persistence-threshold stacked leaves stay FULLY
+                # replicated (all layers resident) — persistent share
+                persistent_elems += full * leaf.shape[0]
+                persistent_bytes += full * leaf.shape[0] * \
+                    leaf_bytes_per_el(leaf)
+                continue
+            per_layer_elems += full
+            per_layer_bytes += full * leaf_bytes_per_el(leaf)
+        outer_elems = outer_bytes = 0
+        for k, plan in outer_plans.items():
+            for leaf, e in zip(jax.tree_util.tree_leaves(params[k]), plan):
+                full = int(np.prod(leaf.shape or (1,)))
+                if e is None:
+                    persistent_elems += full
+                    persistent_bytes += full * leaf_bytes_per_el(leaf)
+                    continue
+                outer_elems += full
+                outer_bytes += full * leaf_bytes_per_el(leaf)
+        n_layers = layer_leaves[0].shape[0] if layer_leaves else 0
+        stats = {
+            # double buffer (computing layer + in-flight gather) + the
+            # step-persistent full leaves: outer gathers AND replicated
+            # below-threshold leaves (always resident) — the full live
+            # window stage3_max_live_parameters governs
+            "live_param_elements": 2 * per_layer_elems + outer_elems
+            + persistent_elems,
+            "live_param_bytes": 2 * per_layer_bytes + outer_bytes
+            + persistent_bytes,
+            "per_layer_gather_bytes": per_layer_bytes,
+            "outer_gather_bytes": outer_bytes,
+            "persistent_replicated_bytes": persistent_bytes,
+            "layers": int(n_layers),
+        }
+        self._prefetch_stats = stats
+        memory_lib.record_live_gathered_param_bytes(
+            stats["live_param_bytes"])
+        max_live = int(self._config.zero_config.max_live_parameters)
+        if max_live and stats["live_param_elements"] > max_live:
+            logger.warning(
+                f"stage3_prefetch: the 2-layer double buffer holds "
+                f"{stats['live_param_elements']} full-parameter elements "
+                f"live, above stage3_max_live_parameters={max_live}; the "
+                f"pipeline depth is structural (one layer ahead) — raise "
+                f"the knob or shrink the layer")
+
     def _sparse_grad_active(self):
         """True when the train step should exchange embedding gradients
         row-compressed (reference sparse_gradients, engine.py:195-202 +
@@ -1274,11 +1669,7 @@ class DeepSpeedEngine:
         gradients implicitly with no collective to replace."""
         if not self._config.sparse_gradients_enabled:
             return False
-        pure_dp = all(
-            mesh_lib.mesh_axis_size(self.mesh, a) == 1
-            for a in (mesh_lib.MODEL_AXIS, mesh_lib.SEQ_AXIS,
-                      mesh_lib.PIPE_AXIS, mesh_lib.EXPERT_AXIS))
-        if not pure_dp or self.zero_optimization_stage() > 0 \
+        if not self._pure_dp_mesh() or self.zero_optimization_stage() > 0 \
                 or self._offload_cfg.enabled \
                 or self._compressed_comm_active():
             log_dist("sparse_gradients requires a pure-DP mesh with ZeRO "
@@ -1497,7 +1888,7 @@ class DeepSpeedEngine:
             metrics = self._host_offload_step(batch)
         elif self.wall_clock_breakdown() and not (
                 self._compressed_comm_active() or self._sparse_grad_active()
-                or self._overlap_comm_active()):
+                or self._overlap_comm_active() or self._prefetch_active()):
             # (1-bit / CSR / overlap paths keep their fused shard_map
             # programs — their comm scheduling lives inside the step and
             # cannot be split into phase programs)

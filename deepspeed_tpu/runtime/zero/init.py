@@ -31,7 +31,7 @@ from deepspeed_tpu.parallel import mesh as mesh_lib
 
 
 def sharded_init(model, rng, example_input, mesh, stage=3, tp_specs=None,
-                 param_persistence_threshold=0):
+                 param_persistence_threshold=0, layer_stacked_prefixes=()):
     """Initialize a flax model with every parameter born sharded.
 
     Two-phase: ``jax.eval_shape`` discovers shapes without allocating, the
@@ -45,6 +45,7 @@ def sharded_init(model, rng, example_input, mesh, stage=3, tp_specs=None,
     params_shapes = shapes["params"] if "params" in shapes else shapes
     part = ZeroPartitioner(mesh, stage, tp_specs=tp_specs,
                            param_persistence_threshold=param_persistence_threshold)
+    part.layer_stacked_prefixes = tuple(layer_stacked_prefixes)
     shardings = part.param_shardings(params_shapes)
 
     @jax.jit

@@ -41,10 +41,13 @@ def shard_spec_for_leaf(shape,
                         dp_size: int,
                         base_spec: Optional[PartitionSpec] = None,
                         min_size: int = 0,
-                        axis_name: str = mesh_lib.DATA_AXIS) -> PartitionSpec:
+                        axis_name: str = mesh_lib.DATA_AXIS,
+                        exclude_dims=()) -> PartitionSpec:
     """Extend ``base_spec`` (TP sharding) with a data-axis shard on the
     largest free, divisible dimension. Returns base_spec unchanged if no
-    dimension qualifies or the tensor is below ``min_size`` elements."""
+    dimension qualifies or the tensor is below ``min_size`` elements.
+    ``exclude_dims`` removes dimensions from candidacy — the prefetch
+    pipeline needs layer-stacked leaves whole along their layer dim."""
     base = tuple(base_spec) if base_spec is not None else ()
     base = base + (None,) * (len(shape) - len(base))
     if dp_size <= 1 or int(np.prod(shape or (1,))) < max(min_size, dp_size):
@@ -52,7 +55,8 @@ def shard_spec_for_leaf(shape,
     # candidate dims: unsharded, divisible by dp, largest first
     candidates = sorted(
         (d for d in range(len(shape))
-         if base[d] is None and shape[d] % dp_size == 0 and shape[d] >= dp_size),
+         if d not in exclude_dims and base[d] is None
+         and shape[d] % dp_size == 0 and shape[d] >= dp_size),
         key=lambda d: shape[d], reverse=True)
     if not candidates:
         return PartitionSpec(*base)
@@ -80,6 +84,11 @@ class ZeroPartitioner:
         # host DRAM (reference offload_param, partitioned_param_swapper.py:36)
         # and stream to HBM inside the step via device_put
         self.param_memory_kind = param_memory_kind
+        # top-level param-tree keys whose leaves are layer-stacked
+        # ([L, ...]): their dim 0 is never a shard candidate, so the
+        # stage3_prefetch pipeline can slice whole layers device-locally
+        # (the engine sets this when the prefetch path is active)
+        self.layer_stacked_prefixes = ()
 
     # -- spec trees --------------------------------------------------------
     def _base_spec(self, path, leaf):
@@ -101,8 +110,14 @@ class ZeroPartitioner:
 
     def _zero_spec(self, path, leaf):
         base = self._base_spec(path, leaf)
+        exclude = ()
+        if self.layer_stacked_prefixes and path:
+            head = getattr(path[0], "key", getattr(path[0], "name", None))
+            if head in self.layer_stacked_prefixes:
+                exclude = (0,)
         return shard_spec_for_leaf(leaf.shape, self.dp, base,
-                                   min_size=self.min_size)
+                                   min_size=self.min_size,
+                                   exclude_dims=exclude)
 
     def _tp_only_spec(self, path, leaf):
         base = self._base_spec(path, leaf)
@@ -162,7 +177,7 @@ class ZeroPartitioner:
                     lambda _: NamedSharding(self.mesh, PartitionSpec()), sub)
         return out
 
-    def explicit_shard_plan(self, params):
+    def explicit_shard_plan(self, params, specs=None):
         """Per-leaf update ownership for the explicit-comm (shard_map)
         overlap train path: a list aligned with ``tree_leaves(params)`` of
         ``(dim, shard_size)`` — the data-axis dim the stage>=1 optimizer
@@ -171,21 +186,17 @@ class ZeroPartitioner:
         update redundantly, which is exact). Inside shard_map the owner
         device updates params[dim slice] with its local moment shard and
         the slices all-gather back (the stage-1/2 updated-param all-gather,
-        stage2.py:~1470, made explicit)."""
+        stage2.py:~1470, made explicit). ``specs`` overrides the moment
+        spec tree (the stage3_prefetch path passes its param specs so
+        the plan matches the resting layout exactly)."""
+        from deepspeed_tpu.parallel.prefetch import plan_from_specs
         leaves = jax.tree_util.tree_leaves(params)
-        specs = jax.tree_util.tree_leaves(
-            self.opt_param_like_specs(params),
-            is_leaf=lambda x: isinstance(x, PartitionSpec))
-        plan = []
-        for leaf, spec in zip(leaves, specs):
-            entry = None
-            for d, ax in enumerate(spec):
-                axes = ax if isinstance(ax, tuple) else (ax,)
-                if mesh_lib.DATA_AXIS in axes:
-                    entry = (d, leaf.shape[d] // self.dp)
-                    break
-            plan.append(entry)
-        return plan
+        if specs is None:
+            specs = self.opt_param_like_specs(params)
+        spec_leaves = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+        return plan_from_specs(leaves, spec_leaves, mesh_lib.DATA_AXIS,
+                               self.dp)
 
     def constrain_grads(self, grads):
         """Apply the stage>=2 reduce-scatter constraint inside the train step."""

@@ -8,6 +8,26 @@ import resource
 
 from deepspeed_tpu.utils.logging import logger
 
+# peak live gathered-parameter bytes of the stage3_prefetch pipeline
+# (parallel/prefetch.py): STATIC accounting from the layer plan — two
+# gathered layers (current + in-flight double buffer) plus the
+# persistent (outer + below-threshold) full leaves. Recorded by the
+# engine when it builds the prefetch train path, so
+# ``stage3_max_live_parameters`` is observable/assertable instead of
+# on-faith. None until a prefetch engine has been built.
+_live_gathered_param_bytes = None
+
+
+def record_live_gathered_param_bytes(nbytes):
+    global _live_gathered_param_bytes
+    _live_gathered_param_bytes = int(nbytes) if nbytes is not None else None
+
+
+def live_gathered_param_bytes():
+    """Peak live gathered-parameter bytes of the most recently built
+    stage3_prefetch train path (None when no prefetch engine exists)."""
+    return _live_gathered_param_bytes
+
 
 def _device_memory_stats():
     try:
@@ -30,4 +50,7 @@ def see_memory_usage(message, force=False):
     lines = [message, f"Host MaxRSS {rss_mb:.1f} MB"]
     for name, in_use, limit in _device_memory_stats():
         lines.append(f"{name}: HBM in use {in_use / 2**30:.2f} GB / {limit / 2**30:.2f} GB")
+    if _live_gathered_param_bytes is not None:
+        lines.append(f"stage3_prefetch live gathered params "
+                     f"{_live_gathered_param_bytes / 2**20:.1f} MB")
     logger.info(" | ".join(lines))

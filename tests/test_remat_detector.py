@@ -10,11 +10,15 @@ eventual fix) are individually visible:
 - the 1F1B pipe-only shard_map program (PR 1's known follow-up) is now
   CLEAN — its per-leaf pipe specs no longer force a reshard — and must
   stay that way;
-- the expert-parallel MoE train step (dp x ep x tp) is the remaining
-  tripper: the token->expert regroup's sharding constraint flips the
-  layer-scan carry between batch- and expert-major layouts. Pinned as
-  strict xfail: fixing the specs turns it into an XPASS error, which is
-  the signal to drop the mark (tracking note in CHANGES.md, PR 2).
+- the expert-parallel MoE train step (dp x ep x tp) is now ALSO CLEAN
+  (PR 3): the layer-scan carry and the pos-embedding broadcast pin to
+  the batch layout on both primal and cotangent edges (gpt2._carry_pin),
+  and the token->expert regroup routes its batch-major <-> expert-major
+  flips through REPLICATED anchors (moe._expert_mesh_pin) — direct
+  tiled<->tiled conversion between the (data x expert)-iota and
+  expert-transposed device orders is unconvertible for the partitioner
+  and was the source of the remat. The former strict xfail is now a
+  plain pin and must stay clean.
 
 The C++ partitioner logs to stderr (not python logging), so each probe
 compiles its program in a subprocess and greps captured stderr — the
@@ -82,16 +86,11 @@ def test_pipeline_1f1b_pipe_only_shard_map_remat_clean():
 
 
 @pytest.mark.slow
-@pytest.mark.xfail(
-    strict=True,
-    reason="MoE expert-parallel step still reshards its layer-scan carry "
-           "between batch- and expert-major layouts (the dryrun "
-           "detector's remaining tripper) — see CHANGES.md PR 2 note; "
-           "an XPASS here means the specs got fixed: delete this mark")
 def test_moe_expert_parallel_step_remat_clean():
     """The dp2 x ep2 x tp2 MoE train step (dryrun_multichip's third
-    config) compiled without involuntary remat — currently it does NOT:
-    strict xfail pins today's detector output so the fix is verifiable."""
+    config) compiles without involuntary remat — fixed in PR 3 by the
+    carry/pos batch-layout pins (models/gpt2.py) plus the MoE regroup's
+    replicated anchors (moe/layer.py); this pin keeps it that way."""
     out = _compile_probe(textwrap.dedent("""
         import numpy as np
         import jax

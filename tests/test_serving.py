@@ -105,6 +105,57 @@ def test_paged_attention_matches_dense_int8(rs):
                                    np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
+@pytest.mark.skipif(
+    all(d.platform == "cpu" for d in jax.devices()),
+    reason="needs a real TPU chip: exercises the MOSAIC lowering of the "
+           "paged kernel's scalar-prefetched page-table index maps "
+           "(interpret-mode covers numerics only). conftest.py FORCE-pins "
+           "the suite to the CPU backend, so from an axon session run it "
+           "bypassing conftest: `python -m pytest --noconftest -m slow "
+           "-k real_chip tests/test_serving.py` (the test is "
+           "self-contained — no conftest fixtures)")
+def test_paged_attention_real_chip_matches_dense(rs):
+    """First-real-chip parity for ``decode_attention_paged`` with
+    ``interpret=False``: the page-table gathers live in Pallas BLOCK
+    INDEX MAPS (pt[b, pb] indexing inside a scalar-prefetch closure),
+    which interpret mode never lowers through Mosaic — a lowering bug
+    there (e.g. dynamic block indices on the pool dim) would pass every
+    CPU test and crash or corrupt on hardware. Same layout as
+    test_paged_attention_matches_dense_fp, interpret forced OFF."""
+    from deepspeed_tpu.ops.pallas.decode import (
+        decode_attention_paged, decode_attention_fp_stacked)
+    Lyr, NB, H, P, D = 2, 9, 4, 16, 64
+    B, R, MAXP = 3, 2, 4
+    L = MAXP * P
+    kp = jnp.asarray(rs.randn(Lyr, NB, H, P, D), jnp.float32) * 0.3
+    vp = jnp.asarray(rs.randn(Lyr, NB, H, P, D), jnp.float32) * 0.3
+    q = jnp.asarray(rs.randn(B, H, R, D), jnp.float32) * 0.3
+    pt = np.zeros((B, MAXP), np.int32)
+    pt[0, :2] = [3, 5]
+    pt[1, :4] = [1, 2, 7, 8]
+    pt[2, :1] = [6]
+    pos = np.array([20, 60, -1], np.int32)
+    got = decode_attention_paged(q, kp, vp, pos, jnp.asarray(pt), 1,
+                                 interpret=False)
+    k_dense = np.zeros((Lyr, B, H, L, D), np.float32)
+    v_dense = np.zeros((Lyr, B, H, L, D), np.float32)
+    for b in range(B):
+        for p in range(MAXP):
+            k_dense[:, b, :, p * P:(p + 1) * P] = np.asarray(kp)[:, pt[b, p]]
+            v_dense[:, b, :, p * P:(p + 1) * P] = np.asarray(vp)[:, pt[b, p]]
+    for b in range(B):
+        if pos[b] < 0:
+            np.testing.assert_array_equal(np.asarray(got[b]), 0.0)
+            continue
+        ref = decode_attention_fp_stacked(
+            q[b:b + 1], jnp.asarray(k_dense[:, b:b + 1]),
+            jnp.asarray(v_dense[:, b:b + 1]), int(pos[b]), 1,
+            interpret=False)
+        np.testing.assert_allclose(np.asarray(got[b:b + 1]),
+                                   np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
 # ----------------------------------------------------------- allocator
 
 
