@@ -88,34 +88,18 @@ def _enable_compile_cache():
         pass
 
 
-PEAK_BF16_FLOPS = {
-    # per-chip dense bf16 peak
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v5": 459e12,
-    "TPU v6 lite": 918e12,   # v6e
-}
-
-
 def peak_flops(device):
-    kind = getattr(device, "device_kind", "")
-    for key, val in PEAK_BF16_FLOPS.items():
-        if kind.startswith(key):
-            return val
-    return 197e12
+    """Single source of truth: profiling/flops_profiler.py (the engine's
+    telemetry MFU gauge resolves the same table)."""
+    from deepspeed_tpu.profiling.flops_profiler import peak_device_flops
+    return peak_device_flops(device)
 
 
 def model_flops_per_token(cfg):
-    """6N + attention term (12·L·S·E per token)."""
-    # weight matmuls fwd+bwd: 6 * (params participating in matmuls)
-    matmul_params = cfg.n_layer * 12 * cfg.n_embd * cfg.n_embd \
-        + cfg.vocab_size * cfg.n_embd
-    flops = 6 * matmul_params
-    # attention scores+context: fwd 2*2*S*E, ×3 for fwd+bwd
-    flops += 12 * cfg.n_layer * cfg.n_positions * cfg.n_embd
-    return flops
+    """6N + attention term (12·L·S·E per token) — canonical copy in
+    profiling/flops_profiler.py, shared with the MFU tests."""
+    from deepspeed_tpu.profiling import flops_profiler
+    return flops_profiler.model_flops_per_token(cfg)
 
 
 XL_WARM_SENTINEL = os.path.join(
@@ -385,6 +369,11 @@ def bench_train_gpt2(dstpu, make_mesh, MeshConfig, dev, jnp):
         "steps_per_print": 1000,
     }
     model = GPT2LMHeadModel(model_cfg)
+    # telemetry: the engine records into the process-wide registry; a
+    # fresh window here keeps earlier sections' train/* values out of
+    # this section's snapshot
+    from deepspeed_tpu.telemetry import default_registry
+    default_registry().reset()
     engine, _, _, _ = dstpu.initialize(config=cfg, model=model, mesh=mesh)
 
     rng = np.random.RandomState(0)
@@ -398,6 +387,7 @@ def bench_train_gpt2(dstpu, make_mesh, MeshConfig, dev, jnp):
     for _ in range(2):
         loss = engine.train_batch(batch)
     float(jax.device_get(loss))
+    engine.telemetry_flush()   # open a steady-state telemetry window
 
     # three timed windows, best wins: the tunneled chip shows ±5%
     # run-to-run noise and the benchmark should report the machine, not
@@ -412,6 +402,11 @@ def bench_train_gpt2(dstpu, make_mesh, MeshConfig, dev, jnp):
             loss = engine.train_batch(batch)
         float(jax.device_get(loss))
         best = min(best, (time.perf_counter() - t0) / iters)
+        # fold each timed window into the step-time histogram (the
+        # fence above already paid the sync). The batch lets the first
+        # fold price MFU from the compiled step's cost analysis —
+        # between windows, outside every timed region.
+        engine.telemetry_flush(batch)
     # the residual fence share still inside the window, measured on
     # scalars this process has NOT read yet (a re-read of `loss` would
     # hit the client-side npy cache and measure ~0 instead of the
@@ -452,6 +447,26 @@ def bench_train_gpt2(dstpu, make_mesh, MeshConfig, dev, jnp):
                 for k, v in engine.wall_clock_times().items()}
     engine._config.wall_clock_breakdown = False
 
+    # unified-telemetry snapshot for the BENCH record: step-time
+    # percentiles over the timed windows, per-phase span histograms
+    # (fed by the instrumented runs above), and the engine's own MFU
+    # gauge (flops from the compiled step's cost analysis, priced at
+    # the first window fold). Snapshot, not flush: the instrumented
+    # window must not fold into the steady-state step-time histogram.
+    tel = engine.telemetry_snapshot()
+    spans = {k.split("span/", 1)[1]: v
+             for k, v in tel["histograms"].items() if k.startswith("span/")}
+    telemetry = {
+        "step_time_s": tel["histograms"].get("train/step_time_s", {}),
+        "spans": spans,
+        "mfu_engine_pct": round(tel["gauges"].get("train/mfu", 0.0) * 100,
+                                2),
+        "tokens_per_sec_engine": round(
+            tel["gauges"].get("train/tokens_per_sec", 0.0), 1),
+        "flops_per_step_cost_analysis": tel["gauges"].get(
+            "train/flops_per_step", 0.0),
+    }
+
     # free the ~8 GB of training state before later sections allocate
     # their params + KV caches (same ordering rule as the BERT section)
     del engine, model, loss
@@ -487,6 +502,10 @@ def bench_train_gpt2(dstpu, make_mesh, MeshConfig, dev, jnp):
         # fused program with its window fence amortized out the same way.
         "phase_breakdown_ms": phase_ms,
         "tunnel_fence_ms_per_readback": round(fence_s * 1000, 1),
+        # unified telemetry (ISSUE 4): per-phase span times, step-time
+        # percentiles over the timed windows, and the engine's own MFU
+        # gauge next to the bench's analytic headline
+        "telemetry": telemetry,
     }
 
 
@@ -535,6 +554,7 @@ def bench_serving():
     exercised at GPT-2-large scale by the decode section's configs."""
     from tests.perf.serving_bench import run_serving_bench
     out = run_serving_bench()
+    tel = out["continuous"].get("telemetry", {})
     return {
         "requests_per_sec_continuous":
             out["continuous"]["requests_per_sec"],
@@ -545,6 +565,12 @@ def bench_serving():
             out["static"]["decode_tokens_per_sec"],
         "speedup_requests_per_sec": out["speedup_requests_per_sec"],
         "mean_slot_occupancy": out["continuous"]["mean_slot_occupancy"],
+        # serving telemetry headline numbers + the full snapshot
+        "ttft_p50_s": tel.get("ttft_s", {}).get("p50"),
+        "ttft_p99_s": tel.get("ttft_s", {}).get("p99"),
+        "page_pool_occupancy_hwm": tel.get(
+            "page_pool", {}).get("occupancy_hwm"),
+        "telemetry": tel,
         "workload": out["workload"],
     }
 

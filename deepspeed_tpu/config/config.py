@@ -221,6 +221,56 @@ class FlopsProfilerConfig:
                                    C.FLOPS_PROFILER_DETAILED_DEFAULT))
 
 
+class MonitorConfig:
+    """``monitor`` block: the unified telemetry export gate
+    (deepspeed_tpu/telemetry). Presence of the block enables the
+    per-``steps_per_print`` registry export — a JSONL stream (one file
+    per rank; every event carries ts/rank/step) plus, when the
+    ``tensorboard`` block is also enabled, a bridge into the
+    SummaryEventWriter scalar stream."""
+
+    def __init__(self, param_dict):
+        d = param_dict.get(C.MONITOR, None)
+        self.enabled = d is not None and bool(
+            d.get(C.MONITOR_ENABLED, C.MONITOR_ENABLED_DEFAULT))
+        d = d or {}
+        self.output_path = d.get(C.MONITOR_OUTPUT_PATH,
+                                 C.MONITOR_OUTPUT_PATH_DEFAULT)
+        self.jsonl_path = d.get(C.MONITOR_JSONL_PATH,
+                                C.MONITOR_JSONL_PATH_DEFAULT)
+
+
+class ProfilingConfig:
+    """``profiling`` block: the programmatic XLA trace window.
+    ``trace_dir`` + ``trace_steps: [start, stop)`` capture that range
+    of global steps via jax.profiler.start_trace/stop_trace, so the
+    telemetry spans' TraceAnnotations and the train fns' named_scope
+    phase labels land in a perfetto/xprof-openable artifact."""
+
+    def __init__(self, param_dict):
+        d = param_dict.get(C.PROFILING, {})
+        self.trace_dir = d.get(C.PROFILING_TRACE_DIR,
+                               C.PROFILING_TRACE_DIR_DEFAULT)
+        steps = d.get(C.PROFILING_TRACE_STEPS,
+                      C.PROFILING_TRACE_STEPS_DEFAULT)
+        if steps:
+            steps = list(steps)
+            if len(steps) != 2 or not all(
+                    isinstance(s, int) and s >= 0 for s in steps) \
+                    or steps[1] <= steps[0]:
+                raise DeepSpeedConfigError(
+                    f"profiling.trace_steps must be [start, stop) with "
+                    f"0 <= start < stop, got {steps!r}")
+        self.trace_steps = tuple(steps or ())
+        if bool(self.trace_dir) != bool(self.trace_steps):
+            raise DeepSpeedConfigError(
+                "profiling.trace_dir and trace_steps gate the window "
+                "together — set both (e.g. trace_dir + trace_steps "
+                "[2, 4]) or neither; got "
+                f"trace_dir={self.trace_dir!r}, "
+                f"trace_steps={list(self.trace_steps)!r}")
+
+
 class QuantizeTrainingConfig:
     """MoQ section (reference runtime/config.py:184-215
     get_quantize_training): progressive bit reduction + optional eigenvalue
@@ -521,6 +571,8 @@ class DeepSpeedConfig:
         self.quantize_training_config = QuantizeTrainingConfig(pd)
         self.aio_config = AioConfig(pd)
         self.tensorboard_config = TensorboardConfig(pd)
+        self.monitor_config = MonitorConfig(pd)
+        self.profiling_config = ProfilingConfig(pd)
         self.sparse_attention_config = SparseAttentionConfig(pd)
         self.pipeline_config = PipelineConfig(pd)
         self.mesh_config = MeshConfigSection(pd)

@@ -310,6 +310,32 @@ FLOPS_PROFILER_DETAILED = "detailed"
 FLOPS_PROFILER_DETAILED_DEFAULT = True
 
 #############################################
+# Telemetry monitor (unified metrics stream, deepspeed_tpu/telemetry —
+# the role of the reference's monitor family tensorboard/csv/wandb):
+# presence of the block + enabled turns on the per-steps_per_print
+# registry export (JSONL stream + SummaryEventWriter bridge).
+#############################################
+MONITOR = "monitor"
+MONITOR_ENABLED = "enabled"
+MONITOR_ENABLED_DEFAULT = True       # presence of the block enables it
+MONITOR_JSONL_PATH = "jsonl_path"
+MONITOR_JSONL_PATH_DEFAULT = ""      # "" -> <output_path>/telemetry_rank{r}.jsonl
+MONITOR_OUTPUT_PATH = "output_path"
+MONITOR_OUTPUT_PATH_DEFAULT = "runs/telemetry"
+
+#############################################
+# Programmatic XLA trace window (profiling.trace_dir + trace_steps):
+# wraps jax.profiler.start_trace/stop_trace around global steps
+# [trace_steps[0], trace_steps[1]) so span annotations land in
+# perfetto/xprof. Off unless trace_dir is set.
+#############################################
+PROFILING = "profiling"
+PROFILING_TRACE_DIR = "trace_dir"
+PROFILING_TRACE_DIR_DEFAULT = ""
+PROFILING_TRACE_STEPS = "trace_steps"
+PROFILING_TRACE_STEPS_DEFAULT = ()
+
+#############################################
 # Progressive layer drop (reference constants.py)
 #############################################
 # MoQ quantize-aware training (reference runtime/constants.py

@@ -300,12 +300,12 @@ def make_mesh(config: Optional[MeshConfig] = None,
         from jax.experimental import mesh_utils
         dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
     except Exception:
-        dev_array = np.asarray(devices).reshape(shape)
+        dev_array = np.asarray(devices).reshape(shape)  # sync-ok: host device list
     return Mesh(dev_array, axis_names=tuple(axis_order))
 
 
 def single_device_mesh() -> Mesh:
-    return Mesh(np.asarray(jax.devices()[:1]).reshape(
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(  # sync-ok: host device list
         (1,) * len(AXIS_ORDER)), AXIS_ORDER)
 
 

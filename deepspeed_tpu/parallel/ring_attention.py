@@ -34,7 +34,8 @@ def ring_attention(q, k, v, mesh, causal=False, scale=None,
     """
     n = mesh.shape.get(axis, 1)
     B, H, S, D = q.shape
-    scale = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
+    scale = float(scale) if scale is not None \
+        else 1.0 / float(np.sqrt(D))  # sync-ok: python scalar at trace time
     if n == 1:
         from deepspeed_tpu.ops.attention import dot_product_attention
         return dot_product_attention(q, k, v, causal=causal, scale=scale)

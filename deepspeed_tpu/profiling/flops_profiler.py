@@ -16,6 +16,56 @@ import numpy as np
 from deepspeed_tpu.utils.logging import logger
 
 
+# per-chip dense bf16 peak FLOPS by device kind — the denominator of
+# MFU. The single source of truth: bench.py and the engine's telemetry
+# MFU gauge both resolve through peak_device_flops().
+PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,   # v6e
+}
+_PEAK_FALLBACK = 197e12
+
+
+def peak_device_flops(device=None):
+    """Dense bf16 peak of ``device`` (default: jax.devices()[0]).
+    Unknown kinds (including CPU backends) fall back to the v5e figure
+    so an MFU computed against it is a LOWER bound on a real chip and
+    an explicitly-absurd number on CPU — callers that care tag the
+    device kind next to the gauge (the engine does)."""
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "")
+    for key, val in PEAK_BF16_FLOPS.items():
+        if kind.startswith(key):
+            return val
+    return _PEAK_FALLBACK
+
+
+def model_flops_per_token(cfg):
+    """Analytic GPT-2-family train flops per token: the standard 6·N
+    weight-matmul accounting (fwd 2N + bwd 4N) plus the attention
+    scores/context term (12·L·S·E per token, fwd+bwd). ``cfg`` needs
+    n_layer / n_embd / vocab_size / n_positions."""
+    matmul_params = cfg.n_layer * 12 * cfg.n_embd * cfg.n_embd \
+        + cfg.vocab_size * cfg.n_embd
+    flops = 6 * matmul_params
+    flops += 12 * cfg.n_layer * cfg.n_positions * cfg.n_embd
+    return flops
+
+
+def mfu(flops_per_step, step_time_s, device=None, n_devices=1):
+    """Model flops utilization: achieved flops/s over the peak of
+    ``n_devices`` chips. Returns a fraction in [0, ~1]."""
+    if step_time_s <= 0:
+        return 0.0
+    return flops_per_step / step_time_s / (
+        peak_device_flops(device) * max(n_devices, 1))
+
+
 def flops_of_jitted(fn, *args, **kwargs):
     """Total flops of `fn(*args)` per XLA's cost analysis."""
     lowered = jax.jit(fn).lower(*args, **kwargs)
@@ -27,6 +77,22 @@ def flops_of_jitted(fn, *args, **kwargs):
         return float(cost.get("flops", 0.0)), cost
     except Exception:
         return 0.0, {}
+
+
+def compiled_step_flops(jitted, *args):
+    """Flops of an ALREADY-jitted callable (one exposing ``.lower``)
+    per XLA's compiled cost analysis. After the first real call this is
+    a compile-cache hit — which is how the engine prices its MFU gauge
+    without recompiling any train path."""
+    try:
+        compiled = jitted.lower(*args).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost.get("flops", 0.0))
+    except Exception as e:
+        logger.warning(f"cost analysis unavailable: {e}")
+        return 0.0
 
 
 def params_count(params):

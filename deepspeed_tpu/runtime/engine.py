@@ -44,6 +44,9 @@ from deepspeed_tpu.ops.optimizer import TpuOptimizer, OptaxOptimizer
 from deepspeed_tpu.utils.logging import logger, log_dist
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from deepspeed_tpu.utils.memory import see_memory_usage
+from deepspeed_tpu.telemetry.registry import default_registry
+from deepspeed_tpu.telemetry.spans import span as tel_span, annotate, \
+    TraceWindow
 
 FORWARD_MICRO_TIMER = "forward_microstep"
 BACKWARD_MICRO_TIMER = "backward_microstep"
@@ -290,6 +293,21 @@ class DeepSpeedEngine:
         self.skipped_steps = 0
         self.global_samples = 0
         self.scalar_history = []  # tensorboard-lite: list of (step, dict)
+
+        # -- unified telemetry (deepspeed_tpu/telemetry): per-step
+        # counters/histograms into the process-wide registry (sync-free),
+        # window folds + exports at steps_per_print boundaries where the
+        # existing loss readback is already the fence, and the
+        # config-gated XLA trace window (profiling.trace_dir/trace_steps)
+        self.telemetry = default_registry()
+        self._trace_window = TraceWindow.from_config(
+            self._config.profiling_config)
+        self._tel_exporter = None      # lazy JSONL stream (monitor gate)
+        self._tel_bridge = None        # lazy SummaryEventWriter bridge
+        self._tel_window_t0 = None     # open measurement window start
+        self._tel_window_step0 = 0
+        self._tel_window_tokens = 0
+        self._tel_flops_per_step = None  # lazily priced via cost analysis
 
         # ZeRO-Offload: optimizer state + fp32 master on host (cpu) or NVMe
         self._offload_cfg = self._config.zero_config.offload_optimizer
@@ -837,8 +855,9 @@ class DeepSpeedEngine:
                 batch = jax.tree_util.tree_map(
                     lambda x: jax.lax.with_sharding_constraint(x, batch_sh),
                     batch)
-                loss, grads = self._micro_loss_and_grads(state, batch, rng,
-                                                         loss_fn=loss_fn)
+                with annotate("ds_fwd_bwd"):
+                    loss, grads = self._micro_loss_and_grads(
+                        state, batch, rng, loss_fn=loss_fn)
                 return grads, loss
             # batch leading dim = gas * micro_global; scan over gas chunks
             def to_chunks(x):
@@ -858,8 +877,9 @@ class DeepSpeedEngine:
                 micro_batch = jax.tree_util.tree_map(
                     lambda x: jax.lax.with_sharding_constraint(x, batch_sh),
                     micro_batch)
-                loss, grads = self._micro_loss_and_grads(state, micro_batch, r,
-                                                         loss_fn=loss_fn)
+                with annotate("ds_fwd_bwd"):
+                    loss, grads = self._micro_loss_and_grads(
+                        state, micro_batch, r, loss_fn=loss_fn)
                 acc_g, acc_l = acc
                 acc_g = jax.tree_util.tree_map(
                     lambda a, g: a + g.astype(acc_dtype) / gas, acc_g, grads)
@@ -874,7 +894,8 @@ class DeepSpeedEngine:
 
         def train_batch_fn(state, batch, rng):
             grads, loss = accumulate_grads(state, batch, rng)
-            return self._apply_grads(state, grads, loss)
+            with annotate("ds_optimizer"):
+                return self._apply_grads(state, grads, loss)
 
         def grads_batch_fn(state, batch, rng):
             # offload path: grads stay on device; host applies the step.
@@ -895,7 +916,8 @@ class DeepSpeedEngine:
             return loss, grads
 
         def apply_grads_fn(state, grads, loss):
-            return self._apply_grads(state, grads, loss)
+            with annotate("ds_optimizer"):
+                return self._apply_grads(state, grads, loss)
 
         self._jit_train_batch = self._pinned(
             jax.jit(train_batch_fn, donate_argnums=(0,)))
@@ -1224,11 +1246,13 @@ class DeepSpeedEngine:
                 check_vma=False)
             def inner(state, batch, rng):
                 tm = jax.tree_util.tree_map
-                grads, loss = accumulate(state, batch, rng)
+                with annotate("ds_fwd_bwd"):
+                    grads, loss = accumulate(state, batch, rng)
                 # the bucket stream — mean-reduced full grads on every
                 # device (identical across the axis afterwards)
-                grads = overlap_lib.bucketed_allreduce(
-                    grads, axis, n, bucket_elems, mode=mode, mean=True)
+                with annotate("ds_overlap_bucket_sync"):
+                    grads = overlap_lib.bucketed_allreduce(
+                        grads, axis, n, bucket_elems, mode=mode, mean=True)
                 loss = jax.lax.pmean(loss, axis)
                 scale = state.scaler["loss_scale"]
                 inv = 1.0 / scale
@@ -1258,13 +1282,15 @@ class DeepSpeedEngine:
                     tdef, [shard_leaf(x, e) for x, e in zip(p_leaves, plan)])
                 g_loc = jax.tree_util.tree_unflatten(
                     tdef, [shard_leaf(x, e) for x, e in zip(g_leaves, plan)])
-                if takes_gscale:
-                    new_p_loc, new_opt = opt.step(
-                        p_loc, g_loc, state.opt_state, lr, grad_scale=gscale)
-                else:
-                    g_loc = tm(lambda g: g * gscale, g_loc)
-                    new_p_loc, new_opt = opt.step(p_loc, g_loc,
-                                                  state.opt_state, lr)
+                with annotate("ds_optimizer"):
+                    if takes_gscale:
+                        new_p_loc, new_opt = opt.step(
+                            p_loc, g_loc, state.opt_state, lr,
+                            grad_scale=gscale)
+                    else:
+                        g_loc = tm(lambda g: g * gscale, g_loc)
+                        new_p_loc, new_opt = opt.step(p_loc, g_loc,
+                                                      state.opt_state, lr)
 
                 def gather_leaf(x, entry):
                     if entry is None:
@@ -1272,9 +1298,11 @@ class DeepSpeedEngine:
                     d, _ = entry
                     return jax.lax.all_gather(x, axis, axis=d, tiled=True)
 
-                new_params = jax.tree_util.tree_unflatten(
-                    tdef, [gather_leaf(x, e) for x, e in
-                           zip(jax.tree_util.tree_leaves(new_p_loc), plan)])
+                with annotate("ds_param_allgather"):
+                    new_params = jax.tree_util.tree_unflatten(
+                        tdef, [gather_leaf(x, e) for x, e in
+                               zip(jax.tree_util.tree_leaves(new_p_loc),
+                                   plan)])
                 new_state = self._finish_explicit_state(
                     state, new_params, new_opt, finite, precision)
                 return new_state, {
@@ -1401,13 +1429,14 @@ class DeepSpeedEngine:
 
         def gather_outer(p):
             out = {}
-            for k in outer_keys:
-                leaves, tdef = jax.tree_util.tree_flatten(p[k])
-                gathered = [
-                    prefetch_lib.make_gathered_param(e, axis, n, mode)(x)
-                    if e is not None else x
-                    for x, e in zip(leaves, outer_plans[k])]
-                out[k] = jax.tree_util.tree_unflatten(tdef, gathered)
+            with annotate("ds_prefetch_outer_gather"):
+                for k in outer_keys:
+                    leaves, tdef = jax.tree_util.tree_flatten(p[k])
+                    gathered = [
+                        prefetch_lib.make_gathered_param(e, axis, n, mode)(x)
+                        if e is not None else x
+                        for x, e in zip(leaves, outer_plans[k])]
+                    out[k] = jax.tree_util.tree_unflatten(tdef, gathered)
             return out
 
         def micro_loss(p_view, micro, keep_prob):
@@ -1534,7 +1563,8 @@ class DeepSpeedEngine:
                      "loss_scale": 0}, PartitionSpec())),
                 check_vma=False)
             def inner(state, batch, rng):
-                grads, loss = accumulate(state, batch, rng)
+                with annotate("ds_fwd_bwd_prefetch"):
+                    grads, loss = accumulate(state, batch, rng)
                 loss = jax.lax.pmean(loss, axis)
                 # sharded-leaf grads came back reduce-scattered as SUMS
                 # over the axis (the custom VJPs); scale to the mean.
@@ -1546,9 +1576,10 @@ class DeepSpeedEngine:
                 repl_ids = [i for i, e in enumerate(full_plan)
                             if e is None]
                 if repl_ids:
-                    red = overlap_lib.bucketed_allreduce(
-                        [g_leaves[i] for i in repl_ids], axis, n,
-                        bucket_elems, mode=mode, mean=True)
+                    with annotate("ds_overlap_bucket_sync"):
+                        red = overlap_lib.bucketed_allreduce(
+                            [g_leaves[i] for i in repl_ids], axis, n,
+                            bucket_elems, mode=mode, mean=True)
                     for i, g in zip(repl_ids, red):
                         g_leaves[i] = g
                 grads = jax.tree_util.tree_unflatten(g_tdef, g_leaves)
@@ -1581,14 +1612,15 @@ class DeepSpeedEngine:
                 # ZeRO-3 update runs entirely on local shards: params and
                 # moments already rest in the shard layout — no slicing,
                 # no post-update gather (params stay sharded at rest)
-                if takes_gscale:
-                    new_params, new_opt = opt.step(
-                        state.params, grads, state.opt_state, lr,
-                        grad_scale=gscale)
-                else:
-                    grads = tm(lambda g: g * gscale, grads)
-                    new_params, new_opt = opt.step(state.params, grads,
-                                                   state.opt_state, lr)
+                with annotate("ds_optimizer"):
+                    if takes_gscale:
+                        new_params, new_opt = opt.step(
+                            state.params, grads, state.opt_state, lr,
+                            grad_scale=gscale)
+                    else:
+                        grads = tm(lambda g: g * gscale, grads)
+                        new_params, new_opt = opt.step(state.params, grads,
+                                                       state.opt_state, lr)
                 new_state = self._finish_explicit_state(
                     state, new_params, new_opt, finite, precision)
                 return new_state, {
@@ -1875,7 +1907,9 @@ class DeepSpeedEngine:
             assert data_iter is not None, "need batch or data_iter"
             micro = [next(data_iter) for _ in range(self.gradient_accumulation_steps())]
             batch = jax.tree_util.tree_map(
-                lambda *xs: np.concatenate([np.asarray(x) for x in xs]), *micro)
+                lambda *xs: np.concatenate(
+                    [np.asarray(x) for x in xs]),  # sync-ok: host loader data
+                *micro)
         # state init inspects host-side shapes; globalize only after
         self._ensure_ready(batch)
         self._ensure_params_resident()
@@ -1883,19 +1917,28 @@ class DeepSpeedEngine:
         if self.flops_profiler is not None:
             self.flops_profiler.maybe_profile(batch)
 
+        step_idx = self.global_steps
+        if self._trace_window is not None:
+            self._trace_window.on_step_begin(step_idx)
         self.tput_timer.start()
-        if self._host_runner is not None:
-            metrics = self._host_offload_step(batch)
-        elif self.wall_clock_breakdown() and not (
-                self._compressed_comm_active() or self._sparse_grad_active()
-                or self._overlap_comm_active() or self._prefetch_active()):
-            # (1-bit / CSR / overlap paths keep their fused shard_map
-            # programs — their comm scheduling lives inside the step and
-            # cannot be split into phase programs)
-            metrics = self._train_batch_instrumented(batch)
-        else:
-            self.state, metrics = self._jit_train_batch(self.state, batch,
-                                                        self._next_rng())
+        # the span measures host-side DISPATCH of the step (async under
+        # jit — no sync); device-true step time comes from the boundary
+        # window fold below
+        with tel_span("train/step_dispatch", self.telemetry):
+            if self._host_runner is not None:
+                metrics = self._host_offload_step(batch)
+            elif self.wall_clock_breakdown() and not (
+                    self._compressed_comm_active()
+                    or self._sparse_grad_active()
+                    or self._overlap_comm_active()
+                    or self._prefetch_active()):
+                # (1-bit / CSR / overlap paths keep their fused shard_map
+                # programs — their comm scheduling lives inside the step
+                # and cannot be split into phase programs)
+                metrics = self._train_batch_instrumented(batch)
+            else:
+                self.state, metrics = self._jit_train_batch(
+                    self.state, batch, self._next_rng())
         self.tput_timer.stop()
 
         gas = self.gradient_accumulation_steps()
@@ -1908,6 +1951,11 @@ class DeepSpeedEngine:
         self._moq_boundary(batch, metrics)
         self._park_params()
         loss = metrics["loss"]
+        self._telemetry_step(batch, loss)
+        if self._trace_window is not None:
+            self._trace_window.on_step_end(
+                step_idx,   # sync-ok: config-gated trace-window close
+                fence=lambda: jax.block_until_ready(loss))
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(loss)
         return loss
@@ -1997,6 +2045,17 @@ class DeepSpeedEngine:
         self.timers(STEP_GLOBAL_TIMER).elapsed_ += \
             max(step_s - fence_s, 0.0)
         self.timers(FENCE_TIMER).elapsed_ += fence_s
+
+        # the instrumented phases are REAL device measurements (each one
+        # fenced) — feed them to the span histograms so the telemetry
+        # stream carries per-phase times whenever this mode is on
+        reg = self.telemetry
+        reg.histogram("span/train/forward").observe(max(fwd_s - fence_s, 0.0))
+        reg.histogram("span/train/backward").observe(max(fwdbwd_s - fwd_s,
+                                                         0.0))
+        reg.histogram("span/train/optimizer").observe(max(step_s - fence_s,
+                                                          0.0))
+        reg.histogram("span/train/fence").observe(fence_s)
 
         if self.global_steps % self.steps_per_print() == 0:
             # per-step means over the print interval (reference resets each
@@ -2280,6 +2339,8 @@ class DeepSpeedEngine:
             self.timers(STEP_MICRO_TIMER).stop()
         self._moq_boundary(getattr(self, "_moq_batch", None), metrics)
         self._park_params()
+        self._telemetry_step(getattr(self, "_moq_batch", None),
+                             metrics["loss"])
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(metrics["loss"])
 
@@ -2350,6 +2411,171 @@ class DeepSpeedEngine:
                                   host["grad_norm"], self.global_samples)
                 if self.global_steps % self.steps_per_print() == 0:
                     writer.flush()
+
+    # ------------------------------------------------------------------
+    # unified telemetry (deepspeed_tpu/telemetry)
+    # ------------------------------------------------------------------
+    def _telemetry_step(self, batch, loss):
+        """Per-step recording (sync-free) + the steps_per_print-boundary
+        window fold. Between boundaries only host counters move; AT the
+        boundary the loss readback — the same fence _report_progress
+        pays right after — closes a wall-clock window whose mean is the
+        honest per-step time (the SynchronizedWallClockTimer
+        sync-per-read pattern, retired)."""
+        reg = self.telemetry
+        reg.counter("train/steps").inc()
+        reg.counter("train/samples").inc(self.train_batch_size())
+        tokens = 0
+        if isinstance(batch, dict) and "input_ids" in batch:
+            tokens = int(np.prod(batch["input_ids"].shape))
+        if tokens:
+            reg.counter("train/tokens").inc(tokens)
+        self._tel_window_tokens += tokens
+        if self.global_steps % self.steps_per_print() != 0:
+            return
+        float(jax.device_get(loss))  # sync-ok: steps_per_print boundary
+        self._telemetry_fold(batch)
+        self._telemetry_export()
+
+    def _telemetry_priced(self):
+        """Whether the MFU cost analysis may be priced: an explicit
+        ``lower().compile()`` re-traces the train fn outside the jit
+        call cache (a real recompile when no persistent XLA cache is
+        on), so it only happens — ONCE per engine — for engines whose
+        config opted into a telemetry export, or on an explicit
+        telemetry_flush()."""
+        return self._config.monitor_config.enabled \
+            or self._config.tensorboard_config.enabled
+
+    def _telemetry_fold(self, batch=None, price_mfu=None):
+        """Close the open measurement window (caller has fenced): one
+        step-time observation (window mean), throughput gauges, MFU, and
+        the memory gauges. Windows containing step 0 are dropped — they
+        measure compile, not steady state."""
+        reg = self.telemetry
+        now = time.perf_counter()
+        if self._tel_window_t0 is not None:
+            steps = self.global_steps - self._tel_window_step0
+            window_s = now - self._tel_window_t0
+            if steps > 0 and window_s > 0 and self._tel_window_step0 > 0:
+                step_s = window_s / steps
+                reg.histogram("train/step_time_s").observe(step_s)
+                reg.gauge("train/samples_per_sec").set(
+                    steps * self.train_batch_size() / window_s)
+                if self._tel_window_tokens:
+                    reg.gauge("train/tokens_per_sec").set(
+                        self._tel_window_tokens / window_s)
+                if price_mfu is None:
+                    price_mfu = self._telemetry_priced()
+                self._telemetry_mfu(batch, step_s, price=price_mfu)
+        self._tel_window_step0 = self.global_steps
+        self._tel_window_tokens = 0
+        self._telemetry_memory_gauges()
+        # open the next window AFTER the fold's own work (the one-time
+        # MFU pricing retrace can take seconds — charging it to the
+        # next window would corrupt its step-time observation)
+        self._tel_window_t0 = time.perf_counter()
+
+    def _telemetry_mfu(self, batch, step_s, price=False):
+        """MFU as a first-class logged metric: flops/step from the
+        COMPILED train step's XLA cost analysis (exact, fusion-aware)
+        over the mesh's peak. Host-offload engines skip it: their step
+        is not one compiled program."""
+        if self._host_runner is not None or step_s <= 0:
+            return
+        if self._tel_flops_per_step is None and batch is not None and price:
+            from deepspeed_tpu.profiling.flops_profiler import \
+                compiled_step_flops
+            self._tel_flops_per_step = compiled_step_flops(
+                self._jit_train_batch, self.state, batch, self._rng)
+        flops = self._tel_flops_per_step
+        if not flops:
+            return
+        from deepspeed_tpu.profiling.flops_profiler import peak_device_flops
+        reg = self.telemetry
+        # cost_analysis() of a partitioned module reports PER-DEVICE
+        # flops (verified on an 8-device SPMD matmul: 2N^3/8, not
+        # 2N^3): per-device flops over ONE device's peak IS the MFU
+        # under uniform sharding; the flops gauge scales to the global
+        # step figure
+        ndev = int(self.mesh.devices.size)
+        dev = self.mesh.devices.flat[0]
+        reg.gauge("train/flops_per_step").set(flops * ndev)
+        reg.gauge("train/mfu").set(
+            flops / step_s / peak_device_flops(dev))
+
+    def _telemetry_memory_gauges(self):
+        """Satellite of the scalar stream: live-gathered-parameter bytes
+        of the stage3_prefetch pipeline (utils/memory.py — previously
+        only warned), the prefetch window breakdown, and host RSS."""
+        from deepspeed_tpu.utils import memory as memory_lib
+        reg = self.telemetry
+        # host RSS, live-gathered window, per-device HBM where the
+        # backend exposes it — one canonical observable list
+        for k, v in memory_lib.memory_metrics().items():
+            reg.gauge(f"memory/{k}").set(v)
+        stats = self.prefetch_live_param_stats()
+        if stats:
+            reg.gauge("memory/prefetch_live_param_elements").set(
+                stats["live_param_elements"])
+            reg.gauge("memory/prefetch_per_layer_gather_bytes").set(
+                stats["per_layer_gather_bytes"])
+            reg.gauge("memory/prefetch_outer_gather_bytes").set(
+                stats["outer_gather_bytes"])
+
+    def _telemetry_exporters(self):
+        mc = self._config.monitor_config
+        out = []
+        if mc.enabled:
+            if self._tel_exporter is None:
+                from deepspeed_tpu.telemetry.registry import (
+                    JsonlExporter, _process_rank)
+                path = mc.jsonl_path or os.path.join(
+                    mc.output_path,
+                    f"telemetry_rank{_process_rank()}.jsonl")
+                try:
+                    self._tel_exporter = JsonlExporter(path, self.telemetry)
+                except OSError as e:
+                    logger.warning(f"telemetry JSONL unavailable: {e}")
+                    self._tel_exporter = False
+            if self._tel_exporter:
+                out.append(self._tel_exporter)
+        if self._config.tensorboard_config.enabled:
+            if self._tel_bridge is None:
+                from deepspeed_tpu.telemetry.registry import SummaryBridge
+                writer = self._summary_writer()
+                self._tel_bridge = SummaryBridge(writer, self.telemetry) \
+                    if writer is not None else False
+            if self._tel_bridge:
+                out.append(self._tel_bridge)
+        return out
+
+    def _telemetry_export(self):
+        exporters = self._telemetry_exporters()
+        if not exporters:
+            return
+        snap = self.telemetry.snapshot()
+        for e in exporters:
+            e.export(self.global_steps, snapshot=snap)
+
+    def telemetry_snapshot(self):
+        """The current registry snapshot (no fence, no fold)."""
+        return self.telemetry.snapshot()
+
+    def telemetry_flush(self, batch=None):
+        """Fence, fold the open window, export, and return the
+        snapshot — a programmatic steps_per_print boundary for bench /
+        notebook use off the print cadence. Pass the current batch to
+        (lazily) price MFU."""
+        if self.state is not None:
+            # fence on a DERIVED value: a device_get of global_step
+            # itself would populate that array's client-side npy cache
+            # and zero out any later fence probe on it (bench.py
+            # measures the tunnel RTT exactly that way)
+            int(jax.device_get(self.state.global_step + 0))  # sync-ok: flush
+        self._telemetry_fold(batch, price_mfu=batch is not None)
+        self._telemetry_export()
+        return self.telemetry.snapshot()
 
     def _summary_writer(self):
         if getattr(self, "_summary_writer_obj", None) is None:

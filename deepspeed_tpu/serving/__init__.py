@@ -62,7 +62,7 @@ def cache_spec_from_config(model_config, family: str, config=None,
 
 
 def build_engine(family: str, model_config, params, config=None,
-                 rng=None, **overrides) -> ContinuousBatcher:
+                 rng=None, registry=None, **overrides) -> ContinuousBatcher:
     """Build a ContinuousBatcher for ``family``:
 
     - ``"gpt2"``: ``params`` is either the training ``GPT2LMHeadModel``
@@ -93,4 +93,6 @@ def build_engine(family: str, model_config, params, config=None,
     else:
         adapter = LlamaServingAdapter(model_config, params, spec,
                                       quantize_bits=qb)
-    return ContinuousBatcher(adapter, rng=rng)
+    # registry: pass telemetry.default_registry() to merge the serving
+    # metrics into the process-wide stream; default is per-engine
+    return ContinuousBatcher(adapter, rng=rng, registry=registry)

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.serving.paged_cache import PagedKVCache, TRASH_BLOCK
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
 
 
 @dataclasses.dataclass
@@ -49,8 +50,9 @@ class Request:
     finish_reason: Optional[str] = None
 
     def tokens(self) -> np.ndarray:
-        return np.concatenate([np.asarray(self.prompt, np.int32),
-                               np.asarray(self.generated, np.int32)])
+        return np.concatenate([          # sync-ok: host-side lists
+            np.asarray(self.prompt, np.int32),
+            np.asarray(self.generated, np.int32)])  # sync-ok: host
 
 
 @dataclasses.dataclass
@@ -77,7 +79,8 @@ class ContinuousBatcher:
     everything (each call runs at most one admission sweep + one tick).
     """
 
-    def __init__(self, adapter, rng: Optional[jax.Array] = None):
+    def __init__(self, adapter, rng: Optional[jax.Array] = None,
+                 registry: Optional[MetricsRegistry] = None):
         self.adapter = adapter
         self.spec = adapter.spec
         self.cache: PagedKVCache = adapter.make_cache()
@@ -88,11 +91,68 @@ class ContinuousBatcher:
         self.last_logits = None       # [slots, V] of the latest tick
         self.stats = {"ticks": 0, "tick_steps": 0, "decode_tokens": 0,
                       "prefills": 0, "prefill_tokens": 0}
+        # per-engine metrics registry (serving/* names) — pass the
+        # process-wide default_registry() to merge into one JSONL
+        # stream with a training engine. All recording is host-side;
+        # the only device readbacks in this scheduler are the token /
+        # logits consumptions it already cannot avoid.
+        self.metrics = registry if registry is not None \
+            else MetricsRegistry()
+        self._t_first_decode = None   # engine-lifetime tokens/sec base
+
+    # ----------------------------------------------------------- metrics
+
+    def _note_pool(self) -> None:
+        """Record page-pool occupancy (+ high-water mark) — called
+        after admissions (the local peak) and after ticks (releases)."""
+        alloc = self.cache.num_blocks - 1
+        used = alloc - self.cache.free_pages
+        m = self.metrics
+        m.gauge("serving/page_pool_used_pages").set(used)
+        occ = used / max(alloc, 1)
+        m.gauge("serving/page_pool_occupancy").set(occ)
+        m.gauge("serving/page_pool_occupancy_hwm").set_max(occ)
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """One JSON-able dict of the serving observables: queue depth,
+        admission wait, time-to-first-token, per-tick decode latency,
+        tokens/sec, slot utilization, page-pool occupancy (+ HWM)."""
+        snap = self.metrics.snapshot()
+        hists = snap["histograms"]
+        gauges = snap["gauges"]
+        now = time.monotonic()
+        lifetime = (now - self._t_first_decode) \
+            if self._t_first_decode is not None else 0.0
+        alloc = self.cache.num_blocks - 1
+        return {
+            "queue_depth": len(self.queue),
+            "active_slots": sum(s.active for s in self.slots),
+            "slots": len(self.slots),
+            "page_pool": {
+                "allocatable_pages": alloc,
+                "used_pages": alloc - self.cache.free_pages,
+                "occupancy": gauges.get("serving/page_pool_occupancy", 0.0),
+                "occupancy_hwm": gauges.get(
+                    "serving/page_pool_occupancy_hwm", 0.0),
+            },
+            "admission_wait_s": hists.get("serving/admission_wait_s",
+                                          {"count": 0}),
+            "ttft_s": hists.get("serving/ttft_s", {"count": 0}),
+            "tick_latency_s": hists.get("serving/tick_latency_s",
+                                        {"count": 0}),
+            "decode_latency_per_token_s": hists.get(
+                "serving/decode_latency_per_token_s", {"count": 0}),
+            "slot_utilization": hists.get("serving/slot_utilization",
+                                          {"count": 0}),
+            "decode_tokens_per_sec": (self.stats["decode_tokens"] / lifetime)
+            if lifetime > 0 else 0.0,
+            **self.stats,
+        }
 
     # ------------------------------------------------------------- queue
 
     def submit(self, request: Request) -> None:
-        S = int(np.asarray(request.prompt).shape[0])
+        S = int(np.asarray(request.prompt).shape[0])  # sync-ok: host prompt
         assert S >= 1, "empty prompt"
         # prefill unconditionally samples the first token, so a zero
         # budget would still emit one — reject instead of over-serving
@@ -131,7 +191,9 @@ class ContinuousBatcher:
             f"only {max_prompt_pages} whole pages of "
             f"{self.spec.page_size} fit the model's "
             f"{self.adapter.max_prompt_len()}-position budget")
+        request._t_submit = time.monotonic()
         self.queue.append(request)
+        self.metrics.gauge("serving/queue_depth").set(len(self.queue))
 
     @property
     def pending(self) -> int:
@@ -167,17 +229,25 @@ class ContinuousBatcher:
             req = self.queue[0]
             if now is not None and req.arrival_time > now:
                 break                 # FIFO: don't skip ahead of arrivals
-            S = int(np.asarray(req.prompt).shape[0])
+            S = int(np.asarray(req.prompt).shape[0])  # sync-ok: host prompt
             slot_id = free[0]
             pages = self.cache.admit(slot_id, S + req.max_new_tokens)
             if pages is None:
                 break                 # pool exhausted; retry next step
             self.queue.popleft()
             free.pop(0)
+            t_admit = time.monotonic()
+            # wait since the request became ADMISSIBLE (its arrival
+            # under respect_arrival_times, its submit otherwise)
+            t_ref = getattr(req, "_t_arrived", None)
+            if t_ref is None:
+                t_ref = getattr(req, "_t_submit", t_admit)
+            self.metrics.histogram("serving/admission_wait_s").observe(
+                max(t_admit - t_ref, 0.0))
             n_pages = self._bucket_pages(S)
             P = self.spec.page_size
             ids = np.zeros((1, n_pages * P), np.int32)
-            ids[0, :S] = np.asarray(req.prompt, np.int32)
+            ids[0, :S] = np.asarray(req.prompt, np.int32)  # sync-ok: host prompt
             page_vec = np.full((n_pages,), TRASH_BLOCK, np.int32)
             k = min(n_pages, len(pages))
             page_vec[:k] = pages[:k]
@@ -187,15 +257,23 @@ class ContinuousBatcher:
             self.cache.pool = pool
             self.stats["prefills"] += 1
             self.stats["prefill_tokens"] += S
-            tok = self._pick_token(np.asarray(logits, np.float32),
-                                   req.temperature)
+            tok = self._pick_token(
+                np.asarray(logits, np.float32),  # sync-ok: scheduler
+                req.temperature)                 # consumes the sample
             req.generated.append(tok)
+            # the prefill logits readback above IS first-token delivery
+            self.metrics.histogram("serving/ttft_s").observe(
+                max(time.monotonic() - t_ref, 0.0))
+            if self._t_first_decode is None:
+                self._t_first_decode = time.monotonic()
             slot = self.slots[slot_id]
             slot.request, slot.pos, slot.last_tok = req, S, tok
             done = self._maybe_finish(slot_id)
             if done is not None:      # max_new_tokens == 1 / instant EOS
                 finished.append(done)
                 free.insert(0, slot_id)
+        self.metrics.gauge("serving/queue_depth").set(len(self.queue))
+        self._note_pool()
         return finished
 
     # -------------------------------------------------------------- tick
@@ -240,6 +318,7 @@ class ContinuousBatcher:
 
     def _tick(self) -> List[Request]:
         steps = self._pick_tick_steps()
+        n_active = sum(s.active for s in self.slots)
         toks = np.array([s.last_tok for s in self.slots], np.int32)
         pos = np.array([s.pos if s.active else -1 for s in self.slots],
                        np.int32)
@@ -247,22 +326,32 @@ class ContinuousBatcher:
             [s.request.temperature if s.active else 0.0
              for s in self.slots], np.float32)
         self._rng, sub = jax.random.split(self._rng)
+        t0 = time.monotonic()
         pool, toks_seq, logits = self.adapter.tick(
             self.cache.pool, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(self.cache.page_table), sub, jnp.asarray(temps),
             steps=steps)
         self.cache.pool = pool
         self.last_logits = logits
-        toks_seq = np.asarray(toks_seq)           # [steps, slots]
+        toks_seq = np.asarray(toks_seq)  # sync-ok: scheduler consumes
+        #                                  the sampled tokens [steps,slots]
+        tick_s = time.monotonic() - t0   # real: the asarray fenced it
+        m = self.metrics
+        m.histogram("serving/tick_latency_s").observe(tick_s)
+        m.histogram("serving/decode_latency_per_token_s").observe(
+            tick_s / max(steps, 1))
+        m.histogram("serving/slot_utilization").observe(
+            n_active / max(len(self.slots), 1))
         self.stats["ticks"] += 1
         self.stats["tick_steps"] += steps
         finished = []
+        tokens_before = self.stats["decode_tokens"]
         for i, slot in enumerate(self.slots):
             if not slot.active:
                 continue
             for t in range(steps):
                 self.stats["decode_tokens"] += 1
-                tok = int(toks_seq[t, i])
+                tok = int(toks_seq[t, i])   # sync-ok: host array already
                 slot.request.generated.append(tok)
                 slot.pos += 1
                 slot.last_tok = tok
@@ -272,6 +361,9 @@ class ContinuousBatcher:
                     # landed in pages this slot owned until right now
                     finished.append(done)
                     break
+        m.counter("serving/decode_tokens").inc(
+            self.stats["decode_tokens"] - tokens_before)
+        self._note_pool()
         return finished
 
     def step(self, now: Optional[float] = None) -> List[Request]:
@@ -295,6 +387,11 @@ class ContinuousBatcher:
             self.submit(r)
         done: Dict[Any, Request] = {}
         t0 = time.monotonic()
+        if respect_arrival_times:
+            # TTFT/admission-wait reference: when arrivals are honoured
+            # a request only becomes admissible at its arrival time
+            for r in requests:
+                r._t_arrived = t0 + r.arrival_time
         while self.pending:
             now = (time.monotonic() - t0) if respect_arrival_times \
                 else None

@@ -1,0 +1,31 @@
+"""Unified telemetry: per-step metrics registry, async-safe spans and a
+config-gated programmatic XLA trace window.
+
+The reference DeepSpeed treats observability as a first-class subsystem
+(TensorBoard scalars + wall-clock breakdown timers + the FLOPS profiler
+wired into the engine loop); this package is the TPU rebuild of that
+layer, with one discipline the reference's CUDA timers didn't need:
+**nothing here forces a device sync in a hot loop**. Under jit the
+dispatch is asynchronous, so spans record host wall time + a profiler
+annotation only, and device-accurate accounting happens (a) at
+``steps_per_print`` boundaries, where the engine's existing loss
+readback is the fence, or (b) inside an XLA trace window where the
+profiler timeline is the source of truth.
+
+Layout:
+
+- ``registry``: process-wide counters / gauges / histograms with
+  snapshot/reset, plus three exporters — JSONL stream,
+  ``SummaryEventWriter`` bridge, Prometheus text dump;
+- ``spans``: ``span("tag")`` host-side context manager
+  (``jax.profiler.TraceAnnotation`` + wall time), ``annotate("tag")``
+  for trace-time ``jax.named_scope`` labels inside jitted train fns,
+  and ``TraceWindow`` wrapping ``jax.profiler.start_trace/stop_trace``
+  around a configured step range.
+"""
+
+from deepspeed_tpu.telemetry.registry import (     # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, default_registry,
+    JsonlExporter, SummaryBridge, prometheus_text)
+from deepspeed_tpu.telemetry.spans import (        # noqa: F401
+    span, annotate, TraceWindow)

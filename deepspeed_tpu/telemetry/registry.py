@@ -1,0 +1,278 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+Design rules (the sync-discipline contract, docs/observability.md):
+
+- recording is host-only and cheap — a lock acquire plus a float store;
+  callers in hot loops (the engine's per-step path, the serving
+  scheduler tick) never pay a device sync to record;
+- histograms keep a bounded reservoir (most-recent ``maxlen``
+  observations) plus exact running count/sum/min/max, so percentiles
+  are over recent behaviour while totals stay exact;
+- everything is thread-safe: the serving scheduler and a training loop
+  may record into the same registry concurrently.
+
+Exporters are pull-based: they serialize a ``snapshot()`` — they never
+hold the registry lock across I/O.
+"""
+
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+
+
+def _process_rank():
+    """This process's rank for event tagging: the launcher's env, else
+    the jax process index — via utils.logging._process_index, which
+    asks WITHOUT initializing a backend (a bare jax.process_index()
+    before jax.distributed.initialize would pin every host to rank 0
+    and break the multi-host rendezvous)."""
+    for var in ("RANK", "PMI_RANK", "SLURM_PROCID"):
+        if os.environ.get(var):
+            try:
+                return int(os.environ[var])
+            except ValueError:
+                pass
+    from deepspeed_tpu.utils.logging import _process_index
+    return int(_process_index())
+
+
+class Counter:
+    """Monotonic float counter."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, lock):
+        self.value = 0.0
+        self._lock = lock
+
+    def inc(self, n=1.0):
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    """Last-value-wins scalar; ``set_max`` keeps a high-water mark."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, lock):
+        self.value = 0.0
+        self._lock = lock
+
+    def set(self, v):
+        with self._lock:
+            self.value = float(v)   # sync-ok: contract — host scalars only
+
+    def set_max(self, v):
+        with self._lock:
+            self.value = max(self.value, float(v))  # sync-ok: host scalars
+
+
+class Histogram:
+    """Bounded-reservoir histogram with exact count/sum/min/max."""
+
+    __slots__ = ("count", "sum", "min", "max", "_values", "_lock")
+
+    def __init__(self, lock, maxlen=1024):
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._values = deque(maxlen=maxlen)
+        self._lock = lock
+
+    def observe(self, v):
+        v = float(v)                # sync-ok: contract — host scalars only
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = min(self.min, v)
+            self.max = max(self.max, v)
+            self._values.append(v)
+
+    def summary(self):
+        with self._lock:
+            vals = sorted(self._values)
+            count, total = self.count, self.sum
+            lo, hi = self.min, self.max
+            # inside the lock: a concurrent observe() between the copy
+            # and this read would make 'last' inconsistent with the
+            # rest of the snapshot (last > max)
+            last = self._values[-1] if self._values else None
+        if not vals:
+            return {"count": 0, "sum": 0.0}
+
+        def pct(q):
+            return vals[min(len(vals) - 1,
+                            max(0, int(round(q / 100.0 * (len(vals) - 1)))))]
+        return {
+            "count": count,
+            "sum": total,
+            "mean": total / max(count, 1),
+            "min": lo,
+            "max": hi,
+            "p50": pct(50),
+            "p90": pct(90),
+            "p99": pct(99),
+            "last": last,
+        }
+
+
+class MetricsRegistry:
+    """Named metric store. Metric names are ``/``-separated paths
+    (``train/step_time_s``, ``serving/ttft_s``); the first segment is
+    the subsystem, which exporters may filter on."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._counters = {}
+        self._gauges = {}
+        self._histograms = {}
+
+    def counter(self, name) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(self._lock)
+            return c
+
+    def gauge(self, name) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(self._lock)
+            return g
+
+    def histogram(self, name, maxlen=1024) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(self._lock, maxlen)
+            return h
+
+    def snapshot(self, prefix=None):
+        """One JSON-able dict of everything (optionally filtered to
+        names starting with ``prefix``)."""
+        with self._lock:
+            counters = {k: c.value for k, c in self._counters.items()}
+            gauges = {k: g.value for k, g in self._gauges.items()}
+            hists = dict(self._histograms)
+        if prefix:
+            counters = {k: v for k, v in counters.items()
+                        if k.startswith(prefix)}
+            gauges = {k: v for k, v in gauges.items()
+                      if k.startswith(prefix)}
+            hists = {k: v for k, v in hists.items()
+                     if k.startswith(prefix)}
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": {k: h.summary() for k, h in hists.items()},
+        }
+
+    def reset(self):
+        """Drop every metric (snapshot-and-reset windows)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+_default = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry — the engine, spans, and serving
+    default here so one JSONL stream carries every subsystem."""
+    return _default
+
+
+# ---------------------------------------------------------------- export
+
+class JsonlExporter:
+    """Appends one JSON line per export: wall-clock timestamp, rank,
+    step, and the full snapshot — the multi-process-mergeable stream
+    (each rank writes its own file; events self-identify)."""
+
+    def __init__(self, path, registry=None):
+        self.path = path
+        self.registry = registry or default_registry()
+        self.rank = _process_rank()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._fh = open(path, "a")
+
+    def export(self, step=None, snapshot=None):
+        snap = snapshot if snapshot is not None else self.registry.snapshot()
+        self._fh.write(json.dumps({
+            "ts": time.time(),
+            "rank": self.rank,
+            "step": step,
+            "metrics": snap,
+        }) + "\n")
+        self._fh.flush()
+
+    def close(self):
+        self._fh.close()
+
+
+class SummaryBridge:
+    """Bridges a snapshot into the existing ``SummaryEventWriter``
+    (TensorBoard when available, JSONL events otherwise): counters and
+    gauges as plain scalars, histograms as p50/p90/p99/mean scalars."""
+
+    def __init__(self, writer, registry=None):
+        self.writer = writer
+        self.registry = registry or default_registry()
+
+    def export(self, step, snapshot=None):
+        snap = snapshot if snapshot is not None else self.registry.snapshot()
+        w = self.writer
+        for k, v in snap["counters"].items():
+            w.add_scalar(k, v, step)
+        for k, v in snap["gauges"].items():
+            w.add_scalar(k, v, step)
+        for k, s in snap["histograms"].items():
+            if not s.get("count"):
+                continue
+            for stat in ("mean", "p50", "p90", "p99"):
+                w.add_scalar(f"{k}/{stat}", s[stat], step)
+        w.flush()
+
+
+def _prom_name(name):
+    out = []
+    for ch in name:
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    n = "".join(out)
+    return ("_" + n) if n[:1].isdigit() else n
+
+
+def prometheus_text(registry=None, snapshot=None):
+    """Prometheus exposition-format text dump of a snapshot: counters
+    as ``counter``, gauges as ``gauge``, histograms as ``summary``
+    (quantiles + _sum/_count)."""
+    snap = snapshot if snapshot is not None else \
+        (registry or default_registry()).snapshot()
+    lines = []
+    for k, v in sorted(snap["counters"].items()):
+        n = _prom_name(k)
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {v}")
+    for k, v in sorted(snap["gauges"].items()):
+        n = _prom_name(k)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {v}")
+    for k, s in sorted(snap["histograms"].items()):
+        n = _prom_name(k)
+        lines.append(f"# TYPE {n} summary")
+        for q, stat in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+            if stat in s:
+                lines.append(f'{n}{{quantile="{q}"}} {s[stat]}')
+        lines.append(f"{n}_sum {s.get('sum', 0.0)}")
+        lines.append(f"{n}_count {s.get('count', 0)}")
+    return "\n".join(lines) + "\n"

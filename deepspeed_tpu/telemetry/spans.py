@@ -1,0 +1,144 @@
+"""Async-safe phase spans + the programmatic XLA trace window.
+
+The retired anti-pattern: ``SynchronizedWallClockTimer`` syncs the
+device on every ``start``/``stop`` read, which serializes dispatch
+against execution when wrapped around hot-loop phases (the reference's
+``cuda.synchronize`` habit, utils/timer.py). Spans here never sync:
+
+- ``span("tag")`` (host side) records the host wall time of the block
+  into ``span/{tag}`` and emits a ``jax.profiler.TraceAnnotation`` so
+  the block shows on the host timeline of an XLA trace. Around a jitted
+  call this measures **dispatch** time (async under jit) — real device
+  time for the block comes from the trace window or from a
+  ``steps_per_print``-boundary fence the caller already pays.
+- ``annotate("tag")`` (trace time) is ``jax.named_scope``: ops traced
+  under it carry the tag in their HLO metadata, so device-side phase
+  attribution (forward / backward / bucket-sync / prefetch-gather)
+  lands in perfetto/xprof without any runtime cost.
+- ``TraceWindow`` wraps ``jax.profiler.start_trace/stop_trace`` around
+  a configured step range (``profiling.trace_dir`` +
+  ``profiling.trace_steps``) — the one place a deliberate fence happens
+  (at stop, so the captured steps' device work is in the trace).
+"""
+
+import contextlib
+import time
+
+from deepspeed_tpu.telemetry.registry import default_registry
+from deepspeed_tpu.utils.logging import logger
+
+
+def annotate(tag):
+    """Trace-time scope: ops traced inside carry ``tag`` in HLO
+    metadata (shows up in xprof/perfetto op names). Zero runtime cost —
+    usable unconditionally inside jitted train fns."""
+    import jax
+    return jax.named_scope(tag)
+
+
+@contextlib.contextmanager
+def span(tag, registry=None, annotation=True):
+    """Host-side phase span: wall time into ``span/{tag}`` plus a
+    profiler TraceAnnotation. NEVER syncs the device — around a jitted
+    call this measures dispatch, by design (sync discipline,
+    docs/observability.md). Async-safe: state lives on the stack, the
+    registry locks per record; concurrent spans from other threads
+    (e.g. the serving scheduler) interleave correctly."""
+    reg = registry or default_registry()
+    ann = None
+    if annotation:
+        try:
+            import jax
+            ann = jax.profiler.TraceAnnotation(tag)
+            ann.__enter__()
+        except Exception:   # profiler backends are optional
+            ann = None
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        reg.histogram(f"span/{tag}").observe(dt)
+
+
+class TraceWindow:
+    """Config-gated programmatic profiler window: capture steps
+    ``[start, stop)`` of the training loop into ``trace_dir`` (xprof
+    format — open in perfetto / tensorboard-profile). Start/stop are
+    engine ``global_steps`` values as seen BEFORE the step runs.
+
+    The window stops with a caller-supplied fence so the traced steps'
+    device work is actually inside the capture; that one sync is the
+    point of the window and never happens unless tracing was on."""
+
+    def __init__(self, trace_dir, start_step, stop_step, registry=None):
+        assert stop_step > start_step >= 0, (start_step, stop_step)
+        self.trace_dir = trace_dir
+        self.start_step = int(start_step)
+        self.stop_step = int(stop_step)
+        self.active = False
+        self.done = False
+        self._registry = registry or default_registry()
+
+    @classmethod
+    def from_config(cls, profiling_cfg):
+        """None when the gate is off (no trace_dir or no trace_steps)."""
+        if not getattr(profiling_cfg, "trace_dir", None):
+            return None
+        steps = getattr(profiling_cfg, "trace_steps", None)
+        if not steps:
+            return None
+        return cls(profiling_cfg.trace_dir, steps[0], steps[1])
+
+    def on_step_begin(self, step):
+        if self.done or self.active or step < self.start_step \
+                or step >= self.stop_step:
+            return
+        import jax
+        try:
+            jax.profiler.start_trace(self.trace_dir)
+        except Exception as e:   # a second live trace, unwritable dir …
+            logger.warning(f"trace window failed to start: {e}")
+            self.done = True
+            return
+        self.active = True
+        # a run that ends before stop_step-1 (crash, short loop) must
+        # still finalize the capture — a dangling live trace writes no
+        # artifact and blocks every later start_trace in the process
+        import atexit
+        atexit.register(self.close)
+        self._registry.counter("profiling/trace_windows").inc()
+        logger.info(f"[telemetry] XLA trace started (steps "
+                    f"[{self.start_step}, {self.stop_step}) -> "
+                    f"{self.trace_dir})")
+
+    def on_step_end(self, step, fence=None):
+        """``step`` is the same pre-run index passed to on_step_begin;
+        ``fence`` (e.g. a loss readback) runs before stop_trace so the
+        final step's device work lands in the capture."""
+        if not self.active or step < self.stop_step - 1:
+            return
+        if fence is not None:
+            try:
+                fence()   # sync-ok: trace-window close, config-gated
+            except Exception:
+                pass
+        self.close()
+
+    def close(self):
+        """Finalize an active capture (idempotent; also the atexit
+        safety net for runs shorter than the configured window)."""
+        if not self.active:
+            return
+        import atexit
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            logger.warning(f"trace window failed to stop: {e}")
+        self.active = False
+        self.done = True
+        atexit.unregister(self.close)
+        logger.info(f"[telemetry] XLA trace written to {self.trace_dir}")

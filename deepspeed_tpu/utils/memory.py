@@ -43,6 +43,24 @@ def _device_memory_stats():
         return []
 
 
+def host_max_rss_mb():
+    """Host peak RSS in MB (ru_maxrss is KB on Linux)."""
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def memory_metrics():
+    """One flat dict of the memory observables, for the telemetry
+    scalar stream: host RSS, per-device HBM in use where the backend
+    exposes it, and the stage3_prefetch live-gathered window."""
+    out = {"host_max_rss_mb": host_max_rss_mb()}
+    if _live_gathered_param_bytes is not None:
+        out["live_gathered_param_bytes"] = _live_gathered_param_bytes
+    for i, (_, in_use, limit) in enumerate(_device_memory_stats()):
+        out[f"device{i}_bytes_in_use"] = in_use
+        out[f"device{i}_bytes_limit"] = limit
+    return out
+
+
 def see_memory_usage(message, force=False):
     if not force:
         return

@@ -10,6 +10,7 @@ monitoring never becomes a hard dependency.
 import atexit
 import json
 import os
+import time
 
 from deepspeed_tpu.utils.logging import logger
 
@@ -22,13 +23,21 @@ class SummaryEventWriter:
         os.makedirs(self.log_dir, exist_ok=True)
         self._tb = None
         self._fh = None
+        # every JSONL event self-identifies for multi-process merge:
+        # {tag, value, step} alone cannot be interleaved across ranks
+        from deepspeed_tpu.telemetry.registry import _process_rank
+        self._rank = _process_rank()
         try:
             from torch.utils.tensorboard import SummaryWriter
             self._tb = SummaryWriter(log_dir=self.log_dir)
         except Exception as e:
             logger.warning(f"tensorboard unavailable ({e}); "
                            f"writing JSONL events to {self.log_dir}")
-            self._fh = open(os.path.join(self.log_dir, "events.jsonl"), "a")
+            # one file per rank: concurrent appends from several
+            # processes into one file would interleave mid-line
+            name = "events.jsonl" if self._rank == 0 \
+                else f"events_rank{self._rank}.jsonl"
+            self._fh = open(os.path.join(self.log_dir, name), "a")
             # the engine has no teardown hook that reliably runs on process
             # exit; without this, scalars buffered since the last
             # steps_per_print flush are lost
@@ -39,7 +48,8 @@ class SummaryEventWriter:
             self._tb.add_scalar(tag, float(value), int(step))
         else:
             self._fh.write(json.dumps(
-                {"tag": tag, "value": float(value), "step": int(step)}) + "\n")
+                {"tag": tag, "value": float(value), "step": int(step),
+                 "ts": time.time(), "rank": self._rank}) + "\n")
 
     def flush(self):
         if self._tb is not None:

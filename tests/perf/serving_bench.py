@@ -99,7 +99,7 @@ def run_serving_bench(n_requests=32, slots=4, seed=0,
         res = eng.serve(make_requests(), respect_arrival_times=True)
         dt = time.monotonic() - t0
         assert len(res) == n_requests
-        return dt, eng.stats
+        return dt, eng.stats, eng.metrics_snapshot()
 
     # one cache length for every static gang → one compiled decode_scan
     max_out = int(np.max(lens)) + int(news.max())
@@ -135,12 +135,12 @@ def run_serving_bench(n_requests=32, slots=4, seed=0,
     # run-to-run noise and the comparison should report the scheduler,
     # not which system a descheduling blip landed on (same rule as
     # bench.py's 3-window MFU)
-    dt_c, stats = run_continuous()
+    dt_c, stats, telemetry = run_continuous()
     dt_s = run_static()
     for _ in range(2):
-        dt_c2, stats2 = run_continuous()
+        dt_c2, stats2, telemetry2 = run_continuous()
         if dt_c2 < dt_c:
-            dt_c, stats = dt_c2, stats2
+            dt_c, stats, telemetry = dt_c2, stats2, telemetry2
         dt_s = min(dt_s, run_static())
 
     out = {
@@ -159,6 +159,10 @@ def run_serving_bench(n_requests=32, slots=4, seed=0,
             "tick_steps": stats["tick_steps"],
             "mean_slot_occupancy": round(
                 stats["decode_tokens"] / max(stats["tick_steps"], 1), 2),
+            # the serving engine's own metrics (TTFT, admission wait,
+            # tick latency, page-pool occupancy HWM — the winning
+            # window's snapshot)
+            "telemetry": telemetry,
         },
         "static": {
             "requests_per_sec": round(n_requests / dt_s, 2),

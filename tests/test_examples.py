@@ -69,6 +69,24 @@ def test_example_bert_squad():
     assert final < first, (first, final)
 
 
+@pytest.mark.slow
+def test_example_observability_demo(tmp_path):
+    """The ISSUE-4 acceptance artifact end to end in a subprocess: a
+    20-step run emits the JSONL snapshot stream, the scalar events, the
+    Prometheus dump, and a non-empty XLA trace window."""
+    out_dir = str(tmp_path / "tel")
+    out = _run_example("observability_demo.py", "--out", out_dir,
+                       "--steps", "12", devices=1)
+    assert os.path.getsize(os.path.join(out_dir,
+                                        "telemetry_rank0.jsonl")) > 0
+    assert os.path.getsize(os.path.join(out_dir, "metrics.prom")) > 0
+    assert any(files for _, _, files
+               in os.walk(os.path.join(out_dir, "trace")))
+    assert '"train/steps": 12.0' in out
+    assert '"train/mfu"' in out and '"train/step_time_s"' in out
+    assert '"span/train/forward"' in out   # per-phase span times
+
+
 def test_example_llama_pretrain():
     out = _run_example("llama_pretrain.py", "--steps", "8", "--batch", "8",
                        "--seq", "64", "--hidden", "128", "--layers", "2",
