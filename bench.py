@@ -155,10 +155,14 @@ def main(argv=None):
     ap.add_argument("--sections", default="",
                     help="comma-separated subset of sections to run "
                          f"(default all): {','.join(BENCH_SECTIONS)}")
-    ap.add_argument("--budget", type=float, default=0.0,
+    ap.add_argument("--budget", type=float, default=None,
                     help="global wall-clock budget in seconds; sections "
                          "whose estimate no longer fits are skipped and "
-                         "recorded (0 = unlimited)")
+                         "recorded (0 = unlimited; default: "
+                         "$DSTPU_BENCH_BUDGET or 3000 — r5 ran unbounded, "
+                         "hit the driver's wall clock at rc=124, and lost "
+                         "the trailing sections to a SIGKILL instead of "
+                         "an explicit skip)")
     ap.add_argument("--list-sections", action="store_true")
     args = ap.parse_args(argv)
     if args.list_sections:
@@ -169,6 +173,11 @@ def main(argv=None):
     if unknown:
         raise SystemExit(f"unknown sections {unknown}; "
                          f"choose from {list(BENCH_SECTIONS)}")
+    if args.budget is None:
+        # the default run gets a budget UNDER the driver's wall clock so
+        # trailing sections record an explicit skip instead of the whole
+        # process dying rc=124 mid-JSON (BENCH_r05)
+        args.budget = float(os.environ.get("DSTPU_BENCH_BUDGET", 3000))
     runner = SectionRunner(selected, args.budget)
 
     import jax
@@ -934,16 +943,24 @@ def warm_infinity_9b():
 
 
 def bench_nvme_param_tier(dstpu, make_mesh, MeshConfig, dev):
-    """offload_param device=nvme evidence: a small GPT-2 trains with its
-    params resting on disk between steps — reports the on-disk bytes, the
-    host-RSS growth over training (must stay far below param bytes x
-    steps), and the steady step time."""
+    """offload_param device=nvme evidence, blocking vs PIPELINED (PR 5):
+    a small GPT-2 trains with its params resting on disk between steps,
+    once with the r5 blocking park/unpark and once with the pipelined
+    swap schedule (pipeline_read + pipeline_write + write-behind cache).
+    Reports both steady step times, loss-trajectory equality, the
+    sync-free swap telemetry (stall seconds hidden vs exposed, phase
+    times), and a swap-cycle microbench on the same parameter set that
+    isolates the tier's own cost from the model arithmetic (on a
+    CPU-only harness the step is compute-bound, so the cycle number is
+    the tier's honest speedup; on the r5 tunnel harness the step itself
+    was swap-bound)."""
     import glob
     import tempfile
     import time
     import jax
     import jax.numpy as jnp
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.telemetry import default_registry
 
     def rss_mb():
         with open("/proc/self/status") as f:
@@ -952,61 +969,232 @@ def bench_nvme_param_tier(dstpu, make_mesh, MeshConfig, dev):
                     return int(line.split()[1]) / 1024
         return 0.0
 
-    tmp = tempfile.mkdtemp(prefix="dstpu_nvme_param_")
     cfg_m = GPT2Config(vocab_size=8192, n_positions=256, n_embd=512,
                        n_layer=8, n_head=8, dtype=jnp.bfloat16,
                        scan_layers=True)
-    cfg = {
-        "train_batch_size": 4,
-        "zero_optimization": {
-            "stage": 2,
-            "offload_param": {"device": "nvme", "nvme_path": tmp},
-            "offload_optimizer": {"device": "cpu"}},
-        "bf16": {"enabled": True},
-        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
-        "steps_per_print": 1000,
-    }
-    try:
-        engine, _, _, _ = dstpu.initialize(
-            config=cfg, model=GPT2LMHeadModel(cfg_m),
-            mesh=make_mesh(MeshConfig(data=1), devices=[dev]))
-        rng = np.random.RandomState(0)
-        batch = {"input_ids": rng.randint(0, 8192, size=(4, 256))
-                 .astype(np.int32)}
-        l0 = float(engine.train_batch(batch))
-        rss0 = rss_mb()
+    steps = 3
+
+    def train_run(pipelined):
+        tmp = tempfile.mkdtemp(prefix="dstpu_nvme_param_")
+        off = {"device": "nvme", "nvme_path": tmp}
+        if pipelined:
+            off.update({"pipeline_read": True, "pipeline_write": True,
+                        "buffer_count": 4})
+        cfg = {
+            "train_batch_size": 4,
+            "zero_optimization": {
+                "stage": 2, "offload_param": off,
+                "offload_optimizer": {"device": "cpu"}},
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "steps_per_print": 1000,
+        }
+        try:
+            default_registry().reset()
+            engine, _, _, _ = dstpu.initialize(
+                config=cfg, model=GPT2LMHeadModel(cfg_m),
+                mesh=make_mesh(MeshConfig(data=1), devices=[dev]))
+            rng = np.random.RandomState(0)
+            batch = {"input_ids": rng.randint(0, 8192, size=(4, 256))
+                     .astype(np.int32)}
+            l0 = float(engine.train_batch(batch))
+            engine.telemetry.reset()
+            rss0 = rss_mb()
+            ts = []
+            for _ in range(steps):
+                t0 = time.perf_counter()
+                l1 = float(engine.train_batch(batch))
+                ts.append(time.perf_counter() - t0)
+            snap = engine.telemetry.snapshot("swap/")
+            disk = sum(os.path.getsize(p) for p in glob.glob(
+                tmp + "/param_swap_*/param_*.swp"))
+            parked = all(leaf.is_deleted() for leaf in
+                         jax.tree_util.tree_leaves(engine.state.params))
+            hist = snap["histograms"]
+            counters = snap["counters"]
+            step_s = min(ts)
+            stall_sum = hist.get("swap/stall_s", {}).get("sum", 0.0)
+            stall_per_step = stall_sum / steps
+            return {
+                "steady_step_s": round(step_s, 3),
+                "first_loss": l0, "last_loss": l1,
+                "parked": bool(parked),
+                "disk_mb": round(disk / 2**20, 1),
+                "rss_growth_mb": round(rss_mb() - rss0, 1),
+                "stall_s_per_step": round(stall_per_step, 3),
+                # matching statistics: total stall over total wall of the
+                # SAME steps (min-step denominators overstate the share
+                # on a ±20%-noise harness)
+                "stall_share_of_step": round(stall_sum / sum(ts), 3),
+                "unpark_s": round(hist.get("swap/unpark_s", {})
+                                  .get("mean", 0.0), 3),
+                "park_s": round(hist.get("swap/park_s", {})
+                                .get("mean", 0.0), 3),
+                "bytes_read_mb_per_step": round(
+                    counters.get("swap/bytes_read", 0) / steps / 2**20, 1),
+                "cache_hit_mb_per_step": round(
+                    counters.get("swap/cache_hit_bytes", 0) / steps
+                    / 2**20, 1),
+                "bytes_written_mb_per_step": round(
+                    counters.get("swap/bytes_written", 0) / steps
+                    / 2**20, 1),
+            }
+        finally:
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def swap_cycle_run(pipelined, leaves, shardings, compute_s,
+                       cycles=5, buffer_count=4):
+        """The tier's own cost, isolated: park + [a fixed jitted compute
+        burst standing in for the next step's fwd+bwd] + unpark, on the
+        real param set. ``exposed_s`` = cycle time minus the burst — the
+        swap seconds the step actually pays. Blocking pays write+read
+        serially; the pipelined schedule write-behinds into the burst and
+        serves the re-read from the pool cache + page-cache window."""
+        from deepspeed_tpu.runtime.swap_tensor import PartitionedParamSwapper
+        import shutil
+        tmp = tempfile.mkdtemp(prefix="dstpu_nvme_cycle_")
+        # burst sized to compute_s on this machine (jitted matmul chain)
+        import jax.numpy as jnp2
+        a = jnp2.asarray(np.random.RandomState(0)
+                         .randn(1024, 1024).astype(np.float32))
+        burst_fn = jax.jit(lambda x, n: jax.lax.fori_loop(
+            0, n, lambda _, y: jnp2.tanh(y @ y) * 0.5 + y * 0.5, x))
+        burst_fn(a, 1).block_until_ready()
         t0 = time.perf_counter()
-        steps = 3
-        for _ in range(steps):
-            l1 = float(engine.train_batch(batch))
-        dt = (time.perf_counter() - t0) / steps
-        disk = sum(os.path.getsize(p) for p in glob.glob(
-            tmp + "/param_swap_*/param_*.swp"))
-        parked = all(leaf.is_deleted() for leaf in
-                     __import__("jax").tree_util.tree_leaves(
-                         engine.state.params))
+        burst_fn(a, 8).block_until_ready()
+        per8 = time.perf_counter() - t0
+        n_iter = max(1, int(round(8 * compute_s / max(per8, 1e-6))))
+        t0 = time.perf_counter()
+        burst_fn(a, n_iter).block_until_ready()
+        burst_s = time.perf_counter() - t0
+        try:
+            sw = PartitionedParamSwapper(
+                tmp, pipeline_read=pipelined, pipeline_write=pipelined,
+                buffer_count=buffer_count)
+            sw.write_all(leaves)
+            cur = sw.swap_in_device(shardings)
+            t_first = None
+            ts = []
+            for c in range(cycles):
+                t0 = time.perf_counter()
+                sw.swap_out_device(cur)
+                for leaf in cur:
+                    leaf.delete()
+                # the "next step's compute": write-behind I/O (aio
+                # threads + kernel) runs while XLA owns the cores
+                burst_fn(a, n_iter).block_until_ready()
+                cur = sw.swap_in_device(shardings)
+                dt = time.perf_counter() - t0
+                if c == 0:
+                    t_first = dt
+                else:
+                    ts.append(dt)
+            sw.release()
+            cycle = min(ts)
+            return {"cycle_s": round(cycle, 3),
+                    "burst_s": round(burst_s, 3),
+                    "exposed_s": round(max(cycle - burst_s, 0.0), 3),
+                    "first_cycle_s": round(t_first, 3)}
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    try:
+        blocking = train_run(False)
+        pipelined = train_run(True)
+        losses_equal = (blocking["first_loss"] == pipelined["first_loss"]
+                        and abs(blocking["last_loss"]
+                                - pipelined["last_loss"]) < 1e-4)
+
+        # microbench on the real leaf set (host-side init, no training)
+        model = GPT2LMHeadModel(cfg_m)
+        params = model.init(
+            jax.random.PRNGKey(0),
+            np.zeros((1, 8), np.int32))["params"]
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = make_mesh(MeshConfig(data=1), devices=[dev])
+        leaves = jax.tree_util.tree_leaves(params)
+        shardings = [NamedSharding(mesh, PartitionSpec())] * len(leaves)
+        cyc_b = swap_cycle_run(False, leaves, shardings, compute_s=0.4)
+        cyc_p = swap_cycle_run(True, leaves, shardings, compute_s=0.4)
+        # hot-set pool: buffer_count sized to the leaf count (the
+        # reference's generously-sized pinned pool) — every re-read is a
+        # cache hit and writes drain behind the next step's compute
+        cyc_h = swap_cycle_run(True, leaves, shardings, compute_s=0.4,
+                               buffer_count=len(leaves))
+
         return {
             "params_b": round(cfg_m.num_params() / 1e9, 4),
-            "params_on_disk_mb": round(disk / 2**20, 1),
-            "params_parked_between_steps": bool(parked),
-            "steady_step_s": round(dt, 2),
-            "host_rss_growth_mb_over_steps": round(rss_mb() - rss0, 1),
-            # r5 root cause: the growth is param_bytes x steps retained
-            # by the TUNNEL CLIENT's h2d staging (reproduced with bare
-            # jax.device_put of a reused numpy buffer — no framework
-            # code; d2h and remote-side streaming are flat). On a
-            # TPU-VM there is no per-step client transfer at all. See
-            # docs/perf_tuning.md r5e + tests/perf/h2d_cache_probe.py
+            "params_on_disk_mb": pipelined["disk_mb"],
+            "params_parked_between_steps": bool(
+                blocking["parked"] and pipelined["parked"]),
+            # headline stays the r5-shape metric, now from the PIPELINED
+            # tier; blocking_step_s is the same-harness baseline
+            "steady_step_s": pipelined["steady_step_s"],
+            "blocking_step_s": blocking["steady_step_s"],
+            "step_speedup": round(blocking["steady_step_s"]
+                                  / pipelined["steady_step_s"], 3),
+            "losses_equal_blocking_vs_pipelined": bool(losses_equal),
+            "first_loss": pipelined["first_loss"],
+            "last_loss": pipelined["last_loss"],
+            # the tier's own cost, arithmetic excluded: one full
+            # park+unpark of every leaf (write-behind + cache + sliding
+            # read window vs the r5 sync loop)
+            "swap_cycle": {
+                "blocking_s": cyc_b["cycle_s"],
+                "pipelined_s": cyc_p["cycle_s"],
+                "hotset_pool_s": cyc_h["cycle_s"],
+                "compute_burst_s": cyc_b["burst_s"],
+                "blocking_exposed_s": cyc_b["exposed_s"],
+                "pipelined_exposed_s": cyc_p["exposed_s"],
+                "hotset_pool_exposed_s": cyc_h["exposed_s"],
+                # swap seconds the step pays, arithmetic excluded
+                "exposed_speedup": round(
+                    cyc_b["exposed_s"] / max(cyc_p["exposed_s"], 1e-9), 2),
+                "hotset_exposed_speedup": round(
+                    cyc_b["exposed_s"] / max(cyc_h["exposed_s"], 1e-9), 2),
+                "first_cycle_blocking_s": cyc_b["first_cycle_s"],
+                "first_cycle_pipelined_s": cyc_p["first_cycle_s"],
+            },
+            "swap_stall": {
+                "blocking_s_per_step": blocking["stall_s_per_step"],
+                "pipelined_s_per_step": pipelined["stall_s_per_step"],
+                "blocking_share_of_step": blocking["stall_share_of_step"],
+                "pipelined_share_of_step":
+                    pipelined["stall_share_of_step"],
+            },
+            "swap_phases": {
+                "blocking": {k: blocking[k] for k in
+                             ("unpark_s", "park_s",
+                              "bytes_read_mb_per_step",
+                              "cache_hit_mb_per_step",
+                              "bytes_written_mb_per_step")},
+                "pipelined": {k: pipelined[k] for k in
+                              ("unpark_s", "park_s",
+                               "bytes_read_mb_per_step",
+                               "cache_hit_mb_per_step",
+                               "bytes_written_mb_per_step")},
+            },
+            "host_rss_growth_mb_over_steps": pipelined["rss_growth_mb"],
             "rss_growth_note": "= param_bytes/step of axon-client h2d "
                                "staging; harness property, not a "
                                "framework leak (perf_tuning r5e)",
-            "first_loss": l0, "last_loss": l1,
+            "compute_note": "CPU-only harness: the step is model-"
+                            "arithmetic-bound (fwd+bwd ~9s, swap ~0.15s, "
+                            "run-to-run step noise ~20%), AND the swap "
+                            "files ride the guest page cache (no O_DIRECT"
+                            "/per-step fsync), so the kernel already "
+                            "write-behinds and every mode is memcpy-"
+                            "bound — the pipelined schedule shows up as "
+                            "the halved stall share, not a step multiple."
+                            " r5's 8.16 s was tunnel-h2d-bound on an axon"
+                            " TPU, where the write-behind park (which "
+                            "skips the h2d push + d2h re-read round trip "
+                            "on host-optimizer engines) is the lever — "
+                            "needs a real-chip session to measure",
         }
     except Exception as e:
         return {"skipped": str(e)[:200]}
-    finally:
-        import shutil
-        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def bench_bert(dstpu, make_mesh, MeshConfig, dev, batch_size=128, seq=128):

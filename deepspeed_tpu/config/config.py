@@ -33,12 +33,22 @@ class ZeroOffloadConfig:
         d = d or {}
         self.device = get_scalar_param(d, C.OFFLOAD_DEVICE, C.OFFLOAD_NONE_DEVICE)
         self.nvme_path = get_scalar_param(d, C.OFFLOAD_NVME_PATH, None)
-        self.buffer_count = int(get_scalar_param(d, C.OFFLOAD_BUFFER_COUNT, 5))
+        self.buffer_count = int(get_scalar_param(
+            d, C.OFFLOAD_BUFFER_COUNT, C.OFFLOAD_BUFFER_COUNT_DEFAULT))
         self.buffer_size = int(get_scalar_param(d, C.OFFLOAD_BUFFER_SIZE, int(1e8)))
         self.pin_memory = bool(get_scalar_param(d, C.OFFLOAD_PIN_MEMORY, False))
         self.max_in_cpu = int(get_scalar_param(d, C.OFFLOAD_MAX_IN_CPU, int(1e9)))
-        self.pipeline_read = bool(get_scalar_param(d, C.OFFLOAD_PIPELINE_READ, False))
-        self.pipeline_write = bool(get_scalar_param(d, C.OFFLOAD_PIPELINE_WRITE, False))
+        # pipelined swap schedules (consumed by swap_tensor/swapper.py):
+        # read = sliding-window swap-in over buffer_count staging slots,
+        # write = write-behind park on a dedicated aio handle
+        self.pipeline_read = bool(get_scalar_param(
+            d, C.OFFLOAD_PIPELINE_READ, C.OFFLOAD_PIPELINE_READ_DEFAULT))
+        self.pipeline_write = bool(get_scalar_param(
+            d, C.OFFLOAD_PIPELINE_WRITE, C.OFFLOAD_PIPELINE_WRITE_DEFAULT))
+        if self.buffer_count < 1:
+            raise DeepSpeedConfigError(
+                f"offload {C.OFFLOAD_BUFFER_COUNT} must be >= 1, "
+                f"got {self.buffer_count}")
         self.fast_init = bool(get_scalar_param(d, C.OFFLOAD_FAST_INIT, False))
         # TPU extension (offload_optimizer only): how the offloaded
         # optimizer step executes.
@@ -72,7 +82,10 @@ class ZeroOffloadConfig:
 
     def repr_dict(self):
         return {"device": self.device, "nvme_path": self.nvme_path,
-                "buffer_count": self.buffer_count, "buffer_size": self.buffer_size}
+                "buffer_count": self.buffer_count,
+                "buffer_size": self.buffer_size,
+                "pipeline_read": self.pipeline_read,
+                "pipeline_write": self.pipeline_write}
 
 
 class DeepSpeedZeroConfig:

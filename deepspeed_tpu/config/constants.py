@@ -162,11 +162,19 @@ OFFLOAD_NVME_DEVICE = "nvme"
 OFFLOAD_NONE_DEVICE = "none"
 OFFLOAD_NVME_PATH = "nvme_path"
 OFFLOAD_BUFFER_COUNT = "buffer_count"
+OFFLOAD_BUFFER_COUNT_DEFAULT = 5
 OFFLOAD_BUFFER_SIZE = "buffer_size"
 OFFLOAD_PIN_MEMORY = "pin_memory"
 OFFLOAD_MAX_IN_CPU = "max_in_cpu"
+# pipelined swap schedules (reference aio/pipelined_optimizer_swapper
+# knobs): pipeline_read streams swap-in through a sliding window of
+# buffer_count staging slots; pipeline_write parks leaves write-behind on
+# a dedicated aio handle (drain-fenced before any re-read). Host staging
+# is bounded at ~2 x buffer_count x largest-leaf bytes.
 OFFLOAD_PIPELINE_READ = "pipeline_read"
 OFFLOAD_PIPELINE_WRITE = "pipeline_write"
+OFFLOAD_PIPELINE_READ_DEFAULT = False
+OFFLOAD_PIPELINE_WRITE_DEFAULT = False
 OFFLOAD_FAST_INIT = "fast_init"
 # TPU extension: how the offloaded optimizer step executes (offload_stream.py)
 OFFLOAD_STREAM = "stream"

@@ -187,6 +187,7 @@ class DeepSpeedEngine:
         self._param_offload_nvme = False
         self._param_swapper = None
         self._params_parked = False
+        self._parked_via_push = False
         if self._param_offload_host:
             from deepspeed_tpu.utils.platform import is_tpu_backend
             if param_offload.device == C.OFFLOAD_NVME_DEVICE:
@@ -600,22 +601,72 @@ class DeepSpeedEngine:
             # the files themselves are first written by the post-step
             # _park_params — params are device-resident until then, so an
             # eager write here would be dead work the first park overwrites
-            from deepspeed_tpu.runtime.swap_tensor import (
-                PartitionedParamSwapper)
-            self._param_swapper = PartitionedParamSwapper(
-                self._config.zero_config.offload_param.nvme_path,
-                self._config.aio_config)
+            self._param_swapper = self._make_param_swapper()
         see_memory_usage("after engine state init",
                          force=self._config.memory_breakdown)
+
+    def _make_param_swapper(self):
+        """The NVMe param-tier swapper, wired to the offload_param
+        pipeline knobs (pipeline_read/pipeline_write/buffer_count) and
+        this engine's telemetry registry."""
+        from deepspeed_tpu.runtime.swap_tensor import PartitionedParamSwapper
+        pc = self._config.zero_config.offload_param
+        return PartitionedParamSwapper(
+            pc.nvme_path, self._config.aio_config,
+            pipeline_read=pc.pipeline_read,
+            pipeline_write=pc.pipeline_write,
+            buffer_count=pc.buffer_count,
+            registry=self.telemetry)
+
+    def _param_swap_order(self):
+        """The per-layer swap schedule: the order param leaves stream
+        disk→host→device at unpark, derived from the partitioner's
+        layer-stacked prefixes (the stage3_prefetch layer contract).
+        First-consumed leaves first — outer (embedding-side) leaves, then
+        the stacked transformer blocks the in-jit prefetch pipeline
+        slices layer by layer — so the device assembles inputs in compute
+        order while later groups are still on disk. Pure metadata: any
+        permutation is correct; this one pipelines best."""
+        order = getattr(self, "_param_swap_order_cache", None)
+        if order is not None and len(order) == len(
+                jax.tree_util.tree_leaves(self.state_shardings.params)):
+            return order
+        flat, _ = jax.tree_util.tree_flatten_with_path(
+            self.state_shardings.params)
+        stacked = set(self.zero.layer_stacked_prefixes or ())
+        if not stacked:
+            sub = getattr(self.module, "prefetch_layer_subtree", None)
+            if sub:
+                stacked = {sub}
+
+        def head(path):
+            if not path:
+                return ""
+            p = path[0]
+            return str(getattr(p, "key", getattr(p, "name", p)))
+
+        outer = [i for i, (p, _) in enumerate(flat) if head(p) not in stacked]
+        inner = [i for i, (p, _) in enumerate(flat) if head(p) in stacked]
+        # flatten order puts the block subtree ("h") before ln_f/wpe/wte;
+        # reversing the outer list puts the embedding leaves first
+        order = outer[::-1] + inner
+        self._param_swap_order_cache = order
+        return order
 
     # -- NVMe parameter residency (ZeRO-Infinity param tier) ---------------
     def _ensure_params_resident(self):
         """Parked params (resting on NVMe) stream back to the device before
-        any computation that reads them."""
+        any computation that reads them — in swap-schedule order, through
+        the pipelined read window (and the write-behind byte cache) when
+        the offload_param pipeline knobs are on."""
         if not self._params_parked:
             return
+        t0 = time.perf_counter()
         leaves = self._param_swapper.swap_in_device(
-            jax.tree_util.tree_leaves(self.state_shardings.params))
+            jax.tree_util.tree_leaves(self.state_shardings.params),
+            order=self._param_swap_order())
+        self.telemetry.histogram("swap/unpark_s").observe(
+            time.perf_counter() - t0)
         self.state = TrainState(
             params=jax.tree_util.tree_unflatten(
                 jax.tree_util.tree_structure(self.state_shardings.params),
@@ -628,17 +679,29 @@ class DeepSpeedEngine:
     def _park_params(self):
         """Write the (updated) device params back to NVMe and free their
         HBM — params rest on disk between steps, so at rest the chip holds
-        no parameter bytes and host RAM holds only the 2-buffer staging."""
+        no parameter bytes and host RAM holds only the bounded staging
+        pool. With ``pipeline_write`` the disk writes run behind this call
+        (swap-out of step N overlaps everything up to step N+1's unpark,
+        whose drain fence guarantees no leaf is re-read mid-write); when
+        the host optimizer already parked the updated leaves directly
+        (``_parked_via_push``), only the stale device copies remain to
+        free."""
         if self._param_swapper is None or self._params_parked:
             return
+        t0 = time.perf_counter()
         leaves = jax.tree_util.tree_leaves(self.state.params)
-        self._param_swapper.swap_out_device(leaves)
+        if getattr(self, "_parked_via_push", False):
+            self._parked_via_push = False
+        else:
+            self._param_swapper.swap_out_device(leaves)
         for leaf in leaves:
             try:
                 leaf.delete()
             except Exception:
                 pass
         self._params_parked = True
+        self.telemetry.histogram("swap/park_s").observe(
+            time.perf_counter() - t0)
 
     # ------------------------------------------------------------------
     # loss
@@ -1334,11 +1397,15 @@ class DeepSpeedEngine:
         zc = self._config.zero_config
         if not zc.stage3_prefetch:
             return False
-        if self._offload_cfg.enabled or self._param_offload_host or \
-                self._param_offload_nvme:
-            log_dist("stage3_prefetch: offload tiers stream params/state "
-                     "through host memory on their own schedule; falling "
-                     "back to the fused GSPMD stage-3 exchange", ranks=[0])
+        if self._offload_cfg.enabled or self._param_offload_host:
+            # the NVMe param tier COMPOSES (its swap schedule streams
+            # disk→host→device before the step; the in-jit pipeline then
+            # gathers layer by layer) — but the optimizer-offload and
+            # pinned-host tiers run the step off-device/off-schedule
+            log_dist("stage3_prefetch: optimizer/pinned-host offload "
+                     "tiers stream state through host memory on their own "
+                     "schedule; falling back to the fused GSPMD stage-3 "
+                     "exchange", ranks=[0])
             return False
         if self._compressed_comm_active() or self._sparse_grad_active():
             return False
@@ -2240,6 +2307,28 @@ class DeepSpeedEngine:
             new_leaves = self._host_runner.step(
                 jax.tree_util.tree_leaves(grads), lr, grad_scale=coef,
                 out_dtype=out_dtype)
+        elif self._param_swapper is not None \
+                and self._param_swapper.pipeline_write \
+                and self.quantizer is None:
+            # (MoQ reads state.params at the step boundary, which this
+            # shortcut leaves stale — quantizing engines keep the push)
+            # pipelined NVMe park, host-optimizer shortcut: each leaf's
+            # updated compute-dtype copy comes OUT of the SIMD step on the
+            # host, so park it straight to the write-behind queue — no h2d
+            # push + d2h re-read round trip (that round trip was the whole
+            # park cost on tunneled backends). The device copies that fed
+            # fwd+bwd are stale now; _park_params just frees them.
+            swapper = self._param_swapper
+
+            def park(i, host_arr):
+                swapper.write_behind(i, host_arr)
+                return None
+
+            self._host_runner.step_streamed(
+                jax.tree_util.tree_leaves(grads), lr, grad_scale=coef,
+                push_fn=park, out_dtype=out_dtype)
+            self._parked_via_push = True
+            new_leaves = jax.tree_util.tree_leaves(self.state.params)
         else:
             shard_leaves = jax.tree_util.tree_leaves(
                 self.state_shardings.params)
@@ -2431,6 +2520,20 @@ class DeepSpeedEngine:
         if tokens:
             reg.counter("train/tokens").inc(tokens)
         self._tel_window_tokens += tokens
+        # swap tier: host seconds this step actually BLOCKED on disk I/O
+        # (the pipelined schedules shrink this toward zero while the
+        # bytes_read/written counters keep moving — I/O hidden behind
+        # compute). Host timers only, sync-free.
+        stall = 0.0
+        have_swap = self._param_swapper is not None
+        if have_swap:
+            stall += self._param_swapper.take_stall_s()
+        opt_swapper = getattr(self._host_runner, "swapper", None)
+        if opt_swapper is not None:
+            have_swap = True
+            stall += opt_swapper.take_stall_s()
+        if have_swap:
+            reg.histogram("swap/stall_s").observe(stall)
         if self.global_steps % self.steps_per_print() != 0:
             return
         float(jax.device_get(loss))  # sync-ok: steps_per_print boundary
@@ -2740,11 +2843,7 @@ class DeepSpeedEngine:
             # fresh engine restoring before any train_batch (no swapper
             # exists yet — the configured tier must not silently disable).
             if self._param_swapper is None:
-                from deepspeed_tpu.runtime.swap_tensor import (
-                    PartitionedParamSwapper)
-                self._param_swapper = PartitionedParamSwapper(
-                    self._config.zero_config.offload_param.nvme_path,
-                    self._config.aio_config)
+                self._param_swapper = self._make_param_swapper()
             self._params_parked = False
         tag = tag or ckpt.read_latest_tag(load_dir)
         self.global_steps = extra.get("global_steps", 0)

@@ -7,10 +7,37 @@ Layout: one file per (tensor, field) under ``<nvme_path>/zero_swap_<pid>/``;
 double-buffered reads (``prefetch`` starts the async read of the next
 tensor while the caller consumes the current one — the reference's
 pipelined swapper overlap, pipelined_optimizer_swapper.py:60).
+
+Pipelined schedules (PR 5, the reference's pipeline_read/pipeline_write
+knobs made real): the param and optimizer swappers each own a SECOND aio
+handle dedicated to write-behind — ``aio_handle_wait`` drains a whole
+handle, so reads and writes must never share one — plus a bounded pool
+of host staging buffers. A write-behind submission copies the leaf into
+a pool buffer and returns immediately; the buffer then doubles as a
+byte-exact cache of the file, so the next swap-in of a recently written
+leaf is a host memcpy instead of a disk read. The drain fence
+(``drain_writes``) runs before any pending leaf is re-read FROM DISK —
+cache-served leaves need no fence because the staged bytes are the
+authoritative copy the file was written from.
+
+Swap files are preallocated (``ftruncate`` + ``posix_fallocate``) and
+kept open without ``O_TRUNC`` across steps, so steady-state writes reuse
+extents instead of reallocating them, and swap-in issues an
+``fadvise(WILLNEED)`` readahead pass before reading — the first-epoch
+read path runs at steady-state bandwidth instead of the 5x-slower
+cold-file rate (BENCH_r05 ``aio_disk.first_read_mbps``).
+
+All swap-path telemetry is sync-free (host wall timers + byte counters
+into the process registry): ``swap/bytes_read``, ``swap/bytes_written``,
+``swap/cache_hit_bytes`` counters, the ``swap/staging_bytes`` occupancy
+gauge, and the per-step I/O-blocked seconds surfaced via
+``take_stall_s()`` (the engine folds them into the ``swap/stall_s``
+histogram).
 """
 
 import os
 import shutil
+import time
 import weakref
 
 import numpy as np
@@ -29,6 +56,25 @@ def _make_aio_handle(aio_config):
         single_submit=getattr(cfg, "single_submit", False),
         overlap_events=getattr(cfg, "overlap_events", True),
         thread_count=getattr(cfg, "thread_count", 2))
+
+
+def _registry():
+    from deepspeed_tpu.telemetry import default_registry
+    return default_registry()
+
+
+def _close_fds_and_rm(path, fds, remove):
+    """weakref.finalize target — must not reference the swapper. ``fds``
+    is the LIVE dict (cleared by release(), so a later GC finalize never
+    double-closes recycled fd numbers)."""
+    for fd in list(fds.values()):
+        try:
+            os.close(fd)
+        except OSError:
+            pass
+    fds.clear()
+    if remove:
+        shutil.rmtree(path, ignore_errors=True)
 
 
 class TensorSwapper:
@@ -103,10 +149,14 @@ class _StagingArena:
     them — so the arena only defragments when nothing is live; requests it
     cannot place contiguously fall back to a plain numpy allocation."""
 
-    def __init__(self):
+    def __init__(self, slots=4):
         self.arena = None
         self._live = 0
         self._max_numel = 0
+        # sized for ``slots`` leaves of the largest size seen — the
+        # double-buffer minimum is 4 (2 Adam fields x 2 leaves in flight);
+        # pipelined write-behind asks for more
+        self._slots = max(4, int(slots))
 
     def take(self, shape):
         """Returns (tid_or_None, float32 array of `shape`)."""
@@ -118,12 +168,11 @@ class _StagingArena:
         # full fetch/store cycle (first-leaf sizing would permanently
         # exile every bigger leaf to the numpy fallback)
         self._max_numel = max(self._max_numel, numel)
-        if self.arena is None or (self._live == 0
-                                  and self.arena.size < 4 * self._max_numel):
-            # size for double-buffering both Adam moments (2 fields x 2
-            # leaves in flight)
-            self.arena = ContiguousMemoryAllocator(4 * self._max_numel,
-                                                   np.float32)
+        if self.arena is None or (
+                self._live == 0
+                and self.arena.size < self._slots * self._max_numel):
+            self.arena = ContiguousMemoryAllocator(
+                self._slots * self._max_numel, np.float32)
         can_place = self.arena._largest_free() >= numel or self._live == 0
         if not can_place or numel > self.arena.total_free:
             return None, np.empty(shape, np.float32)
@@ -142,20 +191,28 @@ class PartitionedParamSwapper:
     (reference swap_tensor/partitioned_param_swapper.py:36). Compute-dtype
     param leaves rest in one file each; around every step they stream
 
-        disk --aio read--> bounded staging (2 buffers) --device_put--> HBM
+        disk --aio read--> bounded staging (buffer_count) --device_put--> HBM
 
-    with the disk read of leaf i+1 overlapping the h2d put of leaf i
-    (double buffering: the put of leaf i must complete before buffer
-    i%2 is reused at leaf i+2 — enforced with a readiness fence), and
-    after the update HBM → staging → disk with the d2h of later leaves
-    overlapping earlier writes. Host RSS for parameters is therefore
-    bounded by TWO staging buffers of the largest leaf regardless of
-    model size — the reference's pinned-buffer-count bound with the
-    count fixed at the double-buffer minimum.
+    with the disk read of leaf group k+1 overlapping the h2d put of group
+    k (sliding read window over ``buffer_count`` staging slots), and after
+    the update HBM → staging → disk. Host RSS for parameters is therefore
+    bounded by ``buffer_count`` read slots + ``buffer_count`` write-behind
+    buffers of the largest leaf regardless of model size — the reference's
+    pinned-buffer-count bound.
+
+    ``pipeline_write`` turns the post-step park into write-behind: leaves
+    are copied into pool buffers and the aio writes run on a dedicated
+    handle while the caller proceeds (the swap-out of step N overlaps
+    whatever follows — the optimizer tail, telemetry, and the next step's
+    swap-in). ``drain_writes()`` is the durability fence; it runs
+    automatically before any pending leaf would be re-read from disk.
+    The pool buffers double as a byte cache of the just-written files, so
+    the next swap-in serves recently written leaves from host memory.
     """
 
     def __init__(self, nvme_path, aio_config=None, sub_dir=None,
-                 durable=False):
+                 durable=False, pipeline_read=False, pipeline_write=False,
+                 buffer_count=2, registry=None):
         """``sub_dir``/``durable``: by default the swap files are
         pid-scoped SCRATCH (reclaimed on GC/exit). A durable tier (the
         ZeRO-Infinity at-rest files, runtime/zero/infinity.py) passes a
@@ -165,12 +222,26 @@ class PartitionedParamSwapper:
             nvme_path, sub_dir or f"param_swap_{os.getpid()}")
         os.makedirs(self.dir, exist_ok=True)
         self.handle = _make_aio_handle(aio_config)
+        self._aio_config = aio_config
         self.meta = {}            # leaf idx -> (shape, numpy dtype)
-        self._staging = [None, None]
+        self.pipeline_read = bool(pipeline_read)
+        self.pipeline_write = bool(pipeline_write)
+        self.buffer_count = max(2, int(buffer_count))
+        self._staging = [None] * (self.buffer_count if pipeline_read else 2)
         self._durable = durable
-        if not durable:
-            self._finalizer = weakref.finalize(
-                self, shutil.rmtree, self.dir, ignore_errors=True)
+        # -- write-behind state (pipeline_write) ---------------------------
+        self._whandle = None      # dedicated aio handle, lazily built
+        self._wpool = []          # staging buffers (np.uint8)
+        self._wbusy = set()       # pool indices with an in-flight write
+        self._cache = {}          # leaf idx -> (pool idx, nbytes)
+        self._pending = set()     # leaf idx with a not-yet-drained write
+        self._wfds = {}           # leaf idx -> preallocated write fd
+        self._fsizes = {}         # leaf idx -> preallocated byte size
+        self._stall_s = 0.0
+        self._registry = registry
+        self._finalizer = weakref.finalize(
+            self, _close_fds_and_rm, self.dir, self._wfds,
+            remove=not durable)
 
     def _path(self, i):
         return os.path.join(self.dir, f"param_{i}.swp")
@@ -194,11 +265,65 @@ class PartitionedParamSwapper:
                      for i, (s, d) in raw.items()}
         return self.meta
 
-    def _stage(self, i, nbytes):
-        buf = self._staging[i % 2]
-        if buf is None or buf.nbytes < nbytes:
-            self._staging[i % 2] = buf = np.empty(nbytes, np.uint8)
-        return buf[:nbytes]
+    # -- telemetry (sync-free: host counters/timers only) ------------------
+    def _reg(self):
+        if self._registry is None:
+            self._registry = _registry()
+        return self._registry
+
+    def take_stall_s(self):
+        """I/O-blocked host seconds accumulated since the last call —
+        time the caller's thread actually waited on disk (sync ops +
+        drain fences), NOT time I/O spent overlapped with other work."""
+        s, self._stall_s = self._stall_s, 0.0
+        return s
+
+    def _timed_wait(self, handle):
+        t0 = time.perf_counter()
+        try:
+            handle.wait()
+        finally:
+            self._stall_s += time.perf_counter() - t0
+
+    def _staging_bytes(self):
+        return sum(b.nbytes for b in self._wpool) + sum(
+            b.nbytes for b in self._staging if b is not None)
+
+    # -- file lifecycle: preallocated, no O_TRUNC churn --------------------
+    def _write_fd(self, i, nbytes):
+        """Cached write fd for leaf ``i``'s file, preallocated to its
+        exact size: steady-state writes reuse extents (no per-step
+        truncate/alloc), and the file size stays byte-exact for
+        ``params_on_disk_bytes`` accounting."""
+        fd = self._wfds.get(i)
+        if fd is None:
+            fd = os.open(self._path(i), os.O_WRONLY | os.O_CREAT, 0o644)
+            self._wfds[i] = fd
+        if self._fsizes.get(i) != nbytes:
+            os.ftruncate(fd, nbytes)
+            try:
+                os.posix_fallocate(fd, 0, nbytes)
+            except OSError:
+                pass  # fs without fallocate: sparse until first write
+            self._fsizes[i] = nbytes
+        return fd
+
+    def _readahead(self, indices):
+        """fadvise(WILLNEED) the files about to be read — kernel
+        readahead fills the page cache while earlier leaves process, so
+        the first epoch reads at steady-state bandwidth (the BENCH_r05
+        first_read_mbps=298-vs-1640 fix)."""
+        for i in indices:
+            try:
+                fd = os.open(self._path(i), os.O_RDONLY)
+            except OSError:
+                continue
+            try:
+                os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_WILLNEED)
+            except OSError:
+                pass
+            finally:
+                os.close(fd)
 
     @staticmethod
     def _as_bytes(arr):
@@ -206,59 +331,203 @@ class PartitionedParamSwapper:
 
     def write_all(self, leaves):
         """Initial population / re-park after checkpoint load: every leaf
-        (device or host) → its file. Sync writes; called off the step
-        path."""
+        (device or host) → its preallocated file. Sync writes; called off
+        the step path. Ends with a readahead pass so the first swap-in is
+        not cold-file-bound."""
+        self.drain_writes()
+        self._cache.clear()
         for i, leaf in enumerate(leaves):
-            arr = np.ascontiguousarray(np.asarray(leaf))
+            arr = np.ascontiguousarray(np.asarray(leaf))  # sync-ok: d2h park
             self.meta[i] = (arr.shape, arr.dtype)
-            self.handle.sync_pwrite(self._as_bytes(arr), self._path(i))
+            b = self._as_bytes(arr)
+            t0 = time.perf_counter()
+            self.handle.sync_pwrite(b, self._write_fd(i, b.nbytes))
+            self._stall_s += time.perf_counter() - t0
+            self._reg().counter("swap/bytes_written").inc(b.nbytes)
         if self._durable:
             self.save_meta()
+        self._readahead(range(len(leaves)))
 
-    def swap_in_device(self, shardings):
-        """disk → device params; returns the list of device leaves."""
+    # -- write-behind ------------------------------------------------------
+    def _take_wbuf(self, nbytes):
+        """A pool buffer free for a new write: not in flight, preferring
+        one that backs no cache entry; evicts the oldest cache entry when
+        the pool is full; drains the write handle when every buffer is
+        busy. Pool is bounded at ``buffer_count`` buffers of the largest
+        leaf size seen."""
+        backing = {idx for idx, _ in self._cache.values()}
+        for attempt in range(2):
+            free = [k for k in range(len(self._wpool))
+                    if k not in self._wbusy and k not in backing]
+            if not free and len(self._wpool) < self.buffer_count:
+                self._wpool.append(np.empty(nbytes, np.uint8))
+                return len(self._wpool) - 1
+            if not free:
+                # evict the oldest cached leaf whose buffer is idle
+                for leaf, (idx, _) in list(self._cache.items()):
+                    if idx not in self._wbusy:
+                        del self._cache[leaf]
+                        free = [idx]
+                        break
+            if free:
+                idx = free[0]
+                if self._wpool[idx].nbytes < nbytes:
+                    self._wpool[idx] = np.empty(nbytes, np.uint8)
+                return idx
+            # every buffer carries an in-flight write: fence and retry
+            self.drain_writes()
+            backing = {idx for idx, _ in self._cache.values()}
+        raise RuntimeError("write-behind pool exhausted after drain")
+
+    def _write_handle(self):
+        if self._whandle is None:
+            self._whandle = _make_aio_handle(self._aio_config)
+        return self._whandle
+
+    def write_behind(self, i, host_arr):
+        """Queue the async write of leaf ``i`` (bytes are copied into a
+        pool buffer — the caller may reuse ``host_arr`` immediately) and
+        return without waiting. The pool copy stays registered as a byte
+        cache of the file, so a following swap-in of this leaf is a host
+        memcpy. ``drain_writes`` (automatic before any disk re-read of a
+        pending leaf) is the durability fence."""
+        arr = np.ascontiguousarray(np.asarray(host_arr))  # sync-ok: d2h park
+        if i in self._pending:
+            # a second write of the same leaf must not race the first on
+            # the same fd (completion order is not defined)
+            self.drain_writes()
+        self.meta[i] = (arr.shape, arr.dtype)
+        b = arr.view(np.uint8).reshape(-1)
+        idx = self._take_wbuf(b.nbytes)
+        buf = self._wpool[idx][:b.nbytes]
+        np.copyto(buf, b)
+        self._write_handle().async_pwrite(buf, self._write_fd(i, b.nbytes))
+        self._wbusy.add(idx)
+        self._cache[i] = (idx, b.nbytes)
+        self._pending.add(i)
+        reg = self._reg()
+        reg.counter("swap/bytes_written").inc(b.nbytes)
+        reg.gauge("swap/staging_bytes").set_max(self._staging_bytes())
+
+    def drain_writes(self):
+        """Fence: wait for every in-flight write-behind. Cheap no-op when
+        nothing is pending."""
+        if not self._pending and not self._wbusy:
+            return
+        self._timed_wait(self._write_handle())
+        self._wbusy.clear()
+        self._pending.clear()
+
+    @property
+    def has_pending_writes(self):
+        return bool(self._pending)
+
+    # -- the swap schedule -------------------------------------------------
+    def _stage(self, slot, nbytes):
+        buf = self._staging[slot]
+        if buf is None or buf.nbytes < nbytes:
+            self._staging[slot] = buf = np.empty(nbytes, np.uint8)
+        return buf[:nbytes]
+
+    def _leaf_nbytes(self, i):
+        shape, dtype = self.meta[i]
+        return int(np.prod(shape or (1,))) * dtype.itemsize
+
+    def _host_view(self, raw, i):
+        shape, dtype = self.meta[i]
+        return raw[:self._leaf_nbytes(i)].view(dtype).reshape(shape)
+
+    def swap_in_device(self, shardings, order=None):
+        """disk → device params; returns the list of device leaves.
+
+        ``order`` (a permutation of leaf indices) is the per-layer swap
+        schedule: leaves stream in the order compute will consume them.
+        Recently write-behind-parked leaves are served from the pool
+        cache (host memcpy, no disk read, no fence needed — the staged
+        bytes are what the file was written from); the rest read through
+        a sliding window of ``len(self._staging)`` staging slots so the
+        disk read of group k+1 overlaps the host/h2d processing of
+        group k."""
         import jax
         n = len(self.meta)
         outs = [None] * n
-        fds = [None] * n
-
-        def start_read(i):
-            shape, dtype = self.meta[i]
-            nbytes = int(np.prod(shape or (1,))) * dtype.itemsize
-            buf = self._stage(i, nbytes)
-            fds[i] = self.handle.open(self._path(i), False)
-            self.handle.async_pread(buf, fds[i])
-            return buf
-
+        if n == 0:
+            return outs
+        order = list(order) if order is not None else list(range(n))
+        assert sorted(order) == list(range(n)), order
         # CPU device_put aliases host memory — a reused staging buffer
         # would corrupt the "device" params. Decide from the TARGET
         # devices (an engine may run a CPU mesh under a TPU default)
-        aliases_host = n > 0 and \
-            shardings[0].mesh.devices.flat[0].platform == "cpu"
-        pending_buf = start_read(0) if n else None
-        for i in range(n):
-            buf = pending_buf
-            self.handle.wait()
-            self.handle.close(fds[i])
-            shape, dtype = self.meta[i]
-            arr = buf[:int(np.prod(shape or (1,))) * dtype.itemsize] \
-                .view(dtype).reshape(shape)
-            host_arr = np.array(arr, copy=True) if aliases_host else arr
-            outs[i] = jax.device_put(host_arr, shardings[i])
-            if i + 1 < n:
-                # the next read lands in buffer (i+1)%2 — leaf i-1's async
-                # h2d from that same buffer must be complete first
-                if i >= 1:
-                    outs[i - 1].block_until_ready()
-                pending_buf = start_read(i + 1)
-        for o in outs:
-            o.block_until_ready()
+        aliases_host = shardings[0].mesh.devices.flat[0].platform == "cpu"
+        reg = self._reg()
+
+        disk = [i for i in order if i not in self._cache]
+        cached = [i for i in order if i in self._cache]
+        self._readahead(disk)
+
+        # cache-served leaves process FIRST, while the write-behind of the
+        # previous park is still in flight — the staged bytes are the
+        # authoritative copy, so no fence is needed for them
+        for i in cached:
+            idx, nbytes = self._cache[i]
+            view = self._host_view(self._wpool[idx][:nbytes], i)
+            # non-aliasing backends: device_put copies and the end-of-
+            # call fence protects the pool view until the h2d lands, so
+            # only the aliasing CPU backend needs the private copy
+            host = np.array(view, copy=True) if aliases_host else view
+            outs[i] = jax.device_put(host, shardings[i])
+            reg.counter("swap/cache_hit_bytes").inc(nbytes)
+
+        if self._pending.intersection(disk):
+            # durability fence: a pending write's file must be whole
+            # before it is re-read from disk
+            self.drain_writes()
+
+        slots = len(self._staging)
+        group = max(1, slots // 2)
+        groups = [disk[k:k + group] for k in range(0, len(disk), group)]
+        fds = {}
+
+        def submit(gi):
+            for j, i in enumerate(groups[gi]):
+                slot = (gi * group + j) % slots
+                buf = self._stage(slot, self._leaf_nbytes(i))
+                fds[i] = self.handle.open(self._path(i), False)
+                self.handle.async_pread(buf, fds[i])
+
+        if groups:
+            submit(0)
+
+        for gi, g in enumerate(groups):
+            self._timed_wait(self.handle)
+            for i in g:
+                self.handle.close(fds.pop(i))
+            if gi + 1 < len(groups):
+                if not aliases_host and gi >= 1:
+                    # group gi+1 reuses group gi-1's slots: their h2d
+                    # puts must have consumed the staging bytes
+                    for i in groups[gi - 1]:
+                        outs[i].block_until_ready()  # sync-ok: slot reuse
+                submit(gi + 1)  # reads overlap the puts below
+            for j, i in enumerate(g):
+                slot = (gi * group + j) % slots
+                arr = self._host_view(self._staging[slot], i)
+                host = np.array(arr, copy=True) if aliases_host else arr
+                outs[i] = jax.device_put(host, shardings[i])
+                reg.counter("swap/bytes_read").inc(self._leaf_nbytes(i))
+        reg.gauge("swap/staging_bytes").set_max(self._staging_bytes())
+        if not aliases_host:
+            for o in outs:
+                o.block_until_ready()  # sync-ok: staging reuse safety
         return outs
 
-    def swap_out_device(self, leaves):
+    def swap_out_device(self, leaves, write_behind=None):
         """device params → disk; frees nothing itself (callers delete the
         device arrays after). d2h transfers for all leaves start up front
-        so later copies overlap earlier writes."""
+        so later copies overlap earlier writes; with ``write_behind`` the
+        disk writes run asynchronously on the dedicated handle and this
+        returns as soon as the d2h copies land in the pool."""
+        wb = self.pipeline_write if write_behind is None else write_behind
         for leaf in leaves:
             if hasattr(leaf, "copy_to_host_async"):
                 try:
@@ -266,11 +535,36 @@ class PartitionedParamSwapper:
                 except Exception:
                     pass
         for i, leaf in enumerate(leaves):
-            arr = np.ascontiguousarray(np.asarray(leaf))
+            if wb:
+                self.write_behind(i, leaf)
+                continue
+            if i in self._pending:
+                # same-fd race guard, mirroring write_behind: a sync
+                # write must not overlap an undrained async one
+                self.drain_writes()
+            arr = np.ascontiguousarray(np.asarray(leaf))  # sync-ok: d2h park
             self.meta[i] = (arr.shape, arr.dtype)
-            self.handle.sync_pwrite(self._as_bytes(arr), self._path(i))
+            b = self._as_bytes(arr)
+            t0 = time.perf_counter()
+            self.handle.sync_pwrite(b, self._write_fd(i, b.nbytes))
+            self._stall_s += time.perf_counter() - t0
+            self._cache.pop(i, None)  # staged bytes (if any) are stale
+            self._reg().counter("swap/bytes_written").inc(b.nbytes)
+        if self._durable:
+            self.save_meta()
 
     def release(self):
+        try:
+            self.drain_writes()
+        except Exception:
+            pass
+        for fd in list(self._wfds.values()):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._wfds.clear()   # the GC finalizer sees the emptied dict
+        self._cache.clear()
         shutil.rmtree(self.dir, ignore_errors=True)
 
 
@@ -280,19 +574,51 @@ class OptimizerStateSwapper:
     DEDICATED aio handle (the reference's PipelinedOptimizerSwapper
     overlap, pipelined_optimizer_swapper.py:60): ``prefetch(next_leaf)``
     starts the async read of the next leaf's moments while the caller
-    computes on the current one; writes stay on the main handle. Staging
-    buffers come from a contiguous arena (_StagingArena) instead of
-    per-call numpy churn."""
+    computes on the current one. With ``pipeline_write`` the stores are
+    write-behind on a third handle (the updated moments copy into a
+    bounded pool and the writes overlap the next leaves' SIMD steps);
+    otherwise writes stay sync on the main handle. Staging buffers come
+    from a contiguous arena (_StagingArena) instead of per-call numpy
+    churn."""
 
     FIELDS = ("exp_avg", "exp_avg_sq")
 
-    def __init__(self, nvme_path, aio_config=None):
+    def __init__(self, nvme_path, aio_config=None, pipeline_write=False,
+                 buffer_count=2, registry=None):
         self.swapper = TensorSwapper(nvme_path, aio_config, "optimizer_swap")
         self.shapes = {}
+        self._aio_config = aio_config
         self._pf_handle = _make_aio_handle(aio_config)
         self._pf = None  # (leaf_id, [bufs], [fds], [tids])
-        self._arena = _StagingArena()
+        self.pipeline_write = bool(pipeline_write)
+        self.buffer_count = max(2, int(buffer_count))
+        # write-behind pool sized for buffer_count leaves x 2 fields over
+        # the shared arena; the arena grows to slots x largest-leaf
+        self._arena = _StagingArena(
+            slots=4 + (2 * self.buffer_count if pipeline_write else 0))
         self._consumed = {}  # leaf_id -> [tids] handed out by fetch()
+        self._wb_handle = None
+        # in-flight write sources: (leaf_id, [tids], [arrays]) — the
+        # array refs keep numpy-fallback staging alive until the drain
+        # (the aio thread reads from those buffers)
+        self._wb_live = []
+        self._wb_pending = set()
+        self._wb_fds = {}    # (leaf_id, field) -> preallocated write fd
+        self._wb_sizes = {}
+        self._registry = registry
+        self._stall_s = 0.0
+        self._fd_finalizer = weakref.finalize(
+            self, _close_fds_and_rm, self.swapper.dir, self._wb_fds,
+            remove=False)
+
+    def _reg(self):
+        if self._registry is None:
+            self._registry = _registry()
+        return self._registry
+
+    def take_stall_s(self):
+        s, self._stall_s = self._stall_s, 0.0
+        return s
 
     def init_state(self, leaf_id, shape):
         self.shapes[leaf_id] = tuple(shape)
@@ -305,9 +631,11 @@ class OptimizerStateSwapper:
             return None
         leaf_id, bufs, fds, tids = self._pf
         self._pf = None
+        t0 = time.perf_counter()
         try:
             self._pf_handle.wait()
         finally:
+            self._stall_s += time.perf_counter() - t0
             for fd in fds:
                 self._pf_handle.close(fd)
         return leaf_id, bufs, tids
@@ -322,11 +650,30 @@ class OptimizerStateSwapper:
         for tid in self._consumed.pop(leaf_id, ()):
             self._arena.give(tid)
 
+    def drain_writes(self):
+        """Fence for the write-behind stores: wait, then release the
+        arena slots that backed the in-flight writes."""
+        if not self._wb_live:
+            return
+        t0 = time.perf_counter()
+        try:
+            self._wb_handle.wait()
+        finally:
+            self._stall_s += time.perf_counter() - t0
+        for _, tids, _arrs in self._wb_live:
+            for tid in tids:
+                self._arena.give(tid)
+        self._wb_live = []
+        self._wb_pending.clear()
+
     def prefetch(self, leaf_id):
         """Start the async read of ``leaf_id``'s moments; the matching
         fetch() consumes them without blocking on the disk."""
         if self._pf is not None and self._pf[0] == leaf_id:
             return
+        if leaf_id in self._wb_pending:
+            # the moments about to be read are still being written
+            self.drain_writes()
         self._discard_prefetch()
         shape = self.shapes[leaf_id]
         bufs, fds, tids = [], [], []
@@ -344,6 +691,8 @@ class OptimizerStateSwapper:
         # a re-fetch without an intervening store (e.g. state_dict() walks
         # every leaf read-only) must not orphan the previous staging slots
         self._release_consumed(leaf_id)
+        if leaf_id in self._wb_pending:
+            self.drain_writes()
         if self._pf is not None and self._pf[0] == leaf_id:
             _, bufs, tids = self._drain_prefetch()
             self._consumed[leaf_id] = tids
@@ -351,25 +700,104 @@ class OptimizerStateSwapper:
         self._discard_prefetch()
         shape = self.shapes[leaf_id]
         out, tids = [], []
+        t0 = time.perf_counter()
         for field in self.FIELDS:
             tid, buf = self._arena.take(shape)
             self.swapper.swap_in(f"{leaf_id}.{field}", buf)
             out.append(buf)
             tids.append(tid)
+        self._stall_s += time.perf_counter() - t0
         self._consumed[leaf_id] = tids
         return out
 
     def store(self, leaf_id, exp_avg, exp_avg_sq):
+        if self.pipeline_write:
+            return self._store_behind(leaf_id, exp_avg, exp_avg_sq)
+        t0 = time.perf_counter()
         self.swapper.swap_out(f"{leaf_id}.exp_avg", exp_avg)
         self.swapper.swap_out(f"{leaf_id}.exp_avg_sq", exp_avg_sq)
+        self._stall_s += time.perf_counter() - t0
+        self._reg().counter("swap/bytes_written").inc(
+            exp_avg.nbytes + exp_avg_sq.nbytes)
         # the fetched staging views are dead once the new moments hit disk
         self._release_consumed(leaf_id)
+
+    def _store_behind(self, leaf_id, exp_avg, exp_avg_sq):
+        """Write-behind store: the updated moments usually ARE the arena
+        views handed out by fetch() (the SIMD step updates them in
+        place) — hand exactly those slots to the write handle and defer
+        their release to the drain, so no extra copy happens; foreign
+        arrays are copied into fresh arena slots first."""
+        if leaf_id in self._wb_pending:
+            self.drain_writes()  # same-fd write race guard
+        elif len(self._wb_live) >= self.buffer_count:
+            # bound the live staged moments at ~buffer_count leaves (the
+            # documented pool bound): without this reap, a whole step's
+            # stores stay live until the next step's first prefetch —
+            # host RSS = total moment bytes, not the pool
+            self.drain_writes()
+        mine = self._consumed.pop(leaf_id, None)
+        arrs = [np.ascontiguousarray(exp_avg, np.float32),
+                np.ascontiguousarray(exp_avg_sq, np.float32)]
+        if mine is not None and arrs[0] is exp_avg and arrs[1] is exp_avg_sq:
+            tids = mine
+        else:
+            # foreign buffers (or a copy was forced): stage them
+            if mine is not None:
+                for tid in mine:
+                    self._arena.give(tid)
+            tids = []
+            staged = []
+            for a in arrs:
+                tid, buf = self._arena.take(a.shape)
+                np.copyto(buf, a)
+                tids.append(tid)
+                staged.append(buf)
+            arrs = staged
+        wh = self._wb_handle
+        if wh is None:
+            wh = self._wb_handle = _make_aio_handle(self._aio_config)
+        for field, a in zip(self.FIELDS, arrs):
+            wh.async_pwrite(a, self._wb_fd(leaf_id, field, a.nbytes))
+        self._wb_live.append((leaf_id, tids, arrs))
+        self._wb_pending.add(leaf_id)
+        self._reg().counter("swap/bytes_written").inc(
+            sum(a.nbytes for a in arrs))
+
+    def _wb_fd(self, leaf_id, field, nbytes):
+        """Cached no-O_TRUNC write fd per moment file, preallocated so
+        steady-state stores reuse extents (the TensorSwapper sync path
+        reopens with O_TRUNC each step — fine off the hot path)."""
+        key = (leaf_id, field)
+        fd = self._wb_fds.get(key)
+        if fd is None:
+            fd = os.open(self.swapper._path(f"{leaf_id}.{field}"),
+                         os.O_WRONLY | os.O_CREAT, 0o644)
+            self._wb_fds[key] = fd
+        if self._wb_sizes.get(key) != nbytes:
+            os.ftruncate(fd, nbytes)
+            try:
+                os.posix_fallocate(fd, 0, nbytes)
+            except OSError:
+                pass
+            self._wb_sizes[key] = nbytes
+        return fd
 
     def release(self):
         try:
             self._discard_prefetch()
         except Exception:
             pass
+        try:
+            self.drain_writes()
+        except Exception:
+            pass
         for leaf in list(self._consumed):
             self._release_consumed(leaf)
+        for fd in list(self._wb_fds.values()):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._wb_fds.clear()
         self.swapper.release()

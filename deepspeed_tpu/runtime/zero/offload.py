@@ -66,7 +66,13 @@ class HostOffloadOptimizer:
         if self.device_nvme:
             from deepspeed_tpu.runtime.swap_tensor import OptimizerStateSwapper
             assert offload_cfg.nvme_path, "offload to nvme requires nvme_path"
-            self.swapper = OptimizerStateSwapper(offload_cfg.nvme_path, aio_cfg)
+            # pipeline_write: moment stores run write-behind on a dedicated
+            # aio handle, overlapping the next leaves' SIMD steps (the
+            # reference's PipelinedOptimizerSwapper write leg)
+            self.swapper = OptimizerStateSwapper(
+                offload_cfg.nvme_path, aio_cfg,
+                pipeline_write=getattr(offload_cfg, "pipeline_write", False),
+                buffer_count=getattr(offload_cfg, "buffer_count", 2))
             for i, m in enumerate(self.master):
                 self.swapper.init_state(i, m.shape)
             self.m = self.v = None

@@ -16,7 +16,11 @@ Scope (the per-step hot paths):
   mesh/attention helpers traced into train steps),
 - ``deepspeed_tpu/serving/*.py`` (the continuous-batching scheduler),
 - ``deepspeed_tpu/telemetry/*.py`` (recording must never sync),
-- the train-fn builders + per-step methods of ``runtime/engine.py``
+- ``deepspeed_tpu/runtime/swap_tensor/*.py`` (PR 5: the pipelined swap
+  schedules run on the per-step path; their d2h parks and staging-slot
+  fences are deliberate and annotated),
+- the train-fn builders + per-step methods of ``runtime/engine.py``,
+  including the NVMe swap-schedule methods
   (``_train_batch_instrumented`` is excluded: it is the
   wall_clock_breakdown MEASUREMENT mode, whose per-phase fences are
   the documented price of turning that flag on).
@@ -41,7 +45,8 @@ FORBIDDEN = re.compile(
 
 ALLOW = "sync-ok"
 
-HOT_GLOBS = ("parallel/*.py", "serving/*.py", "telemetry/*.py")
+HOT_GLOBS = ("parallel/*.py", "serving/*.py", "telemetry/*.py",
+             "runtime/swap_tensor/*.py")
 
 # engine units scanned via inspect (robust to line moves)
 HOT_ENGINE_METHODS = (
@@ -51,6 +56,10 @@ HOT_ENGINE_METHODS = (
     "_build_sparse_train_fn", "_local_grad_accumulator",
     "_apply_grads", "_telemetry_step", "_telemetry_fold",
     "_telemetry_mfu", "_telemetry_memory_gauges", "_telemetry_export",
+    # PR 5: the NVMe swap-schedule methods (park/unpark run per step;
+    # the swapper's own d2h/fences live in runtime/swap_tensor/ above)
+    "_ensure_params_resident", "_park_params", "_param_swap_order",
+    "_make_param_swapper",
 )
 
 
