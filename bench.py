@@ -72,6 +72,133 @@ BENCH_SECTIONS = ("bert", "train", "sparse", "decode", "llama7b", "moe",
                   "infinity6b", "xl")
 
 
+# ---------------------------------------------------------------------------
+# --compare: the CI regression gate (ISSUE 6). Diffs the headline
+# metrics of two bench result documents and exits nonzero when any
+# common metric regressed past the threshold. Handles both the
+# bench-native result JSON and the driver-captured BENCH_rXX.json
+# format ({"parsed": {metric, value, ...}}). This path never imports
+# jax — it runs on artifact files anywhere.
+# ---------------------------------------------------------------------------
+
+def _load_doc(path):
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"--compare: cannot load {path}: {e}")
+
+
+def _num(v):
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def headline_metrics(doc):
+    """Flatten a bench result document into ``{name: (value,
+    direction)}`` where direction is +1 for higher-is-better and -1
+    for lower-is-better. Sections that were skipped (or absent)
+    contribute nothing — the gate compares only metrics BOTH runs
+    measured."""
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and _num(parsed.get("value")):
+        # driver-captured format: the parsed line IS a bench-native doc
+        # (r01-r03 carry the full detail; r05 only the headline) —
+        # recurse so whatever survived the tail capture gates
+        return headline_metrics(parsed)
+    out = {}
+    if _num(doc.get("value")):
+        out[doc.get("metric", "headline")] = (doc["value"], +1)
+    d = doc.get("detail") or {}
+
+    def grab(name, container, key, direction):
+        v = container.get(key) if isinstance(container, dict) else None
+        if _num(v):
+            out[name] = (v, direction)
+
+    grab("tokens_per_sec", d, "tokens_per_sec", +1)
+    grab("samples_per_sec_per_chip", d, "samples_per_sec_per_chip", +1)
+    grab("step_time_ms", d, "step_time_ms", -1)
+    grab("bert_base_seq128_samples_per_sec", d,
+         "bert_base_seq128_samples_per_sec", +1)
+    dec = d.get("decode")
+    if isinstance(dec, dict):
+        for name, entry in sorted(dec.items()):
+            if not isinstance(entry, dict):
+                continue
+            if name == "serving_continuous_batching":
+                grab("serving.requests_per_sec", entry,
+                     "requests_per_sec_continuous", +1)
+                grab("serving.decode_tokens_per_sec", entry,
+                     "decode_tokens_per_sec_continuous", +1)
+                grab("serving.ttft_p99_s", entry, "ttft_p99_s", -1)
+            else:
+                grab(f"decode.{name}.decode_tokens_per_sec", entry,
+                     "decode_tokens_per_sec", +1)
+    grab("moe.tokens_per_sec", d.get("moe"), "tokens_per_sec", +1)
+    grab("nvme_param.steady_step_s", d.get("nvme_param_tier"),
+         "steady_step_s", -1)
+    grab("infinity.steady_step_s", d.get("infinity_6b"),
+         "steady_step_s", -1)
+    return out
+
+
+def compare_docs(prior, candidate, threshold=0.05):
+    """Structured diff of two result documents; ``regressions`` lists
+    common metrics whose direction-signed change is worse than
+    ``threshold`` (a fraction, e.g. 0.05 = 5%)."""
+    pm, cm = headline_metrics(prior), headline_metrics(candidate)
+    compared, regressions, improvements = {}, [], []
+    for k in sorted(set(pm) & set(cm)):
+        pv, direction = pm[k]
+        cv, _ = cm[k]
+        if pv == 0:
+            continue
+        delta = (cv / pv - 1.0) * direction    # > 0 means better
+        compared[k] = {
+            "prior": pv, "candidate": cv,
+            "delta_pct": round(delta * 100, 2),
+            "better": "higher" if direction > 0 else "lower",
+        }
+        if delta < -threshold:
+            regressions.append(k)
+        elif delta > threshold:
+            improvements.append(k)
+    return {
+        "threshold_pct": round(threshold * 100, 2),
+        "compared": compared,
+        "regressions": regressions,
+        "improvements": improvements,
+        "only_in_prior": sorted(set(pm) - set(cm)),
+        "only_in_candidate": sorted(set(cm) - set(pm)),
+    }
+
+
+def compare_and_report(prior_doc, candidate_doc, threshold):
+    """Print the per-metric diff + a machine-readable summary line;
+    return the process exit code (0 pass, 3 regression)."""
+    rep = compare_docs(prior_doc, candidate_doc, threshold)
+    for k, row in rep["compared"].items():
+        flag = "REGRESSION" if k in rep["regressions"] else (
+            "improved" if k in rep["improvements"] else "ok")
+        print(f"  {k}: {row['prior']} -> {row['candidate']} "
+              f"({row['delta_pct']:+.2f}%, {row['better']}-is-better) "
+              f"[{flag}]")
+    print(json.dumps({"compare": rep}), flush=True)
+    if not rep["compared"]:
+        print("WARN: no common headline metrics to compare "
+              "(gate passes vacuously)")
+        return 0
+    if rep["regressions"]:
+        print(f"FAIL: {len(rep['regressions'])} metric(s) regressed "
+              f"past {rep['threshold_pct']}%: "
+              f"{', '.join(rep['regressions'])}")
+        return 3
+    print(f"PASS: no headline metric regressed past "
+          f"{rep['threshold_pct']}% "
+          f"({len(rep['compared'])} compared)")
+    return 0
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache (repo-local): the 1.5B offload
     program compiles in ~40 min through the tunneled backend; caching it
@@ -164,10 +291,31 @@ def main(argv=None):
                          "the trailing sections to a SIGKILL instead of "
                          "an explicit skip)")
     ap.add_argument("--list-sections", action="store_true")
+    ap.add_argument("--compare", metavar="PRIOR.json", default="",
+                    help="regression gate: diff this run's headline "
+                         "metrics against a prior result document "
+                         "(bench-native JSON or a driver BENCH_rXX.json)"
+                         " and exit nonzero past the threshold; with "
+                         "--candidate, diff two files WITHOUT running "
+                         "the bench (no jax import — CI-usable on "
+                         "artifacts)")
+    ap.add_argument("--candidate", metavar="CURRENT.json", default="",
+                    help="candidate result file for --compare "
+                         "(skips the bench run)")
+    ap.add_argument("--regression-threshold", type=float, default=0.05,
+                    help="fractional worsening that fails the gate "
+                         "(default 0.05 = 5%%)")
     args = ap.parse_args(argv)
     if args.list_sections:
         print(json.dumps(list(BENCH_SECTIONS)))
         return 0
+    if args.candidate and not args.compare:
+        raise SystemExit("--candidate requires --compare PRIOR.json")
+    if args.compare and args.candidate:
+        # pure-file gate: no bench run, no jax import
+        return compare_and_report(_load_doc(args.compare),
+                                  _load_doc(args.candidate),
+                                  args.regression_threshold)
     selected = [s.strip() for s in args.sections.split(",") if s.strip()]
     unknown = [s for s in selected if s not in BENCH_SECTIONS]
     if unknown:
@@ -340,6 +488,12 @@ def main(argv=None):
     result["detail"]["sections_skipped"] = dict(runner.skipped)
     print(json.dumps(result))
     print(short(result))
+
+    if args.compare:
+        # the gate rides a full run: this run's result is the candidate
+        return compare_and_report(_load_doc(args.compare), result,
+                                  args.regression_threshold)
+    return 0
 
 
 def bench_train_gpt2(dstpu, make_mesh, MeshConfig, dev, jnp):
@@ -579,6 +733,13 @@ def bench_serving():
         "ttft_p99_s": tel.get("ttft_s", {}).get("p99"),
         "page_pool_occupancy_hwm": tel.get(
             "page_pool", {}).get("occupancy_hwm"),
+        # watchdog verdict next to the percentiles (ISSUE 6): nonzero
+        # trips mean the winning window was NOT clean — read the dump
+        "watchdog_trips": sum(
+            ((tel.get("watchdog") or {}).get("trips") or {}).values()),
+        "watchdog_dump_id": tel.get("dump_id", 0),
+        "watchdog_last_anomaly": (tel.get("last_anomaly") or {}).get(
+            "rule"),
         "telemetry": tel,
         "workload": out["workload"],
     }

@@ -234,13 +234,80 @@ class FlopsProfilerConfig:
                                    C.FLOPS_PROFILER_DETAILED_DEFAULT))
 
 
+class FlightRecorderConfig:
+    """``monitor.flight_recorder`` sub-block (ISSUE 6): the process-wide
+    event ring (telemetry/recorder.py). Default ON — recording is an
+    in-memory dict append, no files; disable or resize here."""
+
+    def __init__(self, monitor_dict):
+        d = monitor_dict.get(C.MONITOR_FLIGHT_RECORDER, {}) or {}
+        self.enabled = bool(d.get(C.FLIGHT_RECORDER_ENABLED,
+                                  C.FLIGHT_RECORDER_ENABLED_DEFAULT))
+        self.capacity = int(d.get(C.FLIGHT_RECORDER_CAPACITY,
+                                  C.FLIGHT_RECORDER_CAPACITY_DEFAULT))
+        if self.capacity < 32:
+            raise DeepSpeedConfigError(
+                f"monitor.flight_recorder.capacity must be >= 32 (a "
+                f"watchdog dump promises the last 32 events), got "
+                f"{self.capacity}")
+
+
+class WatchdogConfig:
+    """``monitor.watchdog`` sub-block (ISSUE 6): fence-point anomaly
+    rules + one-shot ring dumps (telemetry/anomaly.py). Presence of the
+    block enables it (it writes files on trigger, so it is opt-in,
+    unlike the recorder)."""
+
+    def __init__(self, monitor_dict):
+        d = monitor_dict.get(C.MONITOR_WATCHDOG, None)
+        self.enabled = d is not None and bool(
+            d.get(C.WATCHDOG_ENABLED, C.WATCHDOG_ENABLED_DEFAULT))
+        d = d or {}
+        self.dump_dir = d.get(C.WATCHDOG_DUMP_DIR,
+                              C.WATCHDOG_DUMP_DIR_DEFAULT)
+        self.baseline_window = int(d.get(
+            C.WATCHDOG_BASELINE_WINDOW, C.WATCHDOG_BASELINE_WINDOW_DEFAULT))
+        self.min_samples = int(d.get(C.WATCHDOG_MIN_SAMPLES,
+                                     C.WATCHDOG_MIN_SAMPLES_DEFAULT))
+        self.step_time_factor = d.get(
+            C.WATCHDOG_STEP_TIME_FACTOR, C.WATCHDOG_STEP_TIME_FACTOR_DEFAULT)
+        self.swap_stall_factor = d.get(
+            C.WATCHDOG_SWAP_STALL_FACTOR,
+            C.WATCHDOG_SWAP_STALL_FACTOR_DEFAULT)
+        self.swap_stall_min_s = d.get(
+            C.WATCHDOG_SWAP_STALL_MIN_S, C.WATCHDOG_SWAP_STALL_MIN_S_DEFAULT)
+        self.ttft_factor = d.get(C.WATCHDOG_TTFT_FACTOR,
+                                 C.WATCHDOG_TTFT_FACTOR_DEFAULT)
+        self.ttft_min_s = d.get(C.WATCHDOG_TTFT_MIN_S,
+                                C.WATCHDOG_TTFT_MIN_S_DEFAULT)
+        self.check_nan = bool(d.get(C.WATCHDOG_CHECK_NAN,
+                                    C.WATCHDOG_CHECK_NAN_DEFAULT))
+        self.max_dumps = int(d.get(C.WATCHDOG_MAX_DUMPS,
+                                   C.WATCHDOG_MAX_DUMPS_DEFAULT))
+        for name, v in (("step_time_factor", self.step_time_factor),
+                        ("swap_stall_factor", self.swap_stall_factor),
+                        ("ttft_factor", self.ttft_factor)):
+            if not v > 1.0:
+                raise DeepSpeedConfigError(
+                    f"monitor.watchdog.{name} must be > 1 (an outlier "
+                    f"threshold is a multiple of the baseline), got {v!r}")
+        if self.enabled and not self.dump_dir:
+            raise DeepSpeedConfigError(
+                "monitor.watchdog.dump_dir must be set when the "
+                "watchdog is enabled (dumps need somewhere to land)")
+
+
 class MonitorConfig:
     """``monitor`` block: the unified telemetry export gate
     (deepspeed_tpu/telemetry). Presence of the block enables the
     per-``steps_per_print`` registry export — a JSONL stream (one file
-    per rank; every event carries ts/rank/step) plus, when the
+    per rank; every event carries ts/rank/step; size-bounded rotation
+    via ``jsonl_max_mb``/``jsonl_max_files``) plus, when the
     ``tensorboard`` block is also enabled, a bridge into the
-    SummaryEventWriter scalar stream."""
+    SummaryEventWriter scalar stream. The ``flight_recorder`` and
+    ``watchdog`` sub-blocks (ISSUE 6) are parsed whether or not the
+    export itself is enabled — the recorder is passive and the
+    watchdog has its own gate."""
 
     def __init__(self, param_dict):
         d = param_dict.get(C.MONITOR, None)
@@ -251,6 +318,17 @@ class MonitorConfig:
                                  C.MONITOR_OUTPUT_PATH_DEFAULT)
         self.jsonl_path = d.get(C.MONITOR_JSONL_PATH,
                                 C.MONITOR_JSONL_PATH_DEFAULT)
+        self.jsonl_max_mb = d.get(C.MONITOR_JSONL_MAX_MB,
+                                  C.MONITOR_JSONL_MAX_MB_DEFAULT)
+        self.jsonl_max_files = int(d.get(
+            C.MONITOR_JSONL_MAX_FILES, C.MONITOR_JSONL_MAX_FILES_DEFAULT))
+        if self.jsonl_max_mb < 0 or self.jsonl_max_files < 1:
+            raise DeepSpeedConfigError(
+                f"monitor.jsonl_max_mb must be >= 0 (0 disables "
+                f"rotation) and jsonl_max_files >= 1, got "
+                f"{self.jsonl_max_mb!r}/{self.jsonl_max_files!r}")
+        self.flight_recorder = FlightRecorderConfig(d)
+        self.watchdog = WatchdogConfig(d)
 
 
 class ProfilingConfig:

@@ -330,6 +330,49 @@ MONITOR_JSONL_PATH = "jsonl_path"
 MONITOR_JSONL_PATH_DEFAULT = ""      # "" -> <output_path>/telemetry_rank{r}.jsonl
 MONITOR_OUTPUT_PATH = "output_path"
 MONITOR_OUTPUT_PATH_DEFAULT = "runs/telemetry"
+# JSONL stream rotation (ISSUE 6 satellite): size-bounded so multi-hour
+# runs can't grow one unbounded file. 0 MB disables rotation.
+MONITOR_JSONL_MAX_MB = "jsonl_max_mb"
+MONITOR_JSONL_MAX_MB_DEFAULT = 256
+MONITOR_JSONL_MAX_FILES = "jsonl_max_files"
+MONITOR_JSONL_MAX_FILES_DEFAULT = 4
+
+#############################################
+# Flight recorder + anomaly watchdog (monitor sub-blocks, ISSUE 6 —
+# deepspeed_tpu/telemetry/recorder.py + anomaly.py). The recorder is a
+# passive in-memory ring and defaults ON (recording is host-only and
+# cheap); the watchdog writes dump FILES on anomaly and so gates on the
+# presence of its block, like the monitor block itself.
+#############################################
+MONITOR_FLIGHT_RECORDER = "flight_recorder"
+FLIGHT_RECORDER_ENABLED = "enabled"
+FLIGHT_RECORDER_ENABLED_DEFAULT = True
+FLIGHT_RECORDER_CAPACITY = "capacity"
+FLIGHT_RECORDER_CAPACITY_DEFAULT = 4096
+
+MONITOR_WATCHDOG = "watchdog"
+WATCHDOG_ENABLED = "enabled"
+WATCHDOG_ENABLED_DEFAULT = True      # presence of the block enables it
+WATCHDOG_DUMP_DIR = "dump_dir"
+WATCHDOG_DUMP_DIR_DEFAULT = "runs/flight"
+WATCHDOG_BASELINE_WINDOW = "baseline_window"
+WATCHDOG_BASELINE_WINDOW_DEFAULT = 64
+WATCHDOG_MIN_SAMPLES = "min_samples"
+WATCHDOG_MIN_SAMPLES_DEFAULT = 8
+WATCHDOG_STEP_TIME_FACTOR = "step_time_factor"
+WATCHDOG_STEP_TIME_FACTOR_DEFAULT = 3.0
+WATCHDOG_SWAP_STALL_FACTOR = "swap_stall_factor"
+WATCHDOG_SWAP_STALL_FACTOR_DEFAULT = 4.0
+WATCHDOG_SWAP_STALL_MIN_S = "swap_stall_min_s"
+WATCHDOG_SWAP_STALL_MIN_S_DEFAULT = 0.05
+WATCHDOG_TTFT_FACTOR = "ttft_factor"
+WATCHDOG_TTFT_FACTOR_DEFAULT = 4.0
+WATCHDOG_TTFT_MIN_S = "ttft_min_s"
+WATCHDOG_TTFT_MIN_S_DEFAULT = 1.0
+WATCHDOG_CHECK_NAN = "check_nan"
+WATCHDOG_CHECK_NAN_DEFAULT = True
+WATCHDOG_MAX_DUMPS = "max_dumps"
+WATCHDOG_MAX_DUMPS_DEFAULT = 0       # 0 = unlimited
 
 #############################################
 # Programmatic XLA trace window (profiling.trace_dir + trace_steps):

@@ -76,8 +76,10 @@ def plan_buckets(shapes: Sequence, bucket_elems: int,
     ids: List[int] = []
     sizes: List[int] = []
     acc = 0
+    n_leaves = 0
     for i, shape in enumerate(shapes):
         n = int(np.prod(shape or (1,)))
+        n_leaves += 1
         if ids and acc + n > bucket_elems:
             buckets.append(_close_bucket(ids, sizes, axis_size))
             ids, sizes, acc = [], [], 0
@@ -86,6 +88,14 @@ def plan_buckets(shapes: Sequence, bucket_elems: int,
         acc += n
     if ids:
         buckets.append(_close_bucket(ids, sizes, axis_size))
+    # flight-recorder breadcrumb (trace-time only — planning runs once
+    # per compile, never per step): what the bucket stream looked like
+    from deepspeed_tpu.telemetry.recorder import default_recorder
+    default_recorder().record(
+        "overlap_bucket_plan", buckets=len(buckets), leaves=n_leaves,
+        elems=sum(b.numel for b in buckets),
+        padded_elems=sum(b.padded for b in buckets), axis_size=axis_size,
+        bucket_elems=bucket_elems)
     return buckets
 
 

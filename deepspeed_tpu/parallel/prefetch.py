@@ -107,10 +107,19 @@ def build_layer_plan(shard_leaves, plan, n: int) -> LayerPlan:
             f"{leaf.shape}); exclude dim 0 via layer_stacked_prefixes")
         per_layer.append((d - 1, sz))
         groups.setdefault(jnp.dtype(leaf.dtype), []).append(i)
-    return LayerPlan(plan=tuple(per_layer),
-                     groups=tuple((dt, tuple(ids))
-                                  for dt, ids in groups.items()),
-                     n=n)
+    lp = LayerPlan(plan=tuple(per_layer),
+                   groups=tuple((dt, tuple(ids))
+                                for dt, ids in groups.items()),
+                   n=n)
+    # flight-recorder breadcrumb (trace-time only — the plan is built
+    # once per compile): the per-layer gather shape of this train fn
+    from deepspeed_tpu.telemetry.recorder import default_recorder
+    default_recorder().record(
+        "prefetch_layer_plan", groups=len(lp.groups),
+        sharded_leaves=len(lp.sharded_ids),
+        replicated_leaves=sum(1 for e in lp.plan if e is None),
+        axis_size=n)
+    return lp
 
 
 # ---------------------------------------------------------------------------

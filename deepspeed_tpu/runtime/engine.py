@@ -44,6 +44,8 @@ from deepspeed_tpu.ops.optimizer import TpuOptimizer, OptaxOptimizer
 from deepspeed_tpu.utils.logging import logger, log_dist
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from deepspeed_tpu.utils.memory import see_memory_usage
+from deepspeed_tpu.telemetry.anomaly import Watchdog
+from deepspeed_tpu.telemetry.recorder import default_recorder
 from deepspeed_tpu.telemetry.registry import default_registry
 from deepspeed_tpu.telemetry.spans import span as tel_span, annotate, \
     TraceWindow
@@ -309,6 +311,20 @@ class DeepSpeedEngine:
         self._tel_window_step0 = 0
         self._tel_window_tokens = 0
         self._tel_flops_per_step = None  # lazily priced via cost analysis
+
+        # -- flight recorder + anomaly watchdog (ISSUE 6): the recorder
+        # is the process-wide event ring (monitor.flight_recorder sizes/
+        # gates it); the watchdog (monitor.watchdog, opt-in) evaluates
+        # NaN-loss / step-time / swap-stall rules ONLY at the
+        # steps_per_print boundary and window folds — the fences this
+        # engine already pays — and dumps the ring to JSONL on trigger
+        mc = self._config.monitor_config
+        self.flight_recorder = default_recorder().configure(
+            enabled=mc.flight_recorder.enabled,
+            capacity=mc.flight_recorder.capacity)
+        self.watchdog = Watchdog.from_config(
+            mc.watchdog, recorder=self.flight_recorder,
+            registry=self.telemetry, source="train")
 
         # ZeRO-Offload: optimizer state + fp32 master on host (cpu) or NVMe
         self._offload_cfg = self._config.zero_config.offload_optimizer
@@ -1985,6 +2001,8 @@ class DeepSpeedEngine:
             self.flops_profiler.maybe_profile(batch)
 
         step_idx = self.global_steps
+        # events recorded during this step (spans, swap I/O) carry it
+        self.flight_recorder.set_step(step_idx)
         if self._trace_window is not None:
             self._trace_window.on_step_begin(step_idx)
         self.tput_timer.start()
@@ -2117,12 +2135,12 @@ class DeepSpeedEngine:
         # fenced) — feed them to the span histograms so the telemetry
         # stream carries per-phase times whenever this mode is on
         reg = self.telemetry
-        reg.histogram("span/train/forward").observe(max(fwd_s - fence_s, 0.0))
-        reg.histogram("span/train/backward").observe(max(fwdbwd_s - fwd_s,
-                                                         0.0))
-        reg.histogram("span/train/optimizer").observe(max(step_s - fence_s,
-                                                          0.0))
-        reg.histogram("span/train/fence").observe(fence_s)
+        for tag, dur in (("train/forward", max(fwd_s - fence_s, 0.0)),
+                         ("train/backward", max(fwdbwd_s - fwd_s, 0.0)),
+                         ("train/optimizer", max(step_s - fence_s, 0.0)),
+                         ("train/fence", fence_s)):
+            reg.histogram(f"span/{tag}").observe(dur)
+            self.flight_recorder.record("span", tag=tag, dur_s=dur)
 
         if self.global_steps % self.steps_per_print() == 0:
             # per-step means over the print interval (reference resets each
@@ -2408,6 +2426,7 @@ class DeepSpeedEngine:
         if self.micro_steps % self.gradient_accumulation_steps() != 0:
             return  # not at boundary — reference also early-outs
         assert self._pending_grads is not None, "backward() must precede step()"
+        self.flight_recorder.set_step(self.global_steps)
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).start()
         if self._host_runner is not None:
@@ -2510,7 +2529,9 @@ class DeepSpeedEngine:
         boundary the loss readback — the same fence _report_progress
         pays right after — closes a wall-clock window whose mean is the
         honest per-step time (the SynchronizedWallClockTimer
-        sync-per-read pattern, retired)."""
+        sync-per-read pattern, retired). The boundary readback is also
+        where the watchdog's NaN/inf rule sees the loss — the one
+        fence the anomaly layer is allowed to ride (ISSUE 6)."""
         reg = self.telemetry
         reg.counter("train/steps").inc()
         reg.counter("train/samples").inc(self.train_batch_size())
@@ -2534,9 +2555,21 @@ class DeepSpeedEngine:
             stall += opt_swapper.take_stall_s()
         if have_swap:
             reg.histogram("swap/stall_s").observe(stall)
+            if self.watchdog is not None:
+                # host wall timer the swapper already kept — no fence
+                self.watchdog.observe_swap_stall(
+                    stall, step=self.global_steps)
+        self.flight_recorder.record(
+            "step", step=self.global_steps, tokens=tokens,
+            samples=self.train_batch_size(),
+            **({"swap_stall_s": stall} if have_swap else {}))
         if self.global_steps % self.steps_per_print() != 0:
             return
-        float(jax.device_get(loss))  # sync-ok: steps_per_print boundary
+        lval = float(jax.device_get(loss))  # sync-ok: steps_per_print boundary
+        self.flight_recorder.record("loss", step=self.global_steps,
+                                    loss=lval)
+        if self.watchdog is not None:
+            self.watchdog.check_loss(lval, step=self.global_steps)
         self._telemetry_fold(batch)
         self._telemetry_export()
 
@@ -2563,6 +2596,13 @@ class DeepSpeedEngine:
             if steps > 0 and window_s > 0 and self._tel_window_step0 > 0:
                 step_s = window_s / steps
                 reg.histogram("train/step_time_s").observe(step_s)
+                self.flight_recorder.record(
+                    "window", step=self.global_steps, steps=steps,
+                    step_s=step_s)
+                if self.watchdog is not None:
+                    # outlier check on the already-fenced window mean
+                    self.watchdog.observe_step_time(
+                        step_s, step=self.global_steps)
                 reg.gauge("train/samples_per_sec").set(
                     steps * self.train_batch_size() / window_s)
                 if self._tel_window_tokens:
@@ -2637,7 +2677,10 @@ class DeepSpeedEngine:
                     mc.output_path,
                     f"telemetry_rank{_process_rank()}.jsonl")
                 try:
-                    self._tel_exporter = JsonlExporter(path, self.telemetry)
+                    self._tel_exporter = JsonlExporter(
+                        path, self.telemetry,
+                        max_bytes=int(mc.jsonl_max_mb * 2**20),
+                        max_files=mc.jsonl_max_files)
                 except OSError as e:
                     logger.warning(f"telemetry JSONL unavailable: {e}")
                     self._tel_exporter = False

@@ -63,6 +63,11 @@ def _registry():
     return default_registry()
 
 
+def _recorder():
+    from deepspeed_tpu.telemetry import default_recorder
+    return default_recorder()
+
+
 def _close_fds_and_rm(path, fds, remove):
     """weakref.finalize target — must not reference the swapper. ``fds``
     is the LIVE dict (cleared by release(), so a later GC finalize never
@@ -414,9 +419,13 @@ class PartitionedParamSwapper:
         nothing is pending."""
         if not self._pending and not self._wbusy:
             return
+        n = len(self._pending)
+        t0 = time.perf_counter()
         self._timed_wait(self._write_handle())
         self._wbusy.clear()
         self._pending.clear()
+        _recorder().record("swap_drain", leaves=n,
+                           wait_s=time.perf_counter() - t0)
 
     @property
     def has_pending_writes(self):
@@ -519,6 +528,11 @@ class PartitionedParamSwapper:
         if not aliases_host:
             for o in outs:
                 o.block_until_ready()  # sync-ok: staging reuse safety
+        _recorder().record(
+            "swap_in", leaves=n,
+            bytes_read=sum(self._leaf_nbytes(i) for i in disk),
+            cache_hit_bytes=sum(self._cache[i][1] for i in cached
+                                if i in self._cache))
         return outs
 
     def swap_out_device(self, leaves, write_behind=None):
@@ -552,6 +566,10 @@ class PartitionedParamSwapper:
             self._reg().counter("swap/bytes_written").inc(b.nbytes)
         if self._durable:
             self.save_meta()
+        _recorder().record(
+            "swap_out", leaves=len(leaves), write_behind=bool(wb),
+            bytes=sum(self._leaf_nbytes(i) for i in range(len(leaves))
+                      if i in self.meta))
 
     def release(self):
         try:

@@ -24,12 +24,19 @@ from deepspeed_tpu.serving.adapters import (      # noqa: F401
     GPT2ServingAdapter, LlamaServingAdapter)
 
 
-def _serving_section(config):
-    from deepspeed_tpu.config.config import DeepSpeedConfig, ServingConfig
+def _param_dict(config):
+    """Parse a config (dict or json path) ONCE into a param dict; a
+    dict passes through cheaply, so callers can pre-parse and thread
+    the result to avoid re-reading a file."""
+    from deepspeed_tpu.config.config import DeepSpeedConfig
     if config is None:
-        return ServingConfig({})
-    pd = DeepSpeedConfig.load_param_dict(config)
-    return ServingConfig(pd)
+        return {}
+    return DeepSpeedConfig.load_param_dict(config)
+
+
+def _serving_section(config):
+    from deepspeed_tpu.config.config import ServingConfig
+    return ServingConfig(_param_dict(config))
 
 
 def cache_spec_from_config(model_config, family: str, config=None,
@@ -62,7 +69,8 @@ def cache_spec_from_config(model_config, family: str, config=None,
 
 
 def build_engine(family: str, model_config, params, config=None,
-                 rng=None, registry=None, **overrides) -> ContinuousBatcher:
+                 rng=None, registry=None, recorder=None, watchdog=None,
+                 **overrides) -> ContinuousBatcher:
     """Build a ContinuousBatcher for ``family``:
 
     - ``"gpt2"``: ``params`` is either the training ``GPT2LMHeadModel``
@@ -70,29 +78,55 @@ def build_engine(family: str, model_config, params, config=None,
     - ``"llama"``: ``params`` is the PACKED serving tree
       (models.llama_inference.convert_llama_serving_params /
       quantize_llama_serving_params / random_int8_serving_params).
+
+    A ``monitor.watchdog`` block in ``config`` attaches an anomaly
+    watchdog (telemetry/anomaly.py: TTFT blowup + page-pool exhaustion
+    rules, one-shot flight-recorder dumps); pass ``watchdog=`` to
+    supply one directly.
     """
+    from deepspeed_tpu.config import constants as C
+    # parse once; pd is a plain dict, so the helpers below re-load it
+    # for free instead of re-reading a json file per call
+    pd = _param_dict(config)
     if config is not None:
-        from deepspeed_tpu.config.config import DeepSpeedConfig
-        from deepspeed_tpu.config import constants as C
-        pd = DeepSpeedConfig.load_param_dict(config)
-        if C.SERVING in pd and not _serving_section(config).enabled:
+        if C.SERVING in pd and not _serving_section(pd).enabled:
             raise ValueError(
                 "the config's serving block sets enabled: false — "
                 "drop the block (or flip the flag) to build a serving "
                 "engine from it")
-    spec = cache_spec_from_config(model_config, family, config,
-                                  **overrides)
+    spec = cache_spec_from_config(model_config, family, pd, **overrides)
     # serving.quantize_bits = 8 quantizes full-precision param trees to
     # the int8 serving storage at build time; trees that already carry
     # int8 codes ("kernel_q") serve as-is either way
     qb = overrides.get("quantize_bits",
-                       _serving_section(config).quantize_bits)
+                       _serving_section(pd).quantize_bits)
     if family == "gpt2":
         adapter = GPT2ServingAdapter(model_config, params, spec,
                                      quantize_bits=qb)
     else:
         adapter = LlamaServingAdapter(model_config, params, spec,
                                       quantize_bits=qb)
+    if watchdog is None and C.MONITOR in pd:
+        from deepspeed_tpu.config.config import MonitorConfig
+        from deepspeed_tpu.telemetry.anomaly import Watchdog
+        from deepspeed_tpu.telemetry.recorder import default_recorder
+        mc = MonitorConfig(pd)
+        # reconfigure the process recorder only when THIS config
+        # actually carries a monitor block — a serving-only config must
+        # not clobber a training engine's explicit recorder settings
+        default_recorder().configure(
+            enabled=mc.flight_recorder.enabled,
+            capacity=mc.flight_recorder.capacity)
+        if mc.watchdog.enabled and registry is None:
+            # the watchdog's trip counters must land in the SAME
+            # registry the batcher records into, or metrics_snapshot /
+            # an exporter over the engine registry never sees them
+            from deepspeed_tpu.telemetry.registry import MetricsRegistry
+            registry = MetricsRegistry()
+        watchdog = Watchdog.from_config(mc.watchdog, recorder=recorder,
+                                        registry=registry,
+                                        source="serving")
     # registry: pass telemetry.default_registry() to merge the serving
     # metrics into the process-wide stream; default is per-engine
-    return ContinuousBatcher(adapter, rng=rng, registry=registry)
+    return ContinuousBatcher(adapter, rng=rng, registry=registry,
+                             recorder=recorder, watchdog=watchdog)

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.serving.paged_cache import PagedKVCache, TRASH_BLOCK
+from deepspeed_tpu.telemetry.recorder import default_recorder
 from deepspeed_tpu.telemetry.registry import MetricsRegistry
 
 
@@ -80,7 +81,8 @@ class ContinuousBatcher:
     """
 
     def __init__(self, adapter, rng: Optional[jax.Array] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 recorder=None, watchdog=None):
         self.adapter = adapter
         self.spec = adapter.spec
         self.cache: PagedKVCache = adapter.make_cache()
@@ -98,6 +100,15 @@ class ContinuousBatcher:
         # logits consumptions it already cannot avoid.
         self.metrics = registry if registry is not None \
             else MetricsRegistry()
+        # flight recorder (ISSUE 6): request lifecycle events — admit ->
+        # prefill -> ticks -> EOS — land in the process-wide ring by
+        # default; the optional watchdog (telemetry/anomaly.py)
+        # evaluates TTFT-blowup / pool-exhaustion rules at the admission
+        # sweep, the one place those values already exist as host
+        # scalars (never a new device sync)
+        self.recorder = recorder if recorder is not None \
+            else default_recorder()
+        self.watchdog = watchdog
         self._t_first_decode = None   # engine-lifetime tokens/sec base
 
     # ----------------------------------------------------------- metrics
@@ -116,7 +127,10 @@ class ContinuousBatcher:
     def metrics_snapshot(self) -> Dict[str, Any]:
         """One JSON-able dict of the serving observables: queue depth,
         admission wait, time-to-first-token, per-tick decode latency,
-        tokens/sec, slot utilization, page-pool occupancy (+ HWM)."""
+        tokens/sec, slot utilization, page-pool occupancy (+ HWM), and
+        the watchdog state — a monotonic ``dump_id`` plus the last
+        anomaly (ISSUE 6 satellite; 0/None when no watchdog is
+        attached)."""
         snap = self.metrics.snapshot()
         hists = snap["histograms"]
         gauges = snap["gauges"]
@@ -146,6 +160,12 @@ class ContinuousBatcher:
                                           {"count": 0}),
             "decode_tokens_per_sec": (self.stats["decode_tokens"] / lifetime)
             if lifetime > 0 else 0.0,
+            "dump_id": self.watchdog.dump_id
+            if self.watchdog is not None else 0,
+            "last_anomaly": self.watchdog.last_anomaly
+            if self.watchdog is not None else None,
+            "watchdog": self.watchdog.snapshot()
+            if self.watchdog is not None else None,
             **self.stats,
         }
 
@@ -233,7 +253,19 @@ class ContinuousBatcher:
             slot_id = free[0]
             pages = self.cache.admit(slot_id, S + req.max_new_tokens)
             if pages is None:
-                break                 # pool exhausted; retry next step
+                # pool exhausted; retry next step. The watchdog rule is
+                # latched per episode — one dump until pages free again
+                need = self.cache.pages_needed(S + req.max_new_tokens)
+                self.recorder.record(
+                    "pool_exhausted", rid=req.rid, need_pages=need,
+                    free_pages=self.cache.free_pages,
+                    queue_depth=len(self.queue))
+                if self.watchdog is not None:
+                    self.watchdog.note_pool_exhausted(
+                        queue_depth=len(self.queue),
+                        free_pages=self.cache.free_pages,
+                        need_pages=need)
+                break
             self.queue.popleft()
             free.pop(0)
             t_admit = time.monotonic()
@@ -242,8 +274,13 @@ class ContinuousBatcher:
             t_ref = getattr(req, "_t_arrived", None)
             if t_ref is None:
                 t_ref = getattr(req, "_t_submit", t_admit)
+            wait_s = max(t_admit - t_ref, 0.0)
             self.metrics.histogram("serving/admission_wait_s").observe(
-                max(t_admit - t_ref, 0.0))
+                wait_s)
+            self.recorder.record("admit", rid=req.rid, slot=slot_id,
+                                 pages=len(pages), wait_s=wait_s)
+            if self.watchdog is not None:
+                self.watchdog.note_pool_ok()   # re-arm the pool rule
             n_pages = self._bucket_pages(S)
             P = self.spec.page_size
             ids = np.zeros((1, n_pages * P), np.int32)
@@ -262,8 +299,14 @@ class ContinuousBatcher:
                 req.temperature)                 # consumes the sample
             req.generated.append(tok)
             # the prefill logits readback above IS first-token delivery
-            self.metrics.histogram("serving/ttft_s").observe(
-                max(time.monotonic() - t_ref, 0.0))
+            ttft_s = max(time.monotonic() - t_ref, 0.0)
+            self.metrics.histogram("serving/ttft_s").observe(ttft_s)
+            self.recorder.record("prefill", rid=req.rid,
+                                 prompt_tokens=S, ttft_s=ttft_s)
+            if self.watchdog is not None:
+                # the readback above was the fence — the rule sees only
+                # the host scalar it produced
+                self.watchdog.observe_ttft(ttft_s, rid=req.rid)
             if self._t_first_decode is None:
                 self._t_first_decode = time.monotonic()
             slot = self.slots[slot_id]
@@ -292,6 +335,9 @@ class ContinuousBatcher:
             return None
         self.cache.release(slot_id)
         slot.request, slot.pos, slot.last_tok = None, -1, 0
+        self.recorder.record("finish", rid=req.rid,
+                             reason=req.finish_reason,
+                             generated=len(req.generated))
         return req
 
     # multi-step dispatch caps: a tick of K steps amortizes the host
@@ -336,6 +382,8 @@ class ContinuousBatcher:
         toks_seq = np.asarray(toks_seq)  # sync-ok: scheduler consumes
         #                                  the sampled tokens [steps,slots]
         tick_s = time.monotonic() - t0   # real: the asarray fenced it
+        self.recorder.record("tick", steps=steps, active=n_active,
+                             tick_s=tick_s)
         m = self.metrics
         m.histogram("serving/tick_latency_s").observe(tick_s)
         m.histogram("serving/decode_latency_per_token_s").observe(

@@ -21,7 +21,15 @@ Layout:
   (``jax.profiler.TraceAnnotation`` + wall time), ``annotate("tag")``
   for trace-time ``jax.named_scope`` labels inside jitted train fns,
   and ``TraceWindow`` wrapping ``jax.profiler.start_trace/stop_trace``
-  around a configured step range.
+  around a configured step range;
+- ``recorder``: the flight recorder — a process-wide bounded ring of
+  structured events (step/swap/serving lifecycle) for post-anomaly
+  reconstruction (ISSUE 6);
+- ``anomaly``: the watchdog — fence-point anomaly rules (NaN loss,
+  step-time / swap-stall outliers, TTFT blowup, page-pool exhaustion)
+  that write one-shot JSONL dumps of the ring;
+- ``view``: ``python -m deepspeed_tpu.telemetry.view <dump.jsonl>``
+  renders a dump as per-step phase tables + per-request timelines.
 """
 
 from deepspeed_tpu.telemetry.registry import (     # noqa: F401
@@ -29,3 +37,6 @@ from deepspeed_tpu.telemetry.registry import (     # noqa: F401
     JsonlExporter, SummaryBridge, prometheus_text)
 from deepspeed_tpu.telemetry.spans import (        # noqa: F401
     span, annotate, TraceWindow)
+from deepspeed_tpu.telemetry.recorder import (     # noqa: F401
+    FlightRecorder, default_recorder)
+from deepspeed_tpu.telemetry.anomaly import Watchdog  # noqa: F401

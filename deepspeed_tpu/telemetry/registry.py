@@ -195,16 +195,36 @@ def default_registry() -> MetricsRegistry:
 class JsonlExporter:
     """Appends one JSON line per export: wall-clock timestamp, rank,
     step, and the full snapshot — the multi-process-mergeable stream
-    (each rank writes its own file; events self-identify)."""
+    (each rank writes its own file; events self-identify).
 
-    def __init__(self, path, registry=None):
+    Size-bounded rotation (ISSUE 6 satellite): when ``max_bytes`` > 0
+    and the file crosses it after an export, the stream rotates
+    logrotate-style — ``path`` → ``path.1`` → … → ``path.{max_files-1}``
+    and the oldest drops — so a multi-hour run holds at most
+    ``max_files × max_bytes`` of scalar history on disk."""
+
+    def __init__(self, path, registry=None, max_bytes=0, max_files=4):
         self.path = path
         self.registry = registry or default_registry()
         self.rank = _process_rank()
+        self.max_bytes = int(max_bytes or 0)
+        self.max_files = max(int(max_files), 1)
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         self._fh = open(path, "a")
+
+    def _rotate(self):
+        self._fh.close()
+        # shift path.{k} -> path.{k+1}, oldest falls off the end
+        for k in range(self.max_files - 1, 0, -1):
+            src = self.path if k == 1 else f"{self.path}.{k - 1}"
+            dst = f"{self.path}.{k}"
+            if os.path.exists(src):
+                os.replace(src, dst)
+        if self.max_files == 1:          # bounded to ONE file: truncate
+            open(self.path, "w").close()
+        self._fh = open(self.path, "a")
 
     def export(self, step=None, snapshot=None):
         snap = snapshot if snapshot is not None else self.registry.snapshot()
@@ -215,6 +235,8 @@ class JsonlExporter:
             "metrics": snap,
         }) + "\n")
         self._fh.flush()
+        if self.max_bytes and self._fh.tell() >= self.max_bytes:
+            self._rotate()
 
     def close(self):
         self._fh.close()
@@ -252,27 +274,53 @@ def _prom_name(name):
     return ("_" + n) if n[:1].isdigit() else n
 
 
+def _prom_escape_label(value):
+    """Escape a label VALUE per the exposition format (backslash,
+    double-quote and newline must be escaped inside the quotes) — real
+    scrapers reject unescaped ones."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_escape_help(text):
+    """HELP text escaping: backslash and newline only (HELP lines are
+    unquoted)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _prom_header(lines, prom_name, metric_name, kind):
+    """``# HELP`` then ``# TYPE`` (the order scrapers expect) for one
+    metric family. The help text carries the original ``/``-separated
+    metric path — the name mangling is lossy, the HELP line is not."""
+    lines.append(f"# HELP {prom_name} deepspeed_tpu metric "
+                 f"{_prom_escape_help(metric_name)}")
+    lines.append(f"# TYPE {prom_name} {kind}")
+
+
 def prometheus_text(registry=None, snapshot=None):
     """Prometheus exposition-format text dump of a snapshot: counters
     as ``counter``, gauges as ``gauge``, histograms as ``summary``
-    (quantiles + _sum/_count)."""
+    (quantiles + _sum/_count). Every family carries ``# HELP`` and
+    ``# TYPE`` lines and label values are escaped, so real scrapers
+    (prometheus, vmagent) parse the page cleanly (ISSUE 6 satellite)."""
     snap = snapshot if snapshot is not None else \
         (registry or default_registry()).snapshot()
     lines = []
     for k, v in sorted(snap["counters"].items()):
         n = _prom_name(k)
-        lines.append(f"# TYPE {n} counter")
+        _prom_header(lines, n, k, "counter")
         lines.append(f"{n} {v}")
     for k, v in sorted(snap["gauges"].items()):
         n = _prom_name(k)
-        lines.append(f"# TYPE {n} gauge")
+        _prom_header(lines, n, k, "gauge")
         lines.append(f"{n} {v}")
     for k, s in sorted(snap["histograms"].items()):
         n = _prom_name(k)
-        lines.append(f"# TYPE {n} summary")
+        _prom_header(lines, n, k, "summary")
         for q, stat in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
             if stat in s:
-                lines.append(f'{n}{{quantile="{q}"}} {s[stat]}')
+                lines.append(
+                    f'{n}{{quantile="{_prom_escape_label(q)}"}} {s[stat]}')
         lines.append(f"{n}_sum {s.get('sum', 0.0)}")
         lines.append(f"{n}_count {s.get('count', 0)}")
     return "\n".join(lines) + "\n"

@@ -24,6 +24,7 @@ against execution when wrapped around hot-loop phases (the reference's
 import contextlib
 import time
 
+from deepspeed_tpu.telemetry.recorder import default_recorder
 from deepspeed_tpu.telemetry.registry import default_registry
 from deepspeed_tpu.utils.logging import logger
 
@@ -37,14 +38,17 @@ def annotate(tag):
 
 
 @contextlib.contextmanager
-def span(tag, registry=None, annotation=True):
+def span(tag, registry=None, annotation=True, recorder=None):
     """Host-side phase span: wall time into ``span/{tag}`` plus a
-    profiler TraceAnnotation. NEVER syncs the device — around a jitted
-    call this measures dispatch, by design (sync discipline,
+    profiler TraceAnnotation, plus one ``span`` event in the flight
+    recorder (the per-STEP record the histogram's aggregate view
+    cannot reconstruct — recorder.py). NEVER syncs the device — around
+    a jitted call this measures dispatch, by design (sync discipline,
     docs/observability.md). Async-safe: state lives on the stack, the
-    registry locks per record; concurrent spans from other threads
-    (e.g. the serving scheduler) interleave correctly."""
+    registry/recorder lock per record; concurrent spans from other
+    threads (e.g. the serving scheduler) interleave correctly."""
     reg = registry or default_registry()
+    rec = recorder if recorder is not None else default_recorder()
     ann = None
     if annotation:
         try:
@@ -61,6 +65,7 @@ def span(tag, registry=None, annotation=True):
         if ann is not None:
             ann.__exit__(None, None, None)
         reg.histogram(f"span/{tag}").observe(dt)
+        rec.record("span", tag=tag, dur_s=dt)
 
 
 class TraceWindow:

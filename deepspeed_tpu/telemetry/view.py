@@ -1,0 +1,271 @@
+"""Flight-recorder dump viewer.
+
+Usage::
+
+    python -m deepspeed_tpu.telemetry.view <dump.jsonl>
+
+Renders a watchdog dump (anomaly.py) — or any JSONL stream of recorder
+events — as:
+
+- the trigger header (rule, dump id, detail);
+- a per-step phase-attribution table: one row per training step,
+  columns for each recorded span tag (host phase seconds), the step's
+  tokens / swap stall, and the boundary loss readbacks;
+- per-request serving timelines: admit -> prefill (TTFT) -> ticks ->
+  finish, with waits and reasons;
+- a swap-tier I/O summary per step (bytes in/out, drain waits);
+- the trailing raw events with ``--events N``.
+
+Pure stdlib + host-side JSON — the viewer never imports jax, so it runs
+anywhere the dump landed (a dev laptop, a CI artifact store).
+"""
+
+import argparse
+import json
+import sys
+from collections import OrderedDict, defaultdict
+
+
+def load_dump(path):
+    """Returns (header_or_None, events). Unparseable lines are skipped
+    with a count so a truncated dump still renders."""
+    header = None
+    events = []
+    skipped = 0
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                skipped += 1
+                continue
+            if not isinstance(obj, dict):
+                skipped += 1
+                continue
+            if obj.get("kind") == "dump_header" and header is None:
+                header = obj
+            else:
+                events.append(obj)
+    return header, events, skipped
+
+
+def _fmt(v, width):
+    if v is None or v == "":
+        s = "-"
+    elif isinstance(v, float):
+        s = f"{v:.4g}"
+    else:
+        s = str(v)
+    if len(s) > width:
+        s = s[:width - 1] + "…"
+    return s.rjust(width)
+
+
+def _table(headers, rows, out):
+    widths = [max(len(str(h)), 10) for h in headers]
+    out.append("  " + " ".join(_fmt(h, w) for h, w in
+                               zip(headers, widths)))
+    for row in rows:
+        out.append("  " + " ".join(_fmt(v, w) for v, w in
+                                   zip(row, widths)))
+
+
+def render_header(header, out):
+    if header is None:
+        out.append("no dump header (raw event stream)")
+        return
+    det = header.get("detail") or {}
+    out.append(f"flight dump #{header.get('dump_id')} — rule "
+               f"{header.get('rule')!r} (source "
+               f"{header.get('source')}, {header.get('n_events')} "
+               f"events)")
+    if det:
+        out.append("  trigger: " + ", ".join(
+            f"{k}={det[k]!r}" if isinstance(det[k], str)
+            else f"{k}={_fmt(det[k], 12).strip()}" for k in det))
+
+
+def render_steps(events, out):
+    """Per-step phase attribution: span tags as columns (seconds summed
+    per step), plus tokens, swap stall and the boundary loss."""
+    steps = OrderedDict()          # step -> {col: value}
+    tags = []
+    for ev in events:
+        step = ev.get("step")
+        if step is None:
+            continue
+        row = steps.setdefault(step, defaultdict(float))
+        kind = ev.get("kind")
+        if kind == "span":
+            tag = ev.get("tag", "?")
+            if tag not in tags:
+                tags.append(tag)
+            row[("span", tag)] += ev.get("dur_s") or 0.0
+        elif kind == "step":
+            row["tokens"] = ev.get("tokens")
+            if ev.get("swap_stall_s") is not None:
+                row["swap_stall_s"] = ev["swap_stall_s"]
+        elif kind == "loss":
+            row["loss"] = ev.get("loss")
+        elif kind == "window":
+            row["window_step_s"] = ev.get("step_s")
+        elif kind == "anomaly":
+            row["anomaly"] = ev.get("rule")
+    if not steps:
+        return
+    out.append("")
+    out.append("per-step phase attribution (host seconds per span tag):")
+    headers = (["step"] + [t.replace("train/", "") for t in tags]
+               + ["window_step_s", "tokens", "swap_stall_s", "loss",
+                  "anomaly"])
+    rows = []
+    for step, row in steps.items():
+        rows.append([step] + [row.get(("span", t), "") for t in tags]
+                    + [row.get("window_step_s", ""),
+                       row.get("tokens", ""),
+                       row.get("swap_stall_s", ""),
+                       row.get("loss", ""),
+                       row.get("anomaly", "")])
+    _table(headers, rows, out)
+
+
+def render_requests(events, out):
+    """Per-request serving timelines from admit/prefill/finish events,
+    with the global tick stream summarized."""
+    reqs = OrderedDict()           # rid -> fields
+    ticks = 0
+    tick_steps = 0
+    exhausted = 0
+    t0 = None
+    for ev in events:
+        kind = ev.get("kind")
+        if kind in ("admit", "prefill", "finish", "tick",
+                    "pool_exhausted") and t0 is None:
+            t0 = ev.get("ts")
+        if kind == "admit":
+            r = reqs.setdefault(ev.get("rid"), {})
+            r["t_admit"] = ev.get("ts")
+            r["slot"] = ev.get("slot")
+            r["pages"] = ev.get("pages")
+            r["wait_s"] = ev.get("wait_s")
+        elif kind == "prefill":
+            r = reqs.setdefault(ev.get("rid"), {})
+            r["prompt_tokens"] = ev.get("prompt_tokens")
+            r["ttft_s"] = ev.get("ttft_s")
+        elif kind == "finish":
+            r = reqs.setdefault(ev.get("rid"), {})
+            r["t_finish"] = ev.get("ts")
+            r["reason"] = ev.get("reason")
+            r["generated"] = ev.get("generated")
+        elif kind == "tick":
+            ticks += 1
+            tick_steps += ev.get("steps") or 0
+        elif kind == "pool_exhausted":
+            exhausted += 1
+    if not reqs and not ticks:
+        return
+    out.append("")
+    out.append(f"serving: {len(reqs)} requests in window, {ticks} ticks"
+               f" ({tick_steps} decode steps)"
+               + (f", {exhausted} pool-exhausted admissions"
+                  if exhausted else ""))
+    if not reqs:
+        return
+    out.append("per-request timelines (t relative to first serving "
+               "event):")
+    headers = ["rid", "t_admit", "slot", "pages", "wait_s",
+               "prompt_toks", "ttft_s", "t_finish", "reason", "toks"]
+    rows = []
+    for rid, r in reqs.items():
+        rel = (lambda t: (t - t0) if (t is not None and t0 is not None)
+               else None)
+        rows.append([rid, rel(r.get("t_admit")), r.get("slot"),
+                     r.get("pages"), r.get("wait_s"),
+                     r.get("prompt_tokens"), r.get("ttft_s"),
+                     rel(r.get("t_finish")), r.get("reason"),
+                     r.get("generated")])
+    _table(headers, rows, out)
+
+
+def render_swap(events, out):
+    """Swap-tier I/O per step: bytes written/read, cache hits, drains."""
+    per_step = OrderedDict()
+    seen = False
+    for ev in events:
+        kind = ev.get("kind")
+        if kind not in ("swap_out", "swap_in", "swap_drain"):
+            continue
+        seen = True
+        row = per_step.setdefault(ev.get("step"), defaultdict(float))
+        if kind == "swap_out":
+            row["write_mb"] += (ev.get("bytes") or 0) / 2**20
+            row["out_leaves"] += ev.get("leaves") or 0
+        elif kind == "swap_in":
+            row["read_mb"] += (ev.get("bytes_read") or 0) / 2**20
+            row["cache_mb"] += (ev.get("cache_hit_bytes") or 0) / 2**20
+            row["in_leaves"] += ev.get("leaves") or 0
+        elif kind == "swap_drain":
+            row["drain_s"] += ev.get("wait_s") or 0.0
+    if not seen:
+        return
+    out.append("")
+    out.append("swap-tier I/O per step:")
+    headers = ["step", "write_mb", "read_mb", "cache_mb", "out_leaves",
+               "in_leaves", "drain_s"]
+    rows = [[step] + [row.get(h, "") for h in headers[1:]]
+            for step, row in per_step.items()]
+    _table(headers, rows, out)
+
+
+def render(path, tail_events=0):
+    """The full report as a list of lines (the CLI joins and prints)."""
+    header, events, skipped = load_dump(path)
+    out = []
+    render_header(header, out)
+    if skipped:
+        out.append(f"({skipped} unparseable line(s) skipped)")
+    if not events:
+        out.append("no events")
+        return out
+    render_steps(events, out)
+    render_requests(events, out)
+    render_swap(events, out)
+    plans = [ev for ev in events
+             if ev.get("kind") in ("overlap_bucket_plan",
+                                   "prefetch_layer_plan")]
+    if plans:
+        out.append("")
+        out.append("comm bucket plans (trace-time):")
+        for ev in plans:
+            out.append("  " + json.dumps(
+                {k: v for k, v in ev.items() if k not in ("ts", "seq")}))
+    if tail_events:
+        out.append("")
+        out.append(f"last {min(tail_events, len(events))} raw events:")
+        for ev in events[-tail_events:]:
+            out.append("  " + json.dumps(ev))
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.telemetry.view",
+        description=__doc__.splitlines()[0])
+    ap.add_argument("dump", help="flight-recorder dump (JSONL)")
+    ap.add_argument("--events", type=int, default=0, metavar="N",
+                    help="also print the last N raw events")
+    args = ap.parse_args(argv)
+    try:
+        lines = render(args.dump, tail_events=args.events)
+    except OSError as e:
+        print(f"cannot read {args.dump}: {e}", file=sys.stderr)
+        return 2
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

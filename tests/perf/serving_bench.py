@@ -93,8 +93,24 @@ def run_serving_bench(n_requests=32, slots=4, seed=0,
                             "max_pages_per_slot": max_pages_per_slot,
                             "kv_cache_bits": kv_cache_bits}})
 
+    # watchdog rides the measured engine (ISSUE 6): TTFT-blowup /
+    # pool-exhaustion trips surface in the snapshot next to the TTFT
+    # percentiles, so the bench record says whether the run was clean.
+    # One dump SUBDIR per window: each window's Watchdog restarts its
+    # dump_id at 1, so a shared dir would overwrite an earlier window's
+    # incident with a later one's
+    import tempfile
+    from deepspeed_tpu.telemetry.anomaly import Watchdog
+    wd_dump_dir = tempfile.mkdtemp(prefix="dstpu_flight_serving_")
+    wd_window = [0]
+
     def run_continuous():
-        eng = serving.ContinuousBatcher(shared.adapter)
+        wd_window[0] += 1
+        eng = serving.ContinuousBatcher(
+            shared.adapter,
+            watchdog=Watchdog(
+                os.path.join(wd_dump_dir, f"window{wd_window[0]}"),
+                source="serving"))
         t0 = time.monotonic()
         res = eng.serve(make_requests(), respect_arrival_times=True)
         dt = time.monotonic() - t0

@@ -1,0 +1,135 @@
+"""bench.py --compare regression gate (ISSUE 6 satellite): pure-file
+comparison path — identity exits 0, a seeded >=10% regression exits
+nonzero — against both bench-native result JSON and the driver-captured
+BENCH_rXX.json format ({"parsed": {metric, value, ...}}). The compare
+path must never import jax (CI runs it on artifact files)."""
+
+import json
+
+import pytest
+
+import bench
+
+
+def _bench_doc(value=49.0, tokens=19000.0, step_ms=430.0, decode=2700.0,
+               rps=18.0, ttft_p99=0.12):
+    return {
+        "metric": "gpt2_large_774m_zero3_mfu",
+        "value": value,
+        "unit": "%MFU",
+        "vs_baseline": round(value / 45.0, 3),
+        "detail": {
+            "tokens_per_sec": tokens,
+            "step_time_ms": step_ms,
+            "bert_base_seq128_samples_per_sec": 620.0,
+            "decode": {
+                "b32_ctx512_int8kv": {"decode_tokens_per_sec": decode},
+                "llama7b_b1_int8": {"skipped": "budget"},
+                "serving_continuous_batching": {
+                    "requests_per_sec_continuous": rps,
+                    "ttft_p99_s": ttft_p99,
+                },
+            },
+            "moe": {"tokens_per_sec": 30000.0},
+            "nvme_param_tier": {"steady_step_s": 9.5},
+            "sections_skipped": {},
+        },
+    }
+
+
+def _write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def _run(prior, cand, extra=()):
+    return bench.main(["--compare", prior, "--candidate", cand,
+                       *extra])
+
+
+def test_headline_metrics_extraction_both_formats():
+    doc = _bench_doc()
+    m = bench.headline_metrics(doc)
+    assert m["gpt2_large_774m_zero3_mfu"] == (49.0, +1)
+    assert m["step_time_ms"] == (430.0, -1)
+    assert m["decode.b32_ctx512_int8kv.decode_tokens_per_sec"] == \
+        (2700.0, +1)
+    assert m["serving.ttft_p99_s"] == (0.12, -1)
+    # skipped sections contribute nothing
+    assert not any("llama7b" in k for k in m)
+    drv = {"n": 5, "rc": 124, "tail": "...",
+           "parsed": {"metric": "gpt2_large_774m_zero3_mfu",
+                      "value": 49.37, "unit": "%MFU",
+                      "vs_baseline": 1.097}}
+    assert bench.headline_metrics(drv) == {
+        "gpt2_large_774m_zero3_mfu": (49.37, +1)}
+    # a driver doc whose parsed line carries detail (BENCH_r01-r03
+    # shape) contributes those metrics too — the extractor recurses
+    drv["parsed"]["detail"] = {"step_time_ms": 500.0}
+    m = bench.headline_metrics(drv)
+    assert m["step_time_ms"] == (500.0, -1)
+    # parsed: null (the r04 tail overflow) -> no metrics, vacuous gate
+    assert bench.headline_metrics({"n": 4, "parsed": None}) == {}
+
+
+def test_compare_identity_exits_zero(tmp_path, capsys):
+    p = _write(tmp_path, "prior.json", _bench_doc())
+    assert _run(p, p) == 0
+    out = capsys.readouterr().out
+    assert '"regressions": []' in out or '"regressions": [],' in out
+
+
+def test_compare_seeded_regression_exits_nonzero(tmp_path, capsys):
+    prior = _write(tmp_path, "prior.json", _bench_doc())
+    cand = _write(tmp_path, "cand.json", _bench_doc(value=49.0 * 0.89))
+    rc = _run(prior, cand)
+    assert rc != 0
+    assert "gpt2_large_774m_zero3_mfu" in capsys.readouterr().out
+
+
+def test_compare_lower_is_better_regression(tmp_path):
+    prior = _write(tmp_path, "prior.json", _bench_doc())
+    cand = _write(tmp_path, "cand.json", _bench_doc(ttft_p99=0.3))
+    assert _run(prior, cand) != 0
+    # ...and an IMPROVEMENT in a lower-is-better metric passes
+    cand2 = _write(tmp_path, "cand2.json", _bench_doc(ttft_p99=0.05))
+    assert _run(prior, cand2) == 0
+
+
+def test_compare_improvements_and_small_noise_pass(tmp_path):
+    prior = _write(tmp_path, "prior.json", _bench_doc())
+    cand = _write(tmp_path, "cand.json",
+                  _bench_doc(value=49.0 * 1.2, tokens=19000.0 * 0.97))
+    assert _run(prior, cand) == 0       # 3% dip is under the threshold
+    assert _run(prior, cand, extra=("--regression-threshold",
+                                    "0.01")) != 0
+
+
+def test_compare_driver_format_prior(tmp_path):
+    drv = {"n": 5, "cmd": "python bench.py", "rc": 124, "tail": "…",
+           "parsed": {"metric": "gpt2_large_774m_zero3_mfu",
+                      "value": 49.37, "unit": "%MFU",
+                      "vs_baseline": 1.097}}
+    prior = _write(tmp_path, "BENCH_r05.json", drv)
+    same = _write(tmp_path, "cand.json", _bench_doc(value=49.37))
+    assert _run(prior, same) == 0
+    worse = _write(tmp_path, "worse.json", _bench_doc(value=44.0))
+    assert _run(prior, worse) != 0
+
+
+def test_compare_missing_and_extra_metrics_are_reported_not_failed(
+        tmp_path, capsys):
+    prior = _write(tmp_path, "prior.json", _bench_doc())
+    slim = {"metric": "gpt2_large_774m_zero3_mfu", "value": 49.0,
+            "unit": "%MFU", "vs_baseline": 1.089, "detail": {}}
+    cand = _write(tmp_path, "cand.json", slim)
+    assert _run(prior, cand) == 0       # no common regression
+    out = capsys.readouterr().out
+    assert "only_in_prior" in out
+
+
+def test_compare_unreadable_file_is_a_usage_error(tmp_path):
+    prior = _write(tmp_path, "prior.json", _bench_doc())
+    with pytest.raises(SystemExit):
+        _run(str(tmp_path / "nope.json"), prior)

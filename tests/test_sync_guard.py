@@ -14,8 +14,13 @@ dispatch against execution — fails CI instead of landing.
 Scope (the per-step hot paths):
 - ``deepspeed_tpu/parallel/*.py`` (overlap buckets, prefetch pipeline,
   mesh/attention helpers traced into train steps),
-- ``deepspeed_tpu/serving/*.py`` (the continuous-batching scheduler),
-- ``deepspeed_tpu/telemetry/*.py`` (recording must never sync),
+- ``deepspeed_tpu/serving/*.py`` (the continuous-batching scheduler,
+  including its watchdog hooks),
+- ``deepspeed_tpu/telemetry/*.py`` (recording must never sync — ISSUE
+  6 extends this to the flight recorder ``recorder.py``, the anomaly
+  watchdog ``anomaly.py`` and the dump viewer ``view.py``: rule
+  evaluation and dumping consume host scalars their callers already
+  read at existing fences),
 - ``deepspeed_tpu/runtime/swap_tensor/*.py`` (PR 5: the pipelined swap
   schedules run on the per-step path; their d2h parks and staging-slot
   fences are deliberate and annotated),

@@ -132,6 +132,67 @@ def test_prometheus_text_dump():
     assert "train_step_time_s_count 1" in text
 
 
+def test_prometheus_help_type_pairs_and_label_escaping():
+    """ISSUE 6 satellite: every family carries a # HELP line right
+    before its # TYPE line (the order scrapers expect), the HELP text
+    preserves the original /-separated path, and label values escape
+    backslash/quote/newline per the exposition format."""
+    from deepspeed_tpu.telemetry.registry import _prom_escape_label
+    r = MetricsRegistry()
+    r.counter("train/steps").inc(3)
+    r.histogram("serving/ttft_s").observe(0.5)
+    lines = prometheus_text(r).splitlines()
+    helps = [i for i, l in enumerate(lines) if l.startswith("# HELP ")]
+    assert helps, lines
+    for i in helps:
+        name = lines[i].split()[2]
+        assert lines[i + 1] == f"# TYPE {name} " \
+            + lines[i + 1].split()[-1]
+    # the lossy name mangling is recoverable from HELP
+    assert any("# HELP train_steps" in l and "train/steps" in l
+               for l in lines)
+    # one HELP/TYPE per family even with quantile samples following
+    assert sum(1 for l in lines if l.startswith("# TYPE serving_ttft_s "
+                                                )) == 1
+    assert _prom_escape_label('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    assert 'quantile="0.99"' in prometheus_text(r)
+
+
+def test_jsonl_exporter_rotation_bounds_disk(tmp_path):
+    """ISSUE 6 satellite: with max_bytes set the stream rotates
+    logrotate-style and total files never exceed max_files — a
+    multi-hour run cannot grow one unbounded file."""
+    r = MetricsRegistry()
+    r.counter("c").inc()
+    path = str(tmp_path / "m.jsonl")
+    ex = JsonlExporter(path, r, max_bytes=512, max_files=3)
+    for step in range(60):
+        ex.export(step=step)
+    ex.close()
+    files = sorted(os.listdir(tmp_path))
+    assert "m.jsonl" in files
+    assert "m.jsonl.1" in files and "m.jsonl.2" in files
+    assert len(files) == 3                    # oldest fell off the end
+    for f in files:
+        p = os.path.join(str(tmp_path), f)
+        assert os.path.getsize(p) <= 512 + 256   # one event of slack
+        for line in open(p):
+            assert json.loads(line)["metrics"]["counters"]["c"] == 1.0
+    # rotation keeps the newest events in the live file
+    last = [json.loads(l) for l in open(path)]
+    assert last == [] or last[-1]["step"] == 59
+
+
+def test_jsonl_exporter_rotation_off_by_default(tmp_path):
+    r = MetricsRegistry()
+    path = str(tmp_path / "m.jsonl")
+    ex = JsonlExporter(path, r)
+    for step in range(20):
+        ex.export(step=step)
+    ex.close()
+    assert sorted(os.listdir(tmp_path)) == ["m.jsonl"]
+
+
 # --------------------------------------------------------------- MFU math
 
 def test_model_flops_per_token_known_shape():
