@@ -68,8 +68,8 @@ class SectionRunner:
 
 
 BENCH_SECTIONS = ("bert", "train", "sparse", "decode", "llama7b", "moe",
-                  "zero3_prefetch", "aio", "nvme_param", "serving",
-                  "infinity6b", "xl")
+                  "zero3_prefetch", "aio", "nvme_param", "elastic_ckpt",
+                  "serving", "infinity6b", "xl")
 
 
 # ---------------------------------------------------------------------------
@@ -139,6 +139,12 @@ def headline_metrics(doc):
          "steady_step_s", -1)
     grab("infinity.steady_step_s", d.get("infinity_6b"),
          "steady_step_s", -1)
+    # elastic snapshots (ISSUE 7) stay OUT of the gated set on purpose:
+    # step_s_async/blocking_save_s are ~0.2-0.4 s page-cache timings
+    # with documented ±20% box noise — gating them at 5% makes CI
+    # flaky with no real regression (the numbers live in the section
+    # detail; the stable signals are ckpt_stall_s == 0 and
+    # overhead_pct_at_interval_100 < 1)
     return out
 
 
@@ -402,6 +408,11 @@ def main(argv=None):
         "nvme_param",
         lambda: bench_nvme_param_tier(dstpu, make_mesh, MeshConfig, dev),
         est_s=300)
+    jax.clear_caches()
+    elastic_ckpt = runner.run(
+        "elastic_ckpt",
+        lambda: bench_elastic_ckpt(dstpu, make_mesh, MeshConfig, dev),
+        est_s=240)
     jax.clear_caches()   # free HBM before the 1.5B subprocess needs it
 
     tdet = train if isinstance(train, dict) else {}
@@ -439,6 +450,10 @@ def main(argv=None):
             # crosses the ~35 MB/s tunnel, so the step time measures the
             # tunnel; on a TPU-VM the same path is PCIe-fed.
             "nvme_param_tier": nvme_param,
+            # elastic async snapshots (ISSUE 7): step-time overhead of
+            # checkpointing every few steps through the write-behind aio
+            # handle vs the blocking save stall it replaces
+            "elastic_ckpt": elastic_ckpt,
             # expert-parallel MoE training throughput (beyond-reference
             # component; routing einsums regress invisibly without it)
             "moe": moe,
@@ -1101,6 +1116,112 @@ def warm_infinity_9b():
         open(INF9B_WARM_SENTINEL, "w").write(json.dumps(out))
     print(json.dumps(out))
     return out
+
+
+def bench_elastic_ckpt(dstpu, make_mesh, MeshConfig, dev):
+    """Async-snapshot overhead (ISSUE 7 acceptance): steady-state step
+    time of a small GPT-2 run (a) with no checkpointing, (b) with an
+    async snapshot every ``interval`` (4) steps — deliberately tight so
+    the per-snapshot cost is measurable above step noise; begin stages
+    + submits on the write-behind aio handle, the commit fence rides
+    the next step boundary — and (c) the measured blocking
+    engine.save_checkpoint stall the async path replaces. Embeds the
+    sync-free telemetry counters (ckpt/bytes_written, ckpt/stall_s)
+    the engine kept."""
+    import shutil
+    import tempfile
+    import time
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.telemetry import default_registry
+
+    cfg_m = GPT2Config(vocab_size=2048, n_positions=128, n_embd=256,
+                       n_layer=4, n_head=4, dtype=jnp.float32,
+                       scan_layers=True)
+    steps = 8
+    interval = 4
+    tmp = tempfile.mkdtemp(prefix="dstpu_elastic_ckpt_")
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, 2048, size=(4, 128))
+             .astype(np.int32)}
+
+    def run(tagdir, snapshot=False, fsync=False):
+        cfg = {
+            "train_batch_size": 4,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "steps_per_print": 1000,
+        }
+        if snapshot:
+            cfg["snapshot"] = {"path": os.path.join(tmp, tagdir),
+                               "interval_steps": interval, "keep": 2,
+                               "fsync": fsync}
+        default_registry().reset()
+        engine, _, _, _ = dstpu.initialize(
+            config=cfg, model=GPT2LMHeadModel(cfg_m),
+            mesh=make_mesh(MeshConfig(data=1), devices=[dev]))
+        engine.train_batch(batch)        # compile
+        engine.telemetry.reset()
+        ts = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            engine.train_batch(batch)
+            ts.append(time.perf_counter() - t0)
+        # commit the possibly in-flight final-step snapshot BEFORE the
+        # teardown rmtree races its aio writes (and so both begun
+        # snapshots have a measured commit fence)
+        engine.finalize_pending_snapshot()
+        snap = engine.telemetry.snapshot("ckpt/")
+        if engine._preemption is not None:
+            engine._preemption.restore()
+        return engine, sum(ts) / len(ts), snap
+
+    try:
+        eb, base_s, _ = run("never")
+        t0 = time.perf_counter()
+        eb.save_checkpoint(os.path.join(tmp, "blocking"))
+        blocking_s = time.perf_counter() - t0
+        # fsync OFF is the apples-to-apples overhead number (the
+        # blocking save above never fsyncs either); the fsync-fenced
+        # variant prices the durability barrier separately
+        ea, async_s, snap = run("snaps", snapshot=True, fsync=False)
+        _, async_fsync_s, _ = run("snaps_fsync", snapshot=True,
+                                  fsync=True)
+        stall = snap["histograms"].get("ckpt/stall_s", {})
+        n_snaps = max(int(snap["counters"].get("ckpt/snapshots", 0)), 1)
+        bytes_per = snap["counters"].get("ckpt/bytes_written", 0) / n_snaps
+        return {
+            "step_s_base": round(base_s, 3),
+            "step_s_async_ckpt": round(async_s, 3),
+            "async_overhead_pct": round((async_s / base_s - 1) * 100, 1),
+            "per_snapshot_overhead_s": round(
+                (async_s - base_s) * steps / n_snaps, 3),
+            # the acceptance-criterion number: the bench snapshots every
+            # `interval` steps to make the per-snapshot cost measurable;
+            # at the production default cadence (interval_steps=100) the
+            # same cost amortizes to this share of step time
+            "overhead_pct_at_interval_100": round(
+                max(async_s - base_s, 0) * steps / n_snaps
+                / (100 * base_s) * 100, 2),
+            "step_s_async_ckpt_fsync": round(async_fsync_s, 3),
+            "blocking_save_s": round(blocking_s, 3),
+            "blocking_share_if_per_interval_pct": round(
+                blocking_s / (interval * base_s) * 100, 1),
+            "ckpt_mb_per_snapshot": round(bytes_per / 2**20, 1),
+            "ckpt_stall_s_mean": round(stall.get("mean", 0.0), 4),
+            "ckpt_stall_s_max": round(stall.get("max", 0.0), 4),
+            "snapshot_interval_steps": interval,
+            "snapshots_per_run": n_snaps,
+            "note": "overhead = host staging (d2h+memcpy+crc32) of the "
+                    "full state; the aio writes + commit fence overlap "
+                    "the next step (ckpt_stall_s is what the fence "
+                    "actually blocked). CPU-harness caveat: the 2-core "
+                    "box charges the staging AND the overlapped disk "
+                    "writes to the same cores as compute — on a TPU "
+                    "host the step is device-bound and the staging "
+                    "share shrinks by the step-time ratio.",
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def bench_nvme_param_tier(dstpu, make_mesh, MeshConfig, dev):

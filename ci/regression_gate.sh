@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Bench regression gate (PR 6's `bench.py --compare`, runnable as ONE
+# command in CI — ISSUE 7 satellite).
+#
+# Usage:
+#   ci/regression_gate.sh PRIOR.json CANDIDATE.json [THRESHOLD]
+#
+#   PRIOR.json      the baseline result document — a bench-native JSON
+#                   (what `python bench.py` prints as its last complete
+#                   JSON line) or a driver-captured BENCH_rXX.json
+#                   ({"parsed": {...}})
+#   CANDIDATE.json  the result document under test, same formats
+#   THRESHOLD       fractional worsening that fails the gate
+#                   (default 0.05 = 5%)
+#
+# Exit codes:
+#   0  no common headline metric regressed past the threshold
+#   3  at least one metric regressed (bench.py's compare exit code)
+#   2  usage / unreadable input
+#
+# The --candidate path never imports jax and finishes in <2 s, so this
+# runs on artifact files on any CI box. Typical wiring:
+#
+#   python bench.py > bench_out.txt          # on the perf machine
+#   tail -n 2 bench_out.txt | head -n 1 > candidate.json
+#   ci/regression_gate.sh BENCH_r06.json candidate.json || exit $?
+set -u
+
+if [ "$#" -lt 2 ]; then
+    echo "usage: $0 PRIOR.json CANDIDATE.json [THRESHOLD]" >&2
+    exit 2
+fi
+
+PRIOR=$1
+CANDIDATE=$2
+THRESHOLD=${3:-0.05}
+REPO_DIR=$(CDPATH= cd -- "$(dirname -- "$0")/.." && pwd)
+
+exec python "${REPO_DIR}/bench.py" \
+    --compare "${PRIOR}" \
+    --candidate "${CANDIDATE}" \
+    --regression-threshold "${THRESHOLD}"

@@ -45,6 +45,12 @@ class ZeroOffloadConfig:
             d, C.OFFLOAD_PIPELINE_READ, C.OFFLOAD_PIPELINE_READ_DEFAULT))
         self.pipeline_write = bool(get_scalar_param(
             d, C.OFFLOAD_PIPELINE_WRITE, C.OFFLOAD_PIPELINE_WRITE_DEFAULT))
+        # fsync-fenced durability (ISSUE 7 satellite): the drain fence
+        # additionally fsyncs every written swap file, turning it into a
+        # real durability barrier (snapshots taken from parked files
+        # depend on it; plain training does not and keeps the default)
+        self.fsync = bool(get_scalar_param(
+            d, C.OFFLOAD_FSYNC, C.OFFLOAD_FSYNC_DEFAULT))
         if self.buffer_count < 1:
             raise DeepSpeedConfigError(
                 f"offload {C.OFFLOAD_BUFFER_COUNT} must be >= 1, "
@@ -85,7 +91,8 @@ class ZeroOffloadConfig:
                 "buffer_count": self.buffer_count,
                 "buffer_size": self.buffer_size,
                 "pipeline_read": self.pipeline_read,
-                "pipeline_write": self.pipeline_write}
+                "pipeline_write": self.pipeline_write,
+                "fsync": self.fsync}
 
 
 class DeepSpeedZeroConfig:
@@ -280,13 +287,19 @@ class WatchdogConfig:
                                  C.WATCHDOG_TTFT_FACTOR_DEFAULT)
         self.ttft_min_s = d.get(C.WATCHDOG_TTFT_MIN_S,
                                 C.WATCHDOG_TTFT_MIN_S_DEFAULT)
+        self.ckpt_stall_factor = d.get(
+            C.WATCHDOG_CKPT_STALL_FACTOR,
+            C.WATCHDOG_CKPT_STALL_FACTOR_DEFAULT)
+        self.ckpt_stall_min_s = d.get(
+            C.WATCHDOG_CKPT_STALL_MIN_S, C.WATCHDOG_CKPT_STALL_MIN_S_DEFAULT)
         self.check_nan = bool(d.get(C.WATCHDOG_CHECK_NAN,
                                     C.WATCHDOG_CHECK_NAN_DEFAULT))
         self.max_dumps = int(d.get(C.WATCHDOG_MAX_DUMPS,
                                    C.WATCHDOG_MAX_DUMPS_DEFAULT))
         for name, v in (("step_time_factor", self.step_time_factor),
                         ("swap_stall_factor", self.swap_stall_factor),
-                        ("ttft_factor", self.ttft_factor)):
+                        ("ttft_factor", self.ttft_factor),
+                        ("ckpt_stall_factor", self.ckpt_stall_factor)):
             if not v > 1.0:
                 raise DeepSpeedConfigError(
                     f"monitor.watchdog.{name} must be > 1 (an outlier "
@@ -329,6 +342,59 @@ class MonitorConfig:
                 f"{self.jsonl_max_mb!r}/{self.jsonl_max_files!r}")
         self.flight_recorder = FlightRecorderConfig(d)
         self.watchdog = WatchdogConfig(d)
+
+
+class SnapshotConfig:
+    """``snapshot`` block (ISSUE 7): elastic preemption-tolerant
+    training — periodic async checkpoints through the swap tier's
+    write-behind aio handle (runtime/elastic/snapshot.py), a SIGTERM
+    preemption hook with a grace budget, and auto-resume from the
+    newest valid manifest on startup. Presence of the block (plus a
+    ``path``) enables it — like the watchdog, it writes files."""
+
+    def __init__(self, param_dict):
+        d = param_dict.get(C.SNAPSHOT, None)
+        self.enabled = d is not None and bool(
+            d.get(C.SNAPSHOT_ENABLED, C.SNAPSHOT_ENABLED_DEFAULT))
+        d = d or {}
+        self.path = d.get(C.SNAPSHOT_PATH, C.SNAPSHOT_PATH_DEFAULT)
+        self.interval_steps = int(d.get(C.SNAPSHOT_INTERVAL_STEPS,
+                                        C.SNAPSHOT_INTERVAL_STEPS_DEFAULT))
+        self.keep = int(d.get(C.SNAPSHOT_KEEP, C.SNAPSHOT_KEEP_DEFAULT))
+        self.fsync = bool(d.get(C.SNAPSHOT_FSYNC, C.SNAPSHOT_FSYNC_DEFAULT))
+        self.auto_resume = bool(d.get(C.SNAPSHOT_AUTO_RESUME,
+                                      C.SNAPSHOT_AUTO_RESUME_DEFAULT))
+        self.grace_secs = float(d.get(C.SNAPSHOT_GRACE_SECS,
+                                      C.SNAPSHOT_GRACE_SECS_DEFAULT))
+        signals = d.get(C.SNAPSHOT_SIGNALS, C.SNAPSHOT_SIGNALS_DEFAULT)
+        if isinstance(signals, str):
+            signals = (signals,)   # a bare "SIGTERM" must not iterate
+        self.signals = tuple(signals)  # per character
+        if self.enabled:
+            if not self.path:
+                raise DeepSpeedConfigError(
+                    "snapshot.path must be set when the snapshot block "
+                    "is enabled (snapshots need somewhere to land)")
+            if self.interval_steps < 1:
+                raise DeepSpeedConfigError(
+                    f"snapshot.interval_steps must be >= 1, got "
+                    f"{self.interval_steps}")
+            if self.keep < 1:
+                raise DeepSpeedConfigError(
+                    f"snapshot.keep must be >= 1, got {self.keep}")
+            if not self.grace_secs > 0:
+                raise DeepSpeedConfigError(
+                    f"snapshot.grace_secs must be > 0, got "
+                    f"{self.grace_secs}")
+            import signal as _signal
+            for name in self.signals:
+                # must be an actual Signals member: "alarm" etc. are
+                # signal-module attributes (functions) that would pass
+                # a bare getattr probe and crash handler install later
+                if not isinstance(getattr(_signal, str(name), None),
+                                  _signal.Signals):
+                    raise DeepSpeedConfigError(
+                        f"snapshot.signals: unknown signal {name!r}")
 
 
 class ProfilingConfig:
@@ -664,6 +730,7 @@ class DeepSpeedConfig:
         self.tensorboard_config = TensorboardConfig(pd)
         self.monitor_config = MonitorConfig(pd)
         self.profiling_config = ProfilingConfig(pd)
+        self.snapshot_config = SnapshotConfig(pd)
         self.sparse_attention_config = SparseAttentionConfig(pd)
         self.pipeline_config = PipelineConfig(pd)
         self.mesh_config = MeshConfigSection(pd)

@@ -176,6 +176,13 @@ OFFLOAD_PIPELINE_WRITE = "pipeline_write"
 OFFLOAD_PIPELINE_READ_DEFAULT = False
 OFFLOAD_PIPELINE_WRITE_DEFAULT = False
 OFFLOAD_FAST_INIT = "fast_init"
+# TPU extension (ISSUE 7 satellite): fsync-fenced durability for the
+# write-behind aio path. Off by default — swap files are per-step
+# scratch riding the guest page cache — but the drain fence becomes a
+# real durability barrier when on, and elastic snapshots taken FROM the
+# parked files require it for their commit fence to mean anything.
+OFFLOAD_FSYNC = "fsync"
+OFFLOAD_FSYNC_DEFAULT = False
 # TPU extension: how the offloaded optimizer step executes (offload_stream.py)
 OFFLOAD_STREAM = "stream"
 OFFLOAD_STREAM_SEGMENTS = "stream_segments"
@@ -373,6 +380,14 @@ WATCHDOG_CHECK_NAN = "check_nan"
 WATCHDOG_CHECK_NAN_DEFAULT = True
 WATCHDOG_MAX_DUMPS = "max_dumps"
 WATCHDOG_MAX_DUMPS_DEFAULT = 0       # 0 = unlimited
+# snapshot-stall rule (ISSUE 7): the async-snapshot commit fence is
+# supposed to measure ~0 (writes had a whole step to land); a stall
+# past factor x baseline (with an absolute floor) means the aio write
+# stream fell behind training and snapshots are no longer free.
+WATCHDOG_CKPT_STALL_FACTOR = "ckpt_stall_factor"
+WATCHDOG_CKPT_STALL_FACTOR_DEFAULT = 4.0
+WATCHDOG_CKPT_STALL_MIN_S = "ckpt_stall_min_s"
+WATCHDOG_CKPT_STALL_MIN_S_DEFAULT = 0.25
 
 #############################################
 # Programmatic XLA trace window (profiling.trace_dir + trace_steps):
@@ -462,6 +477,30 @@ AIO_SINGLE_SUBMIT = "single_submit"
 AIO_SINGLE_SUBMIT_DEFAULT = False
 AIO_OVERLAP_EVENTS = "overlap_events"
 AIO_OVERLAP_EVENTS_DEFAULT = True
+
+#############################################
+# Elastic snapshots (runtime/elastic, ISSUE 7): periodic async
+# checkpoints through the swap tier's write-behind aio handle, SIGTERM
+# preemption handling with a grace budget, and auto-resume from the
+# newest valid manifest. Presence of the block (plus a path) enables it.
+#############################################
+SNAPSHOT = "snapshot"
+SNAPSHOT_ENABLED = "enabled"
+SNAPSHOT_ENABLED_DEFAULT = True       # presence of the block enables it
+SNAPSHOT_PATH = "path"
+SNAPSHOT_PATH_DEFAULT = ""
+SNAPSHOT_INTERVAL_STEPS = "interval_steps"
+SNAPSHOT_INTERVAL_STEPS_DEFAULT = 100
+SNAPSHOT_KEEP = "keep"                # committed snapshot generations
+SNAPSHOT_KEEP_DEFAULT = 2
+SNAPSHOT_FSYNC = "fsync"              # the commit fence durability
+SNAPSHOT_FSYNC_DEFAULT = True
+SNAPSHOT_AUTO_RESUME = "auto_resume"
+SNAPSHOT_AUTO_RESUME_DEFAULT = True
+SNAPSHOT_GRACE_SECS = "grace_secs"    # preemption grace budget
+SNAPSHOT_GRACE_SECS_DEFAULT = 30.0
+SNAPSHOT_SIGNALS = "signals"
+SNAPSHOT_SIGNALS_DEFAULT = ("SIGTERM",)
 
 #############################################
 # Serving (continuous batching + paged KV cache) [tpu]

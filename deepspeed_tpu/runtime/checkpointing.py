@@ -245,6 +245,31 @@ def _sync(label):
         multihost_utils.sync_global_devices(label)
 
 
+def commit_dir_swap(stage_dir, final_dir, fault_point=None):
+    """THE two-rename publish protocol, shared by the blocking save and
+    the elastic snapshot commit (runtime/elastic/snapshot.py): move the
+    existing final dir aside, swap the finished staging dir in, drop
+    the old one. A crash anywhere in the window leaves either the old
+    tag or ``{tag}.old`` on disk, never a half-written final dir —
+    ``resolve_ckpt_dir`` (and resume's candidate walk) find the
+    survivor. ``fault_point`` names the injection hook fired between
+    the two renames (the fault-injection suite's crash window)."""
+    import shutil
+    if fault_point:
+        # import OUTSIDE the rename window: an ImportError between the
+        # renames would manufacture the half-committed state this
+        # protocol exists to avoid
+        from deepspeed_tpu.runtime.elastic import faults as _faults
+    old_dir = final_dir + ".old"
+    shutil.rmtree(old_dir, ignore_errors=True)
+    if os.path.isdir(final_dir):
+        os.rename(final_dir, old_dir)
+    if fault_point:
+        _faults.fire(fault_point, tag=os.path.basename(final_dir))
+    os.rename(stage_dir, final_dir)
+    shutil.rmtree(old_dir, ignore_errors=True)
+
+
 def save_checkpoint(save_dir, tag, state, extra, save_latest=True,
                     zero_stage=0):
     final_dir = os.path.join(save_dir, str(tag))
@@ -282,14 +307,8 @@ def save_checkpoint(save_dir, tag, state, extra, save_latest=True,
         meta["world_size"] = jax.process_count()
         with open(os.path.join(ckpt_dir, "meta.json"), "w") as f:
             json.dump(meta, f, default=str)
-        # swap the finished save into place; a crash in this window leaves
-        # either the old tag or `{tag}.old` on disk, never nothing
-        old_dir = final_dir + ".old"
-        shutil.rmtree(old_dir, ignore_errors=True)
-        if os.path.isdir(final_dir):
-            os.rename(final_dir, old_dir)
-        os.rename(ckpt_dir, final_dir)
-        shutil.rmtree(old_dir, ignore_errors=True)
+        commit_dir_swap(ckpt_dir, final_dir,
+                        fault_point="ckpt_between_renames")
         ckpt_dir = final_dir
         if save_latest:
             with open(os.path.join(save_dir, LATEST_FILE), "w") as f:

@@ -1,0 +1,110 @@
+"""Deterministic fault injection — the harness the elastic tests drive
+end-to-end (ISSUE 7). Production code calls ``fire(point)`` at named
+injection points; with nothing registered that is one dict lookup.
+Tests register callables with ``inject(point, fn)`` to simulate the
+failure at exactly that point:
+
+- ``step_end`` (engine.train_batch, after the optimizer step + park) —
+  kill-at-step lands here via :func:`kill_at_step`;
+- ``snapshot_between_renames`` (snapshot commit, after the old tag was
+  moved aside and before the staging dir takes its place) — the
+  crash-between-renames window;
+- ``ckpt_between_renames`` (runtime/checkpointing.py save commit) —
+  the same window in the blocking checkpoint path (the hazard the
+  comment at checkpointing.py:318 documents).
+
+Post-commit corruptions (a torn manifest, a rotted shard) are plain
+file edits — :func:`tear_manifest` / :func:`rot_shard` — because they
+model damage that happens AFTER the writer finished (a lost page, a
+bad sector), not a crash inside it.
+
+Stdlib-only on purpose: runtime/checkpointing.py and the engine fire
+points from inside their commit paths, and this module must never pull
+jax (or a sibling elastic module) into those import graphs.
+"""
+
+import contextlib
+import os
+import signal
+
+_HOOKS = {}   # point name -> list of callables
+
+
+class SimulatedCrash(RuntimeError):
+    """Raised by an injected fault to model a process dying at the
+    injection point (the caller's stack unwinds exactly like a crash
+    would leave the filesystem)."""
+
+
+def fire(point, **kw):
+    """Invoke the callables registered at ``point`` (no-op when none)."""
+    for fn in _HOOKS.get(point, ()):
+        fn(**kw)
+
+
+@contextlib.contextmanager
+def inject(point, fn):
+    """Register ``fn`` at ``point`` for the duration of the block."""
+    _HOOKS.setdefault(point, []).append(fn)
+    try:
+        yield
+    finally:
+        _HOOKS[point].remove(fn)
+        if not _HOOKS[point]:
+            del _HOOKS[point]
+
+
+def clear():
+    _HOOKS.clear()
+
+
+# ---------------------------------------------------------------- scenarios
+
+def kill_at_step(at_step, sig=signal.SIGTERM):
+    """Context manager: deliver ``sig`` to this process the first time
+    the engine finishes training step ``at_step`` — the deterministic
+    stand-in for a scheduler preempting the job mid-run. The signal
+    goes through the real kernel delivery path, so the
+    PreemptionHandler under test sees exactly what production would."""
+    fired = []
+
+    def _fn(step=None, **_kw):
+        if step == at_step and not fired:
+            fired.append(True)
+            os.kill(os.getpid(), sig)
+
+    return inject("step_end", _fn)
+
+
+def crash_between_renames(point="snapshot_between_renames"):
+    """Context manager: crash the commit between its two renames —
+    the window where the old tag is already moved aside but the new
+    save has not taken its place."""
+
+    def _fn(**_kw):
+        raise SimulatedCrash(f"injected crash at {point}")
+
+    return inject(point, _fn)
+
+
+def tear_manifest(snap_dir, keep_bytes=20):
+    """Truncate a committed snapshot's manifest mid-JSON (a torn
+    write): loaders must treat the snapshot as invalid and fall back."""
+    path = os.path.join(snap_dir, "manifest.json")
+    with open(path, "r+b") as fh:
+        fh.truncate(keep_bytes)
+    return path
+
+
+def rot_shard(snap_dir, nbytes=8):
+    """Flip the leading bytes of the first data shard of a committed
+    snapshot (bit rot / bad sector): the manifest checksum must catch
+    it at load."""
+    names = sorted(n for n in os.listdir(snap_dir) if n.endswith(".bin"))
+    assert names, f"no data shards in {snap_dir}"
+    path = os.path.join(snap_dir, names[0])
+    with open(path, "r+b") as fh:
+        orig = fh.read(nbytes)
+        fh.seek(0)
+        fh.write(bytes(b ^ 0xFF for b in orig))
+    return path

@@ -1,0 +1,144 @@
+"""Preemption handling (ISSUE 7): a signal hook + grace budget.
+
+Shared TPU pools reclaim chips with a SIGTERM and a short grace window.
+The :class:`PreemptionHandler` turns that into a flag the engine polls
+at step boundaries (the only place a final snapshot is consistent):
+
+- the signal handler itself does the minimum legal work — plain
+  attribute stores stamping the arrival time and flag — because it can
+  interrupt arbitrary Python INCLUDING code holding locks; the
+  ``preempt_signal`` ring event is deferred to ``poll_event()`` at the
+  next step boundary (taking the recorder lock inside signal context
+  could deadlock);
+- ``engine.train_batch`` checks ``requested`` at the end of the step
+  and runs the FINAL snapshot through the async snapshotter, but only
+  while ``remaining()`` grace budget is positive: a snapshot that
+  cannot finish inside the grace window is aborted rather than half
+  committed (the previous snapshot stays the valid one — the manifest
+  is the commit point);
+- the watchdog records the incident: one ``preempt`` flight-recorder
+  dump per preemption, carrying the ring history leading up to it.
+
+Handlers chain: the previously installed handler (a launcher's own
+SIGTERM logic) still runs after ours. ``restore()`` reinstalls the
+original handlers — tests and short-lived engines should call it.
+"""
+
+import signal
+import time
+import weakref
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class PreemptionHandler:
+    def __init__(self, signals=("SIGTERM",), grace_s=30.0, recorder=None):
+        self.grace_s = float(grace_s)  # sync-ok: host config scalar
+        self._recorder = recorder
+        self._requested = None       # (ts, signal name)
+        self._event_pending = False  # preempt_signal event not yet recorded
+        self._installed = {}         # signum -> previous handler
+        try:
+            for name in signals or ():
+                signum = getattr(signal, str(name), None)
+                if not isinstance(signum, signal.Signals):
+                    raise ValueError(f"unknown signal {name!r}")
+                try:
+                    prev = signal.getsignal(signum)
+                    signal.signal(signum,
+                                  self._make_handler(str(name), prev))
+                    self._installed[signum] = prev
+                except ValueError:
+                    # not the main thread: signal delivery cannot be
+                    # hooked here — programmatic request() still works
+                    logger.warning(
+                        f"PreemptionHandler: cannot install {name} "
+                        f"handler off the main thread; request() "
+                        f"remains available")
+        except Exception:
+            self.restore()   # no half-installed handler set may leak
+            raise
+
+    def _make_handler(self, name, prev):
+        # the closure holds only a WEAKREF to this handler object: the
+        # signal table pins installed closures for the process lifetime,
+        # and a strong ref would pin every engine (and its captured
+        # recorder) ever constructed — a dead handler becomes a
+        # pass-through to the chained previous handler instead
+        ref = weakref.ref(self)
+
+        def _handler(signum, frame):
+            # ASYNC-SIGNAL-SAFE by construction: the handler runs on the
+            # main thread between bytecodes and may interrupt code that
+            # HOLDS locks (the flight recorder's ring lock is taken many
+            # times per step) — acquiring any non-reentrant lock here
+            # can deadlock the process past its grace window. So the
+            # handler only does plain attribute stores; the recorder
+            # event is deferred to poll_event() at the step boundary.
+            live = ref()
+            if live is not None:
+                live.request(name)
+            if callable(prev):
+                prev(signum, frame)
+        return _handler
+
+    def _rec(self):
+        if self._recorder is None:
+            from deepspeed_tpu.telemetry import default_recorder
+            self._recorder = default_recorder()
+        return self._recorder
+
+    def request(self, source="manual"):
+        """Mark preemption requested (signal handler or programmatic
+        harness). Idempotent — the first request starts the grace
+        clock. Lock-free plain stores only: this runs inside signal
+        context (see _make_handler)."""
+        if self._requested is None:
+            self._requested = (time.monotonic(), str(source))
+            self._event_pending = True
+
+    def poll_event(self):
+        """Record the deferred ``preempt_signal`` event — called by the
+        engine at the step boundary, OUTSIDE signal context, where
+        taking the recorder lock is safe."""
+        if self._event_pending:
+            self._event_pending = False
+            self._rec().record("preempt_signal", signal=self.source,
+                               grace_s=self.grace_s)
+
+    @property
+    def requested(self):
+        return self._requested is not None
+
+    def remaining(self):
+        """Grace seconds left (None when no preemption is pending)."""
+        if self._requested is None:
+            return None
+        return self.grace_s - (time.monotonic() - self._requested[0])
+
+    @property
+    def source(self):
+        return self._requested[1] if self._requested else None
+
+    def restart_clock(self):
+        """Restart the grace clock at NOW, keeping the request (the
+        multi-process agreement point: signals arrive at arbitrary
+        times but the final snapshot only starts at an aligned interval
+        boundary, so the budget for the snapshot WORK counts from the
+        boundary — size ``interval_steps × step_time`` against the
+        scheduler's external kill deadline accordingly)."""
+        if self._requested is not None:
+            self._requested = (time.monotonic(), self._requested[1])
+
+    def reset(self):
+        self._requested = None
+        self._event_pending = False
+
+    def restore(self):
+        """Reinstall the handlers that were active before this one."""
+        for signum, prev in self._installed.items():
+            try:
+                signal.signal(signum, prev)
+            except (ValueError, TypeError):
+                pass
+        self._installed = {}

@@ -1,0 +1,226 @@
+"""Elastic resume (ISSUE 7): load a snapshot written at dp world size
+W into an engine running at dp W'.
+
+Two mechanisms compose:
+
+- **state re-sharding** is free: the snapshot index records each
+  piece's global window (runtime/checkpointing.py's elastic-restore
+  machinery), so assembly under the new engine's
+  ``ZeroPartitioner``-derived shardings reads exactly the windows each
+  new shard needs — ZeRO-1/2/3 partitions re-shape to W' without a
+  gather;
+- **the batch triangle** is re-solved by the elasticity HCN ladder
+  (elasticity/elasticity.py): with an ``elasticity`` config block, the
+  engine's own config already recomputed micro/grad-accum for W' such
+  that ``micro * gas * W' == final_batch_size`` — this module VERIFIES
+  the snapshot was written under the same effective batch, so the loss
+  trajectory continues as if the run were never interrupted.
+
+``load_latest_valid`` is the recovery policy: newest committed
+snapshot first (the ``latest`` pointer), then every older tag (and its
+``.old`` crash-window sibling), skipping — and reporting, once per
+recovery, through the watchdog — any candidate that fails manifest or
+checksum validation.
+"""
+
+import os
+import time
+
+from deepspeed_tpu.runtime import checkpointing as ckpt
+from deepspeed_tpu.runtime.elastic.snapshot import (
+    MANIFEST, SnapshotCorrupt, SnapshotReader, is_snapshot_dir)
+from deepspeed_tpu.utils.logging import logger
+
+
+def _candidates(snapshot_dir):
+    """Candidate snapshot directories, genuinely-newest first: ordered
+    by commit mtime with the ``latest`` pointer only as a tie-breaker
+    (the pointer is written AFTER the commit rename, so a crash in
+    that window leaves it pointing one generation back while a newer
+    valid snapshot sits on disk — mtime order still finds it). Each
+    tag is followed by its ``.old`` sibling (the crash-between-renames
+    fallback — same rule as checkpointing.resolve_ckpt_dir)."""
+    latest = ckpt.read_latest_tag(snapshot_dir)
+    latest_path = os.path.join(snapshot_dir, latest) if latest else None
+    dated = []
+    try:
+        names = os.listdir(snapshot_dir)
+    except OSError:
+        return
+    for name in names:
+        path = os.path.join(snapshot_dir, name)
+        if not os.path.isdir(path) or name.endswith((".saving", ".old")):
+            continue
+        dated.append((os.path.getmtime(path), path == latest_path, path))
+    dated.sort(reverse=True)
+    ordered = [p for _, _, p in dated]
+    if latest_path is not None and latest_path not in ordered \
+            and os.path.isdir(latest_path + ".old"):
+        ordered.append(latest_path)   # only the .old sibling survives
+    for path in ordered:
+        if os.path.isdir(path):
+            yield path
+        if os.path.isdir(path + ".old"):
+            yield path + ".old"
+
+
+def load_latest_valid(snapshot_dir, shardings_fn=None, on_corrupt=None,
+                      verify=True, load_optimizer=True):
+    """Newest snapshot that validates, as ``(state_tree, meta)`` — or
+    None when nothing under ``snapshot_dir`` is loadable. Invalid
+    candidates invoke ``on_corrupt(path, exc)`` and are skipped."""
+    for cand in _candidates(snapshot_dir):
+        if not is_snapshot_dir(cand):
+            continue
+        try:
+            reader = SnapshotReader(cand, verify=verify)
+            state, meta = reader.state_and_meta(
+                shardings_fn=shardings_fn, load_optimizer=load_optimizer)
+            reader.close()
+            meta["snapshot_dir"] = cand
+            return state, meta
+        except SnapshotCorrupt as e:
+            logger.warning(f"snapshot {cand} invalid ({e}); "
+                           f"falling back to an older one")
+            if on_corrupt is not None:
+                on_corrupt(cand, e)
+    return None
+
+
+def verify_elastic_batch(engine, meta):
+    """The effective-batch contract: when the engine trains elastic,
+    the snapshot's final batch size must match the engine's — the HCN
+    ladder guarantees a compatible (micro, gas) exists for the new
+    world size, and the engine's config already solved it."""
+    snap_batch = meta.get("train_batch_size")
+    if snap_batch is None:
+        return
+    if engine._config.elasticity_enabled:
+        if int(snap_batch) != int(engine.train_batch_size()):
+            raise SnapshotCorrupt(
+                f"snapshot effective batch {snap_batch} != engine "
+                f"{engine.train_batch_size()} — the elastic config "
+                f"changed between save and resume")
+    elif int(snap_batch) != int(engine.train_batch_size()):
+        logger.warning(
+            f"resuming a snapshot with effective batch {snap_batch} "
+            f"into an engine with {engine.train_batch_size()} and no "
+            f"elasticity block — the loss trajectory will diverge "
+            f"from the original run")
+
+
+def elastic_resume(engine, snapshot_dir, tag=None, load_module_only=False,
+                   load_optimizer_states=True,
+                   load_lr_scheduler_states=True):
+    """Restore ``engine`` from the newest valid snapshot under
+    ``snapshot_dir`` (or the specific ``tag``). Returns
+    ``(tag, client_state)`` like ``engine.load_checkpoint``, or None
+    when there is nothing to resume from. The load flags carry the
+    load_checkpoint semantics: module-only restores keep the engine's
+    live optimizer state and counters untouched by the scheduler.
+
+    Corrupt candidates are skipped with exactly one flight-recorder
+    dump per recovery (the watchdog's latched ``ckpt_corrupt`` rule);
+    a successful load re-arms it."""
+    t0 = time.perf_counter()
+    corrupt_seen = []
+
+    def on_corrupt(path, exc):
+        rec = engine.flight_recorder
+        rec.record("ckpt_corrupt", dir=path, reason=repr(exc))
+        if engine.watchdog is not None and not corrupt_seen:
+            engine.watchdog.note_ckpt_corrupt(path, repr(exc))
+        corrupt_seen.append(path)
+
+    # orphaned staging dirs come in two flavors, told apart by whether
+    # the manifest made it in (finalize writes it LAST, just before the
+    # renames):
+    # - manifest present → the process died inside the COMMIT (the
+    #   two-rename window): a genuine incident, reported once through
+    #   the latched watchdog rule;
+    # - no manifest → a snapshot was merely in flight when the process
+    #   stopped (clean exit mid-interval, preemption without grace) —
+    #   expected lifecycle, a ring event but no dump.
+    # Both are cleared now that they are recorded: an uncommitted
+    # .saving dir is never adopted, and leaving it would re-report on
+    # every restart (each restart's fresh watchdog has a fresh latch).
+    import shutil
+    sp = getattr(engine, "_snapshotter", None)
+    live = sp._inflight["stage"] if sp is not None and sp.in_flight \
+        else None
+    stale_staging = []
+    try:
+        for name in sorted(os.listdir(snapshot_dir)):
+            path = os.path.join(snapshot_dir, name)
+            # never sweep the calling engine's own LIVE in-flight
+            # snapshot (aio writes may be landing in it right now)
+            if name.endswith(".saving") and path != live:
+                stale_staging.append(path)
+    except OSError:
+        pass
+    for path in stale_staging:
+        if is_snapshot_dir(path):
+            on_corrupt(path, SnapshotCorrupt(
+                "interrupted commit: staging dir left behind"))
+        else:
+            engine.flight_recorder.record(
+                "ckpt_orphan", dir=path,
+                reason="snapshot in flight at process exit")
+        shutil.rmtree(path, ignore_errors=True)
+
+    shardings_fn = None if engine._offload_cfg.enabled \
+        else engine._ckpt_shardings
+    # module-only restores substitute the engine's live optimizer state
+    # — skip assembling the (2x param bytes) opt_state shards entirely,
+    # unless there is no live state to substitute (mirrors
+    # engine.load_checkpoint's want_opt rule)
+    want_opt = (load_optimizer_states and not load_module_only) \
+        or engine.state is None
+    if tag is not None:
+        cand = ckpt.resolve_ckpt_dir(snapshot_dir, tag)
+        loaded = None
+        if is_snapshot_dir(cand):
+            try:
+                reader = SnapshotReader(cand)
+                loaded = reader.state_and_meta(shardings_fn=shardings_fn,
+                                               load_optimizer=want_opt)
+                reader.close()
+            except SnapshotCorrupt as e:
+                on_corrupt(cand, e)
+        if loaded is None:
+            loaded = load_latest_valid(snapshot_dir,
+                                       shardings_fn=shardings_fn,
+                                       on_corrupt=on_corrupt,
+                                       load_optimizer=want_opt)
+    else:
+        loaded = load_latest_valid(snapshot_dir, shardings_fn=shardings_fn,
+                                   on_corrupt=on_corrupt,
+                                   load_optimizer=want_opt)
+    if loaded is None:
+        if engine.watchdog is not None and not corrupt_seen:
+            engine.watchdog.note_ckpt_ok()
+        return None
+    state_tree, meta = loaded
+    verify_elastic_batch(engine, meta)
+    extra = dict(meta.get("extra") or {})
+    keep_live_opt = load_module_only or not load_optimizer_states
+    engine._adopt_ckpt_tree(state_tree, extra,
+                            keep_live_opt=keep_live_opt,
+                            load_lr=load_lr_scheduler_states)
+    if engine.watchdog is not None:
+        engine.watchdog.note_ckpt_ok()
+    from_dp = meta.get("dp_world_size")
+    engine.flight_recorder.record(
+        "resume", tag=meta.get("tag"), step=engine.global_steps,
+        from_dp=from_dp, to_dp=engine.dp_world_size,
+        micro=engine.train_micro_batch_size_per_gpu(),
+        grad_accum=engine.gradient_accumulation_steps(),
+        fell_back=len(corrupt_seen),
+        load_s=time.perf_counter() - t0)
+    if from_dp is not None and int(from_dp) != engine.dp_world_size:
+        logger.info(
+            f"elastic resume: dp {from_dp} -> {engine.dp_world_size}, "
+            f"micro={engine.train_micro_batch_size_per_gpu()}, "
+            f"gas={engine.gradient_accumulation_steps()}, effective "
+            f"batch {engine.train_batch_size()} preserved")
+    return meta.get("tag"), extra.get("client_state", {})

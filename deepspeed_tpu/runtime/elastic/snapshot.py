@@ -1,0 +1,534 @@
+"""Async snapshots through the swap tier (ISSUE 7 tentpole).
+
+``engine.save_checkpoint`` is a blocking save: d2h every leaf,
+``np.savez`` every shard, fence, rename. A preemption-tolerant job
+needs checkpoints cheap enough to take every few minutes, so the
+:class:`AsyncSnapshotter` splits the save into two halves that bracket
+a training step:
+
+- ``begin(tag, trees)`` — each leaf's replica-0 pieces are copied into
+  host staging buffers (the step-time cost: a d2h + memcpy + crc32 per
+  leaf) and submitted as ``async_pwrite`` batches on a DEDICATED
+  write-behind aio handle — the swap tier's write-handle pattern
+  (``PartitionedParamSwapper``, PR 5), deliberately NOT its handle:
+  ``aio_handle_wait`` drains a whole handle, so a shared stream would
+  let the next unpark's drain fence absorb the snapshot writes after
+  ~0 overlap (and the snapshot fence absorb the parks). Leaves that
+  already rest on NVMe arrive as :class:`FileLeaf` markers: their
+  bytes are read straight from the swap file (page-cache warm — the
+  park just wrote them) and re-queued, never re-serialized from the
+  device. ``begin`` returns immediately; the disk writes overlap the
+  NEXT training step.
+- ``finalize()`` — the drain fence (``handle.wait()``; by the next
+  step boundary the writes have had a whole step to land, so the fence
+  usually measures ~0), the config-gated ``fsync`` pass, the
+  checksummed index + manifest, and the commit: the two-rename
+  protocol from runtime/checkpointing.py (``tag.saving`` swaps in,
+  ``tag.old`` keeps the previous generation alive through the window).
+
+The manifest is the commit point: a snapshot directory without a
+parseable manifest whose per-file crc32s match is NOT a snapshot
+(``SnapshotReader`` raises :class:`SnapshotCorrupt`, and
+``resume.load_latest_valid`` falls back to the newest tag that
+verifies). Elastic restore reuses the window-read machinery of
+``runtime/checkpointing.py``: the index records each piece's global
+index window, so a save at dp=W re-assembles under any dp=W' target
+shardings.
+"""
+
+import json
+import os
+import shutil
+import time
+import zlib
+
+import numpy as np
+
+from deepspeed_tpu.runtime import checkpointing as ckpt
+from deepspeed_tpu.runtime.elastic import faults
+from deepspeed_tpu.utils.logging import logger
+
+MANIFEST = "manifest.json"
+FORMAT = "dstpu-elastic-1"
+
+
+class SnapshotError(IOError):
+    pass
+
+
+class SnapshotCorrupt(SnapshotError):
+    """The snapshot fails validation (torn manifest, missing file,
+    checksum mismatch) — callers fall back to an older snapshot."""
+
+
+class FileLeaf:
+    """A leaf whose bytes already rest in a file on the snapshot
+    filesystem (a parked NVMe swap file): the snapshotter reads the
+    file instead of re-serializing a device array."""
+
+    def __init__(self, path, shape, dtype):
+        self.path = path
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+
+
+def _crc(buf):
+    return zlib.crc32(buf) & 0xFFFFFFFF
+
+
+def _fsync_path(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def is_snapshot_dir(path):
+    return os.path.isfile(os.path.join(path, MANIFEST))
+
+
+def has_snapshots(snapshot_dir):
+    """Whether ``snapshot_dir`` holds ANY committed snapshot — by
+    scanning, not the ``latest`` pointer (a crash before the
+    first-ever pointer write leaves a valid committed tag with no
+    pointer, and loaders must still find it)."""
+    try:
+        names = os.listdir(snapshot_dir)
+    except OSError:
+        return False
+    return any(is_snapshot_dir(os.path.join(snapshot_dir, n))
+               for n in names)
+
+
+def _registry():
+    from deepspeed_tpu.telemetry import default_registry
+    return default_registry()
+
+
+def _recorder():
+    from deepspeed_tpu.telemetry import default_recorder
+    return default_recorder()
+
+
+class AsyncSnapshotter:
+    """See module docstring. One instance per engine; at most one
+    snapshot in flight (the engine finalizes at the next step boundary
+    before beginning another)."""
+
+    def __init__(self, snapshot_dir, aio_config=None, write_handle=None,
+                 fsync=True, keep=2, registry=None, recorder=None):
+        self.dir = str(snapshot_dir)
+        os.makedirs(self.dir, exist_ok=True)
+        if write_handle is None:
+            from deepspeed_tpu.runtime.swap_tensor.swapper import (
+                _make_aio_handle)
+            write_handle = _make_aio_handle(aio_config)
+        self._handle = write_handle
+        self.fsync = bool(fsync)
+        self.keep = max(int(keep), 1)
+        self._registry = registry
+        self._recorder = recorder
+        self._inflight = None
+
+    def _reg(self):
+        if self._registry is None:
+            self._registry = _registry()
+        return self._registry
+
+    def _rec(self):
+        if self._recorder is None:
+            self._recorder = _recorder()
+        return self._recorder
+
+    @property
+    def in_flight(self):
+        return self._inflight is not None
+
+    # ------------------------------------------------------------ begin
+    def begin(self, tag, trees, extra=None, meta=None):
+        """Stage + submit the async writes for one snapshot.
+
+        ``trees``: ``{stem: pytree}`` (the checkpointing.py layout —
+        ``model_states``/``optim_states``). Leaves may be jax arrays,
+        numpy arrays, or :class:`FileLeaf` markers. ``extra`` lands in
+        the manifest under ``"extra"`` (counters, client state);
+        ``meta`` merges into the manifest top level (world sizes,
+        batch triangle). Returns the staged byte count."""
+        assert self._inflight is None, "snapshot already in flight"
+        import jax
+        rank = jax.process_index()
+        final_dir = os.path.join(self.dir, str(tag))
+        stage_dir = final_dir + ".saving"
+        if rank == 0:
+            shutil.rmtree(stage_dir, ignore_errors=True)
+            os.makedirs(stage_dir, exist_ok=True)
+        ckpt._sync(f"snapshot_stage:{tag}")
+
+        t0 = time.perf_counter()
+        files = {}     # fname -> {"crc32", "nbytes"}
+        leaves = {}    # "stem:path" -> {"shape", "dtype", "pieces"}
+        fds, bufs = [], []
+        seq = 0
+        total = 0
+        from_files = 0
+        try:
+            for stem, tree in trees.items():
+                for path, leaf in ckpt._walk(tree):
+                    entries = []
+                    for arr, start, stop, src in self._pieces(leaf):
+                        fname = f"{stem}_r{rank}_{seq:05d}.bin"
+                        seq += 1
+                        buf = np.empty(arr.nbytes, np.uint8)
+                        np.copyto(buf, arr.view(np.uint8).reshape(-1))
+                        fd = os.open(os.path.join(stage_dir, fname),
+                                     os.O_WRONLY | os.O_CREAT, 0o644)
+                        self._handle.async_pwrite(buf, fd)
+                        fds.append(fd)
+                        bufs.append(buf)   # alive until the drain fence
+                        files[fname] = {"crc32": _crc(buf),
+                                        "nbytes": buf.nbytes}
+                        entries.append({"file": fname, "start": start,
+                                        "stop": stop})
+                        total += buf.nbytes
+                        from_files += src == "swapfile"
+                    shape, dtype = _leaf_shape_dtype(leaf)
+                    leaves[f"{stem}:{path}"] = {
+                        "shape": shape, "dtype": dtype, "pieces": entries}
+        except Exception:
+            # mid-loop failure (short swap file, ENOSPC, EMFILE) with
+            # writes already submitted: the aio threads must not keep
+            # writing from buffers this frame is about to drop — drain,
+            # close, remove the staging dir, THEN unwind
+            try:
+                self._handle.wait()
+            except Exception:
+                pass
+            for fd in fds:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+            shutil.rmtree(stage_dir, ignore_errors=True)
+            raise
+        reg = self._reg()
+        reg.counter("ckpt/bytes_written").inc(total)
+        reg.counter("ckpt/snapshots").inc()
+        self._rec().record("ckpt_begin", tag=str(tag), files=seq,
+                           bytes=total, from_swapfiles=from_files,
+                           stage_s=time.perf_counter() - t0)
+        self._inflight = {
+            "tag": str(tag), "stage": stage_dir, "final": final_dir,
+            "fds": fds, "bufs": bufs, "files": files, "leaves": leaves,
+            "bytes": total, "extra": dict(extra or {}),
+            "meta": dict(meta or {}), "t_begin": t0,
+        }
+        return total
+
+    @staticmethod
+    def _pieces(leaf):
+        """Yield (host uint8-viewable array, start, stop, source) for
+        one leaf — FileLeaf bytes come off the swap file (no device
+        readback), everything else goes through the checkpointing
+        replica-0 piece walk (which pays the d2h)."""
+        if isinstance(leaf, FileLeaf):
+            # parked swap files only exist for fully-addressable leaves
+            # (the park path d2h's whole arrays), so every process holds
+            # an identical copy — rank 0 claims the full window, exactly
+            # like ckpt._local_pieces' process-local rule (a per-rank
+            # claim would double-cover and fail the load's coverage
+            # check)
+            import jax
+            if jax.process_index() != 0:
+                return
+            raw = np.fromfile(leaf.path, np.uint8)
+            want = int(np.prod(leaf.shape or (1,))) * leaf.dtype.itemsize
+            if raw.nbytes < want:
+                raise SnapshotError(
+                    f"swap file {leaf.path} holds {raw.nbytes} bytes, "
+                    f"leaf needs {want}")
+            yield raw[:want], [0] * len(leaf.shape), list(leaf.shape), \
+                "swapfile"
+            return
+        for arr, start, stop in ckpt._local_pieces(leaf):
+            yield np.ascontiguousarray(arr), start, stop, "staged"
+
+    # --------------------------------------------------------- finalize
+    def finalize(self):
+        """Drain fence → fsync (gated) → checksummed index + manifest →
+        two-rename commit → latest pointer + pruning. Returns
+        ``(final_dir, stall_s)`` where ``stall_s`` is the host seconds
+        this call actually blocked on the drain."""
+        inf = self._inflight
+        assert inf is not None, "no snapshot in flight"
+        self._inflight = None
+        import jax
+        rank = jax.process_index()
+        try:
+            t0 = time.perf_counter()
+            self._handle.wait()   # the drain fence — inside the try:
+            stall = time.perf_counter() - t0   # an aio write error
+            while inf["fds"]:     # must hit the fd-closing except path
+                fd = inf["fds"][-1]    # peek: a raising fsync/close
+                if self.fsync:         # leaves the fd for the except
+                    os.fsync(fd)       # path's cleanup loop
+                os.close(fd)
+                inf["fds"].pop()
+            index_name = f"files_index_{rank}.json"
+            index_path = os.path.join(inf["stage"], index_name)
+            index_doc = {"files": inf["files"], "leaves": inf["leaves"]}
+            index_bytes = json.dumps(index_doc).encode()
+            with open(index_path, "wb") as fh:
+                fh.write(index_bytes)
+                if self.fsync:
+                    fh.flush()
+                    os.fsync(fh.fileno())
+            ckpt._sync(f"snapshot_save:{inf['tag']}")
+            if rank == 0:
+                self._commit(inf, index_name, index_bytes)
+            self._rec().record(
+                "ckpt_commit", tag=inf["tag"], bytes=inf["bytes"],
+                wait_s=stall, fsync=self.fsync,
+                total_s=time.perf_counter() - inf["t_begin"])
+        except faults.SimulatedCrash:
+            raise          # a simulated crash leaves the disk as-is
+        except Exception as e:
+            # a REAL failure (ENOSPC, I/O error) must not leak fds
+            # across retries — close what the commit loop hadn't
+            # reached; the staging dir stays for the orphan sweep
+            for fd in inf["fds"]:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+            inf["fds"] = []
+            self._rec().record("ckpt_abort", tag=inf["tag"],
+                               reason=repr(e))
+            raise
+        return inf["final"], stall
+
+    def _commit(self, inf, index_name, index_bytes):
+        """Rank-0 commit: manifest into staging, fsync, then the
+        two-rename swap (checkpointing.py's protocol: a crash in this
+        window leaves either the previous tag or ``tag.old`` on disk,
+        never a half-written final directory)."""
+        # in the multi-process shape every rank contributes an index
+        # file; rank 0 records each one's checksum so validation covers
+        # the whole set (a missing rank's shards must fail the load)
+        import jax
+        indexes = {index_name: {"crc32": _crc(index_bytes),
+                                "nbytes": len(index_bytes)}}
+        for r in range(jax.process_count()):
+            name = f"files_index_{r}.json"
+            if name in indexes:
+                continue
+            with open(os.path.join(inf["stage"], name), "rb") as fh:
+                b = fh.read()
+            indexes[name] = {"crc32": _crc(b), "nbytes": len(b)}
+        manifest = {
+            "format": FORMAT,
+            "tag": inf["tag"],
+            "ts": time.time(),
+            "bytes": inf["bytes"],
+            "index_files": indexes,
+            "extra": inf["extra"],
+            **inf["meta"],
+        }
+        man_path = os.path.join(inf["stage"], MANIFEST)
+        with open(man_path, "w") as fh:
+            json.dump(manifest, fh, default=str)
+            if self.fsync:
+                fh.flush()
+                os.fsync(fh.fileno())
+        if self.fsync:
+            # the staging dir's ENTRIES must be durable before the
+            # rename publishes them: data fds fsynced + dirents lost to
+            # power loss would leave a "committed" snapshot that fails
+            # validation — the exact loss the fsync contract prevents
+            _fsync_path(inf["stage"])
+        ckpt.commit_dir_swap(inf["stage"], inf["final"],
+                             fault_point="snapshot_between_renames")
+        if self.fsync:
+            _fsync_path(self.dir)   # the renames themselves
+        with open(os.path.join(self.dir, ckpt.LATEST_FILE), "w") as fh:
+            fh.write(inf["tag"])
+            if self.fsync:
+                fh.flush()
+                os.fsync(fh.fileno())
+        self._prune(keep_tag=inf["tag"])
+
+    def _prune(self, keep_tag):
+        """Retire committed snapshots beyond ``keep`` (newest first by
+        commit time; the just-committed tag always survives)."""
+        tags = []
+        for name in os.listdir(self.dir):
+            path = os.path.join(self.dir, name)
+            if name.endswith((".saving", ".old")) or name == keep_tag:
+                continue
+            if os.path.isdir(path) and is_snapshot_dir(path):
+                tags.append((os.path.getmtime(path), path))
+        tags.sort(reverse=True)
+        for _, path in tags[self.keep - 1:]:
+            shutil.rmtree(path, ignore_errors=True)
+            shutil.rmtree(path + ".old", ignore_errors=True)
+
+    def abort(self, reason="abort"):
+        """Drop an in-flight snapshot: drain (aio must not complete
+        into freed buffers), close fds, remove the staging dir."""
+        inf = self._inflight
+        if inf is None:
+            return
+        self._inflight = None
+        try:
+            self._handle.wait()
+        except Exception:
+            pass
+        for fd in inf["fds"]:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        shutil.rmtree(inf["stage"], ignore_errors=True)
+        self._rec().record("ckpt_abort", tag=inf["tag"], reason=reason)
+
+
+def _leaf_shape_dtype(leaf):
+    if isinstance(leaf, FileLeaf):
+        return list(leaf.shape), str(leaf.dtype)
+    dt = leaf.dtype if hasattr(leaf, "dtype") \
+        else np.asarray(leaf).dtype  # sync-ok: dtype probe of host scalar
+    return list(np.shape(leaf)), str(np.dtype(dt))
+
+
+# ------------------------------------------------------------------ reader
+
+class SnapshotReader(ckpt.ShardedCheckpoint):
+    """Validating reader over one committed snapshot directory.
+    Inherits the window-read assembly (``struct``/``assemble``) from
+    :class:`ShardedCheckpoint` — the piece index windows make a dp=W
+    save loadable under any dp=W' target shardings — and replaces the
+    npz piece source with the snapshot's raw ``.bin`` shards.
+
+    ``verify=True`` (the default) checks every index file and data
+    shard against the manifest's crc32s up front, so a torn manifest,
+    a missing rank, or a rotted shard surfaces as
+    :class:`SnapshotCorrupt` BEFORE any state is assembled."""
+
+    def __init__(self, snap_dir, verify=True):
+        self.ckpt_dir = snap_dir
+        self.leaves = {}
+        self._files = {}
+        man_path = os.path.join(snap_dir, MANIFEST)
+        try:
+            with open(man_path) as fh:
+                self.manifest = json.load(fh)
+        except OSError as e:
+            raise SnapshotCorrupt(f"no manifest in {snap_dir}: {e}")
+        except ValueError as e:
+            raise SnapshotCorrupt(f"torn manifest in {snap_dir}: {e}")
+        if self.manifest.get("format") != FORMAT:
+            raise SnapshotCorrupt(
+                f"unknown snapshot format "
+                f"{self.manifest.get('format')!r} in {snap_dir}")
+        self._file_meta = {}
+        for name, info in (self.manifest.get("index_files") or {}).items():
+            try:
+                with open(os.path.join(snap_dir, name), "rb") as fh:
+                    raw = fh.read()
+            except OSError as e:
+                raise SnapshotCorrupt(f"missing index {name}: {e}")
+            if verify and (_crc(raw) != info["crc32"]
+                           or len(raw) != info["nbytes"]):
+                raise SnapshotCorrupt(f"index {name} fails checksum")
+            try:
+                doc = json.loads(raw)
+            except ValueError as e:
+                raise SnapshotCorrupt(f"torn index {name}: {e}")
+            self._file_meta.update(doc.get("files", {}))
+            for full, info_l in doc.get("leaves", {}).items():
+                entry = self.leaves.setdefault(full, {
+                    "shape": tuple(info_l["shape"]),
+                    "dtype": np.dtype(info_l["dtype"]),
+                    "pieces": []})
+                for p in info_l["pieces"]:
+                    entry["pieces"].append(dict(p, key=None))
+        if not self.leaves:
+            raise SnapshotCorrupt(f"snapshot {snap_dir} indexes no leaves")
+        if verify:
+            self.verify_files()
+
+    def verify_files(self):
+        """Streaming crc pass over every data shard — bounded memory
+        (one 4 MB chunk at a time), no caching: a >RAM-scale snapshot
+        must verify without holding checkpoint-bytes + assembled
+        arrays simultaneously."""
+        for name, info in self._file_meta.items():
+            path = os.path.join(self.ckpt_dir, name)
+            crc, nbytes = 0, 0
+            try:
+                with open(path, "rb") as fh:
+                    while True:
+                        chunk = fh.read(1 << 22)
+                        if not chunk:
+                            break
+                        crc = zlib.crc32(chunk, crc)
+                        nbytes += len(chunk)
+            except OSError as e:
+                raise SnapshotCorrupt(f"missing shard {name}: {e}")
+            if nbytes != info["nbytes"] \
+                    or (crc & 0xFFFFFFFF) != info["crc32"]:
+                raise SnapshotCorrupt(f"shard {name} fails checksum")
+
+    def _piece(self, file, key, dtype, shape):
+        # lazy per-file cache: only shards this load's windows actually
+        # touch are read (each holds exactly one piece)
+        raw = self._files.get(file)
+        if raw is None:
+            raw = np.fromfile(os.path.join(self.ckpt_dir, file), np.uint8)
+            self._files[file] = raw
+        try:
+            return raw.view(dtype).reshape(shape)     # zero-copy
+        except ValueError:
+            return np.frombuffer(raw.tobytes(), dtype).reshape(shape)
+
+    def close(self):
+        self._files = {}
+
+    def state_and_meta(self, shardings_fn=None, load_optimizer=True):
+        """Assemble the full train-state tree (the layout
+        engine.load_checkpoint adopts) + the manifest meta. With
+        ``load_optimizer=False`` the opt_state leaves (typically 2x the
+        parameter bytes) are dropped from the index before assembly, so
+        their shard files are never read — module-only restores
+        substitute the caller's live optimizer state."""
+        if not load_optimizer:
+            for full in list(self.leaves):
+                if full.startswith("optim_states:opt_state/"):
+                    del self.leaves[full]
+        struct = dict(self.struct("model_states"))
+        struct.update(self.struct("optim_states"))
+        shardings = shardings_fn(struct) if shardings_fn is not None \
+            else None
+
+        def sub(key):
+            return None if shardings is None else shardings.get(key)
+
+        state = {"params": self.assemble(
+            "model_states", {"params": sub("params")})["params"]}
+        optim_sh = None
+        if shardings is not None:
+            optim_sh = {k: shardings.get(k) for k in
+                        ("opt_state", "scaler", "global_step",
+                         "skipped_steps") if k in struct}
+        state.update(self.assemble("optim_states", optim_sh))
+        state.setdefault("opt_state", {})
+        meta = {k: v for k, v in self.manifest.items()
+                if k not in ("index_files",)}
+        for key in ("global_steps", "micro_steps", "global_samples",
+                    "skipped_steps"):
+            if key in meta.get("extra", {}):
+                try:
+                    meta["extra"][key] = int(meta["extra"][key])
+                except (TypeError, ValueError):
+                    pass
+        return state, meta

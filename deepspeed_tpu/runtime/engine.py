@@ -47,6 +47,7 @@ from deepspeed_tpu.utils.memory import see_memory_usage
 from deepspeed_tpu.telemetry.anomaly import Watchdog
 from deepspeed_tpu.telemetry.recorder import default_recorder
 from deepspeed_tpu.telemetry.registry import default_registry
+from deepspeed_tpu.runtime.elastic import faults as _faults
 from deepspeed_tpu.telemetry.spans import span as tel_span, annotate, \
     TraceWindow
 
@@ -325,6 +326,26 @@ class DeepSpeedEngine:
         self.watchdog = Watchdog.from_config(
             mc.watchdog, recorder=self.flight_recorder,
             registry=self.telemetry, source="train")
+
+        # -- elastic preemption tolerance (runtime/elastic, ISSUE 7):
+        # periodic async snapshots through the swap tier's write-behind
+        # aio handle, a SIGTERM hook with a grace budget, auto-resume
+        # from the newest valid manifest. All gated on the `snapshot`
+        # config block; the snapshotter itself is built lazily (it may
+        # ride the param swapper's write handle, which exists only
+        # after state init).
+        self._snap_cfg = self._config.snapshot_config
+        self._snapshotter = None
+        self._preemption = None
+        self.preempted = False
+        self._auto_resumed = False
+        if self._snap_cfg.enabled:
+            from deepspeed_tpu.runtime.elastic.preemption import (
+                PreemptionHandler)
+            self._preemption = PreemptionHandler(
+                signals=self._snap_cfg.signals,
+                grace_s=self._snap_cfg.grace_secs,
+                recorder=self.flight_recorder)
 
         # ZeRO-Offload: optimizer state + fp32 master on host (cpu) or NVMe
         self._offload_cfg = self._config.zero_config.offload_optimizer
@@ -632,7 +653,8 @@ class DeepSpeedEngine:
             pipeline_read=pc.pipeline_read,
             pipeline_write=pc.pipeline_write,
             buffer_count=pc.buffer_count,
-            registry=self.telemetry)
+            registry=self.telemetry,
+            fsync=pc.fsync)
 
     def _param_swap_order(self):
         """The per-layer swap schedule: the order param leaves stream
@@ -718,6 +740,234 @@ class DeepSpeedEngine:
         self._params_parked = True
         self.telemetry.histogram("swap/park_s").observe(
             time.perf_counter() - t0)
+
+    # -- elastic snapshots + preemption (runtime/elastic, ISSUE 7) ---------
+    def _make_snapshotter(self):
+        """The async snapshotter, on its OWN dedicated write-behind aio
+        handle (the swap tier's write-handle pattern, not its handle:
+        `aio_handle_wait` drains a whole handle, so literally sharing
+        the park stream would make step N+1's unpark drain fence eat
+        the snapshot writes after ~0 overlap — and charge them to
+        swap/stall_s while ckpt/stall_s reads a structural 0)."""
+        from deepspeed_tpu.runtime.elastic.snapshot import AsyncSnapshotter
+        sc = self._snap_cfg
+        return AsyncSnapshotter(
+            sc.path, aio_config=self._config.aio_config,
+            fsync=sc.fsync, keep=sc.keep, registry=self.telemetry,
+            recorder=self.flight_recorder)
+
+    def _snapshot_trees(self):
+        """The {stem: pytree} payload of one snapshot — the same state
+        save_checkpoint persists, but leaves already parked on NVMe
+        become FileLeaf markers (bytes come off the swap files, or the
+        write-behind staging cache for the most recent parks) instead of
+        being re-serialized from the device."""
+        from deepspeed_tpu.runtime.elastic.snapshot import FileLeaf
+        state = self.state
+        if self._host_runner is not None:
+            # fp32 master + host moments, like save_checkpoint
+            params = self._host_runner.params_tree()
+            opt_state = self._host_runner.state_dict()
+        elif self._params_parked and self._param_swapper is not None:
+            sw = self._param_swapper
+            if sw.has_pending_writes:
+                # the files must be whole before FileLeaf reads them;
+                # cache-backed leaves wouldn't need this, but the
+                # uncached rest do and the fence drains the whole handle
+                sw.drain_writes()
+            flat, tdef = jax.tree_util.tree_flatten(
+                self.state_shardings.params)
+            leaves = []
+            for i in range(len(flat)):
+                shape, dtype = sw.meta[i]
+                value, source = sw.staged_leaf(i)
+                leaves.append(value if source == "cache"
+                              else FileLeaf(value, shape, dtype))
+            params = jax.tree_util.tree_unflatten(tdef, leaves)
+            opt_state = state.opt_state
+        else:
+            params = state.params
+            opt_state = state.opt_state
+        return {
+            "model_states": {"params": params},
+            "optim_states": {
+                "opt_state": opt_state,
+                "scaler": state.scaler,
+                "global_step": state.global_step,
+                "skipped_steps": state.skipped_steps,
+            },
+        }
+
+    def _begin_snapshot(self, tag=None):
+        """Stage + submit one async snapshot (returns its tag). The
+        disk writes overlap the following step; the next _elastic_step
+        boundary is the commit point."""
+        if self._snapshotter is None:
+            self._snapshotter = self._make_snapshotter()
+        if self._snapshotter.in_flight:
+            self._snapshotter.finalize()
+        tag = tag or f"global_step{self.global_steps}"
+        meta = {
+            "zero_stage": self.zero_optimization_stage(),
+            "world_size": jax.process_count(),
+            "dp_world_size": self.dp_world_size,
+            "train_batch_size": self.train_batch_size(),
+            "micro_batch": self.train_micro_batch_size_per_gpu(),
+            "grad_accum": self.gradient_accumulation_steps(),
+            "elastic": bool(self._config.elasticity_enabled),
+        }
+        self._snapshotter.begin(tag, self._snapshot_trees(),
+                                extra=self._ckpt_extra(), meta=meta)
+        return tag
+
+    def _elastic_commit(self):
+        """Commit point of the previous boundary's snapshot — runs
+        BEFORE this step's ``_park_params`` so the drain fence waits
+        only on writes that had a whole step to land (park and
+        snapshot share one write handle when the NVMe tier is
+        pipelined; fencing AFTER the park would synchronously eat the
+        park the write-behind exists to hide, every post-boundary
+        step). The measured stall feeds ckpt/stall_s and the
+        watchdog's snapshot-stall rule."""
+        if not self._snap_cfg.enabled:
+            return
+        if self._snapshotter is not None and self._snapshotter.in_flight:
+            _, stall = self._snapshotter.finalize()
+            # stall observations happen ONLY at commit fences: feeding
+            # zeros on the 99 in-between steps would pin the watchdog's
+            # rolling median at 0 (factor never participates) and
+            # re-arm its latch between commits (one dump per interval
+            # instead of per episode)
+            self.telemetry.histogram("ckpt/stall_s").observe(stall)
+            if self.watchdog is not None:
+                # host wall timer this method already kept — no fence
+                self.watchdog.observe_ckpt_stall(
+                    stall, step=self.global_steps)
+
+    def _elastic_step(self):
+        """Step-boundary elastic hook (after the park): the
+        fault-injection point, preemption handling, and the periodic
+        begin — whose commit rides the NEXT boundary's
+        ``_elastic_commit``."""
+        _faults.fire("step_end", step=self.global_steps, engine=self)
+        sc = self._snap_cfg
+        if not sc.enabled or self.preempted:
+            return
+        at_boundary = bool(sc.interval_steps) \
+            and self.global_steps % sc.interval_steps == 0
+        # multi-process: the snapshot path contains collective barriers
+        # (ckpt._sync), so ranks must AGREE before entering it — a
+        # per-rank signal flag would send ranks down mismatched barrier
+        # sequences and deadlock. The agreement collective runs only at
+        # interval boundaries (every rank reaches the same global_steps
+        # in SPMD lockstep); single-process keeps the immediate
+        # any-step preemption response.
+        if jax.process_count() == 1:
+            preempt_now = self._preemption is not None \
+                and self._preemption.requested
+        else:
+            preempt_now = at_boundary and self._preempt_agreed()
+        if preempt_now:
+            self._preempt_finalize()
+        elif at_boundary:
+            self._begin_snapshot()
+
+    def _preempt_agreed(self):
+        """Cross-process preemption agreement (multi-process only,
+        called at aligned interval boundaries): any rank's pending
+        signal preempts the whole job; ranks that never saw the signal
+        adopt it; and EVERY rank restarts its grace clock at the
+        agreement point — per-rank clocks started at arbitrary signal
+        arrivals, and a diverged (or already-expired) budget check
+        would send ranks down mismatched barrier sequences, or skip
+        the final snapshot entirely whenever the signal landed more
+        than grace_secs before a boundary. The commit protocol makes a
+        past-deadline attempt harmless (a SIGKILL mid-commit leaves
+        the previous snapshot intact), so attempting is always the
+        better branch; the budget bounds the snapshot WORK from
+        here."""
+        pre = self._preemption
+        if pre is None:
+            return False
+        from jax.experimental import multihost_utils
+        flags = multihost_utils.process_allgather(  # sync-ok: boundary
+            np.asarray([pre.requested], np.float64))   # agreement
+        agreed = bool(np.any(flags))
+        if agreed:
+            if not pre.requested:
+                pre.request("peer")
+            if (pre.remaining() or 0) <= 0:
+                logger.warning(
+                    "preemption signal predates this boundary by more "
+                    "than the grace budget; attempting the final "
+                    "snapshot anyway (commit is atomic)")
+            pre.restart_clock()
+        return agreed
+
+    def _preempt_finalize(self):
+        """Final snapshot inside the grace budget, then mark the engine
+        preempted. When the budget is already spent, the snapshot is
+        abandoned rather than half committed — the previous committed
+        one stays ``latest`` (the manifest is the commit point). In
+        the multi-process shape _preempt_agreed restarted every rank's
+        clock at the same boundary, so this check cannot diverge
+        across ranks."""
+        pre = self._preemption
+        pre.poll_event()   # the signal handler deferred its ring event
+        snapshotted = False
+        tag = None
+        if (pre.remaining() or 0) > 0:
+            try:
+                tag = self._begin_snapshot(
+                    tag=f"global_step{self.global_steps}_final")
+                self._snapshotter.finalize()
+                snapshotted = True
+            except _faults.SimulatedCrash:
+                raise
+            except Exception as e:
+                logger.warning(f"preemption snapshot failed: {e}")
+                try:
+                    self._snapshotter.abort("preempt_grace")
+                except Exception:
+                    pass
+        else:
+            logger.warning("preemption grace budget already spent; "
+                           "keeping the previous snapshot")
+        self.preempted = True
+        self.flight_recorder.record(
+            "preempt", step=self.global_steps, snapshotted=snapshotted,
+            tag=tag, source=pre.source, remaining_s=pre.remaining())
+        if self.watchdog is not None:
+            self.watchdog.note_preempt(
+                step=self.global_steps, snapshotted=snapshotted,
+                grace_s=pre.grace_s, source=pre.source)
+
+    def finalize_pending_snapshot(self):
+        """Clean-shutdown hook: commit a snapshot still in flight (a
+        run whose last step began one would otherwise leave an
+        uncommitted ``.saving`` orphan — harmless, resume clears it,
+        but the snapshot itself is lost). Returns the committed dir or
+        None."""
+        if self._snapshotter is not None and self._snapshotter.in_flight:
+            path, _ = self._snapshotter.finalize()
+            return path
+        return None
+
+    def _maybe_auto_resume(self):
+        """Startup auto-resume (once): when the snapshot block is on
+        and a valid manifest exists under snapshot.path, adopt the
+        newest valid snapshot before the first step."""
+        sc = self._snap_cfg
+        if not sc.enabled or not sc.auto_resume or self._auto_resumed:
+            return
+        self._auto_resumed = True
+        if self.global_steps:
+            return   # an explicit load_checkpoint already positioned us
+        from deepspeed_tpu.runtime.elastic.resume import elastic_resume
+        res = elastic_resume(self, sc.path)
+        if res is not None:
+            log_dist(f"auto-resumed from snapshot tag={res[0]} at "
+                     f"step={self.global_steps}", ranks=[0])
 
     # ------------------------------------------------------------------
     # loss
@@ -1971,6 +2221,7 @@ class DeepSpeedEngine:
             self._init_state(example_batch=self._example_from_batch(batch))
         if self._jit_train_batch is None:
             self._build_jit_fns()
+        self._maybe_auto_resume()
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
@@ -2034,7 +2285,9 @@ class DeepSpeedEngine:
         if hasattr(self.lr_scheduler, "step"):
             self.lr_scheduler.step()
         self._moq_boundary(batch, metrics)
+        self._elastic_commit()
         self._park_params()
+        self._elastic_step()
         loss = metrics["loss"]
         self._telemetry_step(batch, loss)
         if self._trace_window is not None:
@@ -2446,7 +2699,9 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).stop()
         self._moq_boundary(getattr(self, "_moq_batch", None), metrics)
+        self._elastic_commit()
         self._park_params()
+        self._elastic_step()
         self._telemetry_step(getattr(self, "_moq_batch", None),
                              metrics["loss"])
         if self.global_steps % self.steps_per_print() == 0:
@@ -2767,12 +3022,9 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # checkpointing (reference engine.py:1562-1891)
     # ------------------------------------------------------------------
-    def save_checkpoint(self, save_dir, tag=None, client_state=None,
-                        save_latest=True):
-        from deepspeed_tpu.runtime import checkpointing as ckpt
-        assert self.state is not None, "no state to save"
-        self._ensure_params_resident()
-        tag = tag or f"global_step{self.global_steps}"
+    def _ckpt_extra(self, client_state=None):
+        """The counters + scheduler state every save carries — shared
+        by the blocking save and the async snapshot path."""
         self._sync_skipped_steps()
         extra = {
             "global_steps": self.global_steps,
@@ -2783,6 +3035,15 @@ class DeepSpeedEngine:
         }
         if isinstance(self.lr_scheduler, _Schedule):
             extra["lr_scheduler"] = self.lr_scheduler.state_dict()
+        return extra
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        from deepspeed_tpu.runtime import checkpointing as ckpt
+        assert self.state is not None, "no state to save"
+        self._ensure_params_resident()
+        tag = tag or f"global_step{self.global_steps}"
+        extra = self._ckpt_extra(client_state)
         state = self.state
         if self._host_runner is not None:
             # persist fp32 master + host moments, not the bf16 device copy
@@ -2852,6 +3113,39 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir, tag=None, load_module_only=False,
                         load_optimizer_states=True, load_lr_scheduler_states=True):
         from deepspeed_tpu.runtime import checkpointing as ckpt
+        # an explicit load expresses intent — auto-resume must never
+        # clobber it afterwards (global_steps==0 is NOT a reliable
+        # proxy: a step-0 save or module-only restore lands there too)
+        self._auto_resumed = True
+        # an in-flight snapshot captures PRE-load state and its staging
+        # dir would be swept as an orphan by the elastic route below —
+        # abandon it before adopting different state
+        if self._snapshotter is not None and self._snapshotter.in_flight:
+            self._snapshotter.abort("load_checkpoint")
+        # elastic-snapshot directories (runtime/elastic, ISSUE 7) load
+        # through the validating snapshot reader — with fallback to the
+        # newest VALID generation when the pointed-at one is corrupt
+        from deepspeed_tpu.runtime.elastic.snapshot import (
+            has_snapshots, is_snapshot_dir)
+        resolved = tag or ckpt.read_latest_tag(load_dir)
+        # route by pointer/tag when one resolves; by SCAN when none
+        # does (a crash before the first-ever `latest` write leaves a
+        # committed snapshot with no pointer — resume's mtime walk
+        # still finds it)
+        if (resolved is not None and is_snapshot_dir(
+                ckpt.resolve_ckpt_dir(load_dir, resolved))) \
+                or (resolved is None and has_snapshots(load_dir)):
+            from deepspeed_tpu.runtime.elastic.resume import elastic_resume
+            res = elastic_resume(
+                self, load_dir, tag=tag,
+                load_module_only=load_module_only,
+                load_optimizer_states=load_optimizer_states,
+                load_lr_scheduler_states=load_lr_scheduler_states)
+            if res is None:
+                logger.warning(
+                    f"no valid snapshot in {load_dir}, tag={tag}")
+                return None, {}
+            return res
         shardings_fn = None if self._offload_cfg.enabled \
             else self._ckpt_shardings
         # module-only restores substitute the live optimizer state below —
@@ -2864,7 +3158,19 @@ class DeepSpeedEngine:
             logger.warning(f"Unable to find checkpoint in {load_dir}, tag={tag}")
             return None, {}
         state_tree, extra = loaded
-        if (load_module_only or not load_optimizer_states) and self.state is not None:
+        keep_live_opt = load_module_only or not load_optimizer_states
+        self._adopt_ckpt_tree(state_tree, extra,
+                              keep_live_opt=keep_live_opt,
+                              load_lr=load_lr_scheduler_states)
+        tag = tag or ckpt.read_latest_tag(load_dir)
+        return tag, extra.get("client_state", {})
+
+    def _adopt_ckpt_tree(self, state_tree, extra, keep_live_opt=False,
+                         load_lr=True):
+        """Adopt a loaded {params, opt_state, scaler, global_step,
+        skipped_steps} tree + counter dict — shared by load_checkpoint
+        and the elastic resume path (runtime/elastic/resume.py)."""
+        if keep_live_opt and self.state is not None:
             # keep the live (possibly non-addressable) sharded opt_state
             # as-is — device_get would gather/fail on multi-host shards
             state_tree["opt_state"] = self.state.opt_state
@@ -2888,15 +3194,13 @@ class DeepSpeedEngine:
             if self._param_swapper is None:
                 self._param_swapper = self._make_param_swapper()
             self._params_parked = False
-        tag = tag or ckpt.read_latest_tag(load_dir)
         self.global_steps = extra.get("global_steps", 0)
         self.micro_steps = extra.get("micro_steps", 0)
         self.global_samples = extra.get("global_samples", 0)
         self.skipped_steps = extra.get("skipped_steps", 0)
-        if load_lr_scheduler_states and isinstance(self.lr_scheduler, _Schedule) \
+        if load_lr and isinstance(self.lr_scheduler, _Schedule) \
                 and "lr_scheduler" in extra:
             self.lr_scheduler.load_state_dict(extra["lr_scheduler"])
-        return tag, extra.get("client_state", {})
 
     def _adopt_loaded_state(self, template: TrainState):
         params = template.params

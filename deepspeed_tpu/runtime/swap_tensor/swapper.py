@@ -217,7 +217,7 @@ class PartitionedParamSwapper:
 
     def __init__(self, nvme_path, aio_config=None, sub_dir=None,
                  durable=False, pipeline_read=False, pipeline_write=False,
-                 buffer_count=2, registry=None):
+                 buffer_count=2, registry=None, fsync=False):
         """``sub_dir``/``durable``: by default the swap files are
         pid-scoped SCRATCH (reclaimed on GC/exit). A durable tier (the
         ZeRO-Infinity at-rest files, runtime/zero/infinity.py) passes a
@@ -242,6 +242,12 @@ class PartitionedParamSwapper:
         self._pending = set()     # leaf idx with a not-yet-drained write
         self._wfds = {}           # leaf idx -> preallocated write fd
         self._fsizes = {}         # leaf idx -> preallocated byte size
+        # fsync-fenced durability (ISSUE 7 satellite): without it the
+        # swap files ride the guest page cache and the drain fence only
+        # orders THIS process's reads after its writes; with it the
+        # fence is a real durability barrier — elastic snapshots that
+        # copy parked files require this mode on the param tier
+        self.fsync = bool(fsync)
         self._stall_s = 0.0
         self._registry = registry
         self._finalizer = weakref.finalize(
@@ -416,20 +422,46 @@ class PartitionedParamSwapper:
 
     def drain_writes(self):
         """Fence: wait for every in-flight write-behind. Cheap no-op when
-        nothing is pending."""
+        nothing is pending. With ``fsync`` on, the fence additionally
+        fsyncs every just-written file — the config-gated durability
+        barrier the snapshot commit point rides."""
         if not self._pending and not self._wbusy:
             return
         n = len(self._pending)
         t0 = time.perf_counter()
         self._timed_wait(self._write_handle())
+        if self.fsync:
+            t1 = time.perf_counter()
+            for i in self._pending:
+                fd = self._wfds.get(i)
+                if fd is not None:
+                    os.fsync(fd)
+            self._stall_s += time.perf_counter() - t1
         self._wbusy.clear()
         self._pending.clear()
-        _recorder().record("swap_drain", leaves=n,
+        _recorder().record("swap_drain", leaves=n, fsync=self.fsync,
                            wait_s=time.perf_counter() - t0)
 
     @property
     def has_pending_writes(self):
         return bool(self._pending)
+
+    def staged_leaf(self, i):
+        """Snapshot-path access to a parked leaf (ISSUE 7): returns
+        ``(value, source)`` where ``value`` is a host ndarray view of
+        the write-behind staging cache (``source="cache"`` — valid
+        only until the next park reuses the pool, so callers must
+        consume/copy it before returning to training) or the swap-file
+        path (``source="file"``). Callers must ``drain_writes()``
+        first while ``has_pending_writes`` — a pending file is not
+        whole yet. This is the supported API for reading parked bytes;
+        the pool/cache internals it wraps are free to change."""
+        shape, dtype = self.meta[i]
+        c = self._cache.get(i)
+        if c is not None:
+            idx, nbytes = c
+            return self._host_view(self._wpool[idx][:nbytes], i), "cache"
+        return self._path(i), "file"
 
     # -- the swap schedule -------------------------------------------------
     def _stage(self, slot, nbytes):
@@ -560,7 +592,10 @@ class PartitionedParamSwapper:
             self.meta[i] = (arr.shape, arr.dtype)
             b = self._as_bytes(arr)
             t0 = time.perf_counter()
-            self.handle.sync_pwrite(b, self._write_fd(i, b.nbytes))
+            fd = self._write_fd(i, b.nbytes)
+            self.handle.sync_pwrite(b, fd)
+            if self.fsync:
+                os.fsync(fd)
             self._stall_s += time.perf_counter() - t0
             self._cache.pop(i, None)  # staged bytes (if any) are stale
             self._reg().counter("swap/bytes_written").inc(b.nbytes)

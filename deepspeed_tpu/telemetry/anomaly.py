@@ -19,7 +19,11 @@ existing fence point:
   tier already keeps (outlier vs baseline, with an absolute floor);
 - ``observe_ttft(s)`` / ``note_pool_exhausted()`` — the serving
   scheduler's admission sweep, whose prefill-logits readback is the
-  TTFT measurement itself.
+  TTFT measurement itself;
+- ``observe_ckpt_stall(s)`` / ``note_ckpt_corrupt()`` /
+  ``note_preempt()`` — the elastic snapshot layer (ISSUE 7): the
+  commit-fence stall timer the engine already keeps, resume-time
+  validation failures, and the preemption incident itself.
 
 Outlier rules keep a rolling baseline of recent NORMAL observations
 (anomalous values never pollute their own baseline) and trip when a
@@ -98,8 +102,10 @@ class Watchdog:
     def __init__(self, dump_dir, recorder=None, registry=None,
                  source="train", step_time_factor=3.0,
                  swap_stall_factor=4.0, swap_stall_min_s=0.05,
-                 ttft_factor=4.0, ttft_min_s=1.0, baseline_window=64,
-                 min_samples=8, check_nan=True, max_dumps=0):
+                 ttft_factor=4.0, ttft_min_s=1.0,
+                 ckpt_stall_factor=4.0, ckpt_stall_min_s=0.25,
+                 baseline_window=64, min_samples=8, check_nan=True,
+                 max_dumps=0):
         self.dump_dir = dump_dir
         self.source = source
         self.recorder = recorder if recorder is not None \
@@ -114,6 +120,8 @@ class Watchdog:
         self._lock = threading.Lock()
         self._nan_tripped = False
         self._pool_tripped = False
+        self._ckpt_corrupt_tripped = False
+        self._preempt_tripped = False
         self._rules = {
             "step_time_outlier": RollingOutlierRule(
                 "step_time_outlier", factor=step_time_factor,
@@ -125,6 +133,14 @@ class Watchdog:
             "ttft_blowup": RollingOutlierRule(
                 "ttft_blowup", factor=ttft_factor, min_value=ttft_min_s,
                 window=baseline_window, min_samples=min_samples),
+            # ISSUE 7: the async-snapshot commit fence is supposed to be
+            # ~free (writes had a whole step to land); a stall past
+            # factor x baseline means the aio write stream fell behind
+            # training — snapshot-stall
+            "ckpt_stall_outlier": RollingOutlierRule(
+                "ckpt_stall_outlier", factor=ckpt_stall_factor,
+                min_value=ckpt_stall_min_s, window=baseline_window,
+                min_samples=min_samples),
         }
 
     @classmethod
@@ -141,6 +157,8 @@ class Watchdog:
             swap_stall_min_s=watchdog_cfg.swap_stall_min_s,
             ttft_factor=watchdog_cfg.ttft_factor,
             ttft_min_s=watchdog_cfg.ttft_min_s,
+            ckpt_stall_factor=watchdog_cfg.ckpt_stall_factor,
+            ckpt_stall_min_s=watchdog_cfg.ckpt_stall_min_s,
             baseline_window=watchdog_cfg.baseline_window,
             min_samples=watchdog_cfg.min_samples,
             check_nan=watchdog_cfg.check_nan,
@@ -204,6 +222,42 @@ class Watchdog:
 
     def note_pool_ok(self):
         self._pool_tripped = False
+
+    def observe_ckpt_stall(self, stall_s, step=None):
+        """Host seconds the engine's step boundary blocked on the
+        snapshot drain fence (ISSUE 7) vs the rolling baseline, with an
+        absolute floor — the snapshot-stall rule."""
+        det = self._rules["ckpt_stall_outlier"].observe(stall_s)
+        if det is None:
+            return None
+        det["step"] = step
+        return self._trigger("ckpt_stall_outlier", det)
+
+    def note_ckpt_corrupt(self, path, reason):
+        """An elastic-resume candidate failed validation (torn
+        manifest, rotted shard, missing rank). Latched per recovery
+        episode: a multi-candidate fallback chain dumps ONCE; a
+        successful load (``note_ckpt_ok``) re-arms."""
+        if self._ckpt_corrupt_tripped:
+            return None
+        self._ckpt_corrupt_tripped = True
+        return self._trigger("ckpt_corrupt",
+                             {"dir": str(path), "reason": str(reason)})
+
+    def note_ckpt_ok(self):
+        self._ckpt_corrupt_tripped = False
+
+    def note_preempt(self, step=None, snapshotted=None, grace_s=None,
+                     source=None):
+        """Preemption incident (ISSUE 7): one dump carrying the ring
+        history leading up to the SIGTERM, stamped with whether the
+        final snapshot committed inside the grace budget."""
+        if self._preempt_tripped:
+            return None
+        self._preempt_tripped = True
+        return self._trigger("preempt",
+                             {"step": step, "snapshotted": snapshotted,
+                              "grace_s": grace_s, "source": source})
 
     # -------------------------------------------------------------- dump
 

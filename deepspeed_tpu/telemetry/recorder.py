@@ -36,6 +36,9 @@ recorder's current training-step context when one is set. Kinds in use
   planning (parallel/overlap.py, parallel/prefetch.py);
 - ``admit`` / ``prefill`` / ``tick`` / ``finish`` / ``pool_exhausted``
   — serving request lifecycle (serving/engine.py);
+- ``ckpt_begin`` / ``ckpt_commit`` / ``ckpt_abort`` / ``ckpt_corrupt``
+  / ``preempt_signal`` / ``preempt`` / ``resume`` — elastic snapshot +
+  preemption lifecycle (runtime/elastic, ISSUE 7);
 - ``anomaly`` — appended by the watchdog after it dumps.
 """
 

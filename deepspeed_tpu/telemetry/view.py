@@ -13,6 +13,9 @@ events — as:
   tokens / swap stall, and the boundary loss readbacks;
 - per-request serving timelines: admit -> prefill (TTFT) -> ticks ->
   finish, with waits and reasons;
+- a checkpoint / restore / preempt timeline (ISSUE 7): snapshot
+  begin/commit pairs with the commit-fence wait, corruption fallbacks,
+  the preemption signal + final snapshot, elastic resumes;
 - a swap-tier I/O summary per step (bytes in/out, drain waits);
 - the trailing raw events with ``--events N``.
 
@@ -190,6 +193,56 @@ def render_requests(events, out):
     _table(headers, rows, out)
 
 
+def render_ckpt(events, out):
+    """Checkpoint / restore / preemption timeline (ISSUE 7): one row
+    per elastic lifecycle event — async snapshot begins and commits
+    (with the commit-fence wait), aborts, resume-time validation
+    failures, the preemption signal and its final snapshot, and the
+    resume itself."""
+    kinds = ("ckpt_begin", "ckpt_commit", "ckpt_abort", "ckpt_corrupt",
+             "preempt_signal", "preempt", "resume")
+    rows = []
+    t0 = None
+    for ev in events:
+        kind = ev.get("kind")
+        if kind not in kinds:
+            continue
+        if t0 is None:
+            t0 = ev.get("ts")
+        detail = ""
+        if kind == "ckpt_begin":
+            detail = f"{ev.get('files', '?')} files, " \
+                     f"{ev.get('from_swapfiles', 0)} from swap tier"
+        elif kind == "ckpt_commit":
+            detail = f"wait {ev.get('wait_s', 0):.4g}s" \
+                     + (", fsync" if ev.get("fsync") else "")
+        elif kind in ("ckpt_abort", "ckpt_corrupt"):
+            detail = str(ev.get("reason", ""))[:40]
+        elif kind == "preempt_signal":
+            detail = f"sig {ev.get('signal')}, grace " \
+                     f"{ev.get('grace_s', '?')}s"
+        elif kind == "preempt":
+            detail = "final snapshot committed" if ev.get("snapshotted") \
+                else "NO final snapshot"
+        elif kind == "resume":
+            detail = f"dp {ev.get('from_dp')}→{ev.get('to_dp')}, " \
+                     f"micro {ev.get('micro')} gas {ev.get('grad_accum')}"
+            if ev.get("fell_back"):
+                detail += f", {ev['fell_back']} corrupt skipped"
+        rows.append([
+            None if t0 is None or ev.get("ts") is None
+            else ev["ts"] - t0,
+            kind, ev.get("step"), ev.get("tag", ev.get("dir", "")),
+            (ev.get("bytes") or 0) / 2**20 if "bytes" in ev else "",
+            detail])
+    if not rows:
+        return
+    out.append("")
+    out.append("checkpoint / restore / preempt timeline (t relative to "
+               "first ckpt event):")
+    _table(["t", "event", "step", "tag", "mb", "detail"], rows, out)
+
+
 def render_swap(events, out):
     """Swap-tier I/O per step: bytes written/read, cache hits, drains."""
     per_step = OrderedDict()
@@ -232,6 +285,7 @@ def render(path, tail_events=0):
         return out
     render_steps(events, out)
     render_requests(events, out)
+    render_ckpt(events, out)
     render_swap(events, out)
     plans = [ev for ev in events
              if ev.get("kind") in ("overlap_bucket_plan",

@@ -1,0 +1,536 @@
+"""Elastic preemption-tolerant training (ISSUE 7): async snapshots
+through the swap tier, fault injection, elastic resume.
+
+The contracts under test:
+
+- **async snapshot**: begin() stages + submits on the write-behind aio
+  handle and returns; finalize() (the next step boundary) is the drain
+  fence + checksummed manifest + two-rename commit. A resumed engine
+  continues the uninterrupted run's loss trajectory exactly.
+- **elastic resume parity** (the acceptance criterion): train at dp=8,
+  kill mid-run via the fault harness, resume the snapshot at dp=4 (and
+  dp=2, slow-marked) — the HCN ladder re-solves micro/grad-accum so the
+  effective batch is unchanged and the loss trajectory matches the
+  uninterrupted run step-for-step.
+- **fault injection**: kill-at-step, torn manifest, rotted shard
+  checksum, crash-between-renames each auto-recover to the newest
+  VALID snapshot and emit exactly one flight-recorder dump.
+- **crash-between-renames in the blocking checkpoint path** (satellite:
+  the hazard documented at checkpointing.py:318): the ``{tag}.old``
+  fallback restores the previous save.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+from deepspeed_tpu.runtime import checkpointing as ckpt
+from deepspeed_tpu.runtime.elastic import faults
+from deepspeed_tpu.runtime.elastic.snapshot import (
+    AsyncSnapshotter, SnapshotCorrupt, SnapshotReader)
+from deepspeed_tpu.telemetry import view
+from deepspeed_tpu.telemetry.recorder import default_recorder
+from tests.simple_model import SimpleModel, base_config, random_batch
+
+
+def _dumps(dump_dir):
+    return sorted(glob.glob(os.path.join(dump_dir, "flight_*.jsonl")))
+
+
+def _restore(*engines):
+    for e in engines:
+        if e._preemption is not None:
+            e._preemption.restore()
+
+
+def _elastic_cfg(snap_path, dump_dir=None, interval=2, grace=20.0):
+    cfg = {
+        "steps_per_print": 1000,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        # HCN ladder: batch 24 factors as micro*gas*dp for dp in
+        # {1,2,3,4,6,8,12} with micros [1,2,4] — dp=8 -> (1,3),
+        # dp=4 -> (2,3), dp=2 -> (4,3); effective batch always 24
+        "elasticity": {"enabled": True, "max_train_batch_size": 24,
+                       "micro_batch_sizes": [1, 2, 4], "min_chips": 1,
+                       "max_chips": 16, "version": 0.1},
+        "snapshot": {"path": snap_path, "interval_steps": interval,
+                     "grace_secs": grace},
+    }
+    if dump_dir is not None:
+        cfg["monitor"] = {"enabled": False,
+                          "watchdog": {"dump_dir": dump_dir,
+                                       "min_samples": 4,
+                                       "step_time_factor": 100.0}}
+    return cfg
+
+
+def _mesh(dp):
+    return make_mesh(MeshConfig(data=dp), devices=jax.devices()[:dp])
+
+
+def _elastic_batch(n=24, seed=0):
+    rs = np.random.RandomState(seed)
+    return (rs.randn(n, 8).astype(np.float32),
+            rs.randint(0, 4, (n,)).astype(np.int32))
+
+
+# ---------------------------------------------------------- unit: snapshot
+
+def test_snapshot_roundtrip_checksums_and_bf16(tmp_path):
+    """Direct snapshotter round trip: mixed-dtype trees come back
+    bit-exact through the raw-byte format, the manifest carries
+    per-file crc32s, and the reader verifies them."""
+    rs = np.random.RandomState(0)
+    trees = {
+        "model_states": {"params": {
+            "w": jnp.asarray(rs.randn(8, 16), jnp.bfloat16),
+            "b": jnp.asarray(rs.randn(16), jnp.float32)}},
+        "optim_states": {
+            "opt_state": {"m": {"w": jnp.asarray(rs.randn(8, 16))}},
+            "scaler": {"loss_scale": jnp.float32(1.0)},
+            "global_step": jnp.int32(7),
+            "skipped_steps": jnp.int32(0)},
+    }
+    sp = AsyncSnapshotter(str(tmp_path), keep=2)
+    sp.begin("t1", trees, extra={"global_steps": 7},
+             meta={"dp_world_size": 1, "train_batch_size": 8})
+    assert sp.in_flight
+    final, stall = sp.finalize()
+    assert not sp.in_flight and stall >= 0
+    man = json.load(open(os.path.join(final, "manifest.json")))
+    assert man["tag"] == "t1" and man["index_files"]
+    reader = SnapshotReader(final)
+    state, meta = reader.state_and_meta()
+    reader.close()
+    assert state["params"]["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(state["params"]["w"], np.float32),
+        np.asarray(trees["model_states"]["params"]["w"], np.float32))
+    np.testing.assert_array_equal(
+        state["opt_state"]["m"]["w"],
+        np.asarray(trees["optim_states"]["opt_state"]["m"]["w"]))
+    assert int(state["global_step"]) == 7
+    assert meta["extra"]["global_steps"] == 7
+    assert meta["train_batch_size"] == 8
+    assert ckpt.read_latest_tag(str(tmp_path)) == "t1"
+
+
+def test_snapshot_reader_rejects_torn_and_rotted(tmp_path):
+    trees = {"model_states": {"params": {
+        "w": jnp.asarray(np.arange(64, dtype=np.float32))}},
+        "optim_states": {"opt_state": {}, "scaler": {},
+                         "global_step": jnp.int32(1),
+                         "skipped_steps": jnp.int32(0)}}
+    sp = AsyncSnapshotter(str(tmp_path))
+    sp.begin("t", trees)
+    final, _ = sp.finalize()
+    SnapshotReader(final)                      # valid
+    rotted = faults.rot_shard(final)
+    with pytest.raises(SnapshotCorrupt):
+        SnapshotReader(final)
+    # un-rot, then tear the manifest instead
+    faults.rot_shard(final)                    # XOR twice restores
+    SnapshotReader(final)
+    faults.tear_manifest(final)
+    with pytest.raises(SnapshotCorrupt):
+        SnapshotReader(final)
+    assert rotted.endswith(".bin")
+
+
+def test_snapshot_config_validation():
+    from deepspeed_tpu.config.config import (DeepSpeedConfig,
+                                             DeepSpeedConfigError)
+    good = base_config()
+    good["snapshot"] = {"path": "/tmp/x"}
+    DeepSpeedConfig(good, world_size=1)
+    for bad in ({"path": ""}, {"path": "/tmp/x", "interval_steps": 0},
+                {"path": "/tmp/x", "keep": 0},
+                {"path": "/tmp/x", "grace_secs": 0},
+                {"path": "/tmp/x", "signals": ["SIGNOPE"]}):
+        cfg = base_config()
+        cfg["snapshot"] = bad
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig(cfg, world_size=1)
+    # disabled block parses without a path
+    cfg = base_config()
+    cfg["snapshot"] = {"enabled": False}
+    assert not DeepSpeedConfig(cfg, world_size=1).snapshot_config.enabled
+
+
+# ------------------------------------------- engine: async snapshot cycle
+
+def test_engine_periodic_async_snapshot_and_auto_resume(tmp_path):
+    """Engine-level round trip: periodic async snapshots commit at the
+    next step boundary, old generations prune to `keep`, and a fresh
+    engine auto-resumes from the newest one and CONTINUES THE SAME LOSS
+    TRAJECTORY as the uninterrupted run."""
+    snap = str(tmp_path / "snaps")
+    cfg = base_config(steps_per_print=1000)
+    cfg["snapshot"] = {"path": snap, "interval_steps": 2, "keep": 2}
+    batch = random_batch()
+
+    e, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel())
+    base = e.telemetry.snapshot("ckpt/")["counters"]  # registry is
+    ref = [float(e.train_batch(batch)) for _ in range(7)]  # process-wide
+    # snapshots begin at steps 2/4/6 and commit at the NEXT boundary
+    # (3/5/7) — all three committed; keep=2 pruned global_step2
+    names = set(os.listdir(snap))
+    assert "global_step4" in names and "global_step6" in names
+    assert "global_step2" not in names       # pruned to keep=2
+    assert ckpt.read_latest_tag(snap) == "global_step6"
+    snapd = e.telemetry.snapshot("ckpt/")
+    assert snapd["counters"]["ckpt/bytes_written"] \
+        > base.get("ckpt/bytes_written", 0)
+    assert snapd["counters"]["ckpt/snapshots"] \
+        == base.get("ckpt/snapshots", 0) + 3
+    assert "ckpt/stall_s" in snapd["histograms"]
+
+    e2, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel())
+    got = float(e2.train_batch(batch))        # auto-resume then step 7
+    assert e2.global_steps == 7
+    np.testing.assert_allclose(got, ref[6], rtol=1e-6)
+    _restore(e, e2)
+
+
+def test_engine_snapshot_from_parked_nvme_leaves(tmp_path):
+    """The swap-tier composition: with params parked on NVMe
+    (pipeline_write, pool smaller than the leaf count), snapshot
+    leaves come off the swap FILES for the uncached leaves (FileLeaf
+    markers — never re-serialized from the device) and the staging
+    cache for the rest; the param swapper runs fsync-fenced, and
+    resume restores the exact trajectory."""
+    snap = str(tmp_path / "snaps")
+    cfg = base_config(steps_per_print=1000)
+    cfg["zero_optimization"] = {
+        "stage": 3,
+        # buffer_count=2 < SimpleModel's 4 leaves, so the write-behind
+        # cache holds only the 2 most recent parks and the other 2
+        # leaves MUST take the FileLeaf (read-the-swap-file) path
+        "offload_param": {"device": "nvme",
+                          "nvme_path": str(tmp_path / "nvme"),
+                          "pipeline_read": True, "pipeline_write": True,
+                          "buffer_count": 2, "fsync": True}}
+    cfg["snapshot"] = {"path": snap, "interval_steps": 2}
+    batch = random_batch()
+
+    rec = default_recorder()
+    rec.clear()
+    e, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel())
+    assert e._config.zero_config.offload_param.fsync
+    ref = [float(e.train_batch(batch)) for _ in range(5)]
+    assert e._params_parked and e._host_runner is None
+    assert e._param_swapper.fsync
+    begins = [ev for ev in rec.events() if ev["kind"] == "ckpt_begin"]
+    assert begins
+    assert any(ev.get("from_swapfiles", 0) > 0 for ev in begins), \
+        "no snapshot leaf came off a swap file — FileLeaf path unused"
+    # snapshot shards rode an aio write stream and committed
+    assert ckpt.read_latest_tag(snap) == "global_step4"
+
+    e2, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel())
+    got = float(e2.train_batch(batch))
+    assert e2.global_steps == 5
+    np.testing.assert_allclose(got, ref[4], rtol=1e-5)
+    _restore(e, e2)
+
+
+def test_manual_fwd_bwd_step_path_snapshots_too(tmp_path):
+    """The forward()/backward()/step() parity API must drive the
+    elastic hook exactly like train_batch — snapshots begin/commit at
+    its step boundaries and a preemption request is honored there (the
+    gap a review caught: parking without _elastic_step left the
+    feature silently dead on this path)."""
+    snap = str(tmp_path / "snaps")
+    cfg = base_config(steps_per_print=1000)
+    cfg["snapshot"] = {"path": snap, "interval_steps": 2,
+                       "grace_secs": 20.0}
+    e, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel())
+    batch = random_batch()
+    for _ in range(5):
+        loss = e.forward(batch)
+        e.backward(loss)
+        e.step()
+    assert ckpt.read_latest_tag(snap) == "global_step4"
+    e._preemption.request("manual")
+    loss = e.forward(batch)
+    e.backward(loss)
+    e.step()
+    assert e.preempted
+    assert ckpt.read_latest_tag(snap) == "global_step6_final"
+    _restore(e)
+
+
+# --------------------------------------------------- faults: kill at step
+
+def test_kill_at_step_final_snapshot_one_preempt_dump(tmp_path):
+    """Fault scenario 1 (kill-at-step): SIGTERM lands mid-run, the
+    engine takes a final snapshot inside the grace budget, marks itself
+    preempted, and the watchdog writes EXACTLY ONE preempt dump whose
+    timeline renders in the viewer."""
+    snap = str(tmp_path / "snaps")
+    dump = str(tmp_path / "flight")
+    cfg = _elastic_cfg(snap, dump_dir=dump)
+    batch = _elastic_batch()
+    e, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                  mesh=_mesh(1))
+    with faults.kill_at_step(3):
+        losses = []
+        for _ in range(6):
+            losses.append(float(e.train_batch(batch)))
+            if e.preempted:
+                break
+    assert e.preempted and len(losses) == 3
+    assert ckpt.read_latest_tag(snap) == "global_step3_final"
+    files = _dumps(dump)
+    assert len(files) == 1 and "preempt" in files[0]
+    header, events, _ = view.load_dump(files[0])
+    assert header["rule"] == "preempt"
+    assert header["detail"]["snapshotted"] is True
+    kinds = {ev["kind"] for ev in events}
+    assert {"ckpt_begin", "ckpt_commit", "preempt_signal"} <= kinds
+    out = "\n".join(view.render(files[0]))
+    assert "checkpoint / restore / preempt timeline" in out
+    # a second train_batch after preemption must not re-snapshot
+    float(e.train_batch(batch))
+    assert _dumps(dump) == files
+    _restore(e)
+
+
+# ------------------------------- faults: corruption + recovery scenarios
+
+def _run_and_snapshot(tmp_path, steps=5):
+    """Common setup: a dp=1 elastic run of 5 steps leaves snapshots of
+    steps 2 and 4 both COMMITTED (begin at the interval boundary,
+    commit at the next step) and nothing in flight."""
+    snap = str(tmp_path / "snaps")
+    dump = str(tmp_path / "flight")
+    cfg = _elastic_cfg(snap, dump_dir=dump)
+    batch = _elastic_batch()
+    e, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                  mesh=_mesh(1))
+    ref = [float(e.train_batch(batch)) for _ in range(steps)]
+    _restore(e)
+    return snap, dump, cfg, batch, ref
+
+
+def test_torn_manifest_falls_back_one_dump(tmp_path):
+    """Fault scenario 2: the newest snapshot's manifest is torn — the
+    resume falls back to the previous valid generation with exactly one
+    flight-recorder dump."""
+    snap, dump, cfg, batch, ref = _run_and_snapshot(tmp_path)
+    faults.tear_manifest(os.path.join(snap, "global_step4"))
+    e2, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                   mesh=_mesh(1))
+    got = float(e2.train_batch(batch))
+    assert e2.global_steps == 3          # resumed from global_step2
+    np.testing.assert_allclose(got, ref[2], rtol=1e-6)
+    files = _dumps(dump)
+    assert len(files) == 1 and "ckpt_corrupt" in files[0]
+    _restore(e2)
+
+
+def test_rotted_shard_falls_back_one_dump(tmp_path):
+    """Fault scenario 3: a data shard of the newest snapshot rots — the
+    manifest checksum catches it at load, recovery falls back, one
+    dump."""
+    snap, dump, cfg, batch, ref = _run_and_snapshot(tmp_path)
+    faults.rot_shard(os.path.join(snap, "global_step4"))
+    e2, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                   mesh=_mesh(1))
+    got = float(e2.train_batch(batch))
+    assert e2.global_steps == 3
+    np.testing.assert_allclose(got, ref[2], rtol=1e-6)
+    files = _dumps(dump)
+    assert len(files) == 1 and "ckpt_corrupt" in files[0]
+    _restore(e2)
+
+
+def test_snapshot_crash_between_renames_recovers_one_dump(tmp_path):
+    """Fault scenario 4: the process dies between the commit's two
+    renames — on disk: an orphaned ``.saving`` staging dir, no final.
+    Recovery reports the interrupted commit ONCE, adopts the newest
+    committed snapshot, and clears the orphan so a second restart is
+    dump-free."""
+    snap = str(tmp_path / "snaps")
+    dump = str(tmp_path / "flight")
+    cfg = _elastic_cfg(snap, dump_dir=dump)
+    batch = _elastic_batch()
+    e, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                  mesh=_mesh(1))
+    ref = [float(e.train_batch(batch)) for _ in range(3)]
+    with faults.crash_between_renames():
+        with pytest.raises(faults.SimulatedCrash):
+            for _ in range(2):           # step 4 commits snapshot of 4
+                ref.append(float(e.train_batch(batch)))
+    _restore(e)
+    assert os.path.isdir(os.path.join(snap, "global_step4.saving"))
+    assert not os.path.isdir(os.path.join(snap, "global_step4"))
+    assert ckpt.read_latest_tag(snap) == "global_step2"
+
+    e2, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                   mesh=_mesh(1))
+    got = float(e2.train_batch(batch))
+    assert e2.global_steps == 3          # newest valid = global_step2
+    np.testing.assert_allclose(got, ref[2], rtol=1e-6)
+    files = _dumps(dump)
+    assert len(files) == 1 and "ckpt_corrupt" in files[0]
+    assert not os.path.isdir(os.path.join(snap, "global_step4.saving"))
+    _restore(e2)
+    # second restart: orphan cleared, nothing new to report
+    e3, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                   mesh=_mesh(1))
+    float(e3.train_batch(batch))
+    assert _dumps(dump) == files
+    _restore(e3)
+
+
+def test_blocking_ckpt_crash_between_renames_old_fallback(tmp_path):
+    """Satellite: the pre-existing recovery window in checkpointing.py
+    (the comment at resolve_ckpt_dir documents it; nothing pinned it).
+    A crash between save_checkpoint's two renames of a RE-SAVED tag
+    leaves the only valid save at ``{tag}.old`` — load_checkpoint must
+    find it instead of silently training from scratch."""
+
+    class _State:
+        def __init__(self, v):
+            self.params = {"w": jnp.full((4, 4), v, jnp.float32)}
+            self.opt_state = {}
+            self.scaler = {"loss_scale": jnp.float32(1.0)}
+            self.global_step = jnp.int32(int(v))
+            self.skipped_steps = jnp.int32(0)
+
+    ckpt.save_checkpoint(str(tmp_path), "t", _State(1.0),
+                         {"global_steps": 1})
+    with faults.crash_between_renames("ckpt_between_renames"):
+        with pytest.raises(faults.SimulatedCrash):
+            ckpt.save_checkpoint(str(tmp_path), "t", _State(2.0),
+                                 {"global_steps": 2})
+    # the crash window: final moved to .old, staging not yet swapped in
+    assert not os.path.isdir(os.path.join(str(tmp_path), "t"))
+    assert os.path.isdir(os.path.join(str(tmp_path), "t.old"))
+    state, meta = ckpt.load_checkpoint(str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(state["params"]["w"]),
+                                  np.full((4, 4), 1.0, np.float32))
+    assert meta["global_steps"] == 1
+
+
+# -------------------------------------------- elastic resume parity (e2e)
+
+def _parity_run(tmp_path, resume_dp, kill_at=5, total=8):
+    """Train dp=8, kill at `kill_at`, resume at `resume_dp`; return
+    (reference_losses, interrupted_losses, resumed_losses)."""
+    snap = str(tmp_path / "snaps")
+    cfg = _elastic_cfg(snap, grace=30.0)
+    batch = _elastic_batch()
+
+    e0, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                   mesh=_mesh(8))
+    assert (e0.train_micro_batch_size_per_gpu(),
+            e0.gradient_accumulation_steps()) == (1, 3)
+    ref = [float(e0.train_batch(batch)) for _ in range(total)]
+    _restore(e0)
+    import shutil
+    shutil.rmtree(snap)
+
+    e1, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                   mesh=_mesh(8))
+    got = []
+    with faults.kill_at_step(kill_at):
+        for _ in range(total):
+            got.append(float(e1.train_batch(batch)))
+            if e1.preempted:
+                break
+    assert e1.preempted and len(got) == kill_at
+    _restore(e1)
+
+    e2, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                   mesh=_mesh(resume_dp))
+    assert e2.train_batch_size() == 24   # HCN plan: effective batch kept
+    rest = []
+    while e2.global_steps < total:
+        rest.append(float(e2.train_batch(batch)))
+    assert e2.global_steps == total and len(rest) == total - kill_at
+    _restore(e2)
+    return ref, got, rest
+
+
+def test_elastic_resume_parity_dp8_to_dp4(tmp_path):
+    """THE acceptance criterion: dp=8 training killed mid-run resumes
+    at dp=4 — micro goes 1→2 with gas 3 (same 24-sample effective
+    batch, same micro partitioning), and the loss trajectory matches
+    the uninterrupted dp=8 run step-for-step."""
+    ref, got, rest = _parity_run(tmp_path, resume_dp=4)
+    np.testing.assert_allclose(got, ref[:len(got)], rtol=1e-6)
+    np.testing.assert_allclose(rest, ref[len(got):], rtol=2e-5)
+
+
+@pytest.mark.slow
+def test_elastic_resume_parity_dp8_to_dp2(tmp_path):
+    """The dp=2 leg of the acceptance criterion (micro 1→4, gas 3)."""
+    ref, got, rest = _parity_run(tmp_path, resume_dp=2)
+    np.testing.assert_allclose(rest, ref[len(got):], rtol=2e-5)
+
+
+@pytest.mark.slow
+def test_elastic_resume_batch_mismatch_rejected(tmp_path):
+    """Changing the elastic config between save and resume (different
+    effective batch) must refuse the snapshot, not silently change the
+    convergence behavior."""
+    snap = str(tmp_path / "snaps")
+    cfg = _elastic_cfg(snap, interval=1)
+    batch = _elastic_batch()
+    e, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                  mesh=_mesh(1))
+    for _ in range(3):
+        e.train_batch(batch)
+    _restore(e)
+    cfg2 = _elastic_cfg(snap, interval=1)
+    cfg2["elasticity"]["max_train_batch_size"] = 12   # batch 24 -> 12
+    e2, _, _, _ = dstpu.initialize(config=cfg2, model=SimpleModel(),
+                                   mesh=_mesh(1))
+    with pytest.raises(SnapshotCorrupt):
+        e2.train_batch((_elastic_batch()[0][:12], _elastic_batch()[1][:12]))
+    _restore(e2)
+
+
+# ------------------------------------------------------------ view render
+
+def test_view_renders_ckpt_timeline_synthetic(tmp_path):
+    """The viewer's checkpoint timeline from a synthetic dump — no
+    engine, no jax arrays, just the event schema."""
+    path = str(tmp_path / "d.jsonl")
+    evs = [
+        {"kind": "dump_header", "rule": "preempt", "dump_id": 1,
+         "source": "train", "ts": 10.0, "detail": {}, "n_events": 5},
+        {"kind": "ckpt_begin", "ts": 10.0, "seq": 1, "step": 2,
+         "tag": "global_step2", "files": 6, "bytes": 4096,
+         "from_swapfiles": 2},
+        {"kind": "ckpt_commit", "ts": 10.5, "seq": 2, "step": 3,
+         "tag": "global_step2", "bytes": 4096, "wait_s": 0.001,
+         "fsync": True},
+        {"kind": "preempt_signal", "ts": 11.0, "seq": 3,
+         "signal": "SIGTERM", "grace_s": 30.0},
+        {"kind": "preempt", "ts": 11.2, "seq": 4, "step": 4,
+         "snapshotted": True, "tag": "global_step4_final"},
+        {"kind": "resume", "ts": 20.0, "seq": 5, "step": 4,
+         "tag": "global_step4_final", "from_dp": 8, "to_dp": 4,
+         "micro": 2, "grad_accum": 3, "fell_back": 1},
+        {"kind": "ckpt_corrupt", "ts": 19.5, "seq": 6,
+         "dir": "/x/global_step6", "reason": "torn manifest"},
+    ]
+    with open(path, "w") as fh:
+        for ev in evs:
+            fh.write(json.dumps(ev) + "\n")
+    out = "\n".join(view.render(path))
+    assert "checkpoint / restore / preempt timeline" in out
+    # the table clips cell text at column width — match the prefixes
+    assert "ckpt_comm" in out and "resume" in out
+    assert "preempt_s" in out and "ckpt_corr" in out
+    assert "dp 8" in out

@@ -138,6 +138,35 @@ def test_read_window_any_order_many_leaves(tmp_path):
     sw.release()
 
 
+def test_staged_leaf_snapshot_contract(tmp_path):
+    """The ISSUE-7 snapshot API: after a drained park, ``staged_leaf``
+    serves recently parked leaves as byte-exact cache views and the
+    rest as their swap-file paths — the contract the engine's
+    snapshot-from-parked-leaves path depends on."""
+    from deepspeed_tpu.runtime.swap_tensor import PartitionedParamSwapper
+    rng = np.random.RandomState(3)
+    leaves = [jnp.asarray(rng.randn(32, 16).astype(np.float32))
+              for _ in range(4)]
+    sw = PartitionedParamSwapper(str(tmp_path), pipeline_read=True,
+                                 pipeline_write=True, buffer_count=2)
+    sw.write_all(leaves)
+    sw.swap_out_device(leaves)           # pool of 2 < 4 leaves
+    assert sw.has_pending_writes
+    sw.drain_writes()
+    sources = {}
+    for i, leaf in enumerate(leaves):
+        value, source = sw.staged_leaf(i)
+        sources[source] = sources.get(source, 0) + 1
+        if source == "cache":
+            np.testing.assert_array_equal(np.asarray(value),
+                                          np.asarray(leaf))
+        else:
+            raw = np.fromfile(value, np.float32).reshape(32, 16)
+            np.testing.assert_array_equal(raw, np.asarray(leaf))
+    assert sources.get("cache", 0) >= 1 and sources.get("file", 0) >= 1
+    sw.release()
+
+
 def test_optimizer_swapper_pipeline_write_roundtrip(tmp_path):
     """OptimizerStateSwapper with write-behind stores: prefetch/fetch of
     a pending leaf drains first; moments accumulate across steps exactly

@@ -51,7 +51,11 @@ FORBIDDEN = re.compile(
 ALLOW = "sync-ok"
 
 HOT_GLOBS = ("parallel/*.py", "serving/*.py", "telemetry/*.py",
-             "runtime/swap_tensor/*.py")
+             "runtime/swap_tensor/*.py",
+             # ISSUE 7: the elastic snapshot layer runs at step
+             # boundaries — staging copies and swap-file reads are
+             # deliberate host work, device readbacks must be annotated
+             "runtime/elastic/*.py")
 
 # engine units scanned via inspect (robust to line moves)
 HOT_ENGINE_METHODS = (
@@ -65,6 +69,12 @@ HOT_ENGINE_METHODS = (
     # the swapper's own d2h/fences live in runtime/swap_tensor/ above)
     "_ensure_params_resident", "_park_params", "_param_swap_order",
     "_make_param_swapper",
+    # ISSUE 7: the elastic snapshot hook runs at every step boundary —
+    # its stall accounting must stay host-timer-only (the snapshot
+    # staging d2h lives in runtime/elastic/snapshot.py above)
+    "_elastic_step", "_elastic_commit", "_begin_snapshot",
+    "_snapshot_trees", "_make_snapshotter", "_preempt_finalize",
+    "_preempt_agreed",
 )
 
 
