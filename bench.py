@@ -135,6 +135,10 @@ def headline_metrics(doc):
                 grab(f"decode.{name}.decode_tokens_per_sec", entry,
                      "decode_tokens_per_sec", +1)
     grab("moe.tokens_per_sec", d.get("moe"), "tokens_per_sec", +1)
+    # ISSUE 8: the tile-granular fused_matmul gather must not regress
+    # vs ring-mode prefetch (CPU-proxy step-time ratio, higher=better)
+    grab("zero3_prefetch.fused_vs_ring", d.get("zero3_prefetch"),
+         "fused_vs_ring", +1)
     grab("nvme_param.steady_step_s", d.get("nvme_param_tier"),
          "steady_step_s", -1)
     grab("infinity.steady_step_s", d.get("infinity_6b"),
@@ -457,8 +461,10 @@ def main(argv=None):
             # expert-parallel MoE training throughput (beyond-reference
             # component; routing einsums regress invisibly without it)
             "moe": moe,
-            # ZeRO-3 layer-wise gather prefetch on vs off (ISSUE 3): on
-            # a single-chip harness this is the 8-virtual-device CPU
+            # ZeRO-3 layer-wise gather prefetch on vs off (ISSUE 3) and
+            # ring vs tile-granular fused_matmul gather (ISSUE 8, with
+            # the gather-wait/compute exposure breakdown): on a
+            # single-chip harness this is the 8-virtual-device CPU
             # step-time proxy (see bench_zero3_prefetch); on a slice it
             # measures the real ICI overlap behind the headline MFU
             "zero3_prefetch": zero3_prefetch,

@@ -168,11 +168,47 @@ class DeepSpeedZeroConfig:
         self.stage3_prefetch_gather = str(
             get_scalar_param(zero_dict, C.ZERO_STAGE3_PREFETCH_GATHER,
                              C.ZERO_STAGE3_PREFETCH_GATHER_DEFAULT))
-        if self.stage3_prefetch_gather not in ("ring", "fused"):
+        if self.stage3_prefetch_gather not in \
+                C.ZERO_STAGE3_PREFETCH_GATHER_MODES:
             raise DeepSpeedConfigError(
                 f"zero_optimization.{C.ZERO_STAGE3_PREFETCH_GATHER} must "
-                f"be 'ring' or 'fused', got "
+                f"be one of {C.ZERO_STAGE3_PREFETCH_GATHER_MODES}, got "
                 f"{self.stage3_prefetch_gather!r}")
+        cm = zero_dict.get(C.ZERO_COLLECTIVE_MATMUL, {}) or {}
+        if not isinstance(cm, dict):
+            raise DeepSpeedConfigError(
+                f"zero_optimization.{C.ZERO_COLLECTIVE_MATMUL} must be a "
+                f"dict of {{{C.CM_BACKEND}, {C.CM_TILE_M}, "
+                f"{C.CM_MIN_SHARD_BYTES}, {C.CM_VMEM_BUDGET}}}, got "
+                f"{cm!r}")
+        self.collective_matmul_backend = str(
+            cm.get(C.CM_BACKEND, C.CM_BACKEND_DEFAULT))
+        if self.collective_matmul_backend not in C.CM_BACKEND_MODES:
+            raise DeepSpeedConfigError(
+                f"zero_optimization.{C.ZERO_COLLECTIVE_MATMUL}."
+                f"{C.CM_BACKEND} must be one of {C.CM_BACKEND_MODES}, "
+                f"got {self.collective_matmul_backend!r}")
+        self.collective_matmul_tile_m = int(
+            cm.get(C.CM_TILE_M, C.CM_TILE_M_DEFAULT))
+        self.collective_matmul_min_shard_bytes = int(
+            cm.get(C.CM_MIN_SHARD_BYTES, C.CM_MIN_SHARD_BYTES_DEFAULT))
+        if self.collective_matmul_tile_m <= 0:
+            raise DeepSpeedConfigError(
+                f"zero_optimization.{C.ZERO_COLLECTIVE_MATMUL}."
+                f"{C.CM_TILE_M} must be positive, got "
+                f"{self.collective_matmul_tile_m}")
+        if self.collective_matmul_min_shard_bytes < 0:
+            raise DeepSpeedConfigError(
+                f"zero_optimization.{C.ZERO_COLLECTIVE_MATMUL}."
+                f"{C.CM_MIN_SHARD_BYTES} must be >= 0, got "
+                f"{self.collective_matmul_min_shard_bytes}")
+        self.collective_matmul_vmem_budget_bytes = int(
+            cm.get(C.CM_VMEM_BUDGET, C.CM_VMEM_BUDGET_DEFAULT))
+        if self.collective_matmul_vmem_budget_bytes <= 0:
+            raise DeepSpeedConfigError(
+                f"zero_optimization.{C.ZERO_COLLECTIVE_MATMUL}."
+                f"{C.CM_VMEM_BUDGET} must be positive, got "
+                f"{self.collective_matmul_vmem_budget_bytes}")
         if self.stage3_prefetch and self.stage != 3:
             raise DeepSpeedConfigError(
                 f"zero_optimization.{C.ZERO_STAGE3_PREFETCH} requires "
@@ -206,6 +242,13 @@ class DeepSpeedZeroConfig:
             "overlap_reduce": self.overlap_reduce,
             "stage3_prefetch": self.stage3_prefetch,
             "stage3_prefetch_gather": self.stage3_prefetch_gather,
+            "collective_matmul": {
+                "backend": self.collective_matmul_backend,
+                "tile_m": self.collective_matmul_tile_m,
+                "min_shard_bytes": self.collective_matmul_min_shard_bytes,
+                "vmem_budget_bytes":
+                    self.collective_matmul_vmem_budget_bytes,
+            },
             "reduce_scatter": self.reduce_scatter,
             "offload_param": self.offload_param.repr_dict(),
             "offload_optimizer": self.offload_optimizer.repr_dict(),

@@ -1,1 +1,4 @@
 from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+from deepspeed_tpu.ops.pallas.fused_collective import (
+    CollectiveMatmulConfig, all_gather_matmul, collective_matmul,
+    matmul_reduce_scatter)

@@ -203,9 +203,19 @@ def bucketed_allreduce(tree, axis_name: str, n: int, bucket_elems: int,
                   (2(n-1) chunk hops the scheduler can float over compute).
     mode="fused": per bucket, one `lax.psum` (XLA picks the algorithm) —
                   still bucketed, so buckets interleave with backward.
+    mode="fused_matmul": the stage-3 tile-granular gather mode (ISSUE
+                  8). The replicated-leaf tail this bucket stream
+                  carries has no GEMM to fuse into — the weight-grad
+                  GEMMs it used to trail behind now reduce-scatter
+                  INSIDE the fused matmul+RS kernels
+                  (ops/pallas/fused_collective.py), so what is left
+                  here exchanges on the plain ppermute ring.
     """
-    if mode not in ("ring", "fused"):
-        raise ValueError(f"mode must be 'ring' or 'fused', got {mode!r}")
+    if mode not in ("ring", "fused", "fused_matmul"):
+        raise ValueError(f"mode must be 'ring', 'fused' or "
+                         f"'fused_matmul', got {mode!r}")
+    if mode == "fused_matmul":
+        mode = "ring"
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if not leaves or n == 1:
         return tree

@@ -78,27 +78,47 @@ class LayerPlan:
     ``plan`` entries are in PER-LAYER leaf coordinates (the stacked
     leaf's dim minus the leading layer dim); sharded leaves group by
     dtype into packed flat buffers (one ring gather per group per
-    layer), replicated leaves ride the scan as sliced inputs."""
+    layer), replicated leaves ride the scan as sliced inputs.
+    ``fused`` ids (ISSUE 8) are sharded leaves EXCLUDED from the packed
+    gather: they ride the scan as resting shards and the model body
+    streams them chunk-by-chunk through the tile-granular fused
+    matmul+collective kernels (ops/pallas/fused_collective.py) — their
+    gradients come back from the body's custom VJPs already
+    reduce-scattered (shard-shaped SUMS over the axis)."""
     plan: Tuple[Optional[Tuple[int, int]], ...]
     # (dtype, leaf_ids) per packed group — leaf order within a group is
     # the flattened-tree order, offsets implied by cumulative sizes
     groups: Tuple[Tuple[Any, Tuple[int, ...]], ...]
     n: int
+    fused: Tuple[int, ...] = ()
 
     @property
     def sharded_ids(self):
         return tuple(i for g in self.groups for i in g[1])
 
 
-def build_layer_plan(shard_leaves, plan, n: int) -> LayerPlan:
+def _collective_mode(mode: str) -> str:
+    """The plain-collective mode backing ``mode``: fused_matmul leaves
+    riding the packed gather (below-threshold / non-2D), the outer
+    step-persistent gathers, and the replicated-leaf bucket stream all
+    exchange via the explicit ppermute ring."""
+    return "ring" if mode == "fused_matmul" else mode
+
+
+def build_layer_plan(shard_leaves, plan, n: int,
+                     fused_ids=()) -> LayerPlan:
     """``shard_leaves``: per-device stacked shards ([L, ...]);
     ``plan``: entries in STACKED coordinates (dim 0 is the layer dim and
     must never be sharded — the partitioner's ``layer_stacked_prefixes``
-    guarantees it)."""
+    guarantees it). ``fused_ids`` (leaf indices, engine-selected) skip
+    the packed groups — see LayerPlan.fused."""
     per_layer = []
     groups = {}
+    fused = tuple(sorted(fused_ids))
     for i, (leaf, entry) in enumerate(zip(shard_leaves, plan)):
         if entry is None:
+            assert i not in fused, \
+                f"fused leaf {i} is not sharded — engine selection bug"
             per_layer.append(None)
             continue
         d, sz = entry
@@ -106,17 +126,18 @@ def build_layer_plan(shard_leaves, plan, n: int) -> LayerPlan:
             f"layer-stacked leaf {i} sharded on its layer dim (shape "
             f"{leaf.shape}); exclude dim 0 via layer_stacked_prefixes")
         per_layer.append((d - 1, sz))
-        groups.setdefault(jnp.dtype(leaf.dtype), []).append(i)
+        if i not in fused:
+            groups.setdefault(jnp.dtype(leaf.dtype), []).append(i)
     lp = LayerPlan(plan=tuple(per_layer),
                    groups=tuple((dt, tuple(ids))
                                 for dt, ids in groups.items()),
-                   n=n)
+                   n=n, fused=fused)
     # flight-recorder breadcrumb (trace-time only — the plan is built
     # once per compile): the per-layer gather shape of this train fn
     from deepspeed_tpu.telemetry.recorder import default_recorder
     default_recorder().record(
         "prefetch_layer_plan", groups=len(lp.groups),
-        sharded_leaves=len(lp.sharded_ids),
+        sharded_leaves=len(lp.sharded_ids), fused_leaves=len(fused),
         replicated_leaves=sum(1 for e in lp.plan if e is None),
         axis_size=n)
     return lp
@@ -145,9 +166,12 @@ def _chunks_from_full(full, d, n):
 def gather_leaf(shard, entry, axis_name: str, n: int, mode: str = "ring"):
     """All-gather one sharded leaf ((dim, size) entry) to its full shape.
     mode="ring": explicit ppermute ring (overlap.ring_all_gather);
-    mode="fused": one ``lax.all_gather`` (XLA picks the algorithm)."""
+    mode="fused": one ``lax.all_gather`` (XLA picks the algorithm);
+    mode="fused_matmul" gathers like "ring" — leaves that reach this
+    function in that mode were NOT selected for fused streaming."""
     if entry is None or n == 1:
         return shard
+    mode = _collective_mode(mode)
     d, _ = entry
     if mode == "fused":
         return jax.lax.all_gather(shard, axis_name, axis=d, tiled=True)
@@ -161,6 +185,7 @@ def scatter_grad(grad_full, entry, axis_name: str, n: int,
     (SUM over the axis), in fp32 — the transpose of ``gather_leaf``."""
     if entry is None or n == 1:
         return grad_full
+    mode = _collective_mode(mode)
     d, _ = entry
     chunks = _chunks_from_full(grad_full.astype(jnp.float32), d, n)
     if mode == "fused":
@@ -173,7 +198,9 @@ def scatter_grad(grad_full, entry, axis_name: str, n: int,
 
 def _gather_groups(group_bufs, axis_name, n, mode):
     """Per-group packed shard [K_g] → gathered [n, K_g] (row j = device
-    j's shard) — ONE collective per group per layer."""
+    j's shard) — ONE collective per group per layer. fused_matmul mode
+    gathers its residual (non-streamed) groups like ring."""
+    mode = _collective_mode(mode)
     out = []
     for buf in group_bufs:
         if mode == "fused":
@@ -215,7 +242,7 @@ def _scatter_layer_grads(grads_by_id, shard_leaves, layer_plan: LayerPlan,
                 grads_by_id[i].astype(jnp.float32), d, n)
                 .reshape(n, -1))
         flat = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
-        if mode == "fused":
+        if _collective_mode(mode) == "fused":
             shard = jax.lax.psum_scatter(flat.reshape(-1), axis_name,
                                          scatter_dimension=0, tiled=True)
         else:
@@ -236,7 +263,8 @@ def _scatter_layer_grads(grads_by_id, shard_leaves, layer_plan: LayerPlan,
 # ---------------------------------------------------------------------------
 
 def make_prefetched_scan(body: Callable, plan: Sequence, axis_name: str,
-                         n: int, mode: str = "ring"):
+                         n: int, mode: str = "ring", fused_ids=(),
+                         fused_cfg=None):
     """Build ``scan_fn(x, layer_shards_tree) -> y`` running ``body(x,
     layer_params_tree)`` over the leading layer dim of
     ``layer_shards_tree`` with double-buffered parameter gathers.
@@ -246,25 +274,54 @@ def make_prefetched_scan(body: Callable, plan: Sequence, axis_name: str,
     replicated leaves). ``body`` receives FULL (gathered) per-layer
     leaves and must be rng-free (the engine gates dropout off).
 
+    mode="fused_matmul" (ISSUE 8): ``fused_ids`` leaves skip the packed
+    gather and reach ``body`` as their per-layer RESTING SHARDS; the
+    body's collective-matmul-aware dense layers (models/gpt2.py
+    CollectiveDense, activated by the ``gather_scope(fused_cfg)``
+    entered around every body trace) stream them chunk-by-chunk through
+    the tile-granular fused kernels. Their gradients therefore come
+    back from the body's custom VJPs ALREADY reduce-scattered
+    (shard-shaped SUMS over the axis) — no _scatter_layer_grads pass.
+    Remaining sharded leaves ride the packed ring gather.
+
     Custom VJP: the backward scan runs in reverse, re-gathering layer
     i-1 while layer i's VJP computes and reduce-scattering layer i's
     parameter gradients in the same iteration. Returns gradients for
-    sharded leaves as fp32 SHARDS summed over the axis; replicated
-    leaves' gradients are LOCAL (caller reduces them).
+    packed sharded leaves as fp32 SHARDS summed over the axis; FUSED
+    leaves come back shard-shaped and summed but in the PARAM dtype
+    (the matmul+RS kernel accumulates the true partial sums in fp32
+    and rounds once on output — under bf16 params that is one rounding
+    of an fp32 accumulation, vs the ring path's fp32 sum of
+    bf16-rounded per-device grads); replicated leaves' gradients are
+    LOCAL (caller reduces them).
     """
-    if mode not in ("ring", "fused"):
-        raise ValueError(f"mode must be 'ring' or 'fused', got {mode!r}")
+    if mode not in ("ring", "fused", "fused_matmul"):
+        raise ValueError(f"mode must be 'ring', 'fused' or "
+                         f"'fused_matmul', got {mode!r}")
+    if fused_ids and mode != "fused_matmul":
+        raise ValueError("fused_ids requires mode='fused_matmul'")
     plan = tuple(tuple(e) if e is not None else None for e in plan)
+    fused_ids = tuple(sorted(fused_ids))
+
+    from deepspeed_tpu.ops.pallas import fused_collective as fc
+
+    def _scope():
+        # trace-scoped: CollectiveDense consults it wherever jax
+        # (re-)traces the body — a no-op scope when nothing is fused
+        return fc.gather_scope(fused_cfg if fused_ids else None)
 
     def _prep(layer_shards):
         leaves, tdef = jax.tree_util.tree_flatten(layer_shards)
-        lp = build_layer_plan(leaves, plan, n)
+        lp = build_layer_plan(leaves, plan, n, fused_ids=fused_ids)
         return leaves, tdef, lp
 
-    def _layer_tree(tdef, lp, leaves, full_by_id, repl_sliced):
+    def _layer_tree(tdef, lp, leaves, full_by_id, fused_sliced,
+                    repl_sliced):
         per_layer: List[Any] = [None] * len(leaves)
         for i in lp.sharded_ids:
             per_layer[i] = full_by_id[i]
+        for i, leaf in zip(lp.fused, fused_sliced):
+            per_layer[i] = leaf
         for i, leaf in zip(
                 (j for j, e in enumerate(lp.plan) if e is None), repl_sliced):
             per_layer[i] = leaf
@@ -280,13 +337,19 @@ def make_prefetched_scan(body: Callable, plan: Sequence, axis_name: str,
         L = leaves[0].shape[0]
         repl_ids = [j for j, e in enumerate(lp.plan) if e is None]
         repl_stack = tuple(leaves[j] for j in repl_ids)
-        if not lp.sharded_ids:
-            # nothing sharded (persistence threshold kept every leaf
-            # replicated): a plain scan, no gathers
+        fused_stack = tuple(leaves[j] for j in lp.fused)
+        if not lp.groups:
+            # no packed gathers (persistence threshold kept every
+            # non-fused leaf replicated): a plain scan — fused shards
+            # still stream through the body's collective kernels
             def step0(carry, inp):
-                lt = _layer_tree(tdef, lp, leaves, {}, inp)
-                return body(carry, lt), carry
-            y, xs_saved = jax.lax.scan(step0, x, repl_stack, length=L)
+                fused_i, repl_i = inp
+                lt = _layer_tree(tdef, lp, leaves, {}, fused_i, repl_i)
+                with _scope():
+                    y = body(carry, lt)
+                return y, carry
+            y, xs_saved = jax.lax.scan(step0, x, (fused_stack, repl_stack),
+                                       length=L)
             return y, (xs_saved, layer_shards)
 
         # stacked packed buffers: [L, K_g] per dtype group
@@ -303,14 +366,16 @@ def make_prefetched_scan(body: Callable, plan: Sequence, axis_name: str,
 
         def step(carry, inp):
             xc, g_cur = carry
-            nxt_bufs, repl_i = inp
+            nxt_bufs, fused_i, repl_i = inp
             g_nxt = _gather_groups(nxt_bufs, axis_name, n, mode)
             full = _unpack_layer_full(g_cur, leaves, lp)
-            lt = _layer_tree(tdef, lp, leaves, full, repl_i)
-            y = body(xc, lt)
+            lt = _layer_tree(tdef, lp, leaves, full, fused_i, repl_i)
+            with _scope():
+                y = body(xc, lt)
             return (y, g_nxt), xc
 
-        (y, _), xs_saved = jax.lax.scan(step, (x, g0), (nxt, repl_stack))
+        (y, _), xs_saved = jax.lax.scan(
+            step, (x, g0), (nxt, fused_stack, repl_stack))
         return y, (xs_saved, layer_shards)
 
     def _fwd(x, layer_shards):
@@ -323,19 +388,22 @@ def make_prefetched_scan(body: Callable, plan: Sequence, axis_name: str,
         L = leaves[0].shape[0]
         repl_ids = [j for j, e in enumerate(lp.plan) if e is None]
         repl_stack = tuple(leaves[j] for j in repl_ids)
+        fused_stack = tuple(leaves[j] for j in lp.fused)
 
         def layer_vjp(x_i, lt, dx):
-            _, vjp = jax.vjp(lambda xx, pp: body(xx, pp), x_i, lt)
+            with _scope():
+                _, vjp = jax.vjp(lambda xx, pp: body(xx, pp), x_i, lt)
             return vjp(dx)
 
-        if not lp.sharded_ids:
+        if not lp.groups:
             def bstep0(dx, inp):
-                x_i, repl_i = inp
-                lt = _layer_tree(tdef, lp, leaves, {}, repl_i)
+                x_i, fused_i, repl_i = inp
+                lt = _layer_tree(tdef, lp, leaves, {}, fused_i, repl_i)
                 dxi, dlt = layer_vjp(x_i, lt, dx)
                 return dxi, tuple(jax.tree_util.tree_leaves(dlt))
-            dx0, dleaves = jax.lax.scan(bstep0, dy, (xs_saved, repl_stack),
-                                        reverse=True)
+            dx0, dleaves = jax.lax.scan(
+                bstep0, dy, (xs_saved, fused_stack, repl_stack),
+                reverse=True)
             dtree = jax.tree_util.tree_unflatten(tdef, list(dleaves))
             return dx0, dtree
 
@@ -352,27 +420,34 @@ def make_prefetched_scan(body: Callable, plan: Sequence, axis_name: str,
 
         def bstep(carry, inp):
             dx, g_cur = carry
-            x_i, prev_bufs, repl_i = inp
+            x_i, prev_bufs, fused_i, repl_i = inp
             g_prev = _gather_groups(prev_bufs, axis_name, n, mode)
             full = _unpack_layer_full(g_cur, leaves, lp)
-            lt = _layer_tree(tdef, lp, leaves, full, repl_i)
+            lt = _layer_tree(tdef, lp, leaves, full, fused_i, repl_i)
             dxi, dlt = layer_vjp(x_i, lt, dx)
             d_leaves = jax.tree_util.tree_leaves(dlt)
             d_by_id = {i: d_leaves[i] for i in lp.sharded_ids}
             # layer i's param-grad reduce-scatter rides the same ring the
-            # re-gather of layer i-1 just seeded — both directions busy
+            # re-gather of layer i-1 just seeded — both directions busy.
+            # Fused leaves are absent here: their reduce-scatter already
+            # happened INSIDE the body's matmul+RS kernels (d_leaves[i]
+            # is the shard-shaped SUM).
             d_shards = _scatter_layer_grads(d_by_id, leaves, lp,
                                             axis_name, n, mode)
             ys = (tuple(d_shards[i] for i in lp.sharded_ids),
+                  tuple(d_leaves[i] for i in lp.fused),
                   tuple(d_leaves[j] for j in repl_ids))
             return (dxi, g_prev), ys
 
-        (dx0, _), (dshard_stack, drepl_stack) = jax.lax.scan(
-            bstep, (dy, gL), (xs_saved, prev, repl_stack), reverse=True)
+        (dx0, _), (dshard_stack, dfused_stack, drepl_stack) = jax.lax.scan(
+            bstep, (dy, gL), (xs_saved, prev, fused_stack, repl_stack),
+            reverse=True)
 
         out: List[Any] = [None] * len(leaves)
         for k, i in enumerate(lp.sharded_ids):
             out[i] = dshard_stack[k]
+        for k, i in enumerate(lp.fused):
+            out[i] = dfused_stack[k]
         for k, j in enumerate(repl_ids):
             out[j] = drepl_stack[k]
         return dx0, jax.tree_util.tree_unflatten(tdef, out)

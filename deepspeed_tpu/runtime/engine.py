@@ -1757,8 +1757,12 @@ class DeepSpeedEngine:
         outer_plans = {k: self.zero.explicit_shard_plan(
             params[k], specs=param_spec_tree[k]) for k in outer_keys}
 
+        fused_ids, fused_cfg = self._select_fused_matmul_leaves(
+            params[subtree], layer_plan, mode, n, axis, cast_bf16)
+
         self._record_prefetch_stats(params, subtree, layer_plan,
-                                    outer_plans, cast_bf16)
+                                    outer_plans, cast_bf16,
+                                    fused_ids=fused_ids)
 
         def gather_outer(p):
             out = {}
@@ -1777,7 +1781,8 @@ class DeepSpeedEngine:
             # keep_prob) and hands it in through the layer_scan hook
             def run_layers(body, x, h_shards):
                 return prefetch_lib.make_prefetched_scan(
-                    body, layer_plan, axis, n, mode=mode)(x, h_shards)
+                    body, layer_plan, axis, n, mode=mode,
+                    fused_ids=fused_ids, fused_cfg=fused_cfg)(x, h_shards)
             if isinstance(micro, dict) and "input_ids" in micro:
                 ids = micro["input_ids"]
                 labels = micro.get("labels", micro["input_ids"])
@@ -1965,8 +1970,95 @@ class DeepSpeedEngine:
 
         return self._jit_explicit_comm(train_fn)
 
+    def _select_fused_matmul_leaves(self, layer_subtree, layer_plan,
+                                    mode, n, axis, cast_bf16):
+        """Which layer-stacked leaves stream through the tile-granular
+        fused matmul+collective kernels (ISSUE 8) when
+        ``stage3_prefetch_gather: fused_matmul``: the dominant 2D
+        projection kernels — sharded, per-layer matrices named
+        ``kernel``, shard at least ``collective_matmul.min_shard_bytes``
+        — consumed by the model's CollectiveDense layers as resting
+        shards. Everything else (biases, LN scales, below-threshold
+        weights) keeps the packed per-layer ring gather. Returns
+        ``(fused_ids, CollectiveMatmulConfig)`` — ``((), None)`` in
+        other gather modes or when the pipeline must fall back, with
+        ``_prefetch_active``-style logging of the reason."""
+        if mode != "fused_matmul":
+            return (), None
+        from deepspeed_tpu.ops.pallas import fused_collective as fc
+        from deepspeed_tpu.telemetry.registry import default_registry
+        zc = self._config.zero_config
+        if not getattr(self.module, "supports_collective_matmul", False):
+            log_dist(
+                f"stage3_prefetch_gather=fused_matmul: "
+                f"{type(self.module).__name__} does not mark "
+                f"supports_collective_matmul (its dense layers would "
+                f"reject shard-shaped kernels); falling back to the "
+                f"ring gather", ranks=[0])
+            return (), None
+        # the per-leaf contract: only leaves the model DECLARES as
+        # CollectiveDense-consumed may receive shards — a 3D "kernel"
+        # under a plain nn.Dense would trip flax's declared-param shape
+        # check at trace time with an opaque error
+        cm_paths = tuple(getattr(self.module, "collective_matmul_paths",
+                                 ()))
+        if not cm_paths:
+            log_dist(
+                f"stage3_prefetch_gather=fused_matmul: "
+                f"{type(self.module).__name__} declares no "
+                f"collective_matmul_paths; falling back to the ring "
+                f"gather", ranks=[0])
+            return (), None
+        min_bytes = int(zc.collective_matmul_min_shard_bytes)
+        flat, _ = jax.tree_util.tree_flatten_with_path(layer_subtree)
+        fused, skipped_small, skipped_shape = [], 0, 0
+        for i, ((path, leaf), e) in enumerate(zip(flat, layer_plan)):
+            if e is None:
+                continue
+            name = getattr(path[-1], "key", None)
+            joined = "/".join(str(getattr(k, "key", k)) for k in path)
+            if leaf.ndim != 3 or name != "kernel" or \
+                    not any(joined == p or joined.endswith("/" + p)
+                            for p in cm_paths):
+                skipped_shape += 1
+                continue
+            itemsize = 2 if (cast_bf16 and leaf.dtype == jnp.float32) \
+                else jnp.dtype(leaf.dtype).itemsize
+            shard_bytes = int(np.prod(leaf.shape[1:])) // n * itemsize
+            if shard_bytes < min_bytes:
+                skipped_small += 1
+                continue
+            fused.append(i)
+        reg = default_registry()
+        reg.gauge("comm/zero3_prefetch/fused_leaves").set(len(fused))
+        reg.gauge("comm/zero3_prefetch/ring_leaves").set(
+            skipped_shape + skipped_small)
+        if not fused:
+            log_dist(
+                f"stage3_prefetch_gather=fused_matmul: no layer leaf "
+                f"qualifies for fused streaming ({skipped_small} sharded "
+                f"kernels below min_shard_bytes={min_bytes}, "
+                f"{skipped_shape} non-2D/non-kernel leaves); the gather "
+                f"behaves as ring", ranks=[0])
+            return (), None
+        log_dist(
+            f"stage3_prefetch_gather=fused_matmul: {len(fused)} "
+            f"projection kernels/layer stream through fused "
+            f"all-gather+matmul / matmul+reduce-scatter "
+            f"(backend={zc.collective_matmul_backend}, "
+            f"tile_m={zc.collective_matmul_tile_m}); {skipped_small} "
+            f"below-threshold + {skipped_shape} non-matrix leaves ride "
+            f"the packed ring gather", ranks=[0])
+        cfg = fc.CollectiveMatmulConfig(
+            axis_name=axis, axis_size=n,
+            backend=zc.collective_matmul_backend,
+            tile_m=zc.collective_matmul_tile_m,
+            min_shard_bytes=min_bytes,
+            vmem_budget_bytes=zc.collective_matmul_vmem_budget_bytes)
+        return tuple(fused), cfg
+
     def _record_prefetch_stats(self, params, subtree, layer_plan,
-                               outer_plans, cast_bf16):
+                               outer_plans, cast_bf16, fused_ids=()):
         """Static live-gathered-parameter accounting (the
         ``stage3_max_live_parameters`` observable, utils/memory.py)."""
         from deepspeed_tpu.utils import memory as memory_lib
@@ -1977,14 +2069,23 @@ class DeepSpeedEngine:
 
         layer_leaves = jax.tree_util.tree_leaves(params[subtree])
         per_layer_elems = per_layer_bytes = 0
+        fused_stream_elems = fused_stream_bytes = 0
         persistent_elems = persistent_bytes = 0
-        for leaf, e in zip(layer_leaves, layer_plan):
+        n_ring = mesh_lib.mesh_axis_size(self.mesh, mesh_lib.DATA_AXIS)
+        for i, (leaf, e) in enumerate(zip(layer_leaves, layer_plan)):
             full = int(np.prod(leaf.shape[1:] or (1,)))
             if e is None:
                 # below-persistence-threshold stacked leaves stay FULLY
                 # replicated (all layers resident) — persistent share
                 persistent_elems += full * leaf.shape[0]
                 persistent_bytes += full * leaf.shape[0] * \
+                    leaf_bytes_per_el(leaf)
+                continue
+            if i in fused_ids:
+                # fused-streamed weights are never materialized full:
+                # live footprint is ~2 ring chunks (current + in-flight)
+                fused_stream_elems += 2 * (full // max(n_ring, 1))
+                fused_stream_bytes += 2 * (full // max(n_ring, 1)) * \
                     leaf_bytes_per_el(leaf)
                 continue
             per_layer_elems += full
@@ -2004,12 +2105,16 @@ class DeepSpeedEngine:
             # double buffer (computing layer + in-flight gather) + the
             # step-persistent full leaves: outer gathers AND replicated
             # below-threshold leaves (always resident) — the full live
-            # window stage3_max_live_parameters governs
+            # window stage3_max_live_parameters governs. Fused-streamed
+            # weights (ISSUE 8) count only their ~2 live ring chunks —
+            # in BOTH the element and byte totals.
             "live_param_elements": 2 * per_layer_elems + outer_elems
-            + persistent_elems,
+            + persistent_elems + fused_stream_elems,
             "live_param_bytes": 2 * per_layer_bytes + outer_bytes
-            + persistent_bytes,
+            + persistent_bytes + fused_stream_bytes,
             "per_layer_gather_bytes": per_layer_bytes,
+            "fused_stream_bytes": fused_stream_bytes,
+            "fused_leaves_per_layer": len(fused_ids),
             "outer_gather_bytes": outer_bytes,
             "persistent_replicated_bytes": persistent_bytes,
             "layers": int(n_layers),

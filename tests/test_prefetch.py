@@ -221,13 +221,14 @@ def _gpt2_tiny(n_layer=2, **kw):
 
 
 def _train(prefetch_on, data=N, n_layer=2, steps=3, gas=1, mode="ring",
-           optimizer=None, bf16=False, model=None):
+           optimizer=None, bf16=False, model=None, cm=None):
     cfg = {
         "train_batch_size": 8 * gas,
         "gradient_accumulation_steps": gas,
         "zero_optimization": {"stage": 3, "stage3_prefetch": prefetch_on,
                               "stage3_prefetch_gather": mode,
-                              "stage3_param_persistence_threshold": 0},
+                              "stage3_param_persistence_threshold": 0,
+                              **({"collective_matmul": cm} if cm else {})},
         "optimizer": optimizer or {"type": "AdamW",
                                    "params": {"lr": 1e-3}},
         "steps_per_print": 1000,
@@ -292,6 +293,38 @@ def test_engine_prefetch_matches_fused_dp8():
         stats["live_param_bytes"]
 
 
+def test_engine_prefetch_fused_matmul_matches_ring_dp8():
+    """ISSUE 8 engine-parity pin: ``stage3_prefetch_gather:
+    fused_matmul`` — the dominant projection kernels streamed through
+    the tile-granular fused all-gather+matmul / matmul+reduce-scatter
+    path — reproduces the fused-GSPMD baseline (and hence ring mode,
+    pinned against the same baseline above) to fp32 rounding: losses
+    AND updated sharded-at-rest params over 3 Adam steps."""
+    loss_b, params_b = _fused_baseline()
+    eng, loss_p, params_p = _train(True, mode="fused_matmul",
+                                   cm={"backend": "lax",
+                                       "min_shard_bytes": 0})
+    assert eng._prefetch_active()
+    stats = eng.prefetch_live_param_stats()
+    # the 4 projection kernels (c_attn/c_proj/c_fc/c_proj) stream;
+    # their full weights never materialize in the live window
+    assert stats["fused_leaves_per_layer"] == 4
+    assert stats["fused_stream_bytes"] > 0
+    _assert_matches((loss_p, params_p), (loss_b, params_b))
+
+
+def test_engine_fused_matmul_below_threshold_falls_back_to_ring():
+    """min_shard_bytes gating: when no layer leaf qualifies (the tiny
+    model's shards are far below the default 64 KiB threshold) the
+    mode degrades to the packed ring gather — same numerics, fallback
+    logged, zero fused leaves in the stats."""
+    loss_b, params_b = _fused_baseline()
+    eng, loss_p, params_p = _train(True, mode="fused_matmul")
+    assert eng._prefetch_active()
+    assert eng.prefetch_live_param_stats()["fused_leaves_per_layer"] == 0
+    _assert_matches((loss_p, params_p), (loss_b, params_b))
+
+
 @pytest.mark.slow
 def test_engine_prefetch_matches_fused_dp2_l3_fused_gather():
     """Different mesh shape, odd layer count, fused-collective mode
@@ -324,6 +357,21 @@ def test_engine_prefetch_bf16_grads_trains():
     np.testing.assert_allclose(loss_p, loss_b, rtol=5e-2)
 
 
+@pytest.mark.slow
+def test_engine_fused_matmul_bf16_grads_trains():
+    """fused_matmul under grad_dtype=bf16 — the configuration where
+    fused-leaf dW comes back in the PARAM dtype (one bf16 rounding of
+    the kernel's fp32 accumulation; make_prefetched_scan docstring):
+    the step stays finite and tracks the fused bf16 baseline."""
+    loss_b, _ = _fused_baseline(bf16=True)
+    eng, loss_p, _ = _train(True, bf16=True, mode="fused_matmul",
+                            cm={"backend": "lax", "min_shard_bytes": 0})
+    assert eng._prefetch_active()
+    assert eng.prefetch_live_param_stats()["fused_leaves_per_layer"] == 4
+    assert np.isfinite(loss_p).all()
+    np.testing.assert_allclose(loss_p, loss_b, rtol=5e-2)
+
+
 def test_engine_prefetch_gating():
     # single-device data axis → nothing sharded, fused path
     eng, losses, _ = _train(True, data=1, steps=1)
@@ -350,11 +398,46 @@ def test_prefetch_config_validation():
     assert cfg.zero_config.stage3_prefetch
     assert cfg.zero_config.stage3_prefetch_gather == "fused"
     assert "stage3_prefetch" in cfg.zero_config.repr_dict()
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {
+            "stage": 3, "stage3_prefetch": True,
+            "stage3_prefetch_gather": "fused_matmul",
+            "collective_matmul": {"backend": "lax", "tile_m": 64,
+                                  "min_shard_bytes": 1024,
+                                  "vmem_budget_bytes": 4 << 20}}},
+        world_size=1)
+    assert cfg.zero_config.stage3_prefetch_gather == "fused_matmul"
+    assert cfg.zero_config.collective_matmul_backend == "lax"
+    assert cfg.zero_config.collective_matmul_tile_m == 64
+    assert cfg.zero_config.collective_matmul_min_shard_bytes == 1024
+    assert cfg.zero_config.collective_matmul_vmem_budget_bytes == 4 << 20
+    assert cfg.zero_config.repr_dict()["collective_matmul"][
+        "backend"] == "lax"
+    assert cfg.zero_config.repr_dict()["collective_matmul"][
+        "vmem_budget_bytes"] == 4 << 20
     with pytest.raises(DeepSpeedConfigError):
         DeepSpeedConfig({"train_batch_size": 8,
                          "zero_optimization": {
                              "stage": 3, "stage3_prefetch_gather": "tree"}},
                         world_size=1)
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "zero_optimization": {
+                             "stage": 3,
+                             "collective_matmul": {"backend": "mosaic"}}},
+                        world_size=1)
+    # the sub-block must be a dict (a bare backend string is a plausible
+    # shorthand mistake), and the numeric knobs are range-checked
+    for bad_cm in ("lax",
+                   {"min_shard_bytes": -1},
+                   {"vmem_budget_bytes": 0}):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({"train_batch_size": 8,
+                             "zero_optimization": {
+                                 "stage": 3,
+                                 "collective_matmul": bad_cm}},
+                            world_size=1)
     with pytest.raises(DeepSpeedConfigError):
         DeepSpeedConfig({"train_batch_size": 8,
                          "zero_optimization": {"stage": 2,

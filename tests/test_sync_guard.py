@@ -55,7 +55,11 @@ HOT_GLOBS = ("parallel/*.py", "serving/*.py", "telemetry/*.py",
              # ISSUE 7: the elastic snapshot layer runs at step
              # boundaries — staging copies and swap-file reads are
              # deliberate host work, device readbacks must be annotated
-             "runtime/elastic/*.py")
+             "runtime/elastic/*.py",
+             # ISSUE 8: the fused matmul+collective kernels trace into
+             # every fused_matmul-mode train step — dispatch must stay
+             # sync-free (breadcrumbs/counters are trace-time host work)
+             "ops/pallas/fused_collective.py")
 
 # engine units scanned via inspect (robust to line moves)
 HOT_ENGINE_METHODS = (

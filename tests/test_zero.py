@@ -143,11 +143,19 @@ def test_mesh_from_config_section():
     assert np.isfinite(float(engine.train_batch(random_batch(batch_size=8))))
 
 
+@pytest.mark.slow
 def test_stage3_persistence_threshold_sweep():
     """SURVEY §7's stage-3 'hard part' knob: sweeping
     stage3_param_persistence_threshold moves leaves between sharded and
     replicated monotonically, and classification follows leaf size
-    exactly (reference stage3.py:287-310 keeps small params resident)."""
+    exactly (reference stage3.py:287-310 keeps small params resident).
+
+    Slow (ISSUE 8 tier-1 wall consolidation): one engine compile per
+    sweep point, ~14 s. Tier-1 keeps the knob's two sides pinned by
+    test_zero3_params_sharded (threshold 0 shards) and
+    tests/test_prefetch.py's below-threshold fallback test (a huge
+    threshold keeps leaves replicated); the monotonic sweep re-runs
+    with -m slow."""
     import deepspeed_tpu as dstpu
     from deepspeed_tpu.models.gpt2 import gpt2_tiny, GPT2LMHeadModel
     from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
