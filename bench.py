@@ -69,7 +69,8 @@ class SectionRunner:
 
 BENCH_SECTIONS = ("bert", "train", "sparse", "decode", "llama7b", "moe",
                   "zero3_prefetch", "aio", "nvme_param", "elastic_ckpt",
-                  "serving", "infinity6b", "xl")
+                  "serving", "serving_prefix", "serving_spec",
+                  "infinity6b", "xl")
 
 
 # ---------------------------------------------------------------------------
@@ -131,6 +132,16 @@ def headline_metrics(doc):
                 grab("serving.decode_tokens_per_sec", entry,
                      "decode_tokens_per_sec_continuous", +1)
                 grab("serving.ttft_p99_s", entry, "ttft_p99_s", -1)
+            elif name == "serving_hot_prefix":
+                # ISSUE 9: repeat-prefix admissions must keep aliasing
+                # resident pages (a drop means the prefix index broke)
+                grab("serving.prefix_hit_rate", entry,
+                     "prefix_hit_rate", +1)
+            elif name == "serving_spec_decode":
+                # ISSUE 9: batched verification must keep beating the
+                # one-model-call-per-token decode loop at b1
+                grab("serving.spec_decode_speedup", entry,
+                     "spec_decode_speedup", +1)
             else:
                 grab(f"decode.{name}.decode_tokens_per_sec", entry,
                      "decode_tokens_per_sec", +1)
@@ -392,6 +403,14 @@ def main(argv=None):
         jax.clear_caches()
     decode["serving_continuous_batching"] = runner.run(
         "serving", bench_serving, est_s=600)
+    jax.clear_caches()
+    # ISSUE 9: prefix-sharing + speculative decoding ride the serving
+    # section (same CPU-proxy model sizing) but gate independently
+    decode["serving_hot_prefix"] = runner.run(
+        "serving_prefix", bench_serving_hot_prefix, est_s=300)
+    jax.clear_caches()
+    decode["serving_spec_decode"] = runner.run(
+        "serving_spec", bench_serving_spec_decode, est_s=300)
     jax.clear_caches()
     moe = runner.run(
         "moe", lambda: bench_moe(dstpu, make_mesh, MeshConfig, dev),
@@ -764,6 +783,28 @@ def bench_serving():
         "telemetry": tel,
         "workload": out["workload"],
     }
+
+
+def bench_serving_hot_prefix():
+    """Hot-prefix serving workload (ISSUE 9): N requests sharing an
+    S-token system prompt, prefix cache off vs on. The headline gate is
+    ``prefix_hit_rate`` (token-level: shared prompt tokens whose pages
+    AND prefill compute were skipped); pages-saved, COW hits and the
+    TTFT shift ride along. ``token_mismatches`` must be 0 — sharing may
+    never change outputs."""
+    from tests.perf.serving_bench import run_hot_prefix_bench
+    return run_hot_prefix_bench()
+
+
+def bench_serving_spec_decode():
+    """Speculative decoding at b1 (ISSUE 9): plain engine vs n-gram
+    self-drafting + one-dispatch multi-query verification, greedy,
+    outputs asserted token-for-token identical. Headline gate:
+    ``spec_decode_speedup`` (tok/s ratio). The CPU proxy sits in the
+    dispatch-bound regime the real chip's b1 decode also lives in
+    (BENCH_r05: 95 tok/s llama7b-b1 was one model call per token)."""
+    from tests.perf.serving_bench import run_spec_decode_bench
+    return run_spec_decode_bench()
 
 
 def bench_sparse_attention(jnp):

@@ -606,16 +606,85 @@ class PipelineConfig:
             d.get(C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL, 0))
 
 
+class ServingPrefixCacheConfig:
+    """``serving.prefix_cache`` sub-block: copy-on-write prefix page
+    sharing. Presence enables the refcounted prefix index."""
+
+    def __init__(self, d):
+        if d is not None and not isinstance(d, dict):
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_PREFIX_CACHE} must be a dict with "
+                f"keys [{C.SERVING_PREFIX_CACHE_ENABLED}, "
+                f"{C.SERVING_PREFIX_CACHE_COW}], got {d!r}")
+        self.enabled = d is not None and bool(
+            d.get(C.SERVING_PREFIX_CACHE_ENABLED,
+                  C.SERVING_PREFIX_CACHE_ENABLED_DEFAULT))
+        d = d or {}
+        self.cow = bool(d.get(C.SERVING_PREFIX_CACHE_COW,
+                              C.SERVING_PREFIX_CACHE_COW_DEFAULT))
+
+    def __repr__(self):
+        return (f"ServingPrefixCacheConfig(enabled={self.enabled}, "
+                f"cow={self.cow})")
+
+
+class ServingSpeculativeConfig:
+    """``serving.speculative`` sub-block: drafter-based speculative
+    decoding. Presence enables; greedy-only verification."""
+
+    def __init__(self, d):
+        if d is not None and not isinstance(d, dict):
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_SPECULATIVE} must be a dict with "
+                f"keys [{C.SERVING_SPEC_ENABLED}, {C.SERVING_SPEC_TOKENS},"
+                f" {C.SERVING_SPEC_DRAFTER}, {C.SERVING_SPEC_NGRAM_MAX}, "
+                f"{C.SERVING_SPEC_NGRAM_MIN}], got {d!r}")
+        self.enabled = d is not None and bool(
+            d.get(C.SERVING_SPEC_ENABLED, C.SERVING_SPEC_ENABLED_DEFAULT))
+        d = d or {}
+        self.tokens = int(d.get(C.SERVING_SPEC_TOKENS,
+                                C.SERVING_SPEC_TOKENS_DEFAULT))
+        self.drafter = str(d.get(C.SERVING_SPEC_DRAFTER,
+                                 C.SERVING_SPEC_DRAFTER_DEFAULT))
+        self.ngram_max = int(d.get(C.SERVING_SPEC_NGRAM_MAX,
+                                   C.SERVING_SPEC_NGRAM_MAX_DEFAULT))
+        self.ngram_min = int(d.get(C.SERVING_SPEC_NGRAM_MIN,
+                                   C.SERVING_SPEC_NGRAM_MIN_DEFAULT))
+        if self.enabled and self.tokens < 1:
+            raise DeepSpeedConfigError(
+                f"serving.speculative.tokens must be >= 1, got "
+                f"{self.tokens}")
+        if self.drafter not in ("ngram", "model"):
+            raise DeepSpeedConfigError(
+                f"serving.speculative.drafter must be 'ngram' or "
+                f"'model', got {self.drafter!r}")
+        if not (self.ngram_max >= self.ngram_min >= 1):
+            raise DeepSpeedConfigError(
+                f"serving.speculative needs ngram_max >= ngram_min >= 1,"
+                f" got {self.ngram_max}/{self.ngram_min}")
+
+    def __repr__(self):
+        return (f"ServingSpeculativeConfig(enabled={self.enabled}, "
+                f"tokens={self.tokens}, drafter={self.drafter!r}, "
+                f"ngram=[{self.ngram_min},{self.ngram_max}])")
+
+
 class ServingConfig:
     """tpu-native ``serving`` block: the continuous-batching engine with
     a paged KV cache (deepspeed_tpu/serving). Presence of the block
-    enables it; geometry maps 1:1 onto PagedCacheSpec."""
+    enables it; geometry maps 1:1 onto PagedCacheSpec. Optional
+    sub-blocks: ``prefix_cache`` (COW prefix page sharing) and
+    ``speculative`` (drafter-based speculative decoding)."""
 
     def __init__(self, param_dict):
         d = param_dict.get(C.SERVING, None)
         self.enabled = d is not None and bool(
             d.get(C.SERVING_ENABLED, C.SERVING_ENABLED_DEFAULT))
         d = d or {}
+        self.prefix_cache = ServingPrefixCacheConfig(
+            d.get(C.SERVING_PREFIX_CACHE, None))
+        self.speculative = ServingSpeculativeConfig(
+            d.get(C.SERVING_SPECULATIVE, None))
         self.slots = int(d.get(C.SERVING_SLOTS, C.SERVING_SLOTS_DEFAULT))
         self.page_size = int(d.get(C.SERVING_PAGE_SIZE,
                                    C.SERVING_PAGE_SIZE_DEFAULT))

@@ -550,3 +550,30 @@ SERVING_KV_CACHE_BITS = "kv_cache_bits"
 SERVING_KV_CACHE_BITS_DEFAULT = 0
 SERVING_QUANTIZE_BITS = "quantize_bits"
 SERVING_QUANTIZE_BITS_DEFAULT = 0
+
+# serving.prefix_cache — copy-on-write prefix page sharing (ISSUE 9):
+# presence of the sub-block enables the refcounted prefix index over
+# the paged allocator; repeat-prefix admissions alias resident pages
+# read-only and prefill only their suffix
+SERVING_PREFIX_CACHE = "prefix_cache"
+SERVING_PREFIX_CACHE_ENABLED = "enabled"
+SERVING_PREFIX_CACHE_ENABLED_DEFAULT = True   # presence enables
+SERVING_PREFIX_CACHE_COW = "cow"
+SERVING_PREFIX_CACHE_COW_DEFAULT = True       # share the partial page
+#                                               via copy-on-write
+
+# serving.speculative — drafter-based speculative decoding (ISSUE 9):
+# presence enables; the drafter proposes `tokens` tokens per round and
+# the target verifies the window in one multi-query paged-attention
+# dispatch (greedy-only; outputs stay token-for-token identical)
+SERVING_SPECULATIVE = "speculative"
+SERVING_SPEC_ENABLED = "enabled"
+SERVING_SPEC_ENABLED_DEFAULT = True           # presence enables
+SERVING_SPEC_TOKENS = "tokens"
+SERVING_SPEC_TOKENS_DEFAULT = 3               # drafts per verify round
+SERVING_SPEC_DRAFTER = "drafter"
+SERVING_SPEC_DRAFTER_DEFAULT = "ngram"        # "ngram" | "model"
+SERVING_SPEC_NGRAM_MAX = "ngram_max"
+SERVING_SPEC_NGRAM_MAX_DEFAULT = 3
+SERVING_SPEC_NGRAM_MIN = "ngram_min"
+SERVING_SPEC_NGRAM_MIN_DEFAULT = 1

@@ -853,7 +853,7 @@ def decode_attention_fp_stacked(q, k_stack, v_stack, pos, layer,
 
 def decode_attention_paged(q, k_pool, v_pool, pos, page_table, layer,
                            k_scale=None, v_scale=None, scale=None,
-                           interpret=None):
+                           interpret=None, rows_per_step=None):
     """S=1 cached attention through a paged KV pool.
 
     q [B, H, R, D] (R = grouped-query rows per KV head, 1 for MHA);
@@ -864,12 +864,24 @@ def decode_attention_paged(q, k_pool, v_pool, pos, page_table, layer,
     idle slot, output zeros); page_table [B, MAXP] int32 — pool block ids
     per slot page; entries past the slot's live pages must still be VALID
     pool indices (the engine points them at the reserved trash block 0).
-    layer: scalar int32. Returns [B, H, R, D] in q.dtype."""
+    layer: scalar int32. Returns [B, H, R, D] in q.dtype.
+
+    ``rows_per_step`` switches the kernel into MULTI-QUERY mode
+    (speculative-decode verification): q's row axis carries
+    ``n_steps x rows_per_step`` query rows in STEP-MAJOR order (row j is
+    spec step ``j // rows_per_step``), and row j masks keys at
+    ``k_pos <= pos[b] + j // rows_per_step`` — each drafted token
+    attends through the page table at its own successive position, so
+    the target model verifies all K draft tokens in ONE paged-attention
+    call instead of K sequential ticks. ``rows_per_step=None`` keeps the
+    single-position mask (all rows share ``pos``)."""
     if interpret is None:
         interpret = _interpret_default()
     quantized = k_scale is not None
     assert (v_scale is not None) == quantized
     B, H, R, D = q.shape
+    if rows_per_step is not None:
+        assert R % rows_per_step == 0, (R, rows_per_step)
     Lyr, NB, Hp, page, Dp = k_pool.shape
     assert (Hp, Dp) == (H, D), (q.shape, k_pool.shape)
     MAXP = page_table.shape[1]
@@ -907,7 +919,8 @@ def decode_attention_paged(q, k_pool, v_pool, pos, page_table, layer,
     )
     out = pl.pallas_call(
         functools.partial(_decode_attn_paged_kernel, scale=scale,
-                          page=page, quantized=quantized),
+                          page=page, quantized=quantized,
+                          rows_per_step=rows_per_step),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, R, D), q.dtype),
         interpret=interpret,
@@ -916,11 +929,13 @@ def decode_attention_paged(q, k_pool, v_pool, pos, page_table, layer,
 
 
 def _decode_attn_paged_kernel(lyr_ref, pos_ref, pt_ref, q_ref, *rest,
-                              scale, page, quantized):
+                              scale, page, quantized, rows_per_step=None):
     """grid=(B, MAXP): same online-softmax state machine as the dense
     stacked kernel, but the block index maps already gathered this
     program's K/V page through the page table, and ``pos`` is read per
-    slot so every batch row masks at its own length."""
+    slot so every batch row masks at its own length. In multi-query mode
+    (``rows_per_step``) each query row masks at its own spec-step offset
+    and pages up to the LAST step's position participate."""
     if quantized:
         k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, d_ref, acc_ref = rest
     else:
@@ -929,6 +944,9 @@ def _decode_attn_paged_kernel(lyr_ref, pos_ref, pt_ref, q_ref, *rest,
     pb = pl.program_id(1)
     npg = pl.num_programs(1)
     pos = pos_ref[b]
+    n_rows = q_ref.shape[2]
+    max_step = 0 if rows_per_step is None \
+        else n_rows // rows_per_step - 1
 
     @pl.when(pb == 0)
     def _init():
@@ -938,7 +956,9 @@ def _decode_attn_paged_kernel(lyr_ref, pos_ref, pt_ref, q_ref, *rest,
 
     base = pb * page
 
-    @pl.when(base <= pos)
+    # idle slots (pos < 0) must skip EVERY page even when max_step > 0
+    # would otherwise pull page 0 in — their output stays zeros
+    @pl.when((pos >= 0) & (base <= pos + max_step))
     def _block():
         q = q_ref[0]                                # [H, R, D]
         k = k_ref[0, 0].astype(q.dtype)             # [H, page, D]
@@ -949,7 +969,12 @@ def _decode_attn_paged_kernel(lyr_ref, pos_ref, pt_ref, q_ref, *rest,
         if quantized:
             s = s * ks_ref[0, 0]                    # [H, 1, page]
         k_pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-        s = jnp.where(k_pos <= pos, s, -1e30)
+        if rows_per_step is None:
+            s = jnp.where(k_pos <= pos, s, -1e30)
+        else:
+            step = jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1) // rows_per_step
+            s = jnp.where(k_pos <= pos + step, s, -1e30)
         m_acc = m_ref[...]
         m_new = jnp.maximum(m_acc, jnp.max(s, axis=2, keepdims=True))
         m_ref[...] = m_new

@@ -70,6 +70,7 @@ def cache_spec_from_config(model_config, family: str, config=None,
 
 def build_engine(family: str, model_config, params, config=None,
                  rng=None, registry=None, recorder=None, watchdog=None,
+                 drafter_model_config=None, drafter_params=None,
                  **overrides) -> ContinuousBatcher:
     """Build a ContinuousBatcher for ``family``:
 
@@ -83,6 +84,12 @@ def build_engine(family: str, model_config, params, config=None,
     watchdog (telemetry/anomaly.py: TTFT blowup + page-pool exhaustion
     rules, one-shot flight-recorder dumps); pass ``watchdog=`` to
     supply one directly.
+
+    A ``serving.prefix_cache`` sub-block turns on copy-on-write prefix
+    page sharing; a ``serving.speculative`` sub-block turns on
+    speculative decoding (``drafter: "model"`` additionally needs
+    ``drafter_model_config`` + ``drafter_params`` — same family, its
+    own smaller geometry).
     """
     from deepspeed_tpu.config import constants as C
     # parse once; pd is a plain dict, so the helpers below re-load it
@@ -94,6 +101,13 @@ def build_engine(family: str, model_config, params, config=None,
                 "the config's serving block sets enabled: false — "
                 "drop the block (or flip the flag) to build a serving "
                 "engine from it")
+    sc = _serving_section(pd)
+    if sc.speculative.enabled and sc.speculative.drafter == "model" \
+            and (drafter_model_config is None or drafter_params is None):
+        raise ValueError(
+            "serving.speculative.drafter='model' needs "
+            "drafter_model_config= and drafter_params= (a smaller "
+            "checkpoint of the SAME family)")
     spec = cache_spec_from_config(model_config, family, pd, **overrides)
     # serving.quantize_bits = 8 quantizes full-precision param trees to
     # the int8 serving storage at build time; trees that already carry
@@ -126,7 +140,34 @@ def build_engine(family: str, model_config, params, config=None,
         watchdog = Watchdog.from_config(mc.watchdog, recorder=recorder,
                                         registry=registry,
                                         source="serving")
+    drafter = None
+    spec_tokens = sc.speculative.tokens
+    if sc.speculative.enabled:
+        from deepspeed_tpu.serving.drafter import (NGramDrafter,
+                                                   ModelDrafter)
+        if sc.speculative.drafter == "model":
+            dspec = cache_spec_from_config(drafter_model_config, family,
+                                           pd, num_blocks=0, **{
+                                               k: v for k, v in
+                                               overrides.items()
+                                               if k != "num_blocks"})
+            if family == "gpt2":
+                dadapter = GPT2ServingAdapter(drafter_model_config,
+                                              drafter_params, dspec,
+                                              quantize_bits=qb)
+            else:
+                dadapter = LlamaServingAdapter(drafter_model_config,
+                                               drafter_params, dspec,
+                                               quantize_bits=qb)
+            drafter = ModelDrafter(dadapter)
+        else:
+            drafter = NGramDrafter(spec.slots,
+                                   ngram_max=sc.speculative.ngram_max,
+                                   ngram_min=sc.speculative.ngram_min)
     # registry: pass telemetry.default_registry() to merge the serving
     # metrics into the process-wide stream; default is per-engine
     return ContinuousBatcher(adapter, rng=rng, registry=registry,
-                             recorder=recorder, watchdog=watchdog)
+                             recorder=recorder, watchdog=watchdog,
+                             prefix_cache=sc.prefix_cache.enabled,
+                             prefix_cow=sc.prefix_cache.cow,
+                             drafter=drafter, spec_tokens=spec_tokens)

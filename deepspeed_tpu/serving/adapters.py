@@ -51,6 +51,15 @@ def _append_rows(pool, cache_q8, l, blk_ids, rows, k3, v3):
     return (kc, vc)
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _copy_pool_block(pool, src, dst):
+    """COW helper: duplicate one pool block (all layers, K and V and any
+    scale arrays) — the sharer of a partially-filled prefix page
+    continues appending into its own copy. Model-independent: every
+    pool array indexes pages on axis 1."""
+    return tuple(a.at[:, dst].set(a[:, src]) for a in pool)
+
+
 def _quant_prompt_rows(t):
     """Per-(.., head, pos) symmetric int8 over the trailing D axis."""
     tf = t.astype(jnp.float32)
@@ -89,6 +98,81 @@ def _write_prompt_pages(pool, cache_q8, k, v, pages, page):
     kc = kc.at[:, pages].set(to_blocks(k).astype(kc.dtype))
     vc = vc.at[:, pages].set(to_blocks(v).astype(vc.dtype))
     return (kc, vc)
+
+
+def _write_suffix_rows(pool, cache_q8, k, v, blks, rows):
+    """Scatter per-position K/V rows (k/v [Lyr, H, Ssuf, D]) into pool
+    blocks at (blks[i], rows[i]) — the mid-page generalization of
+    _write_prompt_pages for SUFFIX prefill: after a prefix-cache share
+    the suffix may start mid-page (COW), so each row lands at its own
+    (block, row) pair. Pad positions arrive pointed at the trash
+    block."""
+    if cache_q8:
+        kc, ks, vc, vs = pool
+        kq, ksc = _quant_prompt_rows(k)     # [Lyr,H,S,D] / [Lyr,H,S]
+        vq, vsc = _quant_prompt_rows(v)
+        # two advanced indices split by a slice put the row axis FIRST:
+        # value layout [S, Lyr, H, ...]
+        kc = kc.at[:, blks, :, rows, :].set(kq.transpose(2, 0, 1, 3))
+        vc = vc.at[:, blks, :, rows, :].set(vq.transpose(2, 0, 1, 3))
+        ks = ks.at[:, blks, :, 0, rows].set(ksc.transpose(2, 0, 1))
+        vs = vs.at[:, blks, :, 0, rows].set(vsc.transpose(2, 0, 1))
+        return (kc, ks, vc, vs)
+    kc, vc = pool
+    kc = kc.at[:, blks, :, rows, :].set(
+        k.transpose(2, 0, 1, 3).astype(kc.dtype))
+    vc = vc.at[:, blks, :, rows, :].set(
+        v.transpose(2, 0, 1, 3).astype(vc.dtype))
+    return (kc, vc)
+
+
+def _gather_prefix_kv(pool, cache_q8, l, pre_ids, dtype):
+    """Gather (and dequantize) a slot's resident prefix K/V from the
+    pool at layer ``l``: pre_ids = the slot's leading page-table
+    entries, padded with trash past the real prefix (those rows are
+    masked off by position in the caller). Returns K, V [H, NPRE*P, D]
+    in ``dtype``."""
+    def fold(x):                             # [NPRE, H, P, D] -> [H, L, D]
+        npg, H, P, D = x.shape
+        return x.transpose(1, 0, 2, 3).reshape(H, npg * P, D)
+
+    if cache_q8:
+        kc, ks, vc, vs = pool
+        kd = kc[l, pre_ids].astype(jnp.float32) \
+            * ks[l, pre_ids].transpose(0, 1, 3, 2)
+        vd = vc[l, pre_ids].astype(jnp.float32) \
+            * vs[l, pre_ids].transpose(0, 1, 3, 2)
+        return fold(kd).astype(dtype), fold(vd).astype(dtype)
+    kc, vc = pool
+    return (fold(kc[l, pre_ids]).astype(dtype),
+            fold(vc[l, pre_ids]).astype(dtype))
+
+
+def _suffix_attn_bias(start, pos_q, n_prefix_rows):
+    """Additive attention bias [1, 1, Ssuf, LPRE+Ssuf] for suffix
+    prefill: prefix rows are valid iff their absolute position < start
+    (rows past the live prefix in the gathered pages are stale), suffix
+    rows mask causally at absolute positions."""
+    lpre = n_prefix_rows
+    kp = jnp.concatenate([jnp.arange(lpre, dtype=jnp.int32), pos_q])
+    kvalid = jnp.concatenate([
+        jnp.arange(lpre, dtype=jnp.int32) < start,
+        jnp.ones(pos_q.shape, bool)])
+    mask = (kp[None, :] <= pos_q[:, None]) & kvalid[None, :]
+    return jnp.where(mask, 0.0, -1e30).astype(jnp.float32)[None, None]
+
+
+def _verify_append_ids(pos, pt, K, page, maxp):
+    """(block ids, row offsets) [B*K] for appending the verification
+    rows of a K-token speculative window at positions pos[b]..pos[b]+K-1
+    per slot. Idle slots (pos < 0) resolve inside their all-trash table
+    rows, same as _gather_blocks."""
+    B = pos.shape[0]
+    posf = (pos[:, None]
+            + jnp.arange(K, dtype=jnp.int32)[None, :]).reshape(B * K)
+    bidx = jnp.clip(posf // page, 0, maxp - 1)
+    batch = jnp.repeat(jnp.arange(B, dtype=jnp.int32), K)
+    return pt[batch, bidx], posf % page, posf
 
 
 def _pick_next(logits, r, temps):
@@ -340,6 +424,201 @@ class GPT2ServingAdapter:
         self._fns[key] = prefill
         return prefill
 
+    def _prefill_suffix_fn(self, n_suf_pages: int, n_pre_pages: int):
+        """Suffix-only prefill for prefix-cache hits: computes (and
+        writes) K/V ONLY for prompt positions >= ``start``, reading the
+        shared-prefix K/V back through the slot's page table. One
+        compiled program per (suffix-pages, prefix-pages) pow2 bucket
+        pair."""
+        cfg, spec = self.cfg, self.spec
+        key = ("prefill_sfx", n_suf_pages, n_pre_pages)
+        if key in self._fns:
+            return self._fns[key]
+        from deepspeed_tpu.ops.attention import dot_product_attention
+        E, H = cfg.n_embd, cfg.n_head
+        D = E // H
+        Lyr = cfg.n_layer
+        P = spec.page_size
+        MAXP = spec.max_pages_per_slot
+        Ssuf = n_suf_pages * P
+        LPRE = n_pre_pages * P
+        eps = cfg.layer_norm_epsilon
+        cache_q8 = self.cache_q8
+        wkey = "kernel_q" if self.weights_q8 else "kernel"
+
+        def deq(sub, l):
+            w = sub[wkey][l]
+            if self.weights_q8:
+                s = sub["kernel_scale"].reshape(Lyr)[l]
+                return (w.astype(jnp.float32) * s).astype(cfg.dtype)
+            return w.astype(cfg.dtype)
+
+        def _ln(x, w, b):
+            xf = x.astype(jnp.float32)
+            mu = jnp.mean(xf, axis=-1, keepdims=True)
+            var = jnp.mean((xf - mu) ** 2, axis=-1, keepdims=True)
+            y = (xf - mu) * jax.lax.rsqrt(var + eps)
+            return (y * w.astype(jnp.float32)
+                    + b.astype(jnp.float32)).astype(x.dtype)
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def prefill_sfx(p, blk, pool, ids, length, start, pt_row):
+            wte = jnp.asarray(p["wte"]).astype(cfg.dtype)
+            wpe = jnp.asarray(p["wpe"]).astype(cfg.dtype)
+            pos_q = start + jnp.arange(Ssuf, dtype=jnp.int32)
+            x = wte[ids] + wpe[jnp.clip(pos_q, 0,
+                                        cfg.n_positions - 1)][None]
+            pre_ids = pt_row[:n_pre_pages]
+            bias = _suffix_attn_bias(start, pos_q, LPRE)
+
+            def layer(x, l):
+                u = _ln(x, blk["attn_nw"]["scale"][l],
+                        blk["attn_nw"]["bias"][l])
+                qkv = u @ deq(blk["attn_qkvw"], l) \
+                    + blk["attn_qkvw"]["bias"][l].astype(cfg.dtype)
+                q = qkv[..., :E].reshape(1, Ssuf, H, D) \
+                    .transpose(0, 2, 1, 3)
+                k = qkv[..., E:2 * E].reshape(1, Ssuf, H, D) \
+                    .transpose(0, 2, 1, 3)
+                v = qkv[..., 2 * E:].reshape(1, Ssuf, H, D) \
+                    .transpose(0, 2, 1, 3)
+                kpre, vpre = _gather_prefix_kv(pool, cache_q8, l,
+                                               pre_ids, cfg.dtype)
+                ka = jnp.concatenate([kpre[None], k], axis=2)
+                va = jnp.concatenate([vpre[None], v], axis=2)
+                ctx = dot_product_attention(q, ka, va, bias=bias)
+                ctx = ctx.transpose(0, 2, 1, 3).reshape(1, Ssuf, E)
+                x = x + ctx @ deq(blk["attn_ow"], l) \
+                    + blk["attn_ow"]["bias"][l].astype(cfg.dtype)
+                u2 = _ln(x, blk["norm_w"]["scale"][l],
+                         blk["norm_w"]["bias"][l])
+                h = jax.nn.gelu(
+                    u2 @ deq(blk["inter_w"], l)
+                    + blk["inter_w"]["bias"][l].astype(cfg.dtype),
+                    approximate=True)
+                x = x + h @ deq(blk["output_w"], l) \
+                    + blk["output_w"]["bias"][l].astype(cfg.dtype)
+                return x, (k[0], v[0])
+
+            x, (ks, vs) = jax.lax.scan(
+                layer, x, jnp.arange(Lyr, dtype=jnp.int32))
+            valid = pos_q < length
+            blks = jnp.where(
+                valid, pt_row[jnp.clip(pos_q // P, 0, MAXP - 1)],
+                jnp.int32(0))
+            pool_out = _write_suffix_rows(pool, cache_q8, ks, vs,
+                                          blks, pos_q % P)
+            xl = x[0, length - 1 - start]
+            xf = xl.astype(jnp.float32)
+            mu = jnp.mean(xf, keepdims=True)
+            var = jnp.mean((xf - mu) ** 2, keepdims=True)
+            y = (xf - mu) * jax.lax.rsqrt(var + eps)
+            y = y * p["ln_f"]["scale"].astype(jnp.float32) \
+                + p["ln_f"]["bias"].astype(jnp.float32)
+            logits = y.astype(cfg.dtype) @ wte.T
+            return pool_out, logits.astype(jnp.float32)
+
+        self._fns[key] = prefill_sfx
+        return prefill_sfx
+
+    def _verify_fn(self, n_rows: int):
+        """Speculative verification: feed ``n_rows`` tokens per slot
+        (the pending token + n_rows-1 drafts) in ONE dispatch; the
+        paged attention runs in multi-query mode so every drafted
+        position attends through the page table at its own offset.
+        Returns (pool, greedy [B, n_rows], logits32 [B, n_rows, V])."""
+        cfg, spec = self.cfg, self.spec
+        key = ("verify", n_rows)
+        if key in self._fns:
+            return self._fns[key]
+        from deepspeed_tpu.ops.pallas.decode import (
+            ln_qkv_int8_stacked, decode_attention_paged,
+            out_ffn_int8_stacked)
+        E, H = cfg.n_embd, cfg.n_head
+        D = E // H
+        Lyr = cfg.n_layer
+        P = spec.page_size
+        MAXP = spec.max_pages_per_slot
+        K = n_rows
+        eps = cfg.layer_norm_epsilon
+        cache_q8 = self.cache_q8
+        wkey = "kernel_q" if self.weights_q8 else "kernel"
+
+        def _wscale(proj):
+            if self.weights_q8:
+                return proj["kernel_scale"].reshape(Lyr)
+            return jnp.ones((Lyr,), jnp.float32)
+
+        def _ln_f(x, w, b):
+            xf = x.astype(jnp.float32)
+            mu = jnp.mean(xf, axis=-1, keepdims=True)
+            var = jnp.mean((xf - mu) ** 2, axis=-1, keepdims=True)
+            y = (xf - mu) * jax.lax.rsqrt(var + eps)
+            return (y * w.astype(jnp.float32)
+                    + b.astype(jnp.float32)).astype(x.dtype)
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def verify(p, blk, pool, toks, pos, pt):
+            wte = jnp.asarray(p["wte"]).astype(cfg.dtype)
+            wpe = jnp.asarray(p["wpe"]).astype(cfg.dtype)
+            Wq, Wp = blk["attn_qkvw"][wkey], blk["attn_ow"][wkey]
+            W1, W2 = blk["inter_w"][wkey], blk["output_w"][wkey]
+            r3 = lambda a: a.reshape(Lyr, 1, a.shape[-1])  # noqa: E731
+            ln1_w = r3(blk["attn_nw"]["scale"])
+            ln1_b = r3(blk["attn_nw"]["bias"])
+            ln2_w = r3(blk["norm_w"]["scale"])
+            ln2_b = r3(blk["norm_w"]["bias"])
+            bq = r3(blk["attn_qkvw"]["bias"])
+            bp = r3(blk["attn_ow"]["bias"])
+            b1 = r3(blk["inter_w"]["bias"])
+            b2 = r3(blk["output_w"]["bias"])
+            sq, sp_ = _wscale(blk["attn_qkvw"]), _wscale(blk["attn_ow"])
+            s1, s2 = _wscale(blk["inter_w"]), _wscale(blk["output_w"])
+            B = toks.shape[0]
+            blk_ids, rows, posf = _verify_append_ids(pos, pt, K, P, MAXP)
+            x = (wte[toks]
+                 + wpe[jnp.clip(posf.reshape(B, K), 0,
+                                cfg.n_positions - 1)]).reshape(B * K, E)
+
+            def layer(car, l):
+                x, pool = car
+                qkv = ln_qkv_int8_stacked(x, ln1_w, ln1_b, Wq, sq,
+                                          bq, l, eps=eps)
+                qh = qkv[:, :E].reshape(B, K, H, D).transpose(0, 2, 1, 3)
+                k3 = qkv[:, E:2 * E].reshape(B * K, H, D)
+                v3 = qkv[:, 2 * E:].reshape(B * K, H, D)
+                pool = _append_rows(pool, cache_q8, l, blk_ids,
+                                    rows, k3, v3)
+                if cache_q8:
+                    kc, ks, vc, vs = pool
+                    ctx = decode_attention_paged(
+                        qh, kc, vc, pos, pt, l, k_scale=ks,
+                        v_scale=vs, scale=1.0 / np.sqrt(D),
+                        rows_per_step=1)
+                else:
+                    kc, vc = pool
+                    ctx = decode_attention_paged(
+                        qh, kc, vc, pos, pt, l,
+                        scale=1.0 / np.sqrt(D), rows_per_step=1)
+                ctx2 = ctx.transpose(0, 2, 1, 3).reshape(B * K, E)
+                x = out_ffn_int8_stacked(
+                    ctx2, x, Wp, sp_, bp, ln2_w, ln2_b, W1, s1, b1,
+                    W2, s2, b2, l, act="gelu_tanh", eps=eps)
+                return (x, pool), None
+
+            (x, pool), _ = jax.lax.scan(
+                layer, (x, pool), jnp.arange(Lyr, dtype=jnp.int32))
+            logits = jnp.einsum(
+                "be,ve->bv",
+                _ln_f(x, p["ln_f"]["scale"], p["ln_f"]["bias"]), wte)
+            logits32 = logits.astype(jnp.float32)
+            greedy = jnp.argmax(logits32, axis=-1).astype(jnp.int32)
+            return (pool, greedy.reshape(B, K),
+                    logits32.reshape(B, K, -1))
+
+        self._fns[key] = verify
+        return verify
+
     # -- engine-facing calls -----------------------------------------------
 
     def tick(self, pool, toks, pos, pt, rng, temps, steps=1):
@@ -351,6 +630,27 @@ class GPT2ServingAdapter:
     def prefill(self, pool, ids, length, pages):
         return self._prefill_fn(ids.shape[1] // self.spec.page_size)(
             self._p, self._blk, pool, ids, length, pages)
+
+    def prefill_suffix(self, pool, ids, length, start, n_pre_pages,
+                       pt_row):
+        """Prefix-cache-hit prefill: compute/write only positions
+        [start, length), attending over the shared prefix through the
+        slot's page table row."""
+        return self._prefill_suffix_fn(
+            ids.shape[1] // self.spec.page_size, n_pre_pages)(
+            self._p, self._blk, pool, ids,
+            jnp.asarray(length, jnp.int32), jnp.asarray(start, jnp.int32),
+            jnp.asarray(pt_row))
+
+    def verify(self, pool, toks, pos, pt):
+        """One speculative verification dispatch over toks [B, n_rows]."""
+        return self._verify_fn(toks.shape[1])(
+            self._p, self._blk, pool, jnp.asarray(toks),
+            jnp.asarray(pos), jnp.asarray(pt))
+
+    def copy_block(self, pool, src, dst):
+        return _copy_pool_block(pool, jnp.asarray(src, jnp.int32),
+                                jnp.asarray(dst, jnp.int32))
 
 
 # ------------------------------------------------------------- LLaMA
@@ -570,6 +870,189 @@ class LlamaServingAdapter:
         self._fns[key] = prefill
         return prefill
 
+    def _prefill_suffix_fn(self, n_suf_pages: int, n_pre_pages: int):
+        """Suffix-only prefill (prefix-cache hits) — LLaMA twin of the
+        GPT-2 variant: RoPE at absolute positions, RMS norms, GQA
+        attention over [shared prefix ++ suffix] K/V."""
+        cfg, spec = self.cfg, self.spec
+        key = ("prefill_sfx", n_suf_pages, n_pre_pages)
+        if key in self._fns:
+            return self._fns[key]
+        from deepspeed_tpu.ops.attention import dot_product_attention
+        from deepspeed_tpu.models.llama import rope_angles, apply_rope
+        from deepspeed_tpu.models.llama_inference import _weights
+        E, H, Hkv, D = (cfg.hidden_size, cfg.n_heads, cfg.kv_heads,
+                        cfg.head_dim)
+        Lyr = cfg.n_layers
+        P = spec.page_size
+        MAXP = spec.max_pages_per_slot
+        Ssuf = n_suf_pages * P
+        LPRE = n_pre_pages * P
+        eps = cfg.rms_eps
+        cache_q8 = self.cache_q8
+
+        def _rms(x, w):
+            xf = x.astype(jnp.float32)
+            n = xf * jax.lax.rsqrt(
+                jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+            return (n * w.astype(jnp.float32)).astype(x.dtype)
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def prefill_sfx(p, blk, pool, ids, length, start, pt_row):
+            x = p["embed"][ids].astype(cfg.dtype)    # [1, Ssuf, E]
+            pos_q = start + jnp.arange(Ssuf, dtype=jnp.int32)
+            cos, sin = rope_angles(pos_q, D, cfg.rope_theta)
+            Wq, sq = _weights(blk, "qkv_w", Lyr)
+            Wo, so = _weights(blk, "o_w", Lyr)
+            Wg, sg = _weights(blk, "gate_w", Lyr)
+            Wu, su = _weights(blk, "up_w", Lyr)
+            Wd, sd = _weights(blk, "down_w", Lyr)
+            pre_ids = pt_row[:n_pre_pages]
+            bias = _suffix_attn_bias(start, pos_q, LPRE)
+
+            def deq(stack, scale, l):
+                w = stack[l]
+                if stack.dtype == jnp.int8:
+                    return (w.astype(jnp.float32)
+                            * scale[l]).astype(cfg.dtype)
+                return w.astype(cfg.dtype)
+
+            def layer(x, l):
+                u = _rms(x, blk["norm1"][l])
+                qkv = u @ deq(Wq, sq, l)
+                q = qkv[..., :H * D].reshape(1, Ssuf, H, D) \
+                    .transpose(0, 2, 1, 3)
+                k = qkv[..., H * D:(H + Hkv) * D] \
+                    .reshape(1, Ssuf, Hkv, D).transpose(0, 2, 1, 3)
+                v = qkv[..., (H + Hkv) * D:] \
+                    .reshape(1, Ssuf, Hkv, D).transpose(0, 2, 1, 3)
+                q = apply_rope(q, cos, sin)
+                k = apply_rope(k, cos, sin)
+                kpre, vpre = _gather_prefix_kv(pool, cache_q8, l,
+                                               pre_ids, cfg.dtype)
+                ka = jnp.concatenate([kpre[None], k], axis=2)
+                va = jnp.concatenate([vpre[None], v], axis=2)
+                ctx = dot_product_attention(q, ka, va, bias=bias)
+                ctx = ctx.transpose(0, 2, 1, 3).reshape(1, Ssuf, H * D)
+                x = x + ctx @ deq(Wo, so, l)
+                u2 = _rms(x, blk["norm2"][l])
+                h = jax.nn.silu(u2 @ deq(Wg, sg, l)) \
+                    * (u2 @ deq(Wu, su, l))
+                x = x + h @ deq(Wd, sd, l)
+                return x, (k[0], v[0])
+
+            x, (ks, vs) = jax.lax.scan(
+                layer, x, jnp.arange(Lyr, dtype=jnp.int32))
+            valid = pos_q < length
+            blks = jnp.where(
+                valid, pt_row[jnp.clip(pos_q // P, 0, MAXP - 1)],
+                jnp.int32(0))
+            pool_out = _write_suffix_rows(pool, cache_q8, ks, vs,
+                                          blks, pos_q % P)
+            xl = x[0, length - 1 - start]
+            logits = _rms(xl, p["norm_scale"]) \
+                @ p["head"].astype(cfg.dtype).T
+            return pool_out, logits.astype(jnp.float32)
+
+        self._fns[key] = prefill_sfx
+        return prefill_sfx
+
+    def _verify_fn(self, n_rows: int):
+        """Speculative verification — LLaMA twin: GQA query rows ride
+        the multi-query paged kernel STEP-major (row = step * rep + r,
+        rows_per_step = rep)."""
+        cfg, spec = self.cfg, self.spec
+        key = ("verify", n_rows)
+        if key in self._fns:
+            return self._fns[key]
+        from deepspeed_tpu.ops.pallas.decode import (
+            ln_qkv_int8_stacked, decode_attention_paged,
+            out_ffn_int8_stacked, matvec_int8_stacked)
+        from deepspeed_tpu.models.llama_inference import _weights
+        E, H, Hkv, D = (cfg.hidden_size, cfg.n_heads, cfg.kv_heads,
+                        cfg.head_dim)
+        Lyr = cfg.n_layers
+        rep = H // Hkv
+        P = spec.page_size
+        MAXP = spec.max_pages_per_slot
+        K = n_rows
+        eps = cfg.rms_eps
+        cache_q8 = self.cache_q8
+
+        def _rms(x, w):
+            xf = x.astype(jnp.float32)
+            n = xf * jax.lax.rsqrt(
+                jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+            return (n * w.astype(jnp.float32)).astype(x.dtype)
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def verify(p, blk, pool, toks, pos, pt):
+            embed = p["embed"].astype(cfg.dtype)
+            head = p["head"].astype(cfg.dtype)
+            Wq, sq = _weights(blk, "qkv_w", Lyr)
+            Wo, so = _weights(blk, "o_w", Lyr)
+            Wg, sg = _weights(blk, "gate_w", Lyr)
+            Wu, su = _weights(blk, "up_w", Lyr)
+            Wd, sd = _weights(blk, "down_w", Lyr)
+            n1 = blk["norm1"].reshape(Lyr, 1, E)
+            n2 = blk["norm2"].reshape(Lyr, 1, E)
+            B = toks.shape[0]
+            blk_ids, rows, posf = _verify_append_ids(pos, pt, K, P, MAXP)
+            x = embed[toks].reshape(B * K, E)
+
+            def layer(car, l):
+                x, pool = car
+                qkv = ln_qkv_int8_stacked(x, n1, None, Wq, sq, None,
+                                          l, eps=eps, norm="rms")
+                q3 = qkv[:, :H * D].reshape(B * K, H, D)
+                k3 = qkv[:, H * D:(H + Hkv) * D].reshape(B * K, Hkv, D)
+                v3 = qkv[:, (H + Hkv) * D:].reshape(B * K, Hkv, D)
+                q3 = _rope_rows(q3, posf, cfg.rope_theta)
+                k3 = _rope_rows(k3, posf, cfg.rope_theta)
+                # STEP-major multi-query rows: row j = step * rep + r
+                qg = q3.reshape(B, K, Hkv, rep, D) \
+                    .transpose(0, 2, 1, 3, 4).reshape(B, Hkv, K * rep, D)
+                pool = _append_rows(pool, cache_q8, l, blk_ids,
+                                    rows, k3, v3)
+                if cache_q8:
+                    kc, ks, vc, vs = pool
+                    ctx = decode_attention_paged(
+                        qg, kc, vc, pos, pt, l, k_scale=ks,
+                        v_scale=vs, scale=1.0 / np.sqrt(D),
+                        rows_per_step=rep)
+                else:
+                    kc, vc = pool
+                    ctx = decode_attention_paged(
+                        qg, kc, vc, pos, pt, l,
+                        scale=1.0 / np.sqrt(D), rows_per_step=rep)
+                ctx2 = ctx.reshape(B, Hkv, K, rep, D) \
+                    .transpose(0, 2, 1, 3, 4).reshape(B * K, H * D)
+                if E * E * Wo.dtype.itemsize <= (6 << 20):
+                    x = out_ffn_int8_stacked(
+                        ctx2, x, Wo, so, None, n2, None, Wg, sg,
+                        None, Wd, sd, None, l, act="swiglu",
+                        eps=eps, norm="rms", w1b_stack=Wu, s1b=su)
+                else:
+                    x1 = x + matvec_int8_stacked(ctx2, Wo, so, l)
+                    x = out_ffn_int8_stacked(
+                        None, x1, None, None, None, n2, None, Wg,
+                        sg, None, Wd, sd, None, l, act="swiglu",
+                        eps=eps, norm="rms", w1b_stack=Wu, s1b=su,
+                        fuse_proj=False)
+                return (x, pool), None
+
+            (x, pool), _ = jax.lax.scan(
+                layer, (x, pool), jnp.arange(Lyr, dtype=jnp.int32))
+            logits = jnp.einsum("be,ve->bv",
+                                _rms(x, p["norm_scale"]), head)
+            logits32 = logits.astype(jnp.float32)
+            greedy = jnp.argmax(logits32, axis=-1).astype(jnp.int32)
+            return (pool, greedy.reshape(B, K),
+                    logits32.reshape(B, K, -1))
+
+        self._fns[key] = verify
+        return verify
+
     def tick(self, pool, toks, pos, pt, rng, temps, steps=1):
         """Run ``steps`` decode steps in ONE dispatch. Returns
         (pool, tokens [steps, B], last-step logits [B, V])."""
@@ -579,3 +1062,20 @@ class LlamaServingAdapter:
     def prefill(self, pool, ids, length, pages):
         return self._prefill_fn(ids.shape[1] // self.spec.page_size)(
             self._p, self._blk, pool, ids, length, pages)
+
+    def prefill_suffix(self, pool, ids, length, start, n_pre_pages,
+                       pt_row):
+        return self._prefill_suffix_fn(
+            ids.shape[1] // self.spec.page_size, n_pre_pages)(
+            self._p, self._blk, pool, ids,
+            jnp.asarray(length, jnp.int32), jnp.asarray(start, jnp.int32),
+            jnp.asarray(pt_row))
+
+    def verify(self, pool, toks, pos, pt):
+        return self._verify_fn(toks.shape[1])(
+            self._p, self._blk, pool, jnp.asarray(toks),
+            jnp.asarray(pos), jnp.asarray(pt))
+
+    def copy_block(self, pool, src, dst):
+        return _copy_pool_block(pool, jnp.asarray(src, jnp.int32),
+                                jnp.asarray(dst, jnp.int32))

@@ -30,7 +30,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.serving.paged_cache import PagedKVCache, TRASH_BLOCK
+from deepspeed_tpu.serving.paged_cache import (PagedKVCache,
+                                               padded_prefill_inputs,
+                                               pow2_page_bucket)
 from deepspeed_tpu.telemetry.recorder import default_recorder
 from deepspeed_tpu.telemetry.registry import MetricsRegistry
 
@@ -82,17 +84,38 @@ class ContinuousBatcher:
 
     def __init__(self, adapter, rng: Optional[jax.Array] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 recorder=None, watchdog=None):
+                 recorder=None, watchdog=None, prefix_cache: bool = False,
+                 prefix_cow: bool = True, drafter=None,
+                 spec_tokens: int = 3):
         self.adapter = adapter
         self.spec = adapter.spec
         self.cache: PagedKVCache = adapter.make_cache()
+        # ISSUE 9 (a): copy-on-write prefix page sharing — admission
+        # consults the refcounted prefix index before allocating, and a
+        # hit skips both the pages AND the prefill compute for the
+        # shared span (prefill_suffix starts at start_pos)
+        self.prefix_cache = bool(prefix_cache)
+        self.prefix_cow = bool(prefix_cow)
+        if self.prefix_cache:
+            self.cache.enable_prefix_sharing()
+        # ISSUE 9 (b): speculative decoding — a drafter proposes
+        # spec_tokens tokens per round and the target model verifies the
+        # whole window in ONE multi-query paged-attention dispatch;
+        # greedy accept/reject keeps outputs token-for-token identical
+        # to the plain engine (verify is greedy-only: any active sampled
+        # request falls the whole step back to the normal tick)
+        self.drafter = drafter
+        self.spec_tokens = int(spec_tokens)
         self.slots = [_Slot() for _ in range(self.spec.slots)]
         self.queue: deque = deque()
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._host_rng = np.random.RandomState(0)
         self.last_logits = None       # [slots, V] of the latest tick
         self.stats = {"ticks": 0, "tick_steps": 0, "decode_tokens": 0,
-                      "prefills": 0, "prefill_tokens": 0}
+                      "prefills": 0, "prefill_tokens": 0,
+                      "spec_rounds": 0, "spec_proposed": 0,
+                      "spec_accepted": 0, "prefix_tokens_shared": 0,
+                      "prefix_tokens_prompt": 0, "prefix_pages_saved": 0}
         # per-engine metrics registry (serving/* names) — pass the
         # process-wide default_registry() to merge into one JSONL
         # stream with a training engine. All recording is host-side;
@@ -115,11 +138,15 @@ class ContinuousBatcher:
 
     def _note_pool(self) -> None:
         """Record page-pool occupancy (+ high-water mark) — called
-        after admissions (the local peak) and after ticks (releases)."""
+        after admissions (the local peak) and after ticks (releases).
+        Refcount-0 resident prefix-cache pages count as CACHED, not
+        live — they free on demand under pool pressure."""
         alloc = self.cache.num_blocks - 1
-        used = alloc - self.cache.free_pages
+        cached = self.cache.cached_pages
+        used = alloc - self.cache.free_pages - cached
         m = self.metrics
         m.gauge("serving/page_pool_used_pages").set(used)
+        m.gauge("serving/prefix_cache_pages").set(cached)
         occ = used / max(alloc, 1)
         m.gauge("serving/page_pool_occupancy").set(occ)
         m.gauge("serving/page_pool_occupancy_hwm").set_max(occ)
@@ -138,16 +165,39 @@ class ContinuousBatcher:
         lifetime = (now - self._t_first_decode) \
             if self._t_first_decode is not None else 0.0
         alloc = self.cache.num_blocks - 1
+        st = self.stats
+        prompt_toks = st["prefix_tokens_prompt"]
         return {
             "queue_depth": len(self.queue),
             "active_slots": sum(s.active for s in self.slots),
             "slots": len(self.slots),
             "page_pool": {
                 "allocatable_pages": alloc,
-                "used_pages": alloc - self.cache.free_pages,
+                "used_pages": alloc - self.cache.free_pages
+                - self.cache.cached_pages,
+                "prefix_cached_pages": self.cache.cached_pages,
                 "occupancy": gauges.get("serving/page_pool_occupancy", 0.0),
                 "occupancy_hwm": gauges.get(
                     "serving/page_pool_occupancy_hwm", 0.0),
+            },
+            "prefix_cache": {
+                "enabled": self.prefix_cache,
+                # token-level hit rate: shared prompt tokens (skipped
+                # prefill compute AND skipped page writes) over all
+                # prompt tokens admitted
+                "hit_rate": (st["prefix_tokens_shared"] / prompt_toks)
+                if prompt_toks else 0.0,
+                "pages_saved": st["prefix_pages_saved"],
+                **({k: v for k, v in self.cache.prefix_stats.items()}
+                   if self.prefix_cache else {}),
+            },
+            "speculative": {
+                "enabled": self.drafter is not None,
+                "rounds": st["spec_rounds"],
+                "proposed": st["spec_proposed"],
+                "accepted": st["spec_accepted"],
+                "accept_rate": (st["spec_accepted"] / st["spec_proposed"])
+                if st["spec_proposed"] else 0.0,
             },
             "admission_wait_s": hists.get("serving/admission_wait_s",
                                           {"count": 0}),
@@ -221,17 +271,14 @@ class ContinuousBatcher:
 
     # --------------------------------------------------------- admission
 
-    def _bucket_pages(self, S: int) -> int:
-        """Prompt pad bucket in PAGES, next power of two — so prefill
-        compiles O(log max_pages) programs, not one per prompt length.
-        Never past the position budget: submit() guarantees the prompt
-        itself fits in whole pages, so the clamp only trims pad."""
-        need = self.cache.pages_needed(S)
-        b = 1
-        while b < need:
-            b *= 2
-        max_pages = self.adapter.max_prompt_len() // self.spec.page_size
-        return min(b, max_pages)
+    def _bucket_count(self, need: int) -> int:
+        """pow2_page_bucket against the position budget (the full
+        prefill path buckets inside padded_prefill_inputs; the
+        suffix/prefix prefill buckets here). submit() guarantees the
+        prompt itself fits in whole pages, so the clamp only trims
+        pad."""
+        return pow2_page_bucket(
+            need, self.adapter.max_prompt_len() // self.spec.page_size)
 
     def _pick_token(self, logits: np.ndarray, temperature: float) -> int:
         if temperature and temperature > 0:
@@ -249,21 +296,29 @@ class ContinuousBatcher:
             req = self.queue[0]
             if now is not None and req.arrival_time > now:
                 break                 # FIFO: don't skip ahead of arrivals
-            S = int(np.asarray(req.prompt).shape[0])  # sync-ok: host prompt
+            prompt_np = np.asarray(req.prompt, np.int32)  # sync-ok: host prompt
+            S = int(prompt_np.shape[0])
             slot_id = free[0]
-            pages = self.cache.admit(slot_id, S + req.max_new_tokens)
+            plan = None
+            if self.prefix_cache:
+                plan = self.cache.admit_prefix(
+                    slot_id, prompt_np, S + req.max_new_tokens,
+                    cow=self.prefix_cow)
+                pages = plan.pages if plan is not None else None
+            else:
+                pages = self.cache.admit(slot_id, S + req.max_new_tokens)
             if pages is None:
                 # pool exhausted; retry next step. The watchdog rule is
                 # latched per episode — one dump until pages free again
                 need = self.cache.pages_needed(S + req.max_new_tokens)
                 self.recorder.record(
                     "pool_exhausted", rid=req.rid, need_pages=need,
-                    free_pages=self.cache.free_pages,
+                    free_pages=self.cache.available_pages,
                     queue_depth=len(self.queue))
                 if self.watchdog is not None:
                     self.watchdog.note_pool_exhausted(
                         queue_depth=len(self.queue),
-                        free_pages=self.cache.free_pages,
+                        free_pages=self.cache.available_pages,
                         need_pages=need)
                 break
             self.queue.popleft()
@@ -277,23 +332,59 @@ class ContinuousBatcher:
             wait_s = max(t_admit - t_ref, 0.0)
             self.metrics.histogram("serving/admission_wait_s").observe(
                 wait_s)
+            start = plan.start_pos if plan is not None else 0
             self.recorder.record("admit", rid=req.rid, slot=slot_id,
-                                 pages=len(pages), wait_s=wait_s)
+                                 pages=len(pages), wait_s=wait_s,
+                                 shared_tokens=start)
             if self.watchdog is not None:
                 self.watchdog.note_pool_ok()   # re-arm the pool rule
-            n_pages = self._bucket_pages(S)
             P = self.spec.page_size
-            ids = np.zeros((1, n_pages * P), np.int32)
-            ids[0, :S] = np.asarray(req.prompt, np.int32)  # sync-ok: host prompt
-            page_vec = np.full((n_pages,), TRASH_BLOCK, np.int32)
-            k = min(n_pages, len(pages))
-            page_vec[:k] = pages[:k]
-            pool, logits = self.adapter.prefill(
-                self.cache.pool, jnp.asarray(ids),
-                jnp.asarray(S, jnp.int32), jnp.asarray(page_vec))
+            if plan is not None and plan.cow is not None:
+                # COW: the matched rows of the partially-filled prefix
+                # page are device-copied into this slot's own page; the
+                # suffix prefill continues writing mid-page. (With
+                # prefix_cow off the cache never matches partial pages,
+                # so plan.cow is None by construction.)
+                src, dst, _rows = plan.cow
+                self.cache.pool = self.adapter.copy_block(
+                    self.cache.pool, src, dst)
+            if start > 0:
+                # prefix hit: prefill ONLY the suffix — the shared
+                # span's K/V is already resident through the page table
+                suf_len = S - start
+                n_pre = min(self._bucket_count(-(-start // P)),
+                            self.spec.max_pages_per_slot)
+                # same pow2 page bucket + zero-pad contract as the full
+                # prefill (the page_vec is unused — prefill_suffix reads
+                # through the slot's page-table row)
+                ids, _ = padded_prefill_inputs(
+                    prompt_np[start:], [], P,
+                    self.adapter.max_prompt_len() // P)
+                pool, logits = self.adapter.prefill_suffix(
+                    self.cache.pool, jnp.asarray(ids), S, start, n_pre,
+                    self.cache.page_table[slot_id])
+                self.stats["prefill_tokens"] += suf_len
+            else:
+                ids, page_vec = padded_prefill_inputs(
+                    prompt_np, pages, P,
+                    self.adapter.max_prompt_len() // P)
+                pool, logits = self.adapter.prefill(
+                    self.cache.pool, jnp.asarray(ids),
+                    jnp.asarray(S, jnp.int32), jnp.asarray(page_vec))
+                self.stats["prefill_tokens"] += S
             self.cache.pool = pool
             self.stats["prefills"] += 1
-            self.stats["prefill_tokens"] += S
+            if self.prefix_cache:
+                self.cache.register_prefix(
+                    slot_id, prompt_np, hashes=plan.hashes)
+                n_shared = start // P
+                self.stats["prefix_tokens_shared"] += start
+                self.stats["prefix_tokens_prompt"] += S
+                self.stats["prefix_pages_saved"] += n_shared
+                m = self.metrics
+                m.counter("serving/prefix_tokens_shared").inc(start)
+                m.counter("serving/prefix_tokens_prompt").inc(S)
+                m.counter("serving/prefix_pages_saved").inc(n_shared)
             tok = self._pick_token(
                 np.asarray(logits, np.float32),  # sync-ok: scheduler
                 req.temperature)                 # consumes the sample
@@ -315,6 +406,11 @@ class ContinuousBatcher:
             if done is not None:      # max_new_tokens == 1 / instant EOS
                 finished.append(done)
                 free.insert(0, slot_id)
+            elif self.drafter is not None:
+                # drafter mirrors the admission (its own prefill for a
+                # ModelDrafter, host history for the n-gram fallback)
+                self.drafter.admit(slot_id, prompt_np, tok,
+                                   S + req.max_new_tokens)
         self.metrics.gauge("serving/queue_depth").set(len(self.queue))
         self._note_pool()
         return finished
@@ -333,7 +429,11 @@ class ContinuousBatcher:
             req.finish_reason = "length"
         else:
             return None
+        # with prefix sharing this is a DECREF: shared pages stay
+        # resident for other holders (or as refcount-0 prefix cache)
         self.cache.release(slot_id)
+        if self.drafter is not None:
+            self.drafter.release(slot_id)
         slot.request, slot.pos, slot.last_tok = None, -1, 0
         self.recorder.record("finish", rid=req.rid,
                              reason=req.finish_reason,
@@ -411,16 +511,151 @@ class ContinuousBatcher:
                     break
         m.counter("serving/decode_tokens").inc(
             self.stats["decode_tokens"] - tokens_before)
+        if self.drafter is not None:
+            # keep the drafter aligned with the committed stream: a
+            # plain tick (sampled slot live / admission pending / 1-token
+            # budget) commits tokens the drafter never saw, and a
+            # ModelDrafter's KV cache would otherwise hold NO rows for
+            # those positions — accept rate silently collapses for the
+            # rest of the request. Survivors committed all `steps`
+            # tokens (an early EOS releases the slot in the loop above).
+            survivors = [i for i in range(len(self.slots))
+                         if pos[i] >= 0 and self.slots[i].active]
+            if survivors:
+                feed = np.vstack([toks[None, :], toks_seq[:-1]])
+                self.drafter.observe_plain(survivors, feed, toks_seq)
         self._note_pool()
         return finished
 
+    # ------------------------------------------------------- speculative
+
+    def _pick_verify_rows(self) -> int:
+        """Verification window (feed token + drafts): exactly the
+        configured window while every active request has budget for it
+        (ONE compiled verify program in steady state), pow2-bucketed
+        only when the min remaining budget clamps it (O(log) extra
+        end-of-request programs) — the appended rows always land inside
+        the slot's admitted pages either way."""
+        active = [s.request for s in self.slots if s.active]
+        rem = min(r.max_new_tokens - len(r.generated) for r in active)
+        cap = min(self.spec_tokens + 1, self.max_tick_steps)
+        if rem >= cap:
+            return cap
+        k = 1
+        while k * 2 <= rem:
+            k *= 2
+        return k
+
+    def _spec_tick(self, V: int, active: List[int]) -> List[Request]:
+        """One speculative round: draft V-1 tokens per active slot,
+        verify the whole window in ONE multi-query dispatch, commit the
+        longest greedy-matching prefix (+ the correction token).
+        Rollback of rejected drafts is a pointer move — the appended
+        rows past the committed position are overwritten by the next
+        round's appends and never read (per-slot pos masking)."""
+        B = len(self.slots)
+        drafts = self.drafter.draft(active, V - 1)        # [n_act, V-1]
+        toks = np.zeros((B, V), np.int32)
+        toks[:, 0] = [s.last_tok for s in self.slots]
+        for row, i in zip(drafts, active):
+            toks[i, 1:] = row
+        pos = np.array([s.pos if s.active else -1 for s in self.slots],
+                       np.int32)
+        t0 = time.monotonic()
+        pool, greedy, logits = self.adapter.verify(
+            self.cache.pool, toks, pos, self.cache.page_table)
+        self.cache.pool = pool
+        greedy = np.asarray(greedy)   # sync-ok: scheduler consumes the
+        #                               verified tokens [B, V]; fences
+        #                               the dispatch, so tick_s is real.
+        #                               logits stay on device — only one
+        #                               row per slot feeds last_logits.
+        tick_s = time.monotonic() - t0
+        n_active = len(active)
+        self.recorder.record("spec_round", rows=V, active=n_active,
+                             tick_s=tick_s)
+        m = self.metrics
+        m.histogram("serving/tick_latency_s").observe(tick_s)
+        m.histogram("serving/slot_utilization").observe(
+            n_active / max(B, 1))
+        self.stats["ticks"] += 1
+        self.stats["tick_steps"] += 1  # one dispatched model step/round
+        self.stats["spec_rounds"] += 1
+        # drafters that keep their own KV state (ModelDrafter) can only
+        # fast-forward through rows they actually appended — the free
+        # correction token is dropped in the all-accepted case
+        aligned = getattr(self.drafter, "aligned", False)
+        finished = []
+        tokens_before = self.stats["decode_tokens"]
+        last_row = np.zeros(B, np.int32)
+        for i in active:
+            slot = self.slots[i]
+            g, d = greedy[i], toks[i]
+            a = 0
+            while a < V - 1 and d[a + 1] == g[a]:
+                a += 1
+            ncommit = a + 1
+            if aligned:
+                ncommit = min(ncommit, V - 1)
+            committed = []
+            for t in range(ncommit):
+                tok = int(g[t])
+                self.stats["decode_tokens"] += 1
+                slot.request.generated.append(tok)
+                slot.pos += 1
+                slot.last_tok = tok
+                committed.append(tok)
+                done = self._maybe_finish(i)
+                if done is not None:
+                    finished.append(done)
+                    break
+            self.stats["spec_proposed"] += V - 1
+            self.stats["spec_accepted"] += min(a, len(committed))
+            last_row[i] = len(committed) - 1
+            if slot.active:
+                self.drafter.commit(i, committed, slot.pos,
+                                    slot.last_tok)
+        # device-side gather of each slot's last committed row — the
+        # last_logits contract without hauling [B, V, vocab] to host
+        self.last_logits = logits[jnp.arange(B), jnp.asarray(last_row)]
+        n_committed = self.stats["decode_tokens"] - tokens_before
+        m.counter("serving/decode_tokens").inc(n_committed)
+        # per-token latency stays live under speculation: one dispatch
+        # commits up to V tokens per slot
+        m.histogram("serving/decode_latency_per_token_s").observe(
+            tick_s / max(n_committed / max(n_active, 1), 1e-9))
+        m.counter("serving/spec_proposed").inc(n_active * (V - 1))
+        m.gauge("serving/spec_accept_rate").set(
+            self.stats["spec_accepted"]
+            / max(self.stats["spec_proposed"], 1))
+        self._note_pool()
+        return finished
+
+    def _decode_step(self) -> List[Request]:
+        """One decode dispatch: the speculative round when a drafter is
+        attached and every active request is greedy, else the plain
+        multi-step tick (speculative verify is greedy-only — sampling
+        would need rejection-sampling verification to stay lossless)."""
+        if self.drafter is None:
+            return self._tick()
+        active = [i for i, s in enumerate(self.slots) if s.active]
+        if any(self.slots[i].request.temperature > 0 for i in active):
+            return self._tick()
+        if self.queue and any(not s.active for s in self.slots):
+            return self._tick()       # admission pending: 1-step tick
+        V = self._pick_verify_rows()
+        if V < 2:
+            return self._tick()
+        return self._spec_tick(V, active)
+
     def step(self, now: Optional[float] = None) -> List[Request]:
         """One scheduler iteration: admit whatever fits, then one decode
-        tick over the active slots. Returns requests finished this step
-        (including any that finished at prefill with max_new_tokens=1)."""
+        tick (or speculative verify round) over the active slots.
+        Returns requests finished this step (including any that finished
+        at prefill with max_new_tokens=1)."""
         finished = self._admit(now)
         if any(s.active for s in self.slots):
-            finished.extend(self._tick())
+            finished.extend(self._decode_step())
         return finished
 
     # ------------------------------------------------------------- serve

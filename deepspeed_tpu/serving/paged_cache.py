@@ -18,10 +18,24 @@ Block 0 is RESERVED as the trash block: idle slots' page-table entries
 (and the pad tail of shorter tables) point at it, so the decode tick's
 append scatter always has a legal target and idle slots can never
 corrupt a live block.
+
+Prefix sharing (``enable_prefix_sharing()``) grows the allocator from
+exclusive ownership to REFCOUNTED shared pages: each admitted request's
+full prompt pages are registered in a chained-hash prefix index, and a
+later request whose prompt matches maps the shared blocks into its own
+page table with an incref instead of allocating + prefilling them. K/V
+pages are append-only, so a full prompt page is immutable once written
+and safe to alias read-only; the first PARTIALLY-filled prompt page is
+shared copy-on-write (the sharer gets a device copy of the page and
+continues writing its own rows there). Release becomes decref;
+refcount-0 registered pages stay RESIDENT as reusable prefix cache and
+are evicted LRU only under pool pressure (or an explicit sweep).
 """
 
 import dataclasses
-from typing import Any, List, Optional
+import hashlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 import jax.numpy as jnp
@@ -88,6 +102,24 @@ class PagedKVCache:
         self.page_table = np.full((spec.slots, spec.max_pages_per_slot),
                                   TRASH_BLOCK, np.int32)
         self._slot_pages: List[List[int]] = [[] for _ in range(spec.slots)]
+        # --- prefix sharing (off until enable_prefix_sharing()) ---
+        self.prefix_sharing = False
+        self._refcount = np.zeros(nb, np.int64)
+        # chain-hash key -> _FullEntry (one immutable full prompt page)
+        self._full_index: Dict[bytes, "_FullEntry"] = {}
+        # chain-hash key of the full-page prefix -> divergent partial
+        # last-prompt-page entries (COW sources)
+        self._partial_index: Dict[bytes, List["_PartialEntry"]] = {}
+        self._block_entry: Dict[int, Any] = {}   # block -> its entry
+        # refcount-0 registered blocks, LRU order (resident prefix cache)
+        self._evictable: "OrderedDict[int, None]" = OrderedDict()
+        self.prefix_stats = {"hit_pages": 0, "cow_hits": 0,
+                             "cow_rows": 0, "fresh_pages": 0,
+                             "evictions": 0, "registered": 0,
+                             "shared_admissions": 0, "admissions": 0}
+
+    def enable_prefix_sharing(self) -> None:
+        self.prefix_sharing = True
 
     # ---------------------------------------------------- host accounting
 
@@ -95,8 +127,31 @@ class PagedKVCache:
     def free_pages(self) -> int:
         return len(self._free)
 
+    @property
+    def cached_pages(self) -> int:
+        """Refcount-0 registered pages held resident as prefix cache."""
+        return len(self._evictable)
+
+    @property
+    def available_pages(self) -> int:
+        """Pages an admission could obtain: free + evictable cache."""
+        return len(self._free) + len(self._evictable)
+
     def pages_needed(self, total_tokens: int) -> int:
         return -(-total_tokens // self.spec.page_size)
+
+    def _take_fresh(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` blocks from the free list, evicting LRU refcount-0
+        prefix entries to cover a shortfall. None (nothing taken) when
+        even eviction can't cover it."""
+        if n > len(self._free) + len(self._evictable):
+            return None
+        while len(self._free) < n:
+            blk, _ = self._evictable.popitem(last=False)   # LRU
+            self._unregister(blk)
+            self._free.append(blk)
+            self.prefix_stats["evictions"] += 1
+        return [self._free.pop() for _ in range(n)]
 
     def admit(self, slot: int, total_tokens: int) -> Optional[List[int]]:
         """Allocate pages covering ``total_tokens`` rows into ``slot``'s
@@ -108,20 +163,273 @@ class PagedKVCache:
             f"{self.spec.max_pages_per_slot} (page_size "
             f"{self.spec.page_size})")
         assert not self._slot_pages[slot], f"slot {slot} already admitted"
-        if n > len(self._free):
+        pages = self._take_fresh(n)
+        if pages is None:
             return None
-        pages = [self._free.pop() for _ in range(n)]
+        self._refcount[pages] = 1
         self._slot_pages[slot] = pages
         row = self.page_table[slot]
         row[:] = TRASH_BLOCK
         row[:n] = pages
+        self.prefix_stats["admissions"] += 1
+        self.prefix_stats["fresh_pages"] += n
         return pages
 
     def release(self, slot: int) -> None:
-        """Return ``slot``'s pages to the free list (on EOS/finish)."""
-        self._free.extend(self._slot_pages[slot])
+        """Decref ``slot``'s pages (on EOS/finish). Pages reaching
+        refcount 0 return to the free list — unless they are registered
+        prefix entries, which stay resident (evictable) so a later
+        request with the same prompt prefix can re-share them."""
+        for blk in self._slot_pages[slot]:
+            self._refcount[blk] -= 1
+            assert self._refcount[blk] >= 0, f"block {blk} over-released"
+            if self._refcount[blk] == 0:
+                if blk in self._block_entry:
+                    self._evictable[blk] = None   # newest = MRU end
+                else:
+                    self._free.append(blk)
         self._slot_pages[slot] = []
         self.page_table[slot, :] = TRASH_BLOCK
 
     def slot_pages(self, slot: int) -> List[int]:
         return list(self._slot_pages[slot])
+
+    # ------------------------------------------------------ prefix index
+
+    def _page_hashes(self, prompt: np.ndarray) -> List[bytes]:
+        """Chained page-granularity hashes: h_i covers tokens
+        [0, (i+1) * page_size) — a full page of K/V is reusable only if
+        EVERY token before its end matches, since row t's K/V depends on
+        tokens 0..t."""
+        P = self.spec.page_size
+        out, h = [], b""
+        for i in range(len(prompt) // P):
+            h = hashlib.sha1(h + prompt[i * P:(i + 1) * P]
+                             .tobytes()).digest()
+            out.append(h)
+        return out
+
+    def match_prefix(self, prompt: np.ndarray,
+                     cow: bool = True) -> "PrefixMatch":
+        """Longest resident prefix for ``prompt``: shared FULL pages
+        (hash-chain walk, content-verified) plus an optional partial
+        last-prompt-page COW source (skipped entirely when ``cow`` is
+        off — page-aligned sharing only, no phantom COW stats). At
+        least one suffix token is always left for prefill — the
+        admission needs last-position logits. The full hash chain rides
+        the returned match so register_prefix can reuse it instead of
+        rehashing the prompt."""
+        prompt = np.asarray(prompt, np.int32)  # sync-ok: host prompt
+        S = len(prompt)
+        P = self.spec.page_size
+        shared: List[int] = []
+        chain = b""
+        hashes = self._page_hashes(prompt)
+        # a fully matched prompt still recomputes its last page, so the
+        # walk stops at (S-1)//P full pages
+        limit = (S - 1) // P
+        for i, h in enumerate(hashes[:limit]):
+            ent = self._full_index.get(h)
+            if ent is None or not np.array_equal(
+                    ent.tokens, prompt[i * P:(i + 1) * P]):
+                break
+            shared.append(ent.block)
+            chain = h
+        cow_src = None
+        if cow and len(shared) == limit and limit == S // P:
+            # full pages all matched and the prompt's last page is
+            # partial — look for a divergent-partial COW source
+            rest = prompt[len(shared) * P:]
+            best_r = 0
+            for ent in self._partial_index.get(chain, []):
+                m = min(len(ent.tokens), len(rest) - 1)
+                if m <= 0:
+                    continue
+                r = int(np.argmin(ent.tokens[:m] == rest[:m])) \
+                    if not np.array_equal(ent.tokens[:m], rest[:m]) \
+                    else m
+                if r > best_r:
+                    best_r, cow_src = r, (ent.block, r)
+        return PrefixMatch(shared_blocks=shared, cow=cow_src,
+                           start_pos=len(shared) * P
+                           + (cow_src[1] if cow_src else 0),
+                           hashes=hashes)
+
+    def admit_prefix(self, slot: int, prompt: np.ndarray,
+                     total_tokens: int,
+                     cow: bool = True) -> Optional["AdmitPlan"]:
+        """Prefix-sharing admission: map the matched resident prefix
+        pages into ``slot``'s table (incref, zero allocation, zero
+        prefill for the shared span), allocate fresh pages for the rest.
+        Returns the plan, or None (nothing allocated/increffed) when
+        fresh pages can't be covered even after eviction."""
+        assert self.prefix_sharing, "enable_prefix_sharing() first"
+        assert not self._slot_pages[slot], f"slot {slot} already admitted"
+        n = self.pages_needed(total_tokens)
+        assert n <= self.spec.max_pages_per_slot
+        m = self.match_prefix(prompt, cow=cow)
+        n_shared = len(m.shared_blocks)
+        # pin the matched blocks (and the read-once COW source) out of
+        # the evictable set BEFORE taking fresh pages — the shortfall
+        # eviction must never reap a block this admission is sharing
+        cow_src = m.cow[0] if m.cow is not None else None
+        pinned = []
+        for b in m.shared_blocks + ([cow_src] if cow_src is not None
+                                    else []):
+            if b in self._evictable:
+                del self._evictable[b]
+                pinned.append(b)
+        fresh = self._take_fresh(n - n_shared)
+        if fresh is None:
+            for blk in pinned:                   # undo: nothing taken
+                self._evictable[blk] = None
+            return None
+        for blk in m.shared_blocks:
+            self._refcount[blk] += 1
+        if cow_src is not None and self._refcount[cow_src] == 0:
+            # the COW source is only READ (once, at the copy) — it goes
+            # back resident at the MRU end, not owned by this slot
+            self._evictable[cow_src] = None
+        self._refcount[fresh] = 1
+        pages = m.shared_blocks + fresh
+        self._slot_pages[slot] = pages
+        row = self.page_table[slot]
+        row[:] = TRASH_BLOCK
+        row[:n] = pages
+        st = self.prefix_stats
+        st["admissions"] += 1
+        st["hit_pages"] += n_shared
+        st["fresh_pages"] += n - n_shared
+        if n_shared or m.cow:
+            st["shared_admissions"] += 1
+        cow_plan = None
+        if m.cow is not None:
+            src, r = m.cow
+            cow_plan = (src, fresh[0], r)
+            st["cow_hits"] += 1
+            st["cow_rows"] += r
+        return AdmitPlan(pages=pages, start_pos=m.start_pos,
+                         cow=cow_plan, hashes=m.hashes)
+
+    def register_prefix(self, slot: int, prompt: np.ndarray,
+                        hashes: Optional[List[bytes]] = None) -> int:
+        """Register ``slot``'s prompt pages in the prefix index (after
+        prefill wrote them): every full prompt page becomes a shareable
+        read-only entry, the partial last prompt page (if any) a COW
+        source. Already-indexed content is skipped. Returns the number
+        of new entries. Pass the hash chain from the admission's
+        AdmitPlan to skip rehashing the prompt."""
+        assert self.prefix_sharing
+        prompt = np.asarray(prompt, np.int32)  # sync-ok: host prompt
+        P = self.spec.page_size
+        pages = self._slot_pages[slot]
+        added, chain = 0, b""
+        if hashes is None:
+            hashes = self._page_hashes(prompt)
+        for i, h in enumerate(hashes):
+            blk = pages[i]
+            if h not in self._full_index and blk not in self._block_entry:
+                ent = _FullEntry(block=blk, key=h,
+                                 tokens=prompt[i * P:(i + 1) * P].copy())
+                self._full_index[h] = ent
+                self._block_entry[blk] = ent
+                added += 1
+            chain = h
+        r = len(prompt) % P
+        if r:
+            blk = pages[len(prompt) // P]
+            toks = prompt[len(prompt) - r:].copy()
+            peers = self._partial_index.setdefault(chain, [])
+            dup = any(len(e.tokens) >= r
+                      and np.array_equal(e.tokens[:r], toks)
+                      for e in peers)
+            if not dup and blk not in self._block_entry:
+                ent = _PartialEntry(block=blk, chain=chain, tokens=toks)
+                peers.append(ent)
+                self._block_entry[blk] = ent
+                added += 1
+        self.prefix_stats["registered"] += added
+        return added
+
+    def _unregister(self, blk: int) -> None:
+        ent = self._block_entry.pop(blk, None)
+        if ent is None:
+            return
+        if isinstance(ent, _FullEntry):
+            self._full_index.pop(ent.key, None)
+        else:
+            peers = self._partial_index.get(ent.chain, [])
+            if ent in peers:
+                peers.remove(ent)
+            if not peers:
+                self._partial_index.pop(ent.chain, None)
+
+    def sweep_prefix_cache(self) -> int:
+        """Evict EVERY refcount-0 resident prefix entry back to the free
+        list (the leak-test / shutdown fence: after a drained workload +
+        sweep, free_pages must equal the allocatable pool)."""
+        n = 0
+        while self._evictable:
+            blk, _ = self._evictable.popitem(last=False)
+            self._unregister(blk)
+            self._free.append(blk)
+            n += 1
+        return n
+
+
+@dataclasses.dataclass(frozen=True)
+class _FullEntry:
+    block: int
+    key: bytes
+    tokens: np.ndarray            # the page's P prompt tokens
+
+
+@dataclasses.dataclass(frozen=True)
+class _PartialEntry:
+    block: int
+    chain: bytes                  # hash of the full-page prefix before it
+    tokens: np.ndarray            # the page's PARTIAL prompt tokens
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefixMatch:
+    shared_blocks: List[int]
+    cow: Optional[Tuple[int, int]]     # (source block, matched rows)
+    start_pos: int                     # prefill resumes here
+    hashes: List[bytes] = dataclasses.field(default_factory=list)
+    #                                  # full chain, for register_prefix
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmitPlan:
+    pages: List[int]
+    start_pos: int
+    cow: Optional[Tuple[int, int, int]]  # (src block, dst block, rows)
+    hashes: List[bytes] = dataclasses.field(default_factory=list)
+
+
+def pow2_page_bucket(need: int, max_pages: int) -> int:
+    """Next-pow2 bucket of a page count, clamped to the position budget
+    — prefill programs compile O(log max_pages) variants, not one per
+    prompt length. ONE rule shared by padded_prefill_inputs and the
+    engine's suffix/prefix bucket picks so they can't drift apart."""
+    b = 1
+    while b < need:
+        b *= 2
+    return min(b, max_pages)
+
+
+def padded_prefill_inputs(prompt: np.ndarray, pages: List[int],
+                          page_size: int, max_pages: int):
+    """Pow2-bucketed prefill inputs: token ids zero-padded to the page
+    bucket, page vector TRASH-padded to the same bucket. ONE contract
+    shared by the engine's admission prefill and the ModelDrafter's
+    mirror prefill so the page-padding rules can't drift apart."""
+    S = len(prompt)
+    n_pages = pow2_page_bucket(max(1, -(-S // page_size)), max_pages)
+    ids = np.zeros((1, n_pages * page_size), np.int32)
+    ids[0, :S] = prompt
+    page_vec = np.full((n_pages,), TRASH_BLOCK, np.int32)
+    k = min(n_pages, len(pages))
+    page_vec[:k] = pages[:k]
+    return ids, page_vec

@@ -141,11 +141,12 @@ def render_requests(events, out):
     reqs = OrderedDict()           # rid -> fields
     ticks = 0
     tick_steps = 0
+    spec_rounds = 0
     exhausted = 0
     t0 = None
     for ev in events:
         kind = ev.get("kind")
-        if kind in ("admit", "prefill", "finish", "tick",
+        if kind in ("admit", "prefill", "finish", "tick", "spec_round",
                     "pool_exhausted") and t0 is None:
             t0 = ev.get("ts")
         if kind == "admit":
@@ -166,6 +167,12 @@ def render_requests(events, out):
         elif kind == "tick":
             ticks += 1
             tick_steps += ev.get("steps") or 0
+        elif kind == "spec_round":
+            # one speculative verify dispatch = one decode step that
+            # commits up to rows tokens
+            ticks += 1
+            tick_steps += 1
+            spec_rounds += 1
         elif kind == "pool_exhausted":
             exhausted += 1
     if not reqs and not ticks:
@@ -173,6 +180,8 @@ def render_requests(events, out):
     out.append("")
     out.append(f"serving: {len(reqs)} requests in window, {ticks} ticks"
                f" ({tick_steps} decode steps)"
+               + (f", {spec_rounds} speculative verify rounds"
+                  if spec_rounds else "")
                + (f", {exhausted} pool-exhausted admissions"
                   if exhausted else ""))
     if not reqs:

@@ -15,7 +15,12 @@ Scope (the per-step hot paths):
 - ``deepspeed_tpu/parallel/*.py`` (overlap buckets, prefetch pipeline,
   mesh/attention helpers traced into train steps),
 - ``deepspeed_tpu/serving/*.py`` (the continuous-batching scheduler,
-  including its watchdog hooks),
+  including its watchdog hooks; ISSUE 9 grows this glob's coverage to
+  the speculative drafters ``drafter.py`` — the ngram drafter must
+  stay pure-host and the model drafter's proposal readback is the
+  scheduler's existing sampled-token fence — and the prefix-index/COW
+  admission path in ``paged_cache.py``, whose page-content probes are
+  deliberate host verification at admission, not per-tick syncs),
 - ``deepspeed_tpu/telemetry/*.py`` (recording must never sync — ISSUE
   6 extends this to the flight recorder ``recorder.py``, the anomaly
   watchdog ``anomaly.py`` and the dump viewer ``view.py``: rule
