@@ -18,6 +18,11 @@
 #   3  at least one metric regressed (bench.py's compare exit code)
 #   2  usage / unreadable input
 #
+# The gated metric set is bench.py's headline_metrics(); since r09 it
+# includes ``onebit_comm.bytes_reduction`` (ISSUE 10: the hierarchical
+# exchange's slow-hop bytes-on-wire reduction, >= 4x — gate against
+# BENCH_r09.json or newer to arm it).
+#
 # The --candidate path never imports jax and finishes in <2 s, so this
 # runs on artifact files on any CI box. Typical wiring:
 #

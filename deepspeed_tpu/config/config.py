@@ -719,6 +719,79 @@ class ServingConfig:
                 f"{self.slots + 1} (fully-provisioned: {min_blocks})")
 
 
+class CommHierarchyConfig:
+    """``comm.hierarchy`` block (ISSUE 10): link-aware two-level
+    gradient exchange for the 1-bit compressed train path — the fast
+    (ICI-class) axis exchanges uncompressed, only the slow (DCN-class)
+    inter-host hop carries sign bits. Presence of the block enables it;
+    ``slow_axis`` 0 derives the split from real process boundaries,
+    >1 forces a synthetic split for single-process testing."""
+
+    def __init__(self, d):
+        if d is not None and not isinstance(d, dict):
+            raise DeepSpeedConfigError(
+                f"comm.{C.COMM_HIERARCHY} must be a dict with keys "
+                f"[{C.COMM_HIERARCHY_ENABLED}, {C.COMM_HIERARCHY_SLOW_AXIS},"
+                f" {C.COMM_HIERARCHY_COMPRESSION}, "
+                f"{C.COMM_HIERARCHY_MIN_BUCKET_BYTES}], got {d!r}")
+        self.enabled = d is not None and bool(
+            d.get(C.COMM_HIERARCHY_ENABLED, C.COMM_HIERARCHY_ENABLED_DEFAULT))
+        d = d or {}
+        slow = d.get(C.COMM_HIERARCHY_SLOW_AXIS,
+                     C.COMM_HIERARCHY_SLOW_AXIS_DEFAULT)
+        if slow in ("auto", None):
+            slow = 0
+        try:
+            self.slow_axis = int(slow)
+        except (TypeError, ValueError):
+            raise DeepSpeedConfigError(
+                f"comm.hierarchy.{C.COMM_HIERARCHY_SLOW_AXIS} must be "
+                f"0, \"auto\", or an integer >= 2, got {slow!r}")
+        if self.slow_axis < 0 or self.slow_axis == 1:
+            raise DeepSpeedConfigError(
+                f"comm.hierarchy.{C.COMM_HIERARCHY_SLOW_AXIS} must be 0 "
+                f"(auto: process boundaries) or >= 2 (synthetic split), "
+                f"got {self.slow_axis}")
+        self.compression = str(d.get(C.COMM_HIERARCHY_COMPRESSION,
+                                     C.COMM_HIERARCHY_COMPRESSION_DEFAULT))
+        if self.compression not in C.COMM_HIERARCHY_COMPRESSION_MODES:
+            raise DeepSpeedConfigError(
+                f"comm.hierarchy.{C.COMM_HIERARCHY_COMPRESSION} must be "
+                f"one of {list(C.COMM_HIERARCHY_COMPRESSION_MODES)}, got "
+                f"{self.compression!r}")
+        try:
+            self.min_bucket_bytes = int(
+                d.get(C.COMM_HIERARCHY_MIN_BUCKET_BYTES,
+                      C.COMM_HIERARCHY_MIN_BUCKET_BYTES_DEFAULT))
+        except (TypeError, ValueError):
+            raise DeepSpeedConfigError(
+                f"comm.hierarchy.{C.COMM_HIERARCHY_MIN_BUCKET_BYTES} "
+                f"must be an integer byte count, got "
+                f"{d.get(C.COMM_HIERARCHY_MIN_BUCKET_BYTES)!r}")
+        if self.min_bucket_bytes < 0:
+            raise DeepSpeedConfigError(
+                f"comm.hierarchy.{C.COMM_HIERARCHY_MIN_BUCKET_BYTES} must "
+                f"be >= 0, got {self.min_bucket_bytes}")
+
+    def __repr__(self):
+        return (f"CommHierarchyConfig(enabled={self.enabled}, "
+                f"slow_axis={self.slow_axis}, "
+                f"compression={self.compression!r}, "
+                f"min_bucket_bytes={self.min_bucket_bytes})")
+
+
+class CommConfig:
+    """Top-level ``comm`` block (tpu-native; the reference's comm knobs
+    ride the optimizer/backend objects instead)."""
+
+    def __init__(self, param_dict):
+        d = param_dict.get(C.COMM, {})
+        if not isinstance(d, dict):
+            raise DeepSpeedConfigError(
+                f"{C.COMM} must be a dict, got {d!r}")
+        self.hierarchy = CommHierarchyConfig(d.get(C.COMM_HIERARCHY, None))
+
+
 class MeshConfigSection:
     """tpu-native: logical mesh axis sizes. -1 on the data axis means
     "whatever is left" after the explicit axes divide the device count."""
@@ -847,6 +920,7 @@ class DeepSpeedConfig:
         self.pipeline_config = PipelineConfig(pd)
         self.mesh_config = MeshConfigSection(pd)
         self.serving_config = ServingConfig(pd)
+        self.comm_config = CommConfig(pd)
 
         self.gradient_clipping = pd.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
 

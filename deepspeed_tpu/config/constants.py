@@ -309,6 +309,25 @@ MESH_PIPE = "pipe"
 MESH_SEQ = "seq"
 MESH_EXPERT = "expert"
 
+# Hierarchical link-aware gradient communication (ISSUE 10): the
+# ``comm.hierarchy`` block splits the data axis at the host/process
+# boundary so the 1-bit compressed exchange pays sign bits only on the
+# slow DCN-class hop. Presence of the hierarchy block enables it.
+COMM = "comm"
+COMM_HIERARCHY = "hierarchy"
+COMM_HIERARCHY_ENABLED = "enabled"
+COMM_HIERARCHY_ENABLED_DEFAULT = True
+# 0 = auto: derive the slow-axis size from jax.distributed process
+# boundaries; >1 = synthetic split into that many slow groups (the
+# single-process testing override).
+COMM_HIERARCHY_SLOW_AXIS = "slow_axis"
+COMM_HIERARCHY_SLOW_AXIS_DEFAULT = 0
+COMM_HIERARCHY_COMPRESSION = "compression"
+COMM_HIERARCHY_COMPRESSION_DEFAULT = "auto"
+COMM_HIERARCHY_COMPRESSION_MODES = ("auto", "always", "never")
+COMM_HIERARCHY_MIN_BUCKET_BYTES = "min_bucket_bytes"
+COMM_HIERARCHY_MIN_BUCKET_BYTES_DEFAULT = 1 << 16
+
 PIPELINE = "pipeline"
 PIPELINE_STAGES = "stages"
 PIPELINE_PARTITION = "partition"

@@ -104,6 +104,51 @@ def compressed_allreduce(buf, worker_error, server_error, axis_name):
     return out.reshape(buf.shape), new_worker_error, new_server_error
 
 
+def hierarchical_allreduce(buf, inter_axis, intra_axis):
+    """Exact two-level mean-allreduce of ``buf`` (the uncompressed leg of
+    the link-aware exchange, ISSUE 10): ring reduce-scatter over the fast
+    ``intra_axis`` (each device ends with one chunk of the intra-group
+    sum), one mean over the slow ``inter_axis`` of just that chunk (XLA
+    picks the algorithm for the DCN-class hop), ring all-gather back over
+    the fast axis. Must run inside shard_map binding both axes;
+    ``buf.size`` must divide by the intra axis size. Matches a flat pmean
+    over both axes to fp32 ring-order rounding."""
+    from deepspeed_tpu.parallel import overlap
+    k = mesh_lib.axis_size(intra_axis)
+    shard = overlap.ring_reduce_scatter(buf, intra_axis, k)
+    shard = jax.lax.pmean(shard, inter_axis) * np.float32(1.0 / k)
+    return overlap.ring_all_gather(shard, intra_axis, k).reshape(buf.shape)
+
+
+def hierarchical_compressed_allreduce(buf, worker_error, server_error,
+                                      inter_axis, intra_axis):
+    """Link-aware 1-bit mean-allreduce (ISSUE 10): only the slow
+    inter-host hop is compressed.
+
+      1. ring reduce-scatter over the fast ``intra_axis`` (uncompressed —
+         ICI-class links, compression would cost more than it saves) and
+         fold in the intra mean: each device holds chunk ``intra_index``
+         of its group's mean;
+      2. the error-compensated 1-bit exchange (`compressed_allreduce`) of
+         that chunk over the slow ``inter_axis`` — sign bits + one scale
+         on the DCN-class wire, ~32x fewer payload bytes than fp32;
+      3. ring all-gather over the fast axis to rebuild the full buffer.
+
+    Per-device error state is chunk-shaped: ``worker_error``
+    [numel/intra], ``server_error`` [numel/(intra*inter)]; ``buf.size``
+    must divide by 8*inter*intra (pad via `padded_numel(numel,
+    inter*intra)`). Returns (approx_mean, new_worker_error,
+    new_server_error) — the result is identical on every device."""
+    from deepspeed_tpu.parallel import overlap
+    k = mesh_lib.axis_size(intra_axis)
+    shard = overlap.ring_reduce_scatter(buf, intra_axis, k) \
+        * np.float32(1.0 / k)
+    red, we2, se2 = compressed_allreduce(shard, worker_error, server_error,
+                                         inter_axis)
+    return (overlap.ring_all_gather(red, intra_axis, k).reshape(buf.shape),
+            we2, se2)
+
+
 def padded_numel(numel, axis_size):
     """Smallest buffer size >= numel divisible by 8*axis_size."""
     q = 8 * axis_size

@@ -33,6 +33,13 @@ EXPERT_AXIS = "expert"
 
 AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
 
+# The hierarchical comm split (ISSUE 10): the data axis factored at the
+# host/process boundary into a slow DCN-class outer axis and a fast
+# ICI-class inner axis. Only the explicit-comm train programs see these
+# names (split_data_axis below); state at rest stays on DATA_AXIS.
+DATA_INTER_AXIS = "data_inter"
+DATA_INTRA_AXIS = "data_intra"
+
 
 # ---------------------------------------------------------------------------
 # shard_map compat shim
@@ -302,6 +309,36 @@ def make_mesh(config: Optional[MeshConfig] = None,
     except Exception:
         dev_array = np.asarray(devices).reshape(shape)  # sync-ok: host device list
     return Mesh(dev_array, axis_names=tuple(axis_order))
+
+
+def split_data_axis(mesh: Mesh, inter: int) -> Mesh:
+    """Mesh with the data axis factored into ``(data_inter, data_intra)``
+    — same devices in the same order (row-major split, so the ``intra``
+    fast-axis neighbors are the devices that were contiguous along the
+    original data axis: one host's local devices when the data axis is
+    laid out host-major). Resharding an array between the two meshes is
+    metadata-only — no device ever changes which elements it holds."""
+    names = list(mesh.axis_names)
+    di = names.index(DATA_AXIS)
+    n = mesh.devices.shape[di]
+    assert inter > 0 and n % inter == 0, (
+        f"data axis {n} not divisible by inter={inter}")
+    shape = list(mesh.devices.shape)
+    shape[di:di + 1] = [inter, n // inter]
+    names[di:di + 1] = [DATA_INTER_AXIS, DATA_INTRA_AXIS]
+    return Mesh(mesh.devices.reshape(shape), tuple(names))
+
+
+def linear_axis_index(axis):
+    """`jax.lax.axis_index` linearized over one bound axis name or an
+    (outer, ..., inner) tuple — the device's odometer rank over the named
+    axes (the pinned 0.4.37 axis_index takes a single name only)."""
+    if isinstance(axis, (tuple, list)):
+        idx = jax.lax.axis_index(axis[0])
+        for a in axis[1:]:
+            idx = idx * axis_size(a) + jax.lax.axis_index(a)
+        return idx
+    return jax.lax.axis_index(axis)
 
 
 def single_device_mesh() -> Mesh:

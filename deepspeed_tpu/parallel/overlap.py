@@ -283,6 +283,165 @@ def bucketed_compressed_allreduce(tree, worker_errors, server_errors,
     return jax.tree_util.tree_unflatten(treedef, out), new_we, new_se
 
 
+# ---------------------------------------------------------------------------
+# hierarchical link-aware exchange (ISSUE 10): per-bucket compression
+# policy over a slow/fast split of the data axis
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class HierarchyPlan:
+    """Static link-aware comm plan for the bucketed exchange: the data
+    axis split into ``inter`` slow-link groups (DCN-class,
+    ``inter_axis``) of ``intra`` fast-link devices (ICI-class,
+    ``intra_axis``), plus the per-bucket compression policy —
+    ``"always"``/``"never"``, or ``"auto"``: compress only buckets whose
+    fp32 payload clears ``min_bucket_bytes`` (small buckets pay more in
+    scale overhead + pack/unpack than the sign bits save)."""
+    inter_axis: str
+    intra_axis: str
+    inter: int
+    intra: int
+    compression: str = "auto"
+    min_bucket_bytes: int = 1 << 16
+    bucket_elems: int = int(5e8)
+
+    @property
+    def axes(self):
+        return (self.inter_axis, self.intra_axis)
+
+    @property
+    def world(self):
+        return self.inter * self.intra
+
+
+def plan_bucket_compression(buckets, plan: HierarchyPlan):
+    """Per-bucket compress/no-compress decision (host-side, static at
+    trace time — the link assignment itself is the plan's axis split).
+    Pure: the engine breadcrumbs the plan once per compile
+    (`comm_hierarchy_plan`), since this runs from several callers
+    (error-state init, the traced exchange, the wire model)."""
+    if plan.compression == "always":
+        return [True] * len(buckets)
+    if plan.compression == "never":
+        return [False] * len(buckets)
+    return [b.padded * 4 >= plan.min_bucket_bytes for b in buckets]
+
+
+def bucketed_hierarchical_mean(tree, plan: HierarchyPlan):
+    """Exact two-level mean of a gradient pytree riding the bucket
+    stream (the warmup-phase exchange of the hierarchical 1-bit path):
+    per bucket, ring reduce-scatter over the fast axis → pmean of the
+    chunk over the slow axis → ring all-gather. Must run inside
+    shard_map binding both plan axes."""
+    from deepspeed_tpu.parallel import compression as comp
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    buckets = plan_buckets([l.shape for l in leaves], plan.bucket_elems,
+                           plan.world)
+    out: List[Optional[jax.Array]] = [None] * len(leaves)
+    for bucket in buckets:
+        flat = _pack_bucket(leaves, bucket)
+        flat = comp.hierarchical_allreduce(flat, plan.inter_axis,
+                                           plan.intra_axis)
+        _unpack_bucket(flat, leaves, bucket, out)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def bucketed_hierarchical_compressed_allreduce(tree, worker_errors,
+                                               server_errors,
+                                               plan: HierarchyPlan):
+    """Policy-driven link-aware mean-allreduce of a pytree over the
+    bucket stream: buckets the policy compresses run the two-level 1-bit
+    exchange (`compression.hierarchical_compressed_allreduce` — slow-axis
+    sign bits with error feedback); the rest run the exact two-level
+    mean. ``worker_errors``/``server_errors`` are per-bucket lists (None
+    entries for uncompressed buckets — see `hierarchical_error_states`).
+    Returns (mean_tree, new_worker_errors, new_server_errors)."""
+    from deepspeed_tpu.parallel import compression as comp
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    buckets = plan_buckets([l.shape for l in leaves], plan.bucket_elems,
+                           plan.world)
+    flags = plan_bucket_compression(buckets, plan)
+    assert len(worker_errors) == len(buckets), \
+        (len(worker_errors), len(buckets))
+    out: List[Optional[jax.Array]] = [None] * len(leaves)
+    new_we, new_se = [], []
+    for bucket, flag, we, se in zip(buckets, flags, worker_errors,
+                                    server_errors):
+        flat = _pack_bucket(leaves, bucket)
+        if flag:
+            pn = comp.padded_numel(bucket.padded, plan.world)
+            if pn != flat.size:
+                flat = jnp.zeros((pn,), jnp.float32) \
+                    .at[:flat.size].set(flat)
+            red, we2, se2 = comp.hierarchical_compressed_allreduce(
+                flat, we, se, plan.inter_axis, plan.intra_axis)
+            red = red[:bucket.padded]
+        else:
+            red = comp.hierarchical_allreduce(flat, plan.inter_axis,
+                                              plan.intra_axis)
+            we2, se2 = we, se
+        new_we.append(we2)
+        new_se.append(se2)
+        _unpack_bucket(red, leaves, bucket, out)
+    return jax.tree_util.tree_unflatten(treedef, out), new_we, new_se
+
+
+def hierarchical_error_states(params, plan: HierarchyPlan):
+    """Zero error-feedback state aligned with the bucket plan AND the
+    compression policy of ``params``: compressed buckets carry
+    chunk-shaped worker [pn/intra] and server [pn/(intra*inter)] errors;
+    uncompressed buckets carry None (nothing to compensate — the None
+    rides the pytree as empty structure through the phase cond)."""
+    from deepspeed_tpu.parallel import compression as comp
+    leaves = jax.tree_util.tree_leaves(params)
+    buckets = plan_buckets([l.shape for l in leaves], plan.bucket_elems,
+                           plan.world)
+    flags = plan_bucket_compression(buckets, plan)
+    wes, ses = [], []
+    for bucket, flag in zip(buckets, flags):
+        if not flag:
+            wes.append(None)
+            ses.append(None)
+            continue
+        pn = comp.padded_numel(bucket.padded, plan.world)
+        wes.append(jnp.zeros((pn // plan.intra,), jnp.float32))
+        ses.append(jnp.zeros((pn // plan.world,), jnp.float32))
+    return wes, ses
+
+
+def hierarchy_wire_bytes(buckets, flags, plan: HierarchyPlan):
+    """Trace-time bytes-on-wire cost model (per device, per step) for
+    the hierarchical exchange — what the telemetry counters
+    ``comm/bytes_on_wire/{intra,inter}`` advance by each step.
+
+    Ring formulas: the fast-axis reduce-scatter + all-gather move
+    2(k-1) fp32 chunks of pn/k elements per device; the slow-axis hop
+    moves, uncompressed, a ring allreduce of the pn/k chunk
+    (2·(ni-1)/ni·4 bytes/elem), or compressed, the packed sign bitmaps
+    both ways (all_to_all + server all-gather, (ni-1)/ni·pn/(8k) bytes
+    each) plus 2(ni-1) fp32 scales. ``inter_uncompressed`` is the
+    would-have-been fp32 cost of the same slow hop — the compression
+    denominator the bench's bytes_reduction headline divides by."""
+    from deepspeed_tpu.parallel import compression as comp
+    k, ni = plan.intra, plan.inter
+    intra = inter = inter_unc = 0
+    for bucket, flag in zip(buckets, flags):
+        pn = comp.padded_numel(bucket.padded, plan.world) if flag \
+            else bucket.padded
+        c = pn // k
+        intra += 2 * (k - 1) * c * 4
+        unc = 2 * c * 4 * (ni - 1) // ni
+        if flag:
+            inter += 2 * (c // 8) * (ni - 1) // ni + 2 * (ni - 1) * 4
+        else:
+            inter += unc
+        inter_unc += unc
+    return {"intra": int(intra), "inter": int(inter),
+            "inter_uncompressed": int(inter_unc)}
+
+
 def compressed_error_states(params, axis_size: int, bucket_elems: int):
     """Zero error-feedback state aligned with the bucket plan of ``params``
     (worker [padded_numel], server [padded_numel/axis] per bucket)."""

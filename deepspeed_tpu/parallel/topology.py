@@ -9,6 +9,8 @@ accessor surface (get_stage_id, get_data_parallel_rank, …) but is backed by a
 `jax.sharding.Mesh` when one is supplied.
 """
 
+import dataclasses
+import itertools
 from collections import namedtuple
 
 import numpy as np
@@ -97,6 +99,71 @@ class ProcessTopology:
 
     def __str__(self):
         return str(self.mapping)
+
+
+@dataclasses.dataclass(frozen=True)
+class DataAxisHierarchy:
+    """A two-level split of the mesh data axis for link-aware comm
+    (ISSUE 10): ``inter`` slow-link groups (DCN-class hops between
+    hosts/processes) of ``intra`` fast-link devices each (ICI-class hops
+    inside a host). ``source`` records how the split was derived —
+    ``"process"`` (real jax.distributed process boundaries) or
+    ``"override"`` (the ``comm.hierarchy.slow_axis`` synthetic split for
+    single-process testing)."""
+    inter: int
+    intra: int
+    source: str
+
+
+def data_axis_devices(mesh, data_axis="data"):
+    """The device sequence along ``data_axis`` (other coordinates fixed
+    at 0), in mesh order — the ordering the hierarchy split and the
+    explicit ring programs both walk."""
+    if data_axis not in mesh.axis_names:
+        return []
+    devs = np.moveaxis(mesh.devices,
+                       list(mesh.axis_names).index(data_axis), 0)
+    return list(devs.reshape(devs.shape[0], -1)[:, 0])
+
+
+def derive_data_hierarchy(mesh, slow_axis=0, data_axis="data"):
+    """Resolve the slow/fast split of ``mesh``'s data axis.
+
+    ``slow_axis > 1`` forces a synthetic split into that many slow-link
+    groups (single-process testing of the multi-host exchange — the
+    config override); ``slow_axis`` 0 derives the split from the REAL
+    process boundaries: the devices along the data axis must form
+    contiguous, equal-sized, per-process blocks (what
+    ``jax.distributed.initialize`` + a host-major mesh produce).
+
+    Returns ``(DataAxisHierarchy, "")`` on success or ``(None, reason)``
+    when no slow axis exists / the placement cannot be split — callers
+    fall back loudly to the flat exchange."""
+    n = mesh.shape.get(data_axis, 1) if hasattr(mesh, "shape") else 1
+    if n <= 1:
+        return None, f"data axis has size {n} (nothing to split)"
+    if slow_axis and int(slow_axis) > 1:
+        s = int(slow_axis)
+        if n % s != 0:
+            return None, (f"slow_axis override {s} does not divide the "
+                          f"data axis size {n}")
+        return DataAxisHierarchy(inter=s, intra=n // s,
+                                 source="override"), ""
+    procs = [getattr(d, "process_index", 0)
+             for d in data_axis_devices(mesh, data_axis)]
+    blocks = [(p, len(list(g))) for p, g in itertools.groupby(procs)]
+    if len(blocks) <= 1:
+        return None, ("single process on the data axis — no slow links "
+                      "(set comm.hierarchy.slow_axis for a synthetic "
+                      "split)")
+    if len({p for p, _ in blocks}) != len(blocks):
+        return None, ("process placement along the data axis is not "
+                      "contiguous (a process's devices interleave with "
+                      "another's)")
+    if len({ln for _, ln in blocks}) != 1:
+        return None, "uneven devices-per-process along the data axis"
+    return DataAxisHierarchy(inter=len(blocks), intra=blocks[0][1],
+                             source="process"), ""
 
 
 def _prime_factors(N):

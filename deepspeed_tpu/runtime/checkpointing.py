@@ -47,6 +47,13 @@ def _walk(tree, prefix=""):
     elif isinstance(tree, (list, tuple)):
         for i, v in enumerate(tree):
             yield from _walk(v, f"{prefix}{i}/")
+    elif tree is None:
+        # empty pytree slot (e.g. the hierarchical comm path's
+        # uncompressed buckets carry None error entries): nothing to
+        # serialize — np.asarray(None) would pickle an object array that
+        # np.load(allow_pickle=False) then refuses. The structure owner
+        # rebuilds the Nones on load (engine._restore_error_lists).
+        return
     else:
         yield prefix[:-1], tree
 

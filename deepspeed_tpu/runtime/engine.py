@@ -491,6 +491,53 @@ class DeepSpeedEngine:
                    for a in (mesh_lib.PIPE_AXIS, mesh_lib.SEQ_AXIS,
                              mesh_lib.MODEL_AXIS, mesh_lib.EXPERT_AXIS))
 
+    def _comm_hierarchy(self):
+        """Resolved slow/fast split of the data axis for the link-aware
+        compressed exchange (ISSUE 10), cached. None = flat single-link
+        exchange — either the hierarchy block is off, or no slow axis
+        exists, in which case the fallback is LOUD (warning + flight
+        breadcrumb): silently compressing the fast links would be the
+        exact mistake this layer exists to avoid."""
+        cached = getattr(self, "_comm_hier_cached", "unset")
+        if cached != "unset":
+            return cached
+        hier = None
+        hcfg = self._config.comm_config.hierarchy
+        if hcfg.enabled and self._compressed_comm_active():
+            from deepspeed_tpu.parallel import topology as topo
+            hier, reason = topo.derive_data_hierarchy(
+                self.mesh, slow_axis=hcfg.slow_axis)
+            if hier is None:
+                logger.warning(
+                    f"comm.hierarchy enabled but no usable slow axis "
+                    f"({reason}); falling back to the FLAT compressed "
+                    f"allreduce — every link pays the sign-pack")
+                self.flight_recorder.record("comm_hierarchy_fallback",
+                                            reason=reason)
+            else:
+                log_dist(
+                    f"comm.hierarchy: data axis split {hier.inter}x"
+                    f"{hier.intra} (source={hier.source}, "
+                    f"compression={hcfg.compression})", ranks=[0])
+        self._comm_hier_cached = hier
+        return hier
+
+    def _comm_plan(self):
+        """The static overlap.HierarchyPlan for the hierarchical
+        compressed exchange, or None (flat path)."""
+        hier = self._comm_hierarchy()
+        if hier is None:
+            return None
+        from deepspeed_tpu.parallel import overlap
+        hcfg = self._config.comm_config.hierarchy
+        return overlap.HierarchyPlan(
+            inter_axis=mesh_lib.DATA_INTER_AXIS,
+            intra_axis=mesh_lib.DATA_INTRA_AXIS,
+            inter=hier.inter, intra=hier.intra,
+            compression=hcfg.compression,
+            min_bucket_bytes=hcfg.min_bucket_bytes,
+            bucket_elems=self._config.zero_config.reduce_bucket_size)
+
     # ------------------------------------------------------------------
     # state init
     # ------------------------------------------------------------------
@@ -607,7 +654,8 @@ class DeepSpeedEngine:
             opt_state = {}
         elif self._compressed_comm_active():
             opt_state = self.optimizer.init_compressed(
-                params, mesh_lib.mesh_axis_size(self.mesh, mesh_lib.DATA_AXIS))
+                params, mesh_lib.mesh_axis_size(self.mesh, mesh_lib.DATA_AXIS),
+                comm=self._comm_plan())
         else:
             opt_state = self.optimizer.init(params)
         scaler = prec.init_scaler_state(self.precision)
@@ -616,22 +664,7 @@ class DeepSpeedEngine:
                            skipped_steps=jnp.zeros((), jnp.int32))
 
         # shard the state onto the mesh per ZeRO stage
-        param_sh = self.zero.param_shardings(params)
-        opt_sh = self.zero.opt_state_shardings(
-            opt_state, params, getattr(self.optimizer, "param_like_state_fields", ()))
-        if self._compressed_comm_active():
-            # per-device error-feedback state: leading [dp] axis sharded
-            # over data so every worker keeps exactly its own error tensors
-            err_sh = NamedSharding(self.mesh, PartitionSpec(mesh_lib.DATA_AXIS))
-            for key in ("worker_error", "server_error"):
-                if key in opt_state:
-                    opt_sh[key] = jax.tree_util.tree_map(
-                        lambda _: err_sh, opt_state[key])
-        repl = NamedSharding(self.mesh, PartitionSpec())
-        scaler_sh = jax.tree_util.tree_map(lambda _: repl, scaler)
-        self.state_shardings = TrainState(
-            params=param_sh, opt_state=opt_sh, scaler=scaler_sh,
-            global_step=repl, skipped_steps=repl)
+        self.state_shardings = self._build_state_shardings(state)
         self.state = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, s), state, self.state_shardings)
         if self._param_offload_nvme:
@@ -1330,7 +1363,7 @@ class DeepSpeedEngine:
 
         def accumulate(state, batch, rng):
             tm = jax.tree_util.tree_map
-            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+            rng = jax.random.fold_in(rng, mesh_lib.linear_axis_index(axis))
             scale = state.scaler["loss_scale"]
             keep_prob = keep_fn(state.global_step)
 
@@ -1381,9 +1414,21 @@ class DeepSpeedEngine:
         warmup pmean / compressed momentum collective itself (the
         reference's compressed_allreduce replacing the engine allreduce,
         comm/nccl.py:47). Params replicated; error-feedback state per-device
-        with a leading [dp] axis."""
-        mesh = self.mesh
-        axis = mesh_lib.DATA_AXIS
+        with a leading [dp] axis.
+
+        With comm.hierarchy resolved (ISSUE 10) the program shard_maps a
+        data-axis-split view of the same mesh ((data_inter, data_intra) —
+        metadata-only reshard) and the optimizer runs the link-aware
+        bucketed exchange: fast-axis hops uncompressed, slow-axis hops
+        sign-packed per the per-bucket policy."""
+        plan = self._comm_plan()
+        if plan is not None:
+            mesh = mesh_lib.split_data_axis(self.mesh, plan.inter)
+            axis = plan.axes
+            self._install_comm_wire_model(plan)
+        else:
+            mesh = self.mesh
+            axis = mesh_lib.DATA_AXIS
         cfg = self._config
         state = self.state
         lr_fn = self._lr_fn()
@@ -1440,7 +1485,8 @@ class DeepSpeedEngine:
                 lr = lr_fn(state.global_step)
                 clip = cfg.gradient_clipping or None
                 new_params, new_opt = opt.step_local(
-                    state.params, grads, opt_local, lr, axis, clip=clip)
+                    state.params, grads, opt_local, lr, axis, clip=clip,
+                    comm=plan)
 
                 for key in ("worker_error", "server_error"):
                     new_opt[key] = tm(lambda x: x[None], new_opt[key])
@@ -2883,6 +2929,68 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # unified telemetry (deepspeed_tpu/telemetry)
     # ------------------------------------------------------------------
+    def _install_comm_wire_model(self, plan):
+        """Trace-time bytes-on-wire cost model for the hierarchical
+        exchange (ISSUE 10): the bucket plan + per-bucket policy are
+        static, so each phase's per-device, per-step wire bytes are one
+        host-side dict computed once — the per-step telemetry just
+        advances counters by it (sync-free)."""
+        from deepspeed_tpu.parallel import overlap
+        leaves = jax.tree_util.tree_leaves(self.state.params)
+        buckets = overlap.plan_buckets([l.shape for l in leaves],
+                                       plan.bucket_elems, plan.world)
+        flags = overlap.plan_bucket_compression(buckets, plan)
+        self.flight_recorder.record(
+            "comm_hierarchy_plan", buckets=len(buckets),
+            compressed=int(sum(flags)), inter=plan.inter,
+            intra=plan.intra, policy=plan.compression,
+            min_bucket_bytes=plan.min_bucket_bytes)
+        self._comm_wire_model = {
+            "warmup": overlap.hierarchy_wire_bytes(
+                buckets, [False] * len(buckets), plan),
+            "compressed": overlap.hierarchy_wire_bytes(buckets, flags,
+                                                       plan),
+        }
+        self.comm_hierarchy = plan
+
+    def _comm_wire_step(self):
+        """Per-step comm accounting for the compressed train paths: the
+        onebit_freeze ring event at the warmup→compressed transition,
+        and (hierarchical path only) the ``comm/bytes_on_wire/*``
+        counter advance from the trace-time cost model. Which phase ran
+        is mirrored from the host counters — the optimizer's own count
+        lives on device and reading it back would be a sync. fp16
+        overflow skips lag the optimizer count behind global_steps;
+        ``self.skipped_steps`` (the steps_per_print-boundary-synced
+        mirror) corrects for them, so the mirror can misattribute at
+        most the steps between an overflow and the next boundary.
+        Returns the step's byte dict or None."""
+        if not self._compressed_comm_active():
+            return None
+        freeze = int(getattr(self.optimizer, "freeze_step", 0) or 0)
+        frozen = (self.global_steps - self.skipped_steps) > freeze
+        if frozen and not getattr(self, "_onebit_freeze_recorded", False):
+            self._onebit_freeze_recorded = True
+            self.flight_recorder.record(
+                "onebit_freeze", step=self.global_steps,
+                freeze_step=freeze,
+                hierarchical=getattr(self, "_comm_wire_model", None)
+                is not None)
+        model = getattr(self, "_comm_wire_model", None)
+        if model is None:
+            return None
+        w = model["compressed" if frozen else "warmup"]
+        reg = self.telemetry
+        reg.counter("comm/bytes_on_wire/intra").inc(w["intra"])
+        reg.counter("comm/bytes_on_wire/inter").inc(w["inter"])
+        reg.counter("comm/bytes_on_wire/inter_uncompressed").inc(
+            w["inter_uncompressed"])
+        reg.gauge("comm/bytes_per_step/intra").set(w["intra"])
+        reg.gauge("comm/bytes_per_step/inter").set(w["inter"])
+        reg.gauge("comm/bytes_per_step/inter_uncompressed").set(
+            w["inter_uncompressed"])
+        return w
+
     def _telemetry_step(self, batch, loss):
         """Per-step recording (sync-free) + the steps_per_print-boundary
         window fold. Between boundaries only host counters move; AT the
@@ -2919,10 +3027,13 @@ class DeepSpeedEngine:
                 # host wall timer the swapper already kept — no fence
                 self.watchdog.observe_swap_stall(
                     stall, step=self.global_steps)
+        wire = self._comm_wire_step()
         self.flight_recorder.record(
             "step", step=self.global_steps, tokens=tokens,
             samples=self.train_batch_size(),
-            **({"swap_stall_s": stall} if have_swap else {}))
+            **({"swap_stall_s": stall} if have_swap else {}),
+            **({"comm_intra_bytes": wire["intra"],
+                "comm_inter_bytes": wire["inter"]} if wire else {}))
         if self.global_steps % self.steps_per_print() != 0:
             return
         lval = float(jax.device_get(loss))  # sync-ok: steps_per_print boundary
@@ -3308,20 +3419,146 @@ class DeepSpeedEngine:
             self.lr_scheduler.load_state_dict(extra["lr_scheduler"])
 
     def _adopt_loaded_state(self, template: TrainState):
-        params = template.params
-        opt_state = template.opt_state
-        scaler = template.scaler
-        param_sh = self.zero.param_shardings(params)
-        opt_sh = self.zero.opt_state_shardings(
-            opt_state, params, getattr(self.optimizer, "param_like_state_fields", ()))
-        repl = NamedSharding(self.mesh, PartitionSpec())
-        scaler_sh = jax.tree_util.tree_map(lambda _: repl, scaler)
-        self.state_shardings = TrainState(params=param_sh, opt_state=opt_sh,
-                                          scaler=scaler_sh, global_step=repl,
-                                          skipped_steps=repl)
+        template = self._restore_error_lists(template)
+        self.state_shardings = self._build_state_shardings(template)
         self.state = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(jnp.asarray(x), s),
             template, self.state_shardings)
+
+    def _restore_error_lists(self, template: TrainState):
+        """The checkpoint serializer rebuilds every container as a dict
+        (checkpointing._unflatten), so the hierarchical comm path's
+        per-BUCKET error LISTS come back digit-keyed — and uncompressed
+        buckets' None entries were dropped at save. Rebuild the lists
+        against the plan's bucket count so the loaded residuals land in
+        the positions the train program's per-bucket zip expects."""
+        if not isinstance(template.opt_state, dict):
+            return template
+        plan = self._comm_plan()
+        if plan is None:
+            return self._restore_flat_error_trees(template)
+        from deepspeed_tpu.parallel import overlap
+        # canonical zero state for the CURRENT policy — the checkpoint
+        # may have been written under a different compression/bucket
+        # config, so loaded residuals only land where the shapes still
+        # agree; anything else resets to zero (or drops) with a warning
+        # instead of tripping a cryptic trace error on a None operand
+        canon = dict(zip(
+            ("worker_error", "server_error"),
+            overlap.hierarchical_error_states(template.params, plan)))
+        dp = mesh_lib.mesh_axis_size(self.mesh, mesh_lib.DATA_AXIS)
+        opt_state, changed = dict(template.opt_state), False
+        for key, zeros in canon.items():
+            v = opt_state.get(key)
+            if isinstance(v, list):
+                continue        # live state kept as-is (keep_live_opt)
+            loaded = v if isinstance(v, dict) \
+                and all(k.isdigit() for k in v) else {}
+            out = []
+            for i, z in enumerate(zeros):
+                lv = loaded.get(str(i))
+                if z is None:
+                    if lv is not None:
+                        logger.warning(
+                            f"{key}[{i}]: bucket is uncompressed under "
+                            f"the current comm.hierarchy policy — "
+                            f"checkpointed residual dropped")
+                    out.append(None)
+                elif lv is not None \
+                        and tuple(np.shape(lv)) == (dp,) + z.shape:
+                    out.append(lv)
+                else:
+                    if lv is not None:
+                        logger.warning(
+                            f"{key}[{i}]: checkpointed residual shape "
+                            f"{np.shape(lv)} does not match the current "
+                            f"plan ({(dp,) + z.shape}) — reset to zero")
+                    out.append(jnp.zeros((dp,) + z.shape, z.dtype))
+            opt_state[key] = out
+            changed = True
+        return template.replace(opt_state=opt_state) if changed \
+            else template
+
+    def _restore_flat_error_trees(self, template: TrainState):
+        """The reverse policy flip: a checkpoint written by the
+        HIERARCHICAL path (per-bucket error lists, digit-keyed after the
+        round trip) resumed on the FLAT compressed path. The bucket-flat
+        residuals have no per-leaf interpretation here — reset to zero
+        per-leaf trees (warned) instead of handing
+        tree_compressed_allreduce a digit-dict and crashing the trace."""
+        if not self._compressed_comm_active():
+            return template
+        opt_state = template.opt_state
+
+        def hier_format(v):
+            # digit-keyed = a round-tripped per-bucket list; None/absent =
+            # an all-None ("never"-policy) list the serializer dropped
+            return v is None or (isinstance(v, dict) and v
+                                 and all(s.isdigit() for s in v))
+        needs = [k for k in ("worker_error", "server_error")
+                 if opt_state and hier_format(opt_state.get(k))]
+        if not needs:
+            return template
+        logger.warning(
+            f"checkpoint carries hierarchical per-bucket error state "
+            f"({needs}) but the engine runs the FLAT compressed "
+            f"exchange — error feedback resets to zero")
+        from deepspeed_tpu.parallel import compression as comp
+        dp = mesh_lib.mesh_axis_size(self.mesh, mesh_lib.DATA_AXIS)
+        we, se = comp.init_error_states(template.params, dp)
+        bump = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: jnp.zeros((dp,) + x.shape, x.dtype), t)
+        opt_state = dict(opt_state)
+        opt_state["worker_error"] = bump(we)
+        opt_state["server_error"] = bump(se)
+        return template.replace(opt_state=opt_state)
+
+    def _build_state_shardings(self, state: TrainState) -> TrainState:
+        """Shardings for a full TrainState per ZeRO stage + the
+        compressed-comm special cases — shared by _init_state and the
+        checkpoint/elastic adoption paths (which previously rebuilt a
+        subset of this and mis-sharded the error-feedback state)."""
+        params, opt_state, scaler = state.params, state.opt_state, \
+            state.scaler
+        param_sh = self.zero.param_shardings(params)
+        opt_sh = self.zero.opt_state_shardings(
+            opt_state, params,
+            getattr(self.optimizer, "param_like_state_fields", ()))
+        state_mesh = self.mesh
+        if self._compressed_comm_active():
+            plan = self._comm_plan()
+            if plan is not None:
+                # hierarchical path (ISSUE 10): rest the whole TrainState
+                # on the split-mesh view. The device layout is identical
+                # (metadata-only), but the hierarchical train program's
+                # shard_map shardings then match its inputs from step one
+                # instead of forcing a second-step retrace when the first
+                # output comes back on the split mesh.
+                state_mesh = mesh_lib.split_data_axis(self.mesh, plan.inter)
+
+                def resplit(s):
+                    spec = tuple(
+                        (plan.inter_axis, plan.intra_axis)
+                        if p == mesh_lib.DATA_AXIS else p
+                        for p in tuple(s.spec))
+                    return NamedSharding(state_mesh, PartitionSpec(*spec))
+                param_sh = jax.tree_util.tree_map(resplit, param_sh)
+                opt_sh = jax.tree_util.tree_map(resplit, opt_sh)
+            # per-device error-feedback state: leading [dp] axis sharded
+            # over data so every worker keeps exactly its own error tensors
+            err_sh = NamedSharding(
+                state_mesh,
+                PartitionSpec((plan.inter_axis, plan.intra_axis)
+                              if plan is not None else mesh_lib.DATA_AXIS))
+            for key in ("worker_error", "server_error"):
+                if key in opt_state:
+                    opt_sh[key] = jax.tree_util.tree_map(
+                        lambda _: err_sh, opt_state[key])
+        repl = NamedSharding(state_mesh, PartitionSpec())
+        scaler_sh = jax.tree_util.tree_map(lambda _: repl, scaler)
+        return TrainState(params=param_sh, opt_state=opt_sh,
+                          scaler=scaler_sh, global_step=repl,
+                          skipped_steps=repl)
 
     def _adopt_loaded_state_offload(self, template: TrainState):
         self._host_runner = self._make_offload_runner(template.params)

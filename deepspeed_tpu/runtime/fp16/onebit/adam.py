@@ -51,13 +51,19 @@ class OnebitAdam(TpuOptimizer):
             "worker_error": tree_zeros_like(params, jnp.float32),
         }
 
-    def init_compressed(self, params, dp_size):
+    def init_compressed(self, params, dp_size, comm=None):
         """Optimizer state for the distributed compressed path: moments are
         replicated (synchronized by the collective); the two error-feedback
         trees are PER-DEVICE, stored with a leading [dp] axis the engine
-        shards over the data axis."""
-        from deepspeed_tpu.parallel import compression as comp
-        we, se = comp.init_error_states(params, dp_size)
+        shards over the data axis. With ``comm`` (an
+        overlap.HierarchyPlan), the errors are per-BUCKET lists shaped
+        for the hierarchical exchange instead of per-leaf trees."""
+        if comm is not None:
+            from deepspeed_tpu.parallel import overlap
+            we, se = overlap.hierarchical_error_states(params, comm)
+        else:
+            from deepspeed_tpu.parallel import compression as comp
+            we, se = comp.init_error_states(params, dp_size)
         bump = lambda t: jax.tree_util.tree_map(  # noqa: E731
             lambda x: jnp.zeros((dp_size,) + x.shape, x.dtype), t)
         return {
@@ -68,15 +74,25 @@ class OnebitAdam(TpuOptimizer):
             "server_error": bump(se),
         }
 
-    def step_local(self, params, grads, state, lr, axis_name, clip=None):
+    def step_local(self, params, grads, state, lr, axis_name, clip=None,
+                   comm=None):
         """Distributed step, called inside shard_map over ``axis_name`` with
         UNREDUCED per-device grads; error-feedback leaves arrive without
         their leading dp axis (the engine strips/restores it).
 
         warmup: exact DP — grads pmean'd, both moments update, optional
         global-norm clip. compressed: momentum updates from LOCAL grads and
-        is synchronized by the 1-bit collective; variance frozen."""
+        is synchronized by the 1-bit collective; variance frozen.
+
+        ``comm`` (overlap.HierarchyPlan) switches both phases to the
+        link-aware bucketed exchange (ISSUE 10): ``axis_name`` is then
+        the (inter, intra) axis tuple, warmup means grads through the
+        two-level uncompressed bucket stream, and the compressed phase
+        runs the per-bucket policy — only slow-axis hops carry sign
+        bits. Error-feedback state is per-bucket lists there (see
+        overlap.hierarchical_error_states)."""
         from deepspeed_tpu.parallel.compression import tree_compressed_allreduce
+        from deepspeed_tpu.parallel import overlap
         lr = self.lr if lr is None else lr
         beta1, beta2 = self.betas
         count = state["step"] + 1
@@ -84,8 +100,15 @@ class OnebitAdam(TpuOptimizer):
         tm = jax.tree_util.tree_map
 
         def warmup(grads, m, v, we, se):
-            g = tm(lambda x: jax.lax.pmean(x.astype(jnp.float32), axis_name),
-                   grads)
+            if comm is not None:
+                # cast BEFORE the bucket stream: _unpack_bucket restores
+                # leaf dtype, so fp32-in keeps the mean at fp32 (no extra
+                # bf16 rounding vs the flat pmean path)
+                g = overlap.bucketed_hierarchical_mean(
+                    tm(lambda x: x.astype(jnp.float32), grads), comm)
+            else:
+                g = tm(lambda x: jax.lax.pmean(x.astype(jnp.float32),
+                                               axis_name), grads)
             if clip:
                 sq = sum(jnp.sum(jnp.square(l))
                          for l in jax.tree_util.tree_leaves(g))
@@ -98,8 +121,13 @@ class OnebitAdam(TpuOptimizer):
         def compressed(grads, m, v, we, se):
             m_loc = tm(lambda mm, gg: beta1 * mm
                        + (1 - beta1) * gg.astype(jnp.float32), m, grads)
-            m_sync, we2, se2 = tree_compressed_allreduce(
-                m_loc, we, se, axis_name)
+            if comm is not None:
+                m_sync, we2, se2 = \
+                    overlap.bucketed_hierarchical_compressed_allreduce(
+                        m_loc, we, se, comm)
+            else:
+                m_sync, we2, se2 = tree_compressed_allreduce(
+                    m_loc, we, se, axis_name)
             return m_sync, m_sync, v, we2, se2
 
         m_eff, m_new, v_new, we2, se2 = jax.lax.cond(

@@ -48,12 +48,18 @@ class OnebitLamb(TpuOptimizer):
             "lamb_coeff": _tree_scalar_like(params, 1.0),
         }
 
-    def init_compressed(self, params, dp_size):
+    def init_compressed(self, params, dp_size, comm=None):
         """State for the distributed compressed path (see OnebitAdam
         .init_compressed): error-feedback trees per-device with a leading
-        [dp] axis; moments and coefficients replicated."""
-        from deepspeed_tpu.parallel import compression as comp
-        we, se = comp.init_error_states(params, dp_size)
+        [dp] axis; moments and coefficients replicated. ``comm`` (an
+        overlap.HierarchyPlan) switches the errors to per-bucket lists
+        for the hierarchical exchange."""
+        if comm is not None:
+            from deepspeed_tpu.parallel import overlap
+            we, se = overlap.hierarchical_error_states(params, comm)
+        else:
+            from deepspeed_tpu.parallel import compression as comp
+            we, se = comp.init_error_states(params, dp_size)
         bump = lambda t: jax.tree_util.tree_map(  # noqa: E731
             lambda x: jnp.zeros((dp_size,) + x.shape, x.dtype), t)
         return {
@@ -65,13 +71,16 @@ class OnebitLamb(TpuOptimizer):
             "lamb_coeff": _tree_scalar_like(params, 1.0),
         }
 
-    def step_local(self, params, grads, state, lr, axis_name, clip=None):
+    def step_local(self, params, grads, state, lr, axis_name, clip=None,
+                   comm=None):
         """Distributed step inside shard_map over ``axis_name`` (unreduced
         per-device grads). Warmup = exact LAMB on pmean'd grads, recording
         the running scaling coefficient; compressed = 1-bit momentum
         collective + frozen coefficient (the reference's two-phase design,
-        arXiv:2104.06069)."""
+        arXiv:2104.06069). ``comm`` switches both phases to the
+        hierarchical bucketed exchange (see OnebitAdam.step_local)."""
         from deepspeed_tpu.parallel.compression import tree_compressed_allreduce
+        from deepspeed_tpu.parallel import overlap
         lr = self.lr if lr is None else lr
         beta1, beta2 = self.betas
         count = state["step"] + 1
@@ -79,8 +88,14 @@ class OnebitLamb(TpuOptimizer):
         tm = jax.tree_util.tree_map
 
         def warmup(grads, m, v, we, se):
-            g = tm(lambda x: jax.lax.pmean(x.astype(jnp.float32), axis_name),
-                   grads)
+            if comm is not None:
+                # fp32-in so _unpack_bucket's leaf-dtype restore does not
+                # re-round the mean (see OnebitAdam.step_local)
+                g = overlap.bucketed_hierarchical_mean(
+                    tm(lambda x: x.astype(jnp.float32), grads), comm)
+            else:
+                g = tm(lambda x: jax.lax.pmean(x.astype(jnp.float32),
+                                               axis_name), grads)
             if clip:
                 sq = sum(jnp.sum(jnp.square(l))
                          for l in jax.tree_util.tree_leaves(g))
@@ -93,8 +108,13 @@ class OnebitLamb(TpuOptimizer):
         def compressed(grads, m, v, we, se):
             m_loc = tm(lambda mm, gg: beta1 * mm
                        + (1 - beta1) * gg.astype(jnp.float32), m, grads)
-            m_sync, we2, se2 = tree_compressed_allreduce(
-                m_loc, we, se, axis_name)
+            if comm is not None:
+                m_sync, we2, se2 = \
+                    overlap.bucketed_hierarchical_compressed_allreduce(
+                        m_loc, we, se, comm)
+            else:
+                m_sync, we2, se2 = tree_compressed_allreduce(
+                    m_loc, we, se, axis_name)
             return m_sync, m_sync, v, we2, se2
 
         m_eff, m_new, v_new, we2, se2 = jax.lax.cond(

@@ -111,6 +111,15 @@ def render_steps(events, out):
             row["tokens"] = ev.get("tokens")
             if ev.get("swap_stall_s") is not None:
                 row["swap_stall_s"] = ev["swap_stall_s"]
+            if ev.get("comm_intra_bytes") is not None \
+                    or ev.get("comm_inter_bytes") is not None:
+                # hierarchical comm cost model (ISSUE 10): bytes this
+                # step put on the wire, fast + slow links
+                row["comm_mb"] = ((ev.get("comm_intra_bytes") or 0)
+                                  + (ev.get("comm_inter_bytes") or 0)) \
+                    / 2**20
+        elif kind == "onebit_freeze":
+            row["comm_phase"] = "freeze"
         elif kind == "loss":
             row["loss"] = ev.get("loss")
         elif kind == "window":
@@ -121,16 +130,19 @@ def render_steps(events, out):
         return
     out.append("")
     out.append("per-step phase attribution (host seconds per span tag):")
+    extra = [c for c in ("comm_mb", "comm_phase")
+             if any(c in row for row in steps.values())]
     headers = (["step"] + [t.replace("train/", "") for t in tags]
-               + ["window_step_s", "tokens", "swap_stall_s", "loss",
-                  "anomaly"])
+               + ["window_step_s", "tokens", "swap_stall_s"] + extra
+               + ["loss", "anomaly"])
     rows = []
     for step, row in steps.items():
         rows.append([step] + [row.get(("span", t), "") for t in tags]
                     + [row.get("window_step_s", ""),
                        row.get("tokens", ""),
-                       row.get("swap_stall_s", ""),
-                       row.get("loss", ""),
+                       row.get("swap_stall_s", "")]
+                    + [row.get(c, "") for c in extra]
+                    + [row.get("loss", ""),
                        row.get("anomaly", "")])
     _table(headers, rows, out)
 
@@ -298,7 +310,9 @@ def render(path, tail_events=0):
     render_swap(events, out)
     plans = [ev for ev in events
              if ev.get("kind") in ("overlap_bucket_plan",
-                                   "prefetch_layer_plan")]
+                                   "prefetch_layer_plan",
+                                   "comm_hierarchy_plan",
+                                   "comm_hierarchy_fallback")]
     if plans:
         out.append("")
         out.append("comm bucket plans (trace-time):")

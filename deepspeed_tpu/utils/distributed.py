@@ -80,6 +80,7 @@ def init_distributed(coordinator_address: Optional[str] = None,
 
     if coordinator_address and num_processes and num_processes > 1:
         import jax
+        _enable_cpu_cross_process_collectives(jax)
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id,
@@ -90,6 +91,24 @@ def init_distributed(coordinator_address: Optional[str] = None,
                  f"n={num_processes}, id={process_id}, "
                  f"local_device_ids={local_device_ids})", ranks=[0])
     _initialized = True
+
+
+def _enable_cpu_cross_process_collectives(jax):
+    """The XLA CPU backend refuses to compile cross-process computations
+    unless a collectives transport is wired into the client — jax
+    defaults to "none" and every multi-host program dies with
+    "Multiprocess computations aren't implemented on the CPU backend".
+    Select gloo (TCP, rendezvous through the same distributed KV store)
+    before the first backend touch so multi-process CPU rendezvous —
+    the DCN-proxy test harnesses and any CPU fallback of a multi-host
+    job — just works. Only the CPU client reads the flag; TPU/GPU
+    backends ignore it. NOTE: must run before jax.distributed.initialize
+    per the backend-init ordering this function already documents; a
+    jaxlib built without gloo keeps the default."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
 
 
 def is_initialized():

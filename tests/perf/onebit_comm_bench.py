@@ -1,0 +1,160 @@
+"""Hierarchical link-aware 1-bit gradient exchange benchmark (ISSUE 10
+acceptance: inter-host bytes-on-wire drop >= 4x post-freeze, step time
+vs the flat allreduce recorded).
+
+Three OneBitAdam engine variants over the same MLP/batch on one mesh,
+data axis split 2 x (n/2) by the synthetic slow-axis override:
+
+  flat        no ``comm.hierarchy`` block — the pre-existing single-link
+              compressed allreduce: EVERY hop pays the sign-pack
+  hier_1bit   hierarchy on, compression "always" — fast-axis ring hops
+              uncompressed, only the slow-axis hop carries sign bits
+  hier_exact  hierarchy on, compression "never" — the exact two-level
+              mean through the same bucket stream (the numeric floor
+              and the fair step-time baseline for the compression cost)
+
+The headline is ``bytes_reduction``: modeled post-freeze slow-hop bytes
+of the fp32 exchange over the sign-packed exchange (the trace-time cost
+model behind the ``comm/bytes_on_wire/*`` counters — exact, because the
+bucket plan and policy are static). Step times ride along; on this
+CPU-emulated mesh every "link" is a memcpy and the virtual devices
+timeshare the host cores, so compression can only ADD pack/unpack
+compute here — the wire-byte ledger is the portable result, the
+step-time ratio is harness calibration (run on a real multi-host slice
+for wall-clock wins; the slow axis then comes from process boundaries,
+not the override). Prints one JSON object.
+
+Run directly: python tests/perf/onebit_comm_bench.py [hidden] [layers]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+
+def _build_engine(n, hidden, layers, comm=None, freeze=5,
+                  bucket_elems=65536):
+    import jax
+    import flax.linen as nn
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+
+    class CommMLP(nn.Module):
+        """Several equal Dense blocks: enough parameter volume for a
+        multi-bucket plan (one SimpleModel bucket would make the
+        per-bucket policy trivial). tanh, NOT relu: a relu unit dead
+        through the whole warmup leaves its variance frozen at exactly
+        0, and the first post-freeze gradient there divides by eps —
+        every 1-bit variant walks off on that, hierarchy or not."""
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(hidden)(x)
+            for _ in range(layers - 1):
+                x = nn.tanh(x)
+                x = nn.Dense(hidden)(x)
+            return nn.Dense(16)(x)
+
+    cfg = {
+        "train_batch_size": 8 * n,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 10**9,
+        "optimizer": {"type": "OneBitAdam",
+                      "params": {"lr": 1e-4, "freeze_step": freeze}},
+        # small buckets so the tree splits into several (the per-bucket
+        # policy and cost model see a real plan, not one blob)
+        "zero_optimization": {"stage": 0,
+                              "reduce_bucket_size": bucket_elems},
+    }
+    if comm is not None:
+        cfg["comm"] = comm
+    mesh = make_mesh(MeshConfig(data=n), devices=jax.devices())
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=CommMLP(),
+                                       mesh=mesh)
+    return engine
+
+
+def run_onebit_comm_bench(hidden=512, layers=4, steps=10, freeze=5):
+    import numpy as np
+    import jax
+
+    n = len(jax.devices())
+    assert n >= 4 and n % 2 == 0, f"need an even mesh >= 4, got {n}"
+    rng = np.random.RandomState(0)
+    # a learnable task (labels from a fixed linear teacher) with several
+    # samples per device: random labels + 1-sample-per-device local
+    # grads leave the compressed momentum nothing but noise to follow
+    # and every variant diverges — that would measure the toy problem
+    xs = rng.randn(8 * n, 64).astype(np.float32)
+    teacher = rng.randn(64, 16).astype(np.float32)
+    batch = (xs, np.argmax(xs @ teacher, axis=1).astype(np.int32))
+
+    variants = {
+        "flat": None,
+        "hier_1bit": {"hierarchy": {"slow_axis": 2,
+                                    "compression": "always"}},
+        "hier_exact": {"hierarchy": {"slow_axis": 2,
+                                     "compression": "never"}},
+    }
+    result = {"devices": n, "split": f"2x{n // 2} (synthetic slow axis)",
+              "hidden": hidden, "layers": layers,
+              "step_time_s": {}, "final_loss": {}}
+    wire = None
+    for name, comm in variants.items():
+        engine = _build_engine(n, hidden, layers, comm=comm,
+                               freeze=freeze)
+        # through the freeze into compressed steady state + compile both
+        # phase programs before the clock starts
+        for _ in range(freeze + 2):
+            loss = engine.train_batch(batch)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch(batch)
+        jax.block_until_ready(loss)
+        result["step_time_s"][name] = round(
+            (time.perf_counter() - t0) / steps, 6)
+        result["final_loss"][name] = round(float(loss), 6)
+        if name == "hier_1bit":
+            wire = dict(engine._comm_wire_model)
+            result["counters"] = {
+                k: int(v) for k, v in engine.telemetry.snapshot(
+                    "comm/")["counters"].items()}
+        del engine
+        jax.clear_caches()
+
+    # the headline: post-freeze slow-hop fp32 bytes over sign-packed
+    # bytes — per step per device, from the static cost model
+    comp = wire["compressed"]
+    result["bytes_per_step"] = wire
+    result["bytes_reduction"] = round(
+        comp["inter_uncompressed"] / comp["inter"], 3)
+    result["hier_vs_flat_step_time"] = round(
+        result["step_time_s"]["flat"]
+        / result["step_time_s"]["hier_1bit"], 3)
+    return result
+
+
+def main(hidden=512, layers=4):
+    import jax
+    if "xla_force_host_platform_device_count" in \
+            os.environ.get("XLA_FLAGS", ""):
+        jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(run_onebit_comm_bench(hidden=hidden, layers=layers),
+                     indent=2))
+
+
+if __name__ == "__main__":
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # re-exec with the multi-device CPU env (XLA_FLAGS is read at
+        # interpreter start)
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        os.execve(sys.executable, [sys.executable, __file__] + sys.argv[1:],
+                  env)
+    main(*(int(a) for a in sys.argv[1:]))

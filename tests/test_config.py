@@ -145,3 +145,51 @@ def test_micro_batch_per_chip_alias():
     cfg = DeepSpeedConfig({"train_micro_batch_size_per_chip": 4}, world_size=2)
     assert cfg.train_micro_batch_size_per_gpu == 4
     assert cfg.train_batch_size == 8
+
+
+def test_comm_hierarchy_section():
+    from deepspeed_tpu.config.config import DeepSpeedConfig
+    # absent block -> disabled, defaults resolved
+    cfg = DeepSpeedConfig({"train_batch_size": 8})
+    h = cfg.comm_config.hierarchy
+    assert not h.enabled and h.slow_axis == 0 and h.compression == "auto"
+    # presence enables; "auto" aliases slow_axis 0
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "comm": {"hierarchy": {"slow_axis": "auto"}}})
+    h = cfg.comm_config.hierarchy
+    assert h.enabled and h.slow_axis == 0
+    assert h.min_bucket_bytes == 1 << 16
+    # explicit knobs
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "comm": {"hierarchy": {"enabled": True, "slow_axis": 2,
+                               "compression": "always",
+                               "min_bucket_bytes": 4096}}})
+    h = cfg.comm_config.hierarchy
+    assert (h.slow_axis, h.compression, h.min_bucket_bytes) \
+        == (2, "always", 4096)
+
+
+def test_comm_hierarchy_validation_errors():
+    import pytest
+    from deepspeed_tpu.config.config import (DeepSpeedConfig,
+                                             DeepSpeedConfigError)
+    base = {"train_batch_size": 8}
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({**base, "comm": {"hierarchy": {"slow_axis": 1}}})
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({**base,
+                         "comm": {"hierarchy": {"compression": "maybe"}}})
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({**base, "comm": {"hierarchy":
+                                          {"min_bucket_bytes": -1}}})
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({**base, "comm": {"hierarchy": "yes"}})
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({**base,
+                         "comm": {"hierarchy": {"slow_axis": "fast"}}})
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({**base, "comm": {"hierarchy":
+                                          {"min_bucket_bytes": "64k"}}})
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({**base, "comm": []})

@@ -393,3 +393,31 @@ def test_view_cli_on_synthetic_dump_and_missing_file(tmp_path, capsys):
     assert "swap-tier I/O per step" in out
     assert "1 unparseable line(s) skipped" in out
     assert view.main([str(tmp_path / "missing.jsonl")]) == 2
+
+
+def test_view_renders_comm_bytes_column_and_hierarchy_plan(tmp_path):
+    """ISSUE 10 satellite: step events carrying the hierarchical comm
+    cost model render a per-step comm-bytes column in the phase table;
+    the onebit_freeze ring event marks the transition and the
+    comm_hierarchy_plan breadcrumb shows up with the bucket plans."""
+    import json
+    path = tmp_path / "comm.jsonl"
+    events = [
+        {"kind": "comm_hierarchy_plan", "buckets": 1, "compressed": 1,
+         "inter": 2, "intra": 4, "policy": "always"},
+        {"kind": "step", "step": 1, "tokens": 128,
+         "comm_intra_bytes": 2 * 2**20, "comm_inter_bytes": 1 * 2**20},
+        {"kind": "onebit_freeze", "step": 2, "freeze_step": 1,
+         "hierarchical": True},
+        {"kind": "step", "step": 2, "tokens": 128,
+         "comm_intra_bytes": 2 * 2**20, "comm_inter_bytes": 65536},
+        {"kind": "loss", "step": 2, "loss": 1.5},
+    ]
+    path.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+    out = _render_lines(str(path))
+    assert "comm_mb" in out
+    assert "comm_phase" in out and "freeze" in out
+    assert "comm_hierarchy_plan" in out
+    # 3 MiB on step 1; the post-freeze step shrinks
+    lines = [ln for ln in out.splitlines() if ln.strip().startswith("1 ")]
+    assert any("3" in ln for ln in lines), out

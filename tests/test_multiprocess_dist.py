@@ -60,6 +60,50 @@ def _free_port():
     return port
 
 
+def spawn_workers(world, script_text, tmp_path, script_args=(),
+                  local_devices=1, timeout=240):
+    """Reusable multi-process harness (ISSUE 10 satellite): write
+    ``script_text`` to disk, fork ``world`` ranked OS processes over the
+    launcher env contract (fresh free-port rendezvous, ``local_devices``
+    virtual CPU devices each), wait with hang detection (the reference
+    harness's common.py:74-88 role), assert every rank exited 0, and
+    return the per-rank stdouts."""
+    script = tmp_path / "worker.py"
+    script.write_text(script_text)
+    port = _free_port()
+    procs = []
+    for rank in range(world):
+        env = dict(os.environ)
+        env.update({
+            "DSTPU_COORDINATOR_ADDR": "127.0.0.1",
+            "DSTPU_COORDINATOR_PORT": str(port),
+            "DSTPU_NUM_PROCESSES": str(world),
+            "DSTPU_PROCESS_ID": str(rank),
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count="
+                         f"{local_devices}",
+            "PYTHONPATH": REPO_ROOT + os.pathsep
+            + os.environ.get("PYTHONPATH", ""),
+        })
+        env.pop("DSTPU_LOCAL_DEVICE_IDS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)] + [str(a) for a in script_args],
+            env=env, cwd=REPO_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"rank {rank} hung (the reference harness's hang "
+                        f"detection, common.py:74-88)")
+        outs.append(out)
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+    return outs
+
+
 def test_rendezvous_env_contract_discovery():
     """Fast tier-1 coverage of the launcher env contract the slow
     multi-process tests rendezvous through: discover_rendezvous is pure
@@ -107,37 +151,7 @@ def test_rendezvous_env_contract_discovery():
 @pytest.mark.parametrize("world", [2])
 @pytest.mark.slow
 def test_two_process_psum_over_launcher_contract(tmp_path, world):
-    script = tmp_path / "worker.py"
-    script.write_text(_WORKER)
-    port = _free_port()
-    procs = []
-    for rank in range(world):
-        env = dict(os.environ)
-        env.update({
-            "DSTPU_COORDINATOR_ADDR": "127.0.0.1",
-            "DSTPU_COORDINATOR_PORT": str(port),
-            "DSTPU_NUM_PROCESSES": str(world),
-            "DSTPU_PROCESS_ID": str(rank),
-            "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
-            "PYTHONPATH": REPO_ROOT + os.pathsep
-            + os.environ.get("PYTHONPATH", ""),
-        })
-        env.pop("DSTPU_LOCAL_DEVICE_IDS", None)
-        procs.append(subprocess.Popen(
-            [sys.executable, str(script)], env=env, cwd=REPO_ROOT,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    outs = []
-    for rank, p in enumerate(procs):
-        try:
-            out, _ = p.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail(f"rank {rank} hung (the reference harness's hang "
-                        f"detection, common.py:74-88)")
-        outs.append(out)
-        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+    outs = spawn_workers(world, _WORKER, tmp_path)
     for rank, out in enumerate(outs):
         assert f"RANK{rank}_OK" in out
 
@@ -174,36 +188,8 @@ def test_engine_trains_across_two_processes(tmp_path):
     """Full engine training over a 2-process global mesh (dp=8, ZeRO-2):
     the true multi-host path — rendezvous, global batch feeding, GSPMD
     collectives over DCN-style process boundaries."""
-    script = tmp_path / "engine_worker.py"
-    script.write_text(_ENGINE_WORKER)
-    port = _free_port()
-    procs = []
-    for rank in range(2):
-        env = dict(os.environ)
-        env.update({
-            "DSTPU_COORDINATOR_ADDR": "127.0.0.1",
-            "DSTPU_COORDINATOR_PORT": str(port),
-            "DSTPU_NUM_PROCESSES": "2",
-            "DSTPU_PROCESS_ID": str(rank),
-            "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-            "PYTHONPATH": REPO_ROOT + os.pathsep
-            + os.environ.get("PYTHONPATH", ""),
-        })
-        env.pop("DSTPU_LOCAL_DEVICE_IDS", None)
-        procs.append(subprocess.Popen(
-            [sys.executable, str(script)], env=env, cwd=REPO_ROOT,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    outs = []
-    for rank, p in enumerate(procs):
-        try:
-            out, _ = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail(f"rank {rank} hung")
-        outs.append(out)
-        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+    outs = spawn_workers(2, _ENGINE_WORKER, tmp_path, local_devices=4,
+                         timeout=300)
 
     import re
     curves = {}
@@ -277,38 +263,10 @@ def test_sharded_checkpoint_two_processes_and_resize(tmp_path):
     training trajectory bit-exactly, and the same checkpoint restores into
     a SINGLE-process engine (world-size resize, the reference's elastic
     restore zero/stage1.py:898-1031)."""
-    script = tmp_path / "ckpt_worker.py"
     ckpt_dir = tmp_path / "ckpt"
-    script.write_text(_CKPT_WORKER)
-    port = _free_port()
-    procs = []
-    for rank in range(2):
-        env = dict(os.environ)
-        env.update({
-            "DSTPU_COORDINATOR_ADDR": "127.0.0.1",
-            "DSTPU_COORDINATOR_PORT": str(port),
-            "DSTPU_NUM_PROCESSES": "2",
-            "DSTPU_PROCESS_ID": str(rank),
-            "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-            "PYTHONPATH": REPO_ROOT + os.pathsep
-            + os.environ.get("PYTHONPATH", ""),
-        })
-        env.pop("DSTPU_LOCAL_DEVICE_IDS", None)
-        procs.append(subprocess.Popen(
-            [sys.executable, str(script), str(ckpt_dir)], env=env,
-            cwd=REPO_ROOT, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True))
-    outs = []
-    for rank, p in enumerate(procs):
-        try:
-            out, _ = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail(f"rank {rank} hung")
-        outs.append(out)
-        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+    outs = spawn_workers(2, _CKPT_WORKER, tmp_path,
+                         script_args=(ckpt_dir,), local_devices=4,
+                         timeout=300)
 
     import re
     for out in outs:
@@ -356,3 +314,100 @@ def test_sharded_checkpoint_two_processes_and_resize(tmp_path):
         assert tag == "t0"
         resumed = float(engine.train_batch(random_batch()))
         assert np.isfinite(resumed)
+
+
+_HIER_WORKER = textwrap.dedent("""
+    import json
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from deepspeed_tpu.utils.distributed import init_distributed
+    init_distributed()
+
+    import numpy as np
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+    from tests.simple_model import SimpleModel, random_batch, base_config
+
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8            # 4 local x 2 processes
+    mesh = make_mesh(MeshConfig(data=8))
+    cfg = base_config()
+    # the test_onebit parity recipe (freeze 5, 15 steps, default init):
+    # 1-bit momentum compression every step is only contractive when the
+    # warmup left the momentum well-scaled — a short freeze on an
+    # adversarial init diverges for the FLAT path too, so the pin here
+    # would measure the toy problem, not the hierarchy
+    cfg["optimizer"] = {"type": "OneBitAdam",
+                        "params": {"lr": 1e-2, "freeze_step": 5}}
+    # slow_axis 0 = auto: the split must come from the REAL process
+    # boundaries (this is the whole point of the test); "always" because
+    # SimpleModel's one bucket is far below the auto policy's floor
+    cfg["comm"] = {"hierarchy": {"slow_axis": 0, "compression": "always"}}
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                       mesh=mesh)
+    batch = random_batch()                    # identical on every process
+    losses = [float(engine.train_batch(batch)) for _ in range(15)]
+
+    plan = engine.comm_hierarchy
+    assert (plan.inter, plan.intra) == (2, 4), plan
+    hier, _ = __import__(
+        "deepspeed_tpu.parallel.topology",
+        fromlist=["derive_data_hierarchy"]).derive_data_hierarchy(mesh)
+    assert hier is not None and hier.source == "process", hier
+    snap = engine.telemetry.snapshot("comm/")["counters"]
+    print("HIER", jax.process_index(), json.dumps({
+        "losses": losses,
+        "wire": engine._comm_wire_model,
+        "counters": snap,
+    }), flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_hierarchical_compressed_allreduce_two_processes(tmp_path):
+    """The tentpole proof leg (ISSUE 10): 2 real processes x 4 devices
+    run the hierarchical 1-bit exchange with the slow axis derived from
+    the ACTUAL jax.distributed process boundary — intra-host ring hops
+    stay uncompressed, the inter-process hop carries sign bits. Pins (a)
+    both ranks observe the identical loss trajectory, (b) the trajectory
+    matches single-process UNCOMPRESSED Adam within the test_onebit
+    convergence envelope, (c) the modeled inter-host bytes-on-wire drop
+    ≥ 4x post-freeze."""
+    import json as _json
+    import re
+    outs = spawn_workers(2, _HIER_WORKER, tmp_path, local_devices=4,
+                         timeout=300)
+    results = {}
+    for out in outs:
+        m = re.search(r"HIER (\d+) (\{.*\})", out)
+        assert m, out
+        results[int(m.group(1))] = _json.loads(m.group(2))
+    # (a) identical trajectory on both ranks (replicated out-shardings)
+    assert results[0]["losses"] == results[1]["losses"]
+
+    # (c) inter-host wire bytes drop ≥4x once the momentum compresses
+    wire = results[0]["wire"]["compressed"]
+    assert wire["inter_uncompressed"] >= 4 * wire["inter"], wire
+    ctr = results[0]["counters"]
+    assert ctr["comm/bytes_on_wire/inter"] > 0
+    assert ctr["comm/bytes_on_wire/intra"] > 0
+
+    # (b) parity vs single-process uncompressed Adam on 8 local devices
+    import jax
+    if len(jax.devices()) >= 8:
+        import deepspeed_tpu as dstpu
+        from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+        from tests.simple_model import SimpleModel, random_batch, \
+            base_config
+        cfg = base_config()
+        cfg["optimizer"] = {"type": "Adam", "params": {"lr": 1e-2}}
+        engine, _, _, _ = dstpu.initialize(
+            config=cfg, model=SimpleModel(),
+            mesh=make_mesh(MeshConfig(data=8), devices=jax.devices()[:8]))
+        batch = random_batch()
+        ref = [float(engine.train_batch(batch)) for _ in range(15)]
+        l_onebit, l_exact = results[0]["losses"][-1], ref[-1]
+        # the test_onebit convergence pin (compressed tracks exact over
+        # a short horizon — error feedback bounds the drift)
+        assert abs(l_onebit - l_exact) \
+            < 0.5 * max(abs(l_exact), 0.1) + 0.3, (l_onebit, l_exact)

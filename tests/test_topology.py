@@ -88,3 +88,66 @@ def test_grid_stage_to_global():
     other = grid.stage_to_global(stage_id=1)
     assert topo.get_coord(other).pipe == 1
     assert topo.get_coord(other).data == topo.get_coord(0).data
+
+
+# ---------------------------------------------------------------------------
+# data-axis hierarchy derivation (ISSUE 10) — the fast sibling of the
+# slow multi-process test (test_multiprocess_dist.py): the split logic
+# is pure over the mesh's device grid, so process-boundary rules pin
+# here without forking processes.
+# ---------------------------------------------------------------------------
+
+class _FakeDev:
+    def __init__(self, process_index):
+        self.process_index = process_index
+
+
+class _FakeMesh:
+    def __init__(self, procs, axis="data"):
+        import numpy as np
+        self.axis_names = (axis,)
+        self.devices = np.asarray([_FakeDev(p) for p in procs],
+                                  dtype=object)
+        self.shape = {axis: len(procs)}
+
+
+def test_derive_hierarchy_from_process_boundaries():
+    from deepspeed_tpu.parallel.topology import derive_data_hierarchy
+    hier, reason = derive_data_hierarchy(_FakeMesh([0, 0, 0, 0,
+                                                    1, 1, 1, 1]))
+    assert reason == "" and (hier.inter, hier.intra) == (2, 4)
+    assert hier.source == "process"
+
+
+def test_derive_hierarchy_single_process_is_none():
+    from deepspeed_tpu.parallel.topology import derive_data_hierarchy
+    hier, reason = derive_data_hierarchy(_FakeMesh([0, 0, 0, 0]))
+    assert hier is None and "single process" in reason
+
+
+def test_derive_hierarchy_rejects_interleaved_processes():
+    from deepspeed_tpu.parallel.topology import derive_data_hierarchy
+    hier, reason = derive_data_hierarchy(_FakeMesh([0, 1, 0, 1]))
+    assert hier is None and "not contiguous" in reason
+
+
+def test_derive_hierarchy_rejects_uneven_blocks():
+    from deepspeed_tpu.parallel.topology import derive_data_hierarchy
+    hier, reason = derive_data_hierarchy(_FakeMesh([0, 0, 0, 1]))
+    assert hier is None and "uneven" in reason
+
+
+def test_derive_hierarchy_override_wins():
+    from deepspeed_tpu.parallel.topology import derive_data_hierarchy
+    # synthetic split on a single process (the single-process testing
+    # override) — and a non-dividing override is rejected with a reason
+    hier, reason = derive_data_hierarchy(_FakeMesh([0] * 8), slow_axis=2)
+    assert (hier.inter, hier.intra, hier.source) == (2, 4, "override")
+    hier, reason = derive_data_hierarchy(_FakeMesh([0] * 8), slow_axis=3)
+    assert hier is None and "does not divide" in reason
+
+
+def test_derive_hierarchy_trivial_axis_is_none():
+    from deepspeed_tpu.parallel.topology import derive_data_hierarchy
+    hier, reason = derive_data_hierarchy(_FakeMesh([0]))
+    assert hier is None and "nothing to split" in reason
