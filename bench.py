@@ -70,7 +70,7 @@ class SectionRunner:
 BENCH_SECTIONS = ("bert", "train", "sparse", "decode", "llama7b", "moe",
                   "zero3_prefetch", "onebit_comm", "aio", "nvme_param",
                   "elastic_ckpt", "serving", "serving_prefix",
-                  "serving_spec", "infinity6b", "xl")
+                  "serving_spec", "serving_elastic", "infinity6b", "xl")
 
 
 # ---------------------------------------------------------------------------
@@ -142,6 +142,14 @@ def headline_metrics(doc):
                 # one-model-call-per-token decode loop at b1
                 grab("serving.spec_decode_speedup", entry,
                      "spec_decode_speedup", +1)
+            elif name == "serving_elastic":
+                # ISSUE 11: one replica kill + one graceful drain must
+                # keep recovering EVERY request (greedy replay makes
+                # recovery token-lossless, so 1.0 is the only pass);
+                # token-loss/restore-latency ride the detail unguarded
+                # (latency is box-noise-bound on the CPU harness)
+                grab("serving.elastic_recovered_fraction", entry,
+                     "recovered_fraction", +1)
             else:
                 grab(f"decode.{name}.decode_tokens_per_sec", entry,
                      "decode_tokens_per_sec", +1)
@@ -416,6 +424,11 @@ def main(argv=None):
     jax.clear_caches()
     decode["serving_spec_decode"] = runner.run(
         "serving_spec", bench_serving_spec_decode, est_s=300)
+    jax.clear_caches()
+    # ISSUE 11: elastic serving — replica kill + graceful drain
+    # recovery and watchdog-driven autoscale under burst overload
+    decode["serving_elastic"] = runner.run(
+        "serving_elastic", bench_serving_elastic, est_s=420)
     jax.clear_caches()
     moe = runner.run(
         "moe", lambda: bench_moe(dstpu, make_mesh, MeshConfig, dev),
@@ -842,6 +855,18 @@ def bench_serving_spec_decode():
     (BENCH_r05: 95 tok/s llama7b-b1 was one model call per token)."""
     from tests.perf.serving_bench import run_spec_decode_bench
     return run_spec_decode_bench()
+
+
+def bench_serving_elastic():
+    """Elastic preemption-tolerant serving (ISSUE 11): a Poisson trace
+    through a 3-replica pool taking one injected hard kill + one
+    graceful drain, both recovered from committed elastic snapshots
+    (headline gate: ``recovered_fraction`` must stay 1.0;
+    ``committed_token_loss`` must be 0 — greedy replay regenerates the
+    identical streams), plus TTFT p99 under a burst overload with the
+    watchdog-trip autoscaler on vs off."""
+    from tests.perf.serving_bench import run_serving_elastic_bench
+    return run_serving_elastic_bench()
 
 
 def bench_sparse_attention(jnp):

@@ -21,7 +21,10 @@
 # The gated metric set is bench.py's headline_metrics(); since r09 it
 # includes ``onebit_comm.bytes_reduction`` (ISSUE 10: the hierarchical
 # exchange's slow-hop bytes-on-wire reduction, >= 4x — gate against
-# BENCH_r09.json or newer to arm it).
+# BENCH_r09.json or newer to arm it), and since r10
+# ``serving.elastic_recovered_fraction`` (ISSUE 11: every request
+# survives one replica kill + one graceful drain, must stay 1.0) —
+# gate against BENCH_r10.json or newer to arm that one.
 #
 # The --candidate path never imports jax and finishes in <2 s, so this
 # runs on artifact files on any CI box. Typical wiring:

@@ -669,12 +669,169 @@ class ServingSpeculativeConfig:
                 f"ngram=[{self.ngram_min},{self.ngram_max}])")
 
 
+class ServingElasticConfig:
+    """``serving.elastic`` sub-block (ISSUE 11): preemption-tolerant
+    serving. Presence (plus a ``snapshot_path``) enables the SIGTERM
+    drain-or-snapshot path: requests that fit the ``grace_secs`` budget
+    finish, the rest are snapshotted (slot state + referenced K/V pages
+    + prefix index) through the two-rename elastic commit so a restore
+    — possibly on a different engine/replica count — resumes them with
+    greedy outputs token-for-token identical. ``max_retries`` /
+    ``backoff_s`` bound the cross-replica requeue of a failed replica's
+    restored requests."""
+
+    def __init__(self, d):
+        if d is not None and not isinstance(d, dict):
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_ELASTIC} must be a dict with keys "
+                f"[{C.SERVING_ELASTIC_ENABLED}, "
+                f"{C.SERVING_ELASTIC_SNAPSHOT_PATH}, "
+                f"{C.SERVING_ELASTIC_GRACE_SECS}, "
+                f"{C.SERVING_ELASTIC_MAX_RETRIES}, "
+                f"{C.SERVING_ELASTIC_BACKOFF_S}, "
+                f"{C.SERVING_ELASTIC_INTERVAL_TICKS}, "
+                f"{C.SERVING_ELASTIC_KEEP}, {C.SERVING_ELASTIC_FSYNC}, "
+                f"{C.SERVING_ELASTIC_SIGNALS}], got {d!r}")
+        self.enabled = d is not None and bool(
+            d.get(C.SERVING_ELASTIC_ENABLED,
+                  C.SERVING_ELASTIC_ENABLED_DEFAULT))
+        d = d or {}
+        self.snapshot_path = d.get(C.SERVING_ELASTIC_SNAPSHOT_PATH,
+                                   C.SERVING_ELASTIC_SNAPSHOT_PATH_DEFAULT)
+
+        def _num(key, default, cast, what):
+            try:
+                return cast(d.get(key, default))
+            except (TypeError, ValueError):
+                raise DeepSpeedConfigError(
+                    f"serving.elastic.{key} must be {what}, got "
+                    f"{d.get(key)!r}")
+
+        self.grace_secs = _num(C.SERVING_ELASTIC_GRACE_SECS,
+                               C.SERVING_ELASTIC_GRACE_SECS_DEFAULT,
+                               float, "a number of seconds")
+        self.max_retries = _num(C.SERVING_ELASTIC_MAX_RETRIES,
+                                C.SERVING_ELASTIC_MAX_RETRIES_DEFAULT,
+                                int, "an integer retry count")
+        self.backoff_s = _num(C.SERVING_ELASTIC_BACKOFF_S,
+                              C.SERVING_ELASTIC_BACKOFF_S_DEFAULT,
+                              float, "a number of seconds")
+        self.interval_ticks = _num(
+            C.SERVING_ELASTIC_INTERVAL_TICKS,
+            C.SERVING_ELASTIC_INTERVAL_TICKS_DEFAULT, int,
+            "an integer tick count")
+        self.keep = _num(C.SERVING_ELASTIC_KEEP,
+                         C.SERVING_ELASTIC_KEEP_DEFAULT, int,
+                         "an integer generation count")
+        self.fsync = bool(d.get(C.SERVING_ELASTIC_FSYNC,
+                                C.SERVING_ELASTIC_FSYNC_DEFAULT))
+        signals = d.get(C.SERVING_ELASTIC_SIGNALS,
+                        C.SERVING_ELASTIC_SIGNALS_DEFAULT)
+        if isinstance(signals, str):
+            signals = (signals,)   # a bare "SIGTERM" must not iterate
+        self.signals = tuple(signals)  # per character
+        if self.enabled:
+            if not self.snapshot_path:
+                raise DeepSpeedConfigError(
+                    "serving.elastic.snapshot_path must be set when the "
+                    "elastic block is enabled (snapshots need somewhere "
+                    "to land)")
+            if not self.grace_secs > 0:
+                raise DeepSpeedConfigError(
+                    f"serving.elastic.grace_secs must be > 0, got "
+                    f"{self.grace_secs}")
+            if self.max_retries < 0:
+                raise DeepSpeedConfigError(
+                    f"serving.elastic.max_retries must be >= 0, got "
+                    f"{self.max_retries}")
+            if self.backoff_s < 0:
+                raise DeepSpeedConfigError(
+                    f"serving.elastic.backoff_s must be >= 0, got "
+                    f"{self.backoff_s}")
+            if self.interval_ticks < 0:
+                raise DeepSpeedConfigError(
+                    f"serving.elastic.interval_ticks must be >= 0 "
+                    f"(0 = snapshot only on preemption), got "
+                    f"{self.interval_ticks}")
+            if self.keep < 1:
+                raise DeepSpeedConfigError(
+                    f"serving.elastic.keep must be >= 1, got {self.keep}")
+            import signal as _signal
+            for name in self.signals:
+                if not isinstance(getattr(_signal, str(name), None),
+                                  _signal.Signals):
+                    raise DeepSpeedConfigError(
+                        f"serving.elastic.signals: unknown signal "
+                        f"{name!r}")
+
+    def __repr__(self):
+        return (f"ServingElasticConfig(enabled={self.enabled}, "
+                f"snapshot_path={self.snapshot_path!r}, "
+                f"grace_secs={self.grace_secs}, "
+                f"max_retries={self.max_retries}, "
+                f"backoff_s={self.backoff_s}, "
+                f"interval_ticks={self.interval_ticks})")
+
+
+class ServingAutoscaleConfig:
+    """``serving.autoscale`` sub-block (ISSUE 11): replica-pool
+    autoscaling bounds + the scale-up signal. ``"watchdog"`` scales up
+    on latched ttft_blowup / page_pool_exhausted watchdog trips and
+    drains an idle replica (through the elastic snapshot path) to scale
+    down; ``"none"`` pins the pool at ``min_replicas``."""
+
+    def __init__(self, d):
+        if d is not None and not isinstance(d, dict):
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_AUTOSCALE} must be a dict with "
+                f"keys [{C.SERVING_AUTOSCALE_MIN_REPLICAS}, "
+                f"{C.SERVING_AUTOSCALE_MAX_REPLICAS}, "
+                f"{C.SERVING_AUTOSCALE_SCALE_SIGNAL}], got {d!r}")
+        d = d or {}
+
+        def _int(key, default):
+            try:
+                return int(d.get(key, default))
+            except (TypeError, ValueError):
+                raise DeepSpeedConfigError(
+                    f"serving.autoscale.{key} must be an integer, got "
+                    f"{d.get(key)!r}")
+
+        self.min_replicas = _int(C.SERVING_AUTOSCALE_MIN_REPLICAS,
+                                 C.SERVING_AUTOSCALE_MIN_REPLICAS_DEFAULT)
+        self.max_replicas = _int(C.SERVING_AUTOSCALE_MAX_REPLICAS,
+                                 C.SERVING_AUTOSCALE_MAX_REPLICAS_DEFAULT)
+        self.scale_signal = str(d.get(
+            C.SERVING_AUTOSCALE_SCALE_SIGNAL,
+            C.SERVING_AUTOSCALE_SCALE_SIGNAL_DEFAULT))
+        if self.min_replicas < 1:
+            raise DeepSpeedConfigError(
+                f"serving.autoscale.min_replicas must be >= 1, got "
+                f"{self.min_replicas}")
+        if self.max_replicas < self.min_replicas:
+            raise DeepSpeedConfigError(
+                f"serving.autoscale.max_replicas {self.max_replicas} < "
+                f"min_replicas {self.min_replicas}")
+        if self.scale_signal not in C.SERVING_AUTOSCALE_SCALE_SIGNAL_MODES:
+            raise DeepSpeedConfigError(
+                f"serving.autoscale.scale_signal must be one of "
+                f"{list(C.SERVING_AUTOSCALE_SCALE_SIGNAL_MODES)}, got "
+                f"{self.scale_signal!r}")
+
+    def __repr__(self):
+        return (f"ServingAutoscaleConfig(min={self.min_replicas}, "
+                f"max={self.max_replicas}, "
+                f"scale_signal={self.scale_signal!r})")
+
+
 class ServingConfig:
     """tpu-native ``serving`` block: the continuous-batching engine with
     a paged KV cache (deepspeed_tpu/serving). Presence of the block
     enables it; geometry maps 1:1 onto PagedCacheSpec. Optional
-    sub-blocks: ``prefix_cache`` (COW prefix page sharing) and
-    ``speculative`` (drafter-based speculative decoding)."""
+    sub-blocks: ``prefix_cache`` (COW prefix page sharing),
+    ``speculative`` (drafter-based speculative decoding), ``elastic``
+    (drain-or-snapshot preemption tolerance) and ``autoscale``
+    (replica-pool bounds + scale signal)."""
 
     def __init__(self, param_dict):
         d = param_dict.get(C.SERVING, None)
@@ -685,6 +842,10 @@ class ServingConfig:
             d.get(C.SERVING_PREFIX_CACHE, None))
         self.speculative = ServingSpeculativeConfig(
             d.get(C.SERVING_SPECULATIVE, None))
+        self.elastic = ServingElasticConfig(
+            d.get(C.SERVING_ELASTIC, None))
+        self.autoscale = ServingAutoscaleConfig(
+            d.get(C.SERVING_AUTOSCALE, None))
         self.slots = int(d.get(C.SERVING_SLOTS, C.SERVING_SLOTS_DEFAULT))
         self.page_size = int(d.get(C.SERVING_PAGE_SIZE,
                                    C.SERVING_PAGE_SIZE_DEFAULT))

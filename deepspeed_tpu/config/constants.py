@@ -596,3 +596,42 @@ SERVING_SPEC_NGRAM_MAX = "ngram_max"
 SERVING_SPEC_NGRAM_MAX_DEFAULT = 3
 SERVING_SPEC_NGRAM_MIN = "ngram_min"
 SERVING_SPEC_NGRAM_MIN_DEFAULT = 1
+
+# serving.elastic — preemption-tolerant serving (ISSUE 11): on SIGTERM
+# the engine drains requests that fit the grace budget and snapshots
+# the rest (per-slot request state + referenced K/V pages + the prefix
+# index) through the elastic snapshot commit path; a restore rebuilds
+# them on a different engine/replica count
+SERVING_ELASTIC = "elastic"
+SERVING_ELASTIC_ENABLED = "enabled"
+SERVING_ELASTIC_ENABLED_DEFAULT = True        # presence enables
+SERVING_ELASTIC_SNAPSHOT_PATH = "snapshot_path"
+SERVING_ELASTIC_SNAPSHOT_PATH_DEFAULT = ""
+SERVING_ELASTIC_GRACE_SECS = "grace_secs"     # preemption drain budget
+SERVING_ELASTIC_GRACE_SECS_DEFAULT = 30.0
+SERVING_ELASTIC_MAX_RETRIES = "max_retries"   # cross-replica requeue cap
+SERVING_ELASTIC_MAX_RETRIES_DEFAULT = 3
+SERVING_ELASTIC_BACKOFF_S = "backoff_s"       # requeue backoff base
+SERVING_ELASTIC_BACKOFF_S_DEFAULT = 0.05      # (jittered, doubles/try)
+SERVING_ELASTIC_INTERVAL_TICKS = "interval_ticks"
+SERVING_ELASTIC_INTERVAL_TICKS_DEFAULT = 0    # 0 = snapshot only on
+#                                               preemption / drain
+SERVING_ELASTIC_KEEP = "keep"
+SERVING_ELASTIC_KEEP_DEFAULT = 2
+SERVING_ELASTIC_FSYNC = "fsync"
+SERVING_ELASTIC_FSYNC_DEFAULT = True
+SERVING_ELASTIC_SIGNALS = "signals"
+SERVING_ELASTIC_SIGNALS_DEFAULT = ("SIGTERM",)
+
+# serving.autoscale — replica-pool autoscaling (ISSUE 11): the
+# ReplicaPool supervisor scales up on latched watchdog incidents
+# (ttft_blowup / page_pool_exhausted trips) and scales down by
+# draining an idle replica through the same snapshot path
+SERVING_AUTOSCALE = "autoscale"
+SERVING_AUTOSCALE_MIN_REPLICAS = "min_replicas"
+SERVING_AUTOSCALE_MIN_REPLICAS_DEFAULT = 1
+SERVING_AUTOSCALE_MAX_REPLICAS = "max_replicas"
+SERVING_AUTOSCALE_MAX_REPLICAS_DEFAULT = 1
+SERVING_AUTOSCALE_SCALE_SIGNAL = "scale_signal"
+SERVING_AUTOSCALE_SCALE_SIGNAL_DEFAULT = "watchdog"
+SERVING_AUTOSCALE_SCALE_SIGNAL_MODES = ("watchdog", "none")

@@ -11,7 +11,13 @@ failure at exactly that point:
   crash-between-renames window;
 - ``ckpt_between_renames`` (runtime/checkpointing.py save commit) —
   the same window in the blocking checkpoint path (the hazard the
-  comment at checkpointing.py:318 documents).
+  comment at checkpointing.py:318 documents);
+- serving fire points (ISSUE 11): ``serving_admit`` (pages allocated,
+  prefill not yet dispatched — the mid-prefill crash window),
+  ``serving_spec_verify`` (the verify dispatch ran, nothing committed
+  — the mid-spec-verify window), ``serving_tick_end`` (the scheduler's
+  step boundary, where :func:`kill_at_serving_tick` delivers a real
+  SIGTERM mid-serve).
 
 Post-commit corruptions (a torn manifest, a rotted shard) are plain
 file edits — :func:`tear_manifest` / :func:`rot_shard` — because they
@@ -74,6 +80,61 @@ def kill_at_step(at_step, sig=signal.SIGTERM):
             os.kill(os.getpid(), sig)
 
     return inject("step_end", _fn)
+
+
+def kill_at_serving_tick(at_tick, sig=signal.SIGTERM):
+    """Context manager: deliver ``sig`` to this process the first time
+    the serving scheduler finishes tick ``at_tick`` — SIGTERM
+    mid-serve, through the real kernel delivery path (the serving
+    drain-or-snapshot sibling of :func:`kill_at_step`). With a drafter
+    attached the tick boundary sits BETWEEN speculative rounds, so the
+    snapshot the handler triggers must contain only verified tokens."""
+    fired = []
+
+    def _fn(tick=None, **_kw):
+        if tick is not None and tick >= at_tick and not fired:
+            fired.append(True)
+            os.kill(os.getpid(), sig)
+
+    return inject("serving_tick_end", _fn)
+
+
+def crash_replica_mid_prefill(match_rid=None, times=1):
+    """Context manager: crash at ``serving_admit`` — the request's
+    pages are allocated but its prefill never dispatched (the replica
+    dies mid-admission; pool recovery must re-serve it from scratch).
+    ``match_rid`` restricts the crash to one request id; ``times``
+    bounds how many matching admissions crash (``None`` = every one —
+    the permanently-poisoned-request scenario the bounded-retry test
+    drives)."""
+    fired = [0]
+
+    def _fn(rid=None, **_kw):
+        if match_rid is not None and rid != match_rid:
+            return
+        if times is not None and fired[0] >= times:
+            return
+        fired[0] += 1
+        raise SimulatedCrash(
+            f"injected crash at serving_admit (rid={rid})")
+
+    return inject("serving_admit", _fn)
+
+
+def crash_replica_mid_spec_verify(at_round=1):
+    """Context manager: crash at the ``at_round``-th
+    ``serving_spec_verify`` point — the verify dispatch completed but
+    no token of the round was committed (drafted-but-unverified rows
+    sit past every slot's pos and must never surface in a restore)."""
+    seen = [0]
+
+    def _fn(**_kw):
+        seen[0] += 1
+        if seen[0] == at_round:
+            raise SimulatedCrash(
+                f"injected crash at serving_spec_verify round {at_round}")
+
+    return inject("serving_spec_verify", _fn)
 
 
 def crash_between_renames(point="snapshot_between_renames"):

@@ -22,6 +22,10 @@ from deepspeed_tpu.serving.engine import (        # noqa: F401
     ContinuousBatcher, Request)
 from deepspeed_tpu.serving.adapters import (      # noqa: F401
     GPT2ServingAdapter, LlamaServingAdapter)
+from deepspeed_tpu.serving.elastic import (       # noqa: F401
+    ElasticServingController, capture_state, load_latest_serving,
+    load_serving_snapshot, restore_serving, snapshot_serving)
+from deepspeed_tpu.serving.replica_pool import ReplicaPool  # noqa: F401
 
 
 def _param_dict(config):
@@ -166,8 +170,16 @@ def build_engine(family: str, model_config, params, config=None,
                                    ngram_min=sc.speculative.ngram_min)
     # registry: pass telemetry.default_registry() to merge the serving
     # metrics into the process-wide stream; default is per-engine
-    return ContinuousBatcher(adapter, rng=rng, registry=registry,
-                             recorder=recorder, watchdog=watchdog,
-                             prefix_cache=sc.prefix_cache.enabled,
-                             prefix_cow=sc.prefix_cache.cow,
-                             drafter=drafter, spec_tokens=spec_tokens)
+    cb = ContinuousBatcher(adapter, rng=rng, registry=registry,
+                           recorder=recorder, watchdog=watchdog,
+                           prefix_cache=sc.prefix_cache.enabled,
+                           prefix_cow=sc.prefix_cache.cow,
+                           drafter=drafter, spec_tokens=spec_tokens)
+    # ISSUE 11: a serving.elastic block attaches the drain-or-snapshot
+    # preemption controller (SIGTERM → finish what fits the grace
+    # budget, snapshot the rest through the two-rename commit path)
+    if sc.elastic.enabled:
+        from deepspeed_tpu.serving.elastic import ElasticServingController
+        cb.attach_elastic(ElasticServingController.from_config(
+            cb, sc.elastic))
+    return cb

@@ -30,6 +30,42 @@ import numpy as np
 import jax
 
 
+def _realign_restored(drafter, slot: int, prompt: np.ndarray,
+                      generated: List[int], total_tokens: int) -> None:
+    """ONE restore-realignment rule for both drafters: admit the prompt
+    with the first committed token, then feed the remaining committed
+    tokens through observe_plain (generated[:-1] fed -> generated[1:]
+    committed — the engine's own feed/commit alignment, so the
+    drafter's pos lands at S + len(generated) - 1 exactly like the
+    engine slot's)."""
+    prompt = np.asarray(prompt, np.int32)  # sync-ok: host token list
+    gen = [int(t) for t in generated]
+    assert gen, "a restored slot always holds the prefill-sampled token"
+    drafter.admit(slot, prompt, gen[0], total_tokens)
+    feed_all = gen[:-1]
+    committed_all = gen[1:]
+    B = len(getattr(drafter, "pos", getattr(drafter, "_hist", [])))
+    off = 0
+    while off < len(feed_all):
+        # pow2 chunks (largest-first decomposition, capped at the
+        # engine's tick ceiling): a ModelDrafter's observe_plain
+        # compiles one verify program per distinct row count, and the
+        # tick/verify paths only ever dispatch pow2 rows — an
+        # arbitrary-length realign here would compile a fresh program
+        # per restored progress value, right on the restore hot path
+        n = 32
+        while n > len(feed_all) - off:
+            n //= 2
+        cols_feed = np.zeros((n, B), np.int32)
+        cols_committed = np.zeros((n, B), np.int32)
+        cols_feed[:, slot] = np.asarray(       # sync-ok: host lists
+            feed_all[off:off + n], np.int32)
+        cols_committed[:, slot] = np.asarray(  # sync-ok: host lists
+            committed_all[off:off + n], np.int32)
+        drafter.observe_plain([slot], cols_feed, cols_committed)
+        off += n
+
+
 class NGramDrafter:
     """Prompt-lookup drafting: propose the continuation of the most
     recent earlier occurrence of the request's trailing n-gram."""
@@ -93,6 +129,16 @@ class NGramDrafter:
         align with ``active_slots`` order)."""
         return np.stack([self._propose(self._hist[s], k)
                          for s in active_slots])
+
+    def restore_slot(self, slot: int, prompt: np.ndarray,
+                     generated: List[int], total_tokens: int) -> None:
+        """Realign after an elastic restore (ISSUE 11): the slot's
+        committed stream is ``prompt + generated`` and the drafter saw
+        none of it — ``admit`` + the existing ``observe_plain``
+        contract rebuild exactly the state an uninterrupted run would
+        hold (for a ModelDrafter that includes the K/V rows, fed
+        through one teacher-forcing verify dispatch)."""
+        _realign_restored(self, slot, prompt, generated, total_tokens)
 
 
 class ModelDrafter:
@@ -193,3 +239,11 @@ class ModelDrafter:
             self.pos[s] += k              # provisional; commit() rewinds
             self.last[s] = toks_seq[-1, s]
         return toks_seq[:, active_slots].T.astype(np.int32)
+
+    def restore_slot(self, slot: int, prompt: np.ndarray,
+                     generated: List[int], total_tokens: int) -> None:
+        """Elastic-restore realignment (see NGramDrafter.restore_slot):
+        re-prefill the prompt through the drafter's own cache, then
+        teacher-force the committed tokens so its K/V holds real rows
+        at every committed position."""
+        _realign_restored(self, slot, prompt, generated, total_tokens)

@@ -1,0 +1,650 @@
+"""Elastic preemption-tolerant serving (ISSUE 11 tentpole).
+
+A preempted serving process used to lose every in-flight request and
+the whole prefix cache. This module points PR 7's elastic machinery at
+the continuous-batching engine:
+
+- :func:`capture_state` — one consistent host-side capture of a
+  :class:`~deepspeed_tpu.serving.engine.ContinuousBatcher` at a tick
+  boundary: per-slot request state (token stream, sampling params,
+  page-table rows), the queued requests, the prefix index, and the
+  K/V bytes of every REFERENCED pool block (one device gather + d2h
+  per pool component — never the whole pool).
+- :func:`snapshot_serving` — the capture written through
+  :class:`~deepspeed_tpu.runtime.elastic.snapshot.AsyncSnapshotter`:
+  async aio writes, crc32-manifested index, and the two-rename
+  ``commit_dir_swap`` commit, so a crash mid-commit recovers to the
+  previous valid snapshot exactly like a training checkpoint.
+- :func:`restore_serving` — rebuild the requests on a DIFFERENT
+  engine (different slot count, different pool size, different
+  replica): saved pages re-register through the refcounted allocator
+  (shared pages stay shared), the prefix index re-imports its entries
+  so the hit-rate survives the restore, spec drafters realign through
+  the existing ``observe_plain`` contract, and requests that don't fit
+  the target's free slots REQUEUE as replay requests (the committed
+  stream becomes the admission prompt — greedy decoding regenerates
+  the same continuation token for token).
+- :class:`ElasticServingController` — the drain-or-snapshot policy at
+  every tick boundary: on SIGTERM (``runtime/elastic/preemption.py``'s
+  lock-free handler chain) the engine stops admitting and keeps
+  ticking while the closest-to-done request still fits the remaining
+  grace budget; when nothing more can finish in time, everything left
+  is snapshotted and the engine parks (``cb.preempted``). Periodic
+  snapshots (``interval_ticks``) overlap the following ticks the same
+  way training snapshots overlap the next step.
+
+K/V pages are APPEND-ONLY and ``slot.pos`` advances only on commit, so
+a snapshot taken at a tick boundary contains committed tokens only —
+a SIGTERM landing mid-speculation rolls back to the last verified
+token by construction (the rows past ``pos`` are never captured as
+state, only as dead bytes in their pages).
+"""
+
+import os
+import time
+
+import numpy as np
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.elastic import faults
+from deepspeed_tpu.runtime.elastic.preemption import PreemptionHandler
+from deepspeed_tpu.runtime.elastic.snapshot import (
+    AsyncSnapshotter, SnapshotCorrupt, SnapshotReader, is_snapshot_dir)
+from deepspeed_tpu.serving.engine import Request
+from deepspeed_tpu.utils.logging import logger
+
+SERVING_KIND = "dstpu-serving-elastic-1"
+
+
+class ServingRestoreError(ValueError):
+    """The snapshot cannot be restored onto this engine (incompatible
+    cache geometry) — distinct from SnapshotCorrupt: the snapshot is
+    fine, the target is wrong."""
+
+
+# --------------------------------------------------------------- capture
+
+def _req_doc(req):
+    return {
+        "rid": req.rid,
+        "prompt": np.asarray(req.prompt, np.int32).tolist(),  # sync-ok:
+        #                                             host token arrays
+        "generated": [int(t) for t in req.generated],
+        "max_new_tokens": int(req.max_new_tokens),
+        "eos_token_id": None if req.eos_token_id is None
+        else int(req.eos_token_id),
+        "temperature": float(req.temperature),
+    }
+
+
+def capture_state(cb):
+    """One consistent capture of a batcher at a tick boundary. Returns
+    ``(host_state, kv)``: ``host_state`` is a JSON-able dict (slots,
+    queue, prefix index, page map) and ``kv`` maps ``c<j>`` to the
+    j-th pool component's referenced blocks ``[Lyr, n_pages, ...]``
+    (host numpy — the snapshot's only device readback)."""
+    cache = cb.cache
+    blocks, index_of = [], {}
+
+    def sidx(blk):
+        blk = int(blk)
+        if blk not in index_of:
+            index_of[blk] = len(blocks)
+            blocks.append(blk)
+        return index_of[blk]
+
+    slots_doc = []
+    for i, slot in enumerate(cb.slots):
+        if not slot.active:
+            continue
+        slots_doc.append({
+            **_req_doc(slot.request),
+            "pos": int(slot.pos),
+            "last_tok": int(slot.last_tok),
+            "pages": [sidx(b) for b in cache.slot_pages(i)],
+        })
+    queued_doc = [_req_doc(r) for r in cb.queue]
+    prefix_doc = None
+    if cb.prefix_cache:
+        exp = cache.export_prefix_entries()
+        prefix_doc = {
+            "full": [{"page": sidx(e["block"]), "key": e["key"],
+                      "tokens": e["tokens"]} for e in exp["full"]],
+            "partial": [{"page": sidx(e["block"]), "chain": e["chain"],
+                         "tokens": e["tokens"]} for e in exp["partial"]],
+        }
+    host = {
+        "format": SERVING_KIND,
+        "slots": slots_doc,
+        "queued": queued_doc,
+        "prefix": prefix_doc,
+        "n_pages": len(blocks),
+        "page_size": int(cache.spec.page_size),
+        "kv_cache_bits": int(cache.spec.kv_cache_bits),
+    }
+    kv = {}
+    if blocks:
+        sel = jnp.asarray(np.asarray(blocks, np.int32))  # sync-ok: host
+        #                                                  block-id list
+        for j, comp in enumerate(cache.pool):
+            # the one deliberate d2h of the snapshot: only REFERENCED
+            # blocks leave the device, gathered in one op per component
+            kv[f"c{j}"] = np.asarray(comp[:, sel])  # sync-ok: snapshot
+            #                                         capture d2h
+    return host, kv
+
+
+# -------------------------------------------------------------- snapshot
+
+def snapshot_serving(cb, snapshotter, tag, meta=None, finalize=True):
+    """Write one committed serving snapshot through ``snapshotter``
+    (an :class:`AsyncSnapshotter` rooted at the serving snapshot dir).
+    With ``finalize=False`` the aio writes are left in flight so they
+    overlap the following ticks — call ``snapshotter.finalize()`` at a
+    later tick boundary (the controller's periodic mode). Returns the
+    committed directory (or None when not finalizing)."""
+    host, kv = capture_state(cb)
+    # the marker leaf keeps a request-only snapshot (queued work, zero
+    # pages) readable — SnapshotReader rejects an empty leaf index
+    trees = {"serving_kv": dict(kv, marker=np.zeros(1, np.uint8))}
+    n_req = len(host["slots"]) + len(host["queued"])
+    snapshotter.begin(tag, trees, extra={"serving": host},
+                      meta={"kind": SERVING_KIND, **(meta or {})})
+    cb.recorder.record("serving_snapshot", tag=str(tag), requests=n_req,
+                       slots=len(host["slots"]),
+                       queued=len(host["queued"]),
+                       pages=host["n_pages"])
+    if finalize:
+        path, _stall = snapshotter.finalize()
+        return path
+    return None
+
+
+def load_serving_snapshot(snap_dir, verify=True):
+    """Validated load of one committed serving snapshot: manifest +
+    per-file crc32 checks up front (:class:`SnapshotReader`), then the
+    host state doc and the K/V component arrays. Raises
+    :class:`SnapshotCorrupt` on any validation failure."""
+    reader = SnapshotReader(snap_dir, verify=verify)
+    if reader.manifest.get("kind") != SERVING_KIND:
+        raise SnapshotCorrupt(
+            f"{snap_dir} is not a serving snapshot "
+            f"(kind={reader.manifest.get('kind')!r})")
+    host = (reader.manifest.get("extra") or {}).get("serving")
+    if not isinstance(host, dict) or host.get("format") != SERVING_KIND:
+        raise SnapshotCorrupt(f"{snap_dir} carries no serving state doc")
+    kv = reader.assemble("serving_kv")
+    kv.pop("marker", None)
+    reader.close()
+    return host, kv
+
+
+def load_latest_serving(snapshot_dir, on_corrupt=None, verify=True):
+    """Newest serving snapshot under ``snapshot_dir`` that validates,
+    as ``(host_state, kv, snap_dir)`` — or None. Same recovery policy
+    as training resume (mtime order, ``latest`` pointer as tie-break,
+    ``.old`` crash-window siblings): corrupt candidates invoke
+    ``on_corrupt(path, exc)`` and are skipped."""
+    from deepspeed_tpu.runtime.elastic.resume import _candidates
+    for cand in _candidates(snapshot_dir):
+        if not is_snapshot_dir(cand):
+            continue
+        try:
+            host, kv = load_serving_snapshot(cand, verify=verify)
+            return host, kv, cand
+        except SnapshotCorrupt as e:
+            logger.warning(f"serving snapshot {cand} invalid ({e}); "
+                           f"falling back to an older one")
+            if on_corrupt is not None:
+                on_corrupt(cand, e)
+    return None
+
+
+# --------------------------------------------------------------- restore
+
+def resume_request(doc):
+    """A REPLAY request for one snapshotted request doc: the committed
+    stream (prompt + generated) becomes the admission prompt, so the
+    prefill recomputes its K/V and — greedy decoding being
+    deterministic — the continuation is token-for-token the one the
+    uninterrupted run would have produced. ``tokens()`` of the finished
+    replay equals ``tokens()`` of the uninterrupted original (the
+    prompt/generated split moves; the stream doesn't)."""
+    prompt = np.asarray(list(doc["prompt"]) + list(doc["generated"]),
+                        np.int32)   # sync-ok: host snapshot doc
+    rem = int(doc["max_new_tokens"]) - len(doc["generated"])
+    assert rem >= 1, "a finished request never lands in a snapshot"
+    req = Request(doc["rid"], prompt, max_new_tokens=rem,
+                  eos_token_id=doc.get("eos_token_id"),  # sync-ok: host
+                  temperature=float(doc.get("temperature", 0.0)))
+    req.resumed_committed = len(doc["generated"])
+    return req
+
+
+def restore_serving(cb, host, kv, requeue_overflow=True):
+    """Rebuild snapshotted requests on ``cb`` (any slot/pool geometry
+    with the same model): the most-progressed requests take free slots
+    DIRECTLY — their pages are re-allocated through the refcounted
+    allocator, the saved K/V bytes scattered back in one device op per
+    pool component, page tables and slot state rebuilt, drafters
+    realigned — and everything that doesn't fit (plus the snapshot's
+    queue) is requeued as replay requests. Prefix-index entries
+    re-import against the restored pages (refcount-0 entries become
+    resident cache again) so the hit-rate survives; they are the first
+    thing dropped under pool pressure.
+
+    Returns ``{"restored": [...], "requeued": [...],
+    "dropped_prefix_pages": n, "restore_s": s}``."""
+    t0 = time.perf_counter()
+    cache = cb.cache
+    n_pages = int(host.get("n_pages", 0))
+    comps = [kv.get(f"c{j}") for j in range(len(cache.pool))]
+    if n_pages:
+        for j, comp in enumerate(cache.pool):
+            arr = comps[j]
+            if arr is None or arr.shape[0] != comp.shape[0] \
+                    or tuple(arr.shape[2:]) != tuple(comp.shape[2:]) \
+                    or arr.shape[1] != n_pages:
+                raise ServingRestoreError(
+                    f"snapshot KV component c{j} "
+                    f"{None if arr is None else arr.shape} does not fit "
+                    f"the target pool {comp.shape} (same model/page "
+                    f"geometry required)")
+    if int(host.get("page_size", cache.spec.page_size)) \
+            != cache.spec.page_size:
+        raise ServingRestoreError(
+            f"snapshot page_size {host.get('page_size')} != target "
+            f"{cache.spec.page_size}")
+
+    # a request over the TARGET's per-slot/prompt capacity can neither
+    # rebuild directly nor replay (submit enforces the same ceilings)
+    # — surface the geometry mismatch BEFORE mutating the target,
+    # instead of a deep admission assert after pages were adopted
+    P = cache.spec.page_size
+    max_prompt_pages = cb.adapter.max_prompt_len() // P
+    over = []
+    for sd in list(host.get("slots", [])) + list(host.get("queued", [])):
+        total = len(sd["prompt"]) + int(sd["max_new_tokens"])
+        # the replay prompt folds committed tokens in, so its
+        # whole-page prefill constraint covers prompt+generated
+        replay_prompt = len(sd["prompt"]) + len(sd["generated"])
+        if cache.pages_needed(total) > cache.spec.max_pages_per_slot \
+                or cache.pages_needed(max(replay_prompt, 1)) \
+                > max_prompt_pages:
+            over.append(sd["rid"])
+    if over:
+        raise ServingRestoreError(
+            f"request(s) {over} exceed the target's per-slot page "
+            f"capacity ({cache.spec.max_pages_per_slot} pages of {P}) "
+            f"or prompt-page budget — restore onto an engine with at "
+            f"least the snapshot engine's capacity")
+
+    # most-progressed first: replaying those would cost the most
+    saved = sorted(host.get("slots", []),
+                   key=lambda s: -len(s["generated"]))
+    free_slots = [i for i, s in enumerate(cb.slots) if not s.active]
+    chosen = saved[:len(free_slots)]
+    overflow = saved[len(free_slots):] + list(host.get("queued", []))
+
+    # allocate the direct slots' pages (shared saved pages allocate
+    # ONCE — sharing survives the restore); on shortfall the least-
+    # progressed chosen slot falls back to the requeue path and we try
+    # again with the smaller set
+    while True:
+        uniq, seen = [], set()
+        for sd in chosen:
+            for p in sd["pages"]:
+                if p not in seen:
+                    seen.add(p)
+                    uniq.append(p)
+        fresh = cache.take_blocks(len(uniq))
+        if fresh is not None:
+            break
+        if not chosen:
+            fresh, uniq = [], []
+            break
+        overflow.insert(0, chosen.pop())
+    blk_map = dict(zip(uniq, fresh))
+
+    # prefix entries ride along best-effort: entries over slot pages
+    # share the mapping, cache-only entries get their own block while
+    # the pool can spare one (tracked in extra_blocks — a failed
+    # import must hand such a block straight back or it leaks:
+    # refcount 0, unregistered, on no list)
+    prefix_entries = []
+    dropped_prefix = 0
+    extra_blocks = {}
+    if cb.prefix_cache and host.get("prefix"):
+        for kind in ("full", "partial"):
+            for e in host["prefix"].get(kind, []):
+                prefix_entries.append((kind, e))
+        for _, e in prefix_entries:
+            p = e["page"]
+            if p in blk_map:
+                continue
+            got = cache.take_blocks(1)
+            if not got:
+                dropped_prefix += 1
+                continue
+            blk_map[p] = extra_blocks[p] = got[0]
+        prefix_entries = [(k, e) for k, e in prefix_entries
+                          if e["page"] in blk_map]
+
+    # ONE scatter per pool component writes every restored block
+    if blk_map:
+        pairs = sorted(blk_map.items())
+        src = np.asarray([p for p, _ in pairs], np.int32)  # sync-ok:
+        dst = jnp.asarray(                                 # host ids
+            np.asarray([b for _, b in pairs], np.int32))   # sync-ok: host
+        cache.pool = tuple(
+            comp.at[:, dst].set(jnp.asarray(comps[j][:, src]))
+            for j, comp in enumerate(cache.pool))
+
+    restored = []
+    now = time.monotonic()
+    for sd, slot_id in zip(chosen, free_slots):
+        cache.adopt_slot(slot_id, [blk_map[p] for p in sd["pages"]])
+        req = Request(sd["rid"],
+                      np.asarray(sd["prompt"], np.int32),  # sync-ok:
+                      max_new_tokens=int(sd["max_new_tokens"]),  # host
+                      eos_token_id=sd.get("eos_token_id"),  # snapshot doc
+                      temperature=float(sd.get("temperature", 0.0)))
+        req.generated = [int(t) for t in sd["generated"]]
+        req._t_submit = now
+        slot = cb.slots[slot_id]
+        slot.request = req
+        slot.pos = int(sd["pos"])
+        slot.last_tok = int(sd["last_tok"])
+        if cb.drafter is not None:
+            cb.drafter.restore_slot(
+                slot_id, req.prompt, req.generated,
+                len(sd["prompt"]) + int(sd["max_new_tokens"]))
+        restored.append(req)
+
+    # import the prefix index AFTER adoption: entries over live slot
+    # pages register at refcount > 0, cache-only entries at refcount 0
+    # become resident (evictable) exactly as they were. A DUPLICATE
+    # (the target already indexes the same content — e.g. a survivor
+    # that served the same prompts) returns False without registering:
+    # a block allocated solely for that entry goes straight back
+    for kind, e in prefix_entries:
+        blk = blk_map[e["page"]]
+        if kind == "full":
+            ok = cache.import_prefix_entry(blk, e["tokens"],
+                                           key=bytes.fromhex(e["key"]))
+        else:
+            ok = cache.import_prefix_entry(
+                blk, e["tokens"], chain=bytes.fromhex(e["chain"]))
+        if not ok and e["page"] in extra_blocks:
+            cache.return_blocks([extra_blocks.pop(e["page"])])
+            del blk_map[e["page"]]
+            dropped_prefix += 1
+
+    requeued = []
+    if requeue_overflow:
+        for sd in overflow:
+            req = resume_request(sd)
+            cb.submit(req)
+            cb.recorder.record("serving_requeue", rid=sd["rid"],
+                               committed=len(sd["generated"]),
+                               remaining=req.max_new_tokens)
+            requeued.append(req)
+    restore_s = time.perf_counter() - t0
+    cb.recorder.record("serving_restore", restored=len(restored),
+                       requeued=len(requeued), pages=len(blk_map),
+                       dropped_prefix_pages=dropped_prefix,
+                       restore_s=restore_s)
+    m = cb.metrics
+    m.counter("serving/restored_requests").inc(len(restored))
+    m.counter("serving/requeued_requests").inc(len(requeued))
+    m.histogram("serving/restore_s").observe(restore_s)
+    cb._note_pool()
+    return {"restored": restored, "requeued": requeued,
+            "overflow": list(overflow),
+            "dropped_prefix_pages": dropped_prefix,
+            "restore_s": restore_s}
+
+
+# ------------------------------------------------------------ controller
+
+class ElasticServingController:
+    """Drain-or-snapshot policy for one batcher (see module docstring).
+    Attach with ``cb.attach_elastic(controller)`` — ``build_engine``
+    does it from a ``serving.elastic`` config block. The engine calls
+    :meth:`on_tick_end` at every step boundary."""
+
+    def __init__(self, cb, snapshot_path, grace_secs=30.0,
+                 interval_ticks=0, keep=2, fsync=True,
+                 signals=("SIGTERM",), max_retries=3, backoff_s=0.05,
+                 watchdog=None, aio_config=None, install_signals=True):
+        self.cb = cb
+        self.snapshot_dir = str(snapshot_path)
+        self.grace_secs = float(grace_secs)   # sync-ok: config scalar
+        self.interval_ticks = int(interval_ticks)
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)     # sync-ok: config scalar
+        self.watchdog = watchdog
+        self.snapshotter = AsyncSnapshotter(
+            self.snapshot_dir, aio_config=aio_config, fsync=fsync,
+            keep=keep, recorder=cb.recorder)
+        self.preemption = PreemptionHandler(
+            signals if install_signals else (), grace_s=self.grace_secs,
+            recorder=cb.recorder)
+        self.preempted = False
+        self.last_snapshot_dir = None
+        self._draining = False
+        self._preempt_pending_rids = None
+        self._begin_tick = None
+        self._begin_info = None
+        self._last_snap_tick = -1
+        self._seq = 0
+        self._t_last = None
+        self._est_step_s = None
+
+    @classmethod
+    def from_config(cls, cb, elastic_cfg, watchdog=None,
+                    install_signals=True):
+        """None when the block is off (mirrors Watchdog.from_config)."""
+        if not getattr(elastic_cfg, "enabled", False):
+            return None
+        return cls(cb, elastic_cfg.snapshot_path,
+                   grace_secs=elastic_cfg.grace_secs,
+                   interval_ticks=elastic_cfg.interval_ticks,
+                   keep=elastic_cfg.keep, fsync=elastic_cfg.fsync,
+                   signals=elastic_cfg.signals,
+                   max_retries=elastic_cfg.max_retries,
+                   backoff_s=elastic_cfg.backoff_s, watchdog=watchdog,
+                   install_signals=install_signals)
+
+    def _wd(self):
+        return self.watchdog if self.watchdog is not None \
+            else self.cb.watchdog
+
+    def _next_tag(self):
+        self._seq += 1
+        return f"serving_{os.getpid()}_{self._seq:04d}"
+
+    def request_preemption(self, source="manual"):
+        """Programmatic preemption (scale-down drain, tests) — same
+        path as a delivered signal."""
+        self.preemption.request(source)
+
+    # ------------------------------------------------------------- tick
+
+    def on_tick_end(self, idle=False):
+        if self.preempted:
+            return
+        now = time.monotonic()
+        if idle:
+            # an arrival-wait poll, not a decode tick: handle a pending
+            # signal below but keep the ~50ms sleeps OUT of the
+            # tick-latency EMA the drain budget divides by
+            self._t_last = None
+        else:
+            if self._t_last is not None:
+                dt = now - self._t_last
+                self._est_step_s = dt if self._est_step_s is None \
+                    else 0.5 * self._est_step_s + 0.5 * dt
+            self._t_last = now
+        tick = self.cb.stats["ticks"]
+        if self.snapshotter.in_flight and not self._draining \
+                and tick > self._begin_tick:
+            self._finalize_periodic()
+        if self.preemption.requested:
+            self.preemption.poll_event()
+            self._preempt_tick()
+            return
+        if self.interval_ticks and self.cb.pending \
+                and not self.snapshotter.in_flight \
+                and tick >= self._last_snap_tick + self.interval_ticks:
+            # periodic snapshot: begin now, writes overlap the next
+            # tick(s), commit at the next boundary past this tick
+            self._last_snap_tick = tick
+            self._begin_tick = tick
+            tag = self._next_tag()
+            snapshot_serving(self.cb, self.snapshotter, tag,
+                             finalize=False)
+
+    def _finalize_periodic(self):
+        try:
+            path, stall = self.snapshotter.finalize()
+        except faults.SimulatedCrash:
+            raise
+        except Exception as e:   # ENOSPC etc: serving must outlive it
+            logger.warning(f"serving snapshot commit failed: {e}")
+            return
+        self.last_snapshot_dir = path
+        wd = self._wd()
+        if wd is not None:
+            wd.observe_ckpt_stall(stall, step=self.cb.stats["ticks"])
+
+    # ---------------------------------------------------------- preempt
+
+    def _pending_rids(self):
+        cb = self.cb
+        rids = [s.request.rid for s in cb.slots if s.active]
+        rids += [r.rid for r in cb.queue]
+        return rids
+
+    def _preempt_tick(self):
+        cb = self.cb
+        if not self._draining:
+            self._draining = True
+            self._preempt_pending_rids = list(self._pending_rids())
+            cb._admitting = False   # the snapshot set must stop growing
+            if self.snapshotter.in_flight:
+                # a periodic snapshot in flight predates the drain's
+                # finishes — the final snapshot supersedes it
+                self.snapshotter.abort("superseded by final snapshot")
+        active = [s.request for s in cb.slots if s.active]
+        if active:
+            rem = self.preemption.remaining()
+            est = self._est_step_s or 0.0
+            margin = min(0.25 * self.grace_secs, 2.0)
+            budget = (rem if rem is not None else self.grace_secs) \
+                - margin
+            min_rem_toks = min(r.max_new_tokens - len(r.generated)
+                               for r in active)
+            if budget > max(min_rem_toks, 1) * est:
+                return          # the closest-to-done request still fits
+        self._final_snapshot()
+
+    def _final_snapshot(self):
+        cb = self.cb
+        left = self._pending_rids()
+        drained = [r for r in self._preempt_pending_rids
+                   if r not in left]
+        snapshotted = False
+        if not left:
+            # clean drain: every request finished inside the grace
+            # budget, so any PERIODIC snapshot still on disk is stale —
+            # leaving it would make a later recovery replay completed
+            # requests. The engine owes nothing; prune the dir.
+            self._prune_all()
+        else:
+            # attempted even past the grace deadline: the commit is
+            # atomic (two-rename), so losing the race to the external
+            # killer leaves the previous valid snapshot — while NOT
+            # attempting guarantees these requests are lost (unlike
+            # training, no older snapshot holds them)
+            tag = self._next_tag()
+            try:
+                self.last_snapshot_dir = snapshot_serving(
+                    cb, self.snapshotter, tag)
+                snapshotted = True
+            except faults.SimulatedCrash:
+                # the injected crash-between-renames: disk is left
+                # as the crash would leave it; the engine still parks
+                cb.recorder.record(
+                    "serving_drain", drained=len(drained),
+                    left=len(left), snapshotted=False,
+                    grace_s=self.grace_secs)
+                self.preempted = True
+                raise
+            except Exception as e:
+                logger.warning(f"final serving snapshot failed: {e}")
+        cb.recorder.record("serving_drain", drained=len(drained),
+                           left=len(left), snapshotted=snapshotted,
+                           grace_s=self.grace_secs,
+                           source=self.preemption.source)
+        wd = self._wd()
+        if wd is not None:
+            wd.note_preempt(step=cb.stats["ticks"],
+                            snapshotted=snapshotted,
+                            grace_s=self.grace_secs,
+                            source=self.preemption.source)
+        self.preempted = True
+
+    def _prune_all(self):
+        """Remove every committed snapshot (clean-drain cleanup — see
+        _final_snapshot). Other engines' dirs are untouched: each
+        controller owns its own snapshot_dir."""
+        import shutil
+        from deepspeed_tpu.runtime import checkpointing as ckpt
+        self.last_snapshot_dir = None
+        try:
+            names = os.listdir(self.snapshot_dir)
+        except OSError:
+            return
+        pruned = 0
+        for name in names:
+            path = os.path.join(self.snapshot_dir, name)
+            if os.path.isdir(path) and (
+                    is_snapshot_dir(path)
+                    or name.endswith((".old", ".saving"))):
+                shutil.rmtree(path, ignore_errors=True)
+                pruned += 1
+        try:
+            os.remove(os.path.join(self.snapshot_dir, ckpt.LATEST_FILE))
+        except OSError:
+            pass
+        if pruned:
+            self.cb.recorder.record("serving_snapshot_prune",
+                                    pruned=pruned, reason="clean_drain")
+
+    # ------------------------------------------------------------ close
+
+    def finalize_pending(self):
+        """Commit an in-flight periodic snapshot (clean-shutdown hook,
+        mirrors engine.finalize_pending_snapshot)."""
+        if self.snapshotter.in_flight:
+            self._finalize_periodic()
+
+    def release(self):
+        """Retire the controller WITHOUT touching the signal table:
+        aborts any in-flight snapshot and leaves the installed handlers
+        as weakref pass-throughs. This is what a pool supervisor must
+        use when retiring ONE replica — ``restore()`` would reinstall
+        the pre-replica handler and silently drop every LATER-installed
+        replica's handler from the chain, so a real SIGTERM would never
+        reach them."""
+        if self.snapshotter.in_flight:
+            self.snapshotter.abort("controller released")
+
+    def close(self):
+        """Drop any in-flight snapshot and reinstall the previous
+        signal handlers — tests and short-lived single engines call
+        this (a pool retiring one of several replicas must use
+        :meth:`release` instead; see its docstring)."""
+        self.release()
+        self.preemption.restore()

@@ -30,6 +30,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.runtime.elastic import faults
 from deepspeed_tpu.serving.paged_cache import (PagedKVCache,
                                                padded_prefill_inputs,
                                                pow2_page_bucket)
@@ -133,6 +134,23 @@ class ContinuousBatcher:
             else default_recorder()
         self.watchdog = watchdog
         self._t_first_decode = None   # engine-lifetime tokens/sec base
+        # ISSUE 11: elastic preemption tolerance — an
+        # ElasticServingController (serving/elastic.py) attached here
+        # runs the drain-or-snapshot policy at every tick end; while it
+        # drains, _admitting gates new admissions off so the snapshot
+        # set stops growing
+        self.elastic = None
+        self._admitting = True
+
+    @property
+    def preempted(self) -> bool:
+        """True once the elastic controller finished its
+        drain-or-snapshot pass — serve() stops stepping and the
+        leftover requests live in the committed snapshot."""
+        return self.elastic is not None and self.elastic.preempted
+
+    def attach_elastic(self, controller) -> None:
+        self.elastic = controller
 
     # ----------------------------------------------------------- metrics
 
@@ -323,6 +341,10 @@ class ContinuousBatcher:
                 break
             self.queue.popleft()
             free.pop(0)
+            # fault point (ISSUE 11): pages are allocated, nothing is
+            # prefilled yet — a replica dying HERE models the
+            # mid-prefill crash the pool recovery tests drive
+            faults.fire("serving_admit", rid=req.rid, slot=slot_id)
             t_admit = time.monotonic()
             # wait since the request became ADMISSIBLE (its arrival
             # under respect_arrival_times, its submit otherwise)
@@ -572,6 +594,11 @@ class ContinuousBatcher:
         #                               row per slot feeds last_logits.
         tick_s = time.monotonic() - t0
         n_active = len(active)
+        # fault point (ISSUE 11): the verify dispatch ran but NOTHING is
+        # committed yet — a crash here models dying mid-spec-verify;
+        # every slot's pos still points at its last committed token, so
+        # a snapshot/restore sees only verified tokens
+        faults.fire("serving_spec_verify", rows=V, active=n_active)
         self.recorder.record("spec_round", rows=V, active=n_active,
                              tick_s=tick_s)
         m = self.metrics
@@ -648,14 +675,69 @@ class ContinuousBatcher:
             return self._tick()
         return self._spec_tick(V, active)
 
+    # ------------------------------------------------------------- abort
+
+    def abort(self, request_id) -> Optional[Request]:
+        """Abort one admitted-or-queued request (ISSUE 11 satellite):
+        decref its pages NOW instead of leaking them until EOS, release
+        the drafter's mirror state, emit a ``serving_abort`` ring event.
+        Returns the request with ``finish_reason="aborted"`` (its
+        committed ``generated`` tokens intact), or None when the id is
+        unknown (already finished)."""
+        for slot_id, slot in enumerate(self.slots):
+            if slot.active and slot.request.rid == request_id:
+                req = slot.request
+                self.cache.release(slot_id)
+                if self.drafter is not None:
+                    self.drafter.release(slot_id)
+                slot.request, slot.pos, slot.last_tok = None, -1, 0
+                req.finish_reason = "aborted"
+                self.recorder.record("serving_abort", rid=req.rid,
+                                     slot=slot_id, where="slot",
+                                     generated=len(req.generated))
+                self._note_pool()
+                return req
+        for req in self.queue:
+            if req.rid == request_id:
+                self.queue.remove(req)
+                req.finish_reason = "aborted"
+                self.recorder.record("serving_abort", rid=req.rid,
+                                     slot=None, where="queue",
+                                     generated=0)
+                self.metrics.gauge("serving/queue_depth").set(
+                    len(self.queue))
+                return req
+        return None
+
+    def drain(self) -> List[Request]:
+        """Abort EVERY in-flight and queued request (shutdown /
+        scale-down fence): after drain() the pool holds no live pages —
+        only refcount-0 resident prefix cache, which
+        ``sweep_prefix_cache()`` returns to the free list."""
+        out = []
+        for slot in list(self.slots):
+            if slot.active:
+                out.append(self.abort(slot.request.rid))
+        while self.queue:
+            out.append(self.abort(self.queue[0].rid))
+        return out
+
     def step(self, now: Optional[float] = None) -> List[Request]:
         """One scheduler iteration: admit whatever fits, then one decode
         tick (or speculative verify round) over the active slots.
         Returns requests finished this step (including any that finished
         at prefill with max_new_tokens=1)."""
-        finished = self._admit(now)
+        finished = self._admit(now) if self._admitting else []
         if any(s.active for s in self.slots):
             finished.extend(self._decode_step())
+        # fault point + elastic policy (ISSUE 11): the tick boundary is
+        # the only place slot state is consistent (no speculation in
+        # flight), so SIGTERM handling, periodic snapshot begin/commit
+        # and the drain-or-snapshot decision all live here
+        faults.fire("serving_tick_end", tick=self.stats["ticks"],
+                    pending=self.pending)
+        if self.elastic is not None:
+            self.elastic.on_tick_end()
         return finished
 
     # ------------------------------------------------------------- serve
@@ -675,7 +757,7 @@ class ContinuousBatcher:
             # a request only becomes admissible at its arrival time
             for r in requests:
                 r._t_arrived = t0 + r.arrival_time
-        while self.pending:
+        while self.pending and not self.preempted:
             now = (time.monotonic() - t0) if respect_arrival_times \
                 else None
             if respect_arrival_times and not any(
@@ -684,6 +766,13 @@ class ContinuousBatcher:
                     time.monotonic() - t0)
                 if wait > 0:
                     time.sleep(min(wait, 0.05))
+                    if self.elastic is not None:
+                        # a SIGTERM landing while we idle between
+                        # arrivals must not wait for the next tick —
+                        # the queued (never-admitted) requests snapshot
+                        # here exactly like at a tick boundary (idle:
+                        # the sleep must not feed the tick-latency EMA)
+                        self.elastic.on_tick_end(idle=True)
                     continue
             for req in self.step(now):
                 done[req.rid] = req

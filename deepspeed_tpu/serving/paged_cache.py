@@ -364,6 +364,93 @@ class PagedKVCache:
             if not peers:
                 self._partial_index.pop(ent.chain, None)
 
+    # ------------------------------------------- elastic snapshot/restore
+
+    def take_blocks(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` fresh blocks (evicting LRU refcount-0 prefix
+        entries to cover a shortfall), refcount 0 — the elastic-restore
+        allocation primitive. The caller distributes refcounts through
+        :meth:`adopt_slot` / :meth:`import_prefix_entry`; unreferenced
+        blocks must go back through :meth:`return_blocks`."""
+        if n == 0:
+            return []
+        return self._take_fresh(n)
+
+    def return_blocks(self, blocks: List[int]) -> None:
+        """Give back refcount-0 blocks from :meth:`take_blocks` (a
+        restore that could not finish must not leak the pool)."""
+        for blk in blocks:
+            assert self._refcount[blk] == 0, f"block {blk} still held"
+            assert blk not in self._block_entry, f"block {blk} registered"
+            self._free.append(blk)
+
+    def adopt_slot(self, slot: int, blocks: List[int]) -> None:
+        """Map an explicit block list into ``slot``'s page table with an
+        incref per block — the elastic-restore admission (blocks were
+        allocated by :meth:`take_blocks` and may be SHARED between
+        restored slots; refcount ends at the number of holders, exactly
+        the invariant :meth:`release` decrefs against)."""
+        n = len(blocks)
+        assert n <= self.spec.max_pages_per_slot, (n, slot)
+        assert not self._slot_pages[slot], f"slot {slot} already admitted"
+        for blk in blocks:
+            self._refcount[blk] += 1
+            if blk in self._evictable:   # re-shared resident entry
+                del self._evictable[blk]
+        self._slot_pages[slot] = list(blocks)
+        row = self.page_table[slot]
+        row[:] = TRASH_BLOCK
+        row[:n] = blocks
+
+    def export_prefix_entries(self):
+        """JSON-able dump of the prefix index: every registered full /
+        partial entry as ``{"block", "key"/"chain" (hex), "tokens"}`` —
+        the content a restore needs to rebuild :attr:`_full_index` /
+        :attr:`_partial_index` on a different engine without rehashing
+        (and without the original prompt streams)."""
+        full, partial = [], []
+        for key, ent in self._full_index.items():
+            full.append({"block": int(ent.block), "key": key.hex(),
+                         "tokens": ent.tokens.tolist()})
+        for chain, peers in self._partial_index.items():
+            for ent in peers:
+                partial.append({"block": int(ent.block),
+                                "chain": chain.hex(),
+                                "tokens": ent.tokens.tolist()})
+        return {"full": full, "partial": partial}
+
+    def import_prefix_entry(self, block: int, tokens, key: bytes = None,
+                            chain: bytes = None) -> bool:
+        """Re-register one exported prefix entry against ``block`` (a
+        restored page): ``key`` makes a full entry, ``chain`` a partial
+        one. Refcount-0 blocks become resident prefix cache (MRU end).
+        Returns False (nothing registered) when the content is already
+        indexed or the block carries an entry."""
+        assert self.prefix_sharing
+        assert (key is None) != (chain is None), "key XOR chain"
+        toks = np.asarray(tokens, np.int32)  # sync-ok: host token list
+        if block in self._block_entry:
+            return False
+        if key is not None:
+            if key in self._full_index:
+                return False
+            ent = _FullEntry(block=block, key=key, tokens=toks)
+            self._full_index[key] = ent
+        else:
+            peers = self._partial_index.setdefault(chain, [])
+            r = len(toks)
+            if any(len(e.tokens) >= r
+                   and np.array_equal(e.tokens[:r], toks)
+                   for e in peers):
+                return False
+            ent = _PartialEntry(block=block, chain=chain, tokens=toks)
+            peers.append(ent)
+        self._block_entry[block] = ent
+        self.prefix_stats["registered"] += 1
+        if self._refcount[block] == 0:
+            self._evictable[block] = None
+        return True
+
     def sweep_prefix_cache(self) -> int:
         """Evict EVERY refcount-0 resident prefix entry back to the free
         list (the leak-test / shutdown fence: after a drained workload +

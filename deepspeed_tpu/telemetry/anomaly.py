@@ -259,6 +259,13 @@ class Watchdog:
                              {"step": step, "snapshotted": snapshotted,
                               "grace_s": grace_s, "source": source})
 
+    def note_preempt_ok(self):
+        """Re-arm the preempt latch after an incident is fully handled
+        (ISSUE 11: a replica-pool supervisor survives its replicas, so
+        a SECOND kill later in the same process must dump again —
+        unlike training, where one preemption ends the process)."""
+        self._preempt_tripped = False
+
     # -------------------------------------------------------------- dump
 
     def force_dump(self, reason="manual"):

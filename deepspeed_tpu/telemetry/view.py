@@ -15,7 +15,9 @@ events — as:
   finish, with waits and reasons;
 - a checkpoint / restore / preempt timeline (ISSUE 7): snapshot
   begin/commit pairs with the commit-fence wait, corruption fallbacks,
-  the preemption signal + final snapshot, elastic resumes;
+  the preemption signal + final snapshot, elastic resumes — and the
+  elastic-serving lifecycle (ISSUE 11): drain -> snapshot -> restore
+  -> requeue, aborts, replica kills and pool scale events;
 - a swap-tier I/O summary per step (bytes in/out, drain waits);
 - the trailing raw events with ``--events N``.
 
@@ -221,7 +223,13 @@ def render_ckpt(events, out):
     failures, the preemption signal and its final snapshot, and the
     resume itself."""
     kinds = ("ckpt_begin", "ckpt_commit", "ckpt_abort", "ckpt_corrupt",
-             "preempt_signal", "preempt", "resume")
+             "preempt_signal", "preempt", "resume",
+             # elastic serving lifecycle (ISSUE 11): the
+             # drain -> snapshot -> restore -> requeue chain plus the
+             # replica-pool scale/kill incidents ride the same timeline
+             "serving_drain", "serving_snapshot", "serving_restore",
+             "serving_requeue", "serving_abort", "replica_scale",
+             "replica_kill")
     rows = []
     t0 = None
     for ev in events:
@@ -231,7 +239,44 @@ def render_ckpt(events, out):
         if t0 is None:
             t0 = ev.get("ts")
         detail = ""
-        if kind == "ckpt_begin":
+        if kind == "serving_drain":
+            detail = (f"{ev.get('drained', 0)} drained, "
+                      f"{ev.get('left', 0)} left"
+                      + (", snapshotted" if ev.get("snapshotted")
+                         else ", NO snapshot"))
+        elif kind == "serving_snapshot":
+            detail = (f"{ev.get('requests', '?')} req "
+                      f"({ev.get('slots', '?')} slots + "
+                      f"{ev.get('queued', '?')} queued), "
+                      f"{ev.get('pages', '?')} pages")
+        elif kind == "serving_restore":
+            detail = (f"{ev.get('restored', 0)} direct + "
+                      f"{ev.get('requeued', 0)} requeued, "
+                      f"{ev.get('pages', 0)} pages, "
+                      f"{ev.get('restore_s', 0):.4g}s")
+            if ev.get("dropped_prefix_pages"):
+                detail += (f", {ev['dropped_prefix_pages']} prefix "
+                           f"pages dropped")
+        elif kind == "serving_requeue":
+            detail = f"rid {ev.get('rid')!r}"
+            if ev.get("outcome"):
+                detail += (f" {ev['outcome']} "
+                           f"(attempt {ev.get('attempts', '?')})")
+            if ev.get("committed") is not None:
+                detail += f", {ev['committed']} committed tokens kept"
+        elif kind == "serving_abort":
+            detail = (f"rid {ev.get('rid')!r} from "
+                      f"{ev.get('where', '?')}, "
+                      f"{ev.get('generated', 0)} tokens generated")
+        elif kind == "replica_scale":
+            detail = (f"{ev.get('direction')} -> "
+                      f"{ev.get('replicas', '?')} replicas "
+                      f"(replica {ev.get('replica')}, "
+                      f"{ev.get('reason', '')})")
+        elif kind == "replica_kill":
+            detail = (f"replica {ev.get('replica')}: "
+                      f"{str(ev.get('reason', ''))[:40]}")
+        elif kind == "ckpt_begin":
             detail = f"{ev.get('files', '?')} files, " \
                      f"{ev.get('from_swapfiles', 0)} from swap tier"
         elif kind == "ckpt_commit":
@@ -261,7 +306,13 @@ def render_ckpt(events, out):
     out.append("")
     out.append("checkpoint / restore / preempt timeline (t relative to "
                "first ckpt event):")
-    _table(["t", "event", "step", "tag", "mb", "detail"], rows, out)
+    # the serving-elastic kinds (and their details) outgrow the
+    # default 10-char column — size both to their longest row (detail
+    # capped so one verbose reason can't blow up the table)
+    ev_w = max(len("event"), *(len(str(r[1])) for r in rows))
+    det_w = min(max(10, *(len(str(r[5])) for r in rows)), 60)
+    _table(["t", "event".ljust(ev_w), "step", "tag", "mb",
+            "detail".ljust(det_w)], rows, out)
 
 
 def render_swap(events, out):
