@@ -90,6 +90,46 @@ def _load_doc(path):
         raise SystemExit(f"--compare: cannot load {path}: {e}")
 
 
+def provenance(jax_version=None):
+    """Stamp for every result JSON (``meta.provenance``, ISSUE 12
+    satellite): the ±25% box swing between identical-content runs keeps
+    getting rediscovered by hand — a compare that shows two different
+    hostnames/cpu_counts (or the same sha measured twice) answers "is
+    this a regression or a different box" without archaeology. Pass
+    ``jax_version`` from the caller that already imported jax; this
+    function itself must stay importable jax-free (the --candidate
+    compare path)."""
+    import platform
+    import socket
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        sha = subprocess.check_output(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=here,
+            stderr=subprocess.DEVNULL).decode().strip()
+    except Exception:
+        sha = "unknown"
+    return {
+        "git_sha": sha,
+        "hostname": socket.gethostname(),
+        "cpu_count": os.cpu_count(),
+        "jax_version": jax_version or "unknown",
+        "python_version": platform.python_version(),
+    }
+
+
+def _doc_provenance(doc):
+    """meta.provenance of a result document (driver-captured docs may
+    carry it beside ``parsed``), or None."""
+    for d in (doc, doc.get("parsed") if isinstance(doc.get("parsed"),
+                                                   dict) else {}):
+        if isinstance(d, dict):
+            p = (d.get("meta") or {}).get("provenance")
+            if isinstance(p, dict):
+                return p
+    return None
+
+
 def _num(v):
     return isinstance(v, (int, float)) and not isinstance(v, bool)
 
@@ -211,6 +251,13 @@ def compare_and_report(prior_doc, candidate_doc, threshold):
     """Print the per-metric diff + a machine-readable summary line;
     return the process exit code (0 pass, 3 regression)."""
     rep = compare_docs(prior_doc, candidate_doc, threshold)
+    # both sides' provenance up front: a "regression" measured on a
+    # different hostname/cpu_count is the box, not the code
+    for side, doc in (("prior", prior_doc), ("candidate", candidate_doc)):
+        prov = _doc_provenance(doc)
+        print(f"  {side} provenance: "
+              + (json.dumps(prov, sort_keys=True) if prov
+                 else "<none recorded>"))
     for k, row in rep["compared"].items():
         flag = "REGRESSION" if k in rep["regressions"] else (
             "improved" if k in rep["improvements"] else "ok")
@@ -461,6 +508,7 @@ def main(argv=None):
     tdet = train if isinstance(train, dict) else {}
     skipped_train = "skipped" in tdet
     result = {
+        "meta": {"provenance": provenance(jax_version=jax.__version__)},
         "metric": "gpt2_large_774m_zero3_mfu",
         "value": None if skipped_train else tdet["mfu_pct"],
         "unit": "%MFU",

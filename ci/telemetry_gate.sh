@@ -1,0 +1,62 @@
+#!/usr/bin/env bash
+# Observability fast gate (ISSUE 12 satellite): the jax-free telemetry
+# plumbing regressions — a broken --compare path, a viewer that grew a
+# jax import, a prometheus page real scrapers reject, metric names that
+# rotted out of the docs — gate in <30 s without a bench run or an
+# accelerator. Wire it next to ci/regression_gate.sh (which gates the
+# MEASURED headline numbers; this script gates the instrumentation).
+#
+# Usage:
+#   ci/telemetry_gate.sh [PRIOR.json] [CANDIDATE.json]
+#
+# Defaults: the newest two BENCH_r*.json in the repo (identity compare
+# when only one exists). Exit nonzero on any failure.
+set -eu
+
+REPO_DIR=$(CDPATH= cd -- "$(dirname -- "$0")/.." && pwd)
+cd "${REPO_DIR}"
+
+newest=$(ls -1 BENCH_r*.json 2>/dev/null | sort | tail -n 2)
+PRIOR=${1:-$(echo "${newest}" | head -n 1)}
+CANDIDATE=${2:-$(echo "${newest}" | tail -n 1)}
+if [ -z "${PRIOR}" ] || [ -z "${CANDIDATE}" ]; then
+    echo "telemetry_gate: no BENCH_r*.json artifacts and no args" >&2
+    exit 2
+fi
+
+echo "== [1/3] bench compare path (jax-free, ${PRIOR} -> ${CANDIDATE})"
+# the recorded artifacts span PRs with real metric movement; the gate
+# here is "the compare path runs and exits 0 or 3", not the diff itself
+rc=0
+python bench.py --compare "${PRIOR}" --candidate "${CANDIDATE}" \
+    --regression-threshold 0.05 >/dev/null || rc=$?
+if [ "${rc}" != 0 ] && [ "${rc}" != 3 ]; then
+    echo "telemetry_gate: compare path failed (rc=${rc})" >&2
+    exit 1
+fi
+echo "   ok (rc=${rc})"
+
+echo "== [2/3] viewer import guard (poisoned jax + numpy stubs)"
+python - <<'EOF'
+import os, subprocess, sys, tempfile
+d = tempfile.mkdtemp(prefix="poisoned_deps_")
+for name in ("jax", "numpy"):
+    with open(os.path.join(d, name + ".py"), "w") as fh:
+        fh.write("raise ImportError('poisoned: the viewer must not "
+                 "import " + name + "')\n")
+env = dict(os.environ)
+env["PYTHONPATH"] = d + os.pathsep + env.get("PYTHONPATH", "")
+r = subprocess.run(
+    [sys.executable, "-c", "import deepspeed_tpu.telemetry.view"],
+    env=env, capture_output=True, text=True)
+if r.returncode != 0:
+    sys.stderr.write("viewer import chain pulled jax/numpy:\n" + r.stderr)
+    sys.exit(1)
+print("   ok (stdlib-only import chain)")
+EOF
+
+echo "== [3/3] prometheus grammar + metric-name drift tests"
+JAX_PLATFORMS=cpu python -m pytest tests/test_metric_names.py -q \
+    -p no:cacheprovider -p no:randomly
+
+echo "telemetry_gate: PASS"

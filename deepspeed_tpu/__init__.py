@@ -20,25 +20,51 @@ Typical use::
 
 from deepspeed_tpu.version import __version__, git_hash, git_branch
 
-from deepspeed_tpu.config.config import DeepSpeedConfig
-from deepspeed_tpu.runtime.engine import DeepSpeedEngine
-from deepspeed_tpu.runtime.lr_schedules import add_tuning_arguments
-from deepspeed_tpu.parallel.mesh import (
-    MeshConfig,
-    make_mesh,
-    init_distributed,
-)
-from deepspeed_tpu.runtime.pipe.module import PipelineModule, LayerSpec, TiedLayerSpec
 from deepspeed_tpu.utils import logging as _logging
 
-from deepspeed_tpu import elasticity  # noqa: F401
-from deepspeed_tpu import module_inject  # noqa: F401
-from deepspeed_tpu import ops  # noqa: F401
-from deepspeed_tpu import models  # noqa: F401
-from deepspeed_tpu.runtime import zero  # noqa: F401  (deepspeed.zero parity)
-from deepspeed_tpu import runtime  # noqa: F401
-
 logger = _logging.logger
+
+# The public surface resolves LAZILY (PEP 562): importing the bare
+# package must not drag in jax — the stdlib-only tooling (the flight
+# dump viewer `python -m deepspeed_tpu.telemetry.view`, bench.py's
+# --candidate compare path, ci/telemetry_gate.sh) runs on machines
+# where jax does not exist, and tests/test_metric_names.py pins that
+# with a poisoned-jax import. Everything below behaves exactly like
+# the old eager imports: `dstpu.DeepSpeedEngine`, `dstpu.zero`,
+# `from deepspeed_tpu import MeshConfig` all still work — the import
+# just happens on first attribute access.
+_LAZY_ATTRS = {
+    "DeepSpeedConfig": ("deepspeed_tpu.config.config", "DeepSpeedConfig"),
+    "DeepSpeedEngine": ("deepspeed_tpu.runtime.engine", "DeepSpeedEngine"),
+    "add_tuning_arguments": ("deepspeed_tpu.runtime.lr_schedules",
+                             "add_tuning_arguments"),
+    "MeshConfig": ("deepspeed_tpu.parallel.mesh", "MeshConfig"),
+    "make_mesh": ("deepspeed_tpu.parallel.mesh", "make_mesh"),
+    "init_distributed": ("deepspeed_tpu.parallel.mesh",
+                         "init_distributed"),
+    "PipelineModule": ("deepspeed_tpu.runtime.pipe.module",
+                       "PipelineModule"),
+    "LayerSpec": ("deepspeed_tpu.runtime.pipe.module", "LayerSpec"),
+    "TiedLayerSpec": ("deepspeed_tpu.runtime.pipe.module",
+                      "TiedLayerSpec"),
+    # subpackages the old root bound (eager imports made even
+    # `deepspeed_tpu.config` / `.parallel` reachable as attributes)
+    "config": ("deepspeed_tpu.config", None),
+    "parallel": ("deepspeed_tpu.parallel", None),
+    "utils": ("deepspeed_tpu.utils", None),
+    "elasticity": ("deepspeed_tpu.elasticity", None),
+    "module_inject": ("deepspeed_tpu.module_inject", None),
+    "ops": ("deepspeed_tpu.ops", None),
+    "models": ("deepspeed_tpu.models", None),
+    "zero": ("deepspeed_tpu.runtime.zero", None),
+    "runtime": ("deepspeed_tpu.runtime", None),
+    "serving": ("deepspeed_tpu.serving", None),
+    "telemetry": ("deepspeed_tpu.telemetry", None),
+}
+
+from deepspeed_tpu.utils.lazy import lazy_attrs  # noqa: E402
+
+__getattr__, __dir__ = lazy_attrs(__name__, _LAZY_ATTRS)
 
 
 def initialize(args=None,
@@ -80,6 +106,12 @@ def initialize(args=None,
         A tuple ``(engine, optimizer, training_dataloader, lr_scheduler)``
         exactly like the reference.
     """
+    # local imports: global-name lookup inside a function bypasses the
+    # module-level lazy __getattr__, and initialize() is where the
+    # heavy (jax-importing) machinery genuinely becomes necessary
+    from deepspeed_tpu.config.config import DeepSpeedConfig
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
     from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
 
     if config is None:

@@ -339,10 +339,24 @@ class WatchdogConfig:
                                     C.WATCHDOG_CHECK_NAN_DEFAULT))
         self.max_dumps = int(d.get(C.WATCHDOG_MAX_DUMPS,
                                    C.WATCHDOG_MAX_DUMPS_DEFAULT))
+        # rank-straggler rule (ISSUE 12): evaluated on rank 0 at cluster
+        # fences, against the leave-one-out median of the other ranks
+        self.straggler_factor = d.get(C.WATCHDOG_STRAGGLER_FACTOR,
+                                      C.WATCHDOG_STRAGGLER_FACTOR_DEFAULT)
+        self.straggler_fences = int(d.get(
+            C.WATCHDOG_STRAGGLER_FENCES, C.WATCHDOG_STRAGGLER_FENCES_DEFAULT))
+        self.straggler_min_s = d.get(C.WATCHDOG_STRAGGLER_MIN_S,
+                                     C.WATCHDOG_STRAGGLER_MIN_S_DEFAULT)
+        if self.straggler_fences < 1:
+            raise DeepSpeedConfigError(
+                f"monitor.watchdog.straggler_fences must be >= 1 "
+                f"(consecutive fences before the rule trips), got "
+                f"{self.straggler_fences}")
         for name, v in (("step_time_factor", self.step_time_factor),
                         ("swap_stall_factor", self.swap_stall_factor),
                         ("ttft_factor", self.ttft_factor),
-                        ("ckpt_stall_factor", self.ckpt_stall_factor)):
+                        ("ckpt_stall_factor", self.ckpt_stall_factor),
+                        ("straggler_factor", self.straggler_factor)):
             if not v > 1.0:
                 raise DeepSpeedConfigError(
                     f"monitor.watchdog.{name} must be > 1 (an outlier "
@@ -351,6 +365,20 @@ class WatchdogConfig:
             raise DeepSpeedConfigError(
                 "monitor.watchdog.dump_dir must be set when the "
                 "watchdog is enabled (dumps need somewhere to land)")
+
+
+class ClusterTelemetryConfig:
+    """``monitor.cluster`` sub-block (ISSUE 12): cross-rank metric
+    aggregation at the engine's existing fence points (the
+    ``steps_per_print`` loss readback; snapshot commit fences). Default
+    ON — the exchange is a ~7-float allgather at a host sync the engine
+    already pays, and single-process it degenerates to local
+    ``cluster/*`` gauges with no collective at all."""
+
+    def __init__(self, monitor_dict):
+        d = monitor_dict.get(C.MONITOR_CLUSTER, {}) or {}
+        self.enabled = bool(d.get(C.CLUSTER_ENABLED,
+                                  C.CLUSTER_ENABLED_DEFAULT))
 
 
 class MonitorConfig:
@@ -383,8 +411,20 @@ class MonitorConfig:
                 f"monitor.jsonl_max_mb must be >= 0 (0 disables "
                 f"rotation) and jsonl_max_files >= 1, got "
                 f"{self.jsonl_max_mb!r}/{self.jsonl_max_files!r}")
+        # live /metrics + /healthz endpoint (ISSUE 12): a stdlib
+        # http.server thread on rank 0; 0 = off (the default — it
+        # binds a socket, so it is opt-in like every file-writing gate)
+        self.serve_port = int(d.get(C.MONITOR_SERVE_PORT,
+                                    C.MONITOR_SERVE_PORT_DEFAULT))
+        self.serve_host = str(d.get(C.MONITOR_SERVE_HOST,
+                                    C.MONITOR_SERVE_HOST_DEFAULT))
+        if not 0 <= self.serve_port <= 65535:
+            raise DeepSpeedConfigError(
+                f"monitor.serve_port must be 0 (off) or a valid TCP "
+                f"port, got {self.serve_port}")
         self.flight_recorder = FlightRecorderConfig(d)
         self.watchdog = WatchdogConfig(d)
+        self.cluster = ClusterTelemetryConfig(d)
 
 
 class SnapshotConfig:

@@ -437,6 +437,36 @@ WATCHDOG_CKPT_STALL_FACTOR = "ckpt_stall_factor"
 WATCHDOG_CKPT_STALL_FACTOR_DEFAULT = 4.0
 WATCHDOG_CKPT_STALL_MIN_S = "ckpt_stall_min_s"
 WATCHDOG_CKPT_STALL_MIN_S_DEFAULT = 0.25
+# rank-straggler rule (ISSUE 12): at cluster fences, a rank whose
+# step time exceeds straggler_factor x the median of the OTHER ranks
+# for straggler_fences CONSECUTIVE fences trips one latched dump
+# naming the rank. Leave-one-out median: with small worlds (2 ranks)
+# a whole-cluster median would include the straggler itself and the
+# ratio could never reach 2x.
+WATCHDOG_STRAGGLER_FACTOR = "straggler_factor"
+WATCHDOG_STRAGGLER_FACTOR_DEFAULT = 2.0
+WATCHDOG_STRAGGLER_FENCES = "straggler_fences"
+WATCHDOG_STRAGGLER_FENCES_DEFAULT = 3
+WATCHDOG_STRAGGLER_MIN_S = "straggler_min_s"
+WATCHDOG_STRAGGLER_MIN_S_DEFAULT = 0.05   # absolute floor: sub-50ms
+# per-step host-time skew is dispatch noise, not a straggler
+
+#############################################
+# Cluster telemetry plane (monitor sub-block + serve_port, ISSUE 12 —
+# deepspeed_tpu/telemetry/cluster.py + serve.py). The cross-rank
+# aggregation is a small fp32 allgather at fences the engine already
+# pays (the steps_per_print loss readback; snapshot commit fences) and
+# defaults ON like the flight recorder (single-process it degenerates
+# to local gauges, no collective). serve_port gates the live /metrics
+# + /healthz http.server thread; 0 = off.
+#############################################
+MONITOR_CLUSTER = "cluster"
+CLUSTER_ENABLED = "enabled"
+CLUSTER_ENABLED_DEFAULT = True
+MONITOR_SERVE_PORT = "serve_port"
+MONITOR_SERVE_PORT_DEFAULT = 0       # 0 = no endpoint
+MONITOR_SERVE_HOST = "serve_host"
+MONITOR_SERVE_HOST_DEFAULT = "127.0.0.1"
 
 #############################################
 # Programmatic XLA trace window (profiling.trace_dir + trace_steps):

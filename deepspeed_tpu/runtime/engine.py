@@ -327,6 +327,34 @@ class DeepSpeedEngine:
             mc.watchdog, recorder=self.flight_recorder,
             registry=self.telemetry, source="train")
 
+        # -- cluster telemetry plane (ISSUE 12): cross-rank aggregation
+        # at the fences this engine already pays (the steps_per_print
+        # loss readback; snapshot commit fences) — a ~7-float gloo
+        # allgather folded on rank 0 into cluster/* skew gauges and the
+        # watchdog's rank_straggler rule. Single-process it degenerates
+        # to local gauges with no collective.
+        self._cluster = None
+        self._tel_last_step_s = None   # the just-closed window's mean
+        self._tel_last_host_step_s = None  # rank-attributable component
+        self._tel_window_dispatch_s = 0.0  # blocked-in-dispatch seconds
+        self._tel_last_fence_ts = None
+        if mc.cluster.enabled:
+            from deepspeed_tpu.telemetry.cluster import ClusterAggregator
+            self._cluster = ClusterAggregator(
+                registry=self.telemetry, recorder=self.flight_recorder,
+                watchdog=self.watchdog)
+        # live /metrics + /healthz endpoint (monitor.serve_port, rank 0
+        # only — that is where the cluster gauges fold; a bind failure
+        # warns instead of killing training)
+        self._metrics_server = None
+        from deepspeed_tpu.utils.logging import _process_index
+        if mc.serve_port and _process_index() == 0:
+            from deepspeed_tpu.telemetry.serve import start_metrics_server
+            self._metrics_server = start_metrics_server(
+                mc.serve_port, host=mc.serve_host,
+                registry=self.telemetry, watchdog=self.watchdog,
+                fence_age_fn=lambda: self._tel_last_fence_ts)
+
         # -- elastic preemption tolerance (runtime/elastic, ISSUE 7):
         # periodic async snapshots through the swap tier's write-behind
         # aio handle, a SIGTERM hook with a grace budget, auto-resume
@@ -876,6 +904,28 @@ class DeepSpeedEngine:
                 # host wall timer this method already kept — no fence
                 self.watchdog.observe_ckpt_stall(
                     stall, step=self.global_steps)
+            # ISSUE 12: the commit fence is the second aligned
+            # aggregation point (snapshot begins happen at aligned
+            # interval boundaries, so in_flight agrees across ranks) —
+            # the fresh ckpt/stall_s observation rides the exchange
+            if self._cluster is not None:
+                # step_time_s is explicitly UNMEASURED here: the last
+                # boundary's value is stale, and re-feeding it would
+                # let one slow window satisfy the straggler rule's
+                # K-CONSECUTIVE-fences debounce by itself (the rule
+                # skips NaN ranks). This fence aggregates the fresh
+                # ckpt stall; step-time skew belongs to boundaries.
+                self._cluster.exchange_from_registry(
+                    step=self.global_steps,
+                    overrides={"step_time_s": None,
+                               "ckpt_stall_s": stall})
+                self._tel_last_fence_ts = time.time()
+                # NO window re-stamp here (unlike the boundary
+                # exchange): this fence sits mid-window and moving t0
+                # would shrink window_s under an unchanged step count,
+                # corrupting train/step_time_s. The cost: the wait for
+                # the slowest rank's arrival lands in this window —
+                # once per snapshot interval, not per boundary.
 
     def _elastic_step(self):
         """Step-boundary elastic hook (after the park): the
@@ -2410,7 +2460,13 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         # the span measures host-side DISPATCH of the step (async under
         # jit — no sync); device-true step time comes from the boundary
-        # window fold below
+        # window fold below. The same interval feeds the cluster
+        # plane's per-rank SELF time (ISSUE 12): time spent blocked
+        # INSIDE the dispatch call is where a healthy rank absorbs a
+        # straggler's delay (backends that execute cross-process
+        # collectives synchronously block right here), so host_step_s
+        # excludes it — what remains is rank-attributable host work.
+        _t_disp = time.perf_counter()
         with tel_span("train/step_dispatch", self.telemetry):
             if self._host_runner is not None:
                 metrics = self._host_offload_step(batch)
@@ -2426,6 +2482,7 @@ class DeepSpeedEngine:
             else:
                 self.state, metrics = self._jit_train_batch(
                     self.state, batch, self._next_rng())
+        self._tel_window_dispatch_s += time.perf_counter() - _t_disp
         self.tput_timer.stop()
 
         gas = self.gradient_accumulation_steps()
@@ -3036,6 +3093,25 @@ class DeepSpeedEngine:
                 "comm_inter_bytes": wire["inter"]} if wire else {}))
         if self.global_steps % self.steps_per_print() != 0:
             return
+        # per-rank SELF step time (ISSUE 12): host time this rank OWNS
+        # per step — window wall time to ARRIVE at this fence
+        # (pre-readback stamp) minus the seconds spent blocked inside
+        # the step-dispatch calls. In synchronous SPMD every rank's
+        # FENCED wall time converges to the slowest rank, and a
+        # backend that executes cross-process collectives synchronously
+        # parks the healthy rank inside dispatch — so only the
+        # remainder (driver loop, park/unpark, swap stalls, GC pauses,
+        # an injected sleep) is attributable to THIS rank. First
+        # (compile) window dropped like the fenced one.
+        t_arrive = time.perf_counter()
+        steps_in_window = self.global_steps - self._tel_window_step0
+        if steps_in_window > 0 and self._tel_window_t0 is not None \
+                and self._tel_window_step0 > 0:
+            self._tel_last_host_step_s = max(
+                (t_arrive - self._tel_window_t0
+                 - self._tel_window_dispatch_s), 0.0) / steps_in_window
+            reg.histogram("train/host_step_s").observe(
+                self._tel_last_host_step_s)
         lval = float(jax.device_get(loss))  # sync-ok: steps_per_print boundary
         self.flight_recorder.record("loss", step=self.global_steps,
                                     loss=lval)
@@ -3043,6 +3119,34 @@ class DeepSpeedEngine:
             self.watchdog.check_loss(lval, step=self.global_steps)
         self._telemetry_fold(batch)
         self._telemetry_export()
+        # ISSUE 12: cross-rank aggregation rides the fence the loss
+        # readback above already paid — every rank reaches this exact
+        # boundary in SPMD lockstep, so the allgather is aligned. The
+        # just-closed window's step time is threaded directly (the
+        # process-wide registry may hold another engine's history).
+        if self._cluster is not None:
+            self._cluster.exchange_from_registry(
+                loss=lval, step=self.global_steps,
+                overrides={"step_time_s": self._cluster_step_value(),
+                           "swap_stall_s": stall if have_swap else None})
+            # re-open the window AFTER the exchange (same rule as the
+            # fold's MFU-pricing re-stamp): the allgather blocks until
+            # the SLOWEST rank arrives, and charging that wait to the
+            # next window would hand every healthy rank the straggler's
+            # time — the exact skew signal this plane exists to expose
+            self._tel_window_dispatch_s = 0.0
+            self._tel_window_t0 = time.perf_counter()
+        self._tel_last_fence_ts = time.time()
+
+    def _cluster_step_value(self):
+        """The per-rank step time the cluster vector carries (ISSUE
+        12): single-process the fenced window mean IS self time (no
+        peer to wait on); multi-process the host-arrival component —
+        the fenced figure converges to the slowest rank under the
+        boundary collectives, which would blind the straggler rule."""
+        if jax.process_count() == 1:
+            return self._tel_last_step_s
+        return self._tel_last_host_step_s
 
     def _telemetry_priced(self):
         """Whether the MFU cost analysis may be priced: an explicit
@@ -3066,6 +3170,7 @@ class DeepSpeedEngine:
             window_s = now - self._tel_window_t0
             if steps > 0 and window_s > 0 and self._tel_window_step0 > 0:
                 step_s = window_s / steps
+                self._tel_last_step_s = step_s
                 reg.histogram("train/step_time_s").observe(step_s)
                 self.flight_recorder.record(
                     "window", step=self.global_steps, steps=steps,
@@ -3088,6 +3193,7 @@ class DeepSpeedEngine:
         # open the next window AFTER the fold's own work (the one-time
         # MFU pricing retrace can take seconds — charging it to the
         # next window would corrupt its step-time observation)
+        self._tel_window_dispatch_s = 0.0
         self._tel_window_t0 = time.perf_counter()
 
     def _telemetry_mfu(self, batch, step_s, price=False):

@@ -124,11 +124,13 @@ def build_engine(family: str, model_config, params, config=None,
     else:
         adapter = LlamaServingAdapter(model_config, params, spec,
                                       quantize_bits=qb)
-    if watchdog is None and C.MONITOR in pd:
+    mc = None
+    if C.MONITOR in pd:
         from deepspeed_tpu.config.config import MonitorConfig
+        mc = MonitorConfig(pd)   # parsed ONCE for watchdog + endpoint
+    if watchdog is None and mc is not None:
         from deepspeed_tpu.telemetry.anomaly import Watchdog
         from deepspeed_tpu.telemetry.recorder import default_recorder
-        mc = MonitorConfig(pd)
         # reconfigure the process recorder only when THIS config
         # actually carries a monitor block — a serving-only config must
         # not clobber a training engine's explicit recorder settings
@@ -182,4 +184,13 @@ def build_engine(family: str, model_config, params, config=None,
         from deepspeed_tpu.serving.elastic import ElasticServingController
         cb.attach_elastic(ElasticServingController.from_config(
             cb, sc.elastic))
+    # ISSUE 12: live /metrics + /healthz over THIS engine's registry
+    # (monitor.serve_port; a bind failure warns instead of killing the
+    # server — e.g. a training engine in the same process won the port)
+    if mc is not None and mc.serve_port:
+        from deepspeed_tpu.telemetry.serve import start_metrics_server
+        cb.metrics_server = start_metrics_server(
+            mc.serve_port, host=mc.serve_host, registry=cb.metrics,
+            watchdog=cb.watchdog,
+            fence_age_fn=lambda: cb._t_last_step_ts)
     return cb

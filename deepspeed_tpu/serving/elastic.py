@@ -74,6 +74,10 @@ def _req_doc(req):
         "eos_token_id": None if req.eos_token_id is None
         else int(req.eos_token_id),
         "temperature": float(req.temperature),
+        # ISSUE 12: the trace identity survives snapshot -> restore ->
+        # requeue handoffs — the stitched cross-replica timeline hangs
+        # off this field
+        "trace_id": getattr(req, "trace_id", None),
     }
 
 
@@ -150,10 +154,12 @@ def snapshot_serving(cb, snapshotter, tag, meta=None, finalize=True):
     n_req = len(host["slots"]) + len(host["queued"])
     snapshotter.begin(tag, trees, extra={"serving": host},
                       meta={"kind": SERVING_KIND, **(meta or {})})
-    cb.recorder.record("serving_snapshot", tag=str(tag), requests=n_req,
-                       slots=len(host["slots"]),
-                       queued=len(host["queued"]),
-                       pages=host["n_pages"])
+    cb._record("serving_snapshot", tag=str(tag), requests=n_req,
+               slots=len(host["slots"]),
+               queued=len(host["queued"]),
+               pages=host["n_pages"],
+               traces=[d.get("trace_id") for d in
+                       host["slots"] + host["queued"]])
     if finalize:
         path, _stall = snapshotter.finalize()
         return path
@@ -216,7 +222,8 @@ def resume_request(doc):
     assert rem >= 1, "a finished request never lands in a snapshot"
     req = Request(doc["rid"], prompt, max_new_tokens=rem,
                   eos_token_id=doc.get("eos_token_id"),  # sync-ok: host
-                  temperature=float(doc.get("temperature", 0.0)))
+                  temperature=float(doc.get("temperature", 0.0)),
+                  trace_id=doc.get("trace_id"))
     req.resumed_committed = len(doc["generated"])
     return req
 
@@ -348,7 +355,8 @@ def restore_serving(cb, host, kv, requeue_overflow=True):
                       np.asarray(sd["prompt"], np.int32),  # sync-ok:
                       max_new_tokens=int(sd["max_new_tokens"]),  # host
                       eos_token_id=sd.get("eos_token_id"),  # snapshot doc
-                      temperature=float(sd.get("temperature", 0.0)))
+                      temperature=float(sd.get("temperature", 0.0)),
+                      trace_id=sd.get("trace_id"))
         req.generated = [int(t) for t in sd["generated"]]
         req._t_submit = now
         slot = cb.slots[slot_id]
@@ -385,15 +393,17 @@ def restore_serving(cb, host, kv, requeue_overflow=True):
         for sd in overflow:
             req = resume_request(sd)
             cb.submit(req)
-            cb.recorder.record("serving_requeue", rid=sd["rid"],
-                               committed=len(sd["generated"]),
-                               remaining=req.max_new_tokens)
+            cb._record("serving_requeue", rid=sd["rid"],
+                       trace=sd.get("trace_id"),
+                       committed=len(sd["generated"]),
+                       remaining=req.max_new_tokens)
             requeued.append(req)
     restore_s = time.perf_counter() - t0
-    cb.recorder.record("serving_restore", restored=len(restored),
-                       requeued=len(requeued), pages=len(blk_map),
-                       dropped_prefix_pages=dropped_prefix,
-                       restore_s=restore_s)
+    cb._record("serving_restore", restored=len(restored),
+               requeued=len(requeued), pages=len(blk_map),
+               dropped_prefix_pages=dropped_prefix,
+               restore_s=restore_s,
+               traces=[getattr(r, "trace_id", None) for r in restored])
     m = cb.metrics
     m.counter("serving/restored_requests").inc(len(restored))
     m.counter("serving/requeued_requests").inc(len(requeued))
@@ -575,7 +585,7 @@ class ElasticServingController:
             except faults.SimulatedCrash:
                 # the injected crash-between-renames: disk is left
                 # as the crash would leave it; the engine still parks
-                cb.recorder.record(
+                cb._record(
                     "serving_drain", drained=len(drained),
                     left=len(left), snapshotted=False,
                     grace_s=self.grace_secs)
@@ -583,10 +593,10 @@ class ElasticServingController:
                 raise
             except Exception as e:
                 logger.warning(f"final serving snapshot failed: {e}")
-        cb.recorder.record("serving_drain", drained=len(drained),
-                           left=len(left), snapshotted=snapshotted,
-                           grace_s=self.grace_secs,
-                           source=self.preemption.source)
+        cb._record("serving_drain", drained=len(drained),
+                   left=len(left), snapshotted=snapshotted,
+                   grace_s=self.grace_secs,
+                   source=self.preemption.source)
         wd = self._wd()
         if wd is not None:
             wd.note_preempt(step=cb.stats["ticks"],
@@ -619,8 +629,8 @@ class ElasticServingController:
         except OSError:
             pass
         if pruned:
-            self.cb.recorder.record("serving_snapshot_prune",
-                                    pruned=pruned, reason="clean_drain")
+            self.cb._record("serving_snapshot_prune",
+                            pruned=pruned, reason="clean_drain")
 
     # ------------------------------------------------------------ close
 

@@ -23,6 +23,7 @@ fused computation-collective argument applied to prefill/decode).
 
 import dataclasses
 import time
+import uuid
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -49,6 +50,13 @@ class Request:
     eos_token_id: Optional[int] = None
     temperature: float = 0.0
     arrival_time: float = 0.0
+    # request-scoped distributed tracing (ISSUE 12): stamped once at
+    # first submit, carried through every lifecycle ring event and
+    # across snapshot -> restore -> requeue replica handoffs, so
+    # telemetry/view.py can stitch one cross-replica timeline per
+    # request from N dump files. Never re-stamped: a replayed or
+    # restored request keeps the identity it was born with.
+    trace_id: Optional[str] = None
     # filled by the engine:
     generated: List[int] = dataclasses.field(default_factory=list)
     finish_reason: Optional[str] = None
@@ -57,6 +65,14 @@ class Request:
         return np.concatenate([          # sync-ok: host-side lists
             np.asarray(self.prompt, np.int32),
             np.asarray(self.generated, np.int32)])  # sync-ok: host
+
+
+def ensure_trace_id(request) -> str:
+    """Stamp a stable ``trace_id`` at first submit (idempotent — a
+    restored/replayed request arrives with the one it was born with)."""
+    if getattr(request, "trace_id", None) is None:
+        request.trace_id = uuid.uuid4().hex[:16]
+    return request.trace_id
 
 
 @dataclasses.dataclass
@@ -141,6 +157,20 @@ class ContinuousBatcher:
         # set stops growing
         self.elastic = None
         self._admitting = True
+        # ISSUE 12: a ReplicaPool stamps its replica id here so ring
+        # events self-identify (replicas share the process-wide ring);
+        # _t_last_step_ts feeds the /healthz fence age
+        self.replica_id = None
+        self._t_last_step_ts = None
+        self.metrics_server = None
+
+    def _record(self, kind, **fields):
+        """Ring event with the replica identity stamped (ISSUE 12):
+        cross-replica trace stitching needs to know which engine
+        emitted what when N replicas share one recorder."""
+        if self.replica_id is not None and "replica" not in fields:
+            fields["replica"] = self.replica_id
+        self.recorder.record(kind, **fields)
 
     @property
     def preempted(self) -> bool:
@@ -279,6 +309,7 @@ class ContinuousBatcher:
             f"only {max_prompt_pages} whole pages of "
             f"{self.spec.page_size} fit the model's "
             f"{self.adapter.max_prompt_len()}-position budget")
+        ensure_trace_id(request)
         request._t_submit = time.monotonic()
         self.queue.append(request)
         self.metrics.gauge("serving/queue_depth").set(len(self.queue))
@@ -329,8 +360,9 @@ class ContinuousBatcher:
                 # pool exhausted; retry next step. The watchdog rule is
                 # latched per episode — one dump until pages free again
                 need = self.cache.pages_needed(S + req.max_new_tokens)
-                self.recorder.record(
-                    "pool_exhausted", rid=req.rid, need_pages=need,
+                self._record(
+                    "pool_exhausted", rid=req.rid,
+                    trace=getattr(req, "trace_id", None), need_pages=need,
                     free_pages=self.cache.available_pages,
                     queue_depth=len(self.queue))
                 if self.watchdog is not None:
@@ -355,9 +387,10 @@ class ContinuousBatcher:
             self.metrics.histogram("serving/admission_wait_s").observe(
                 wait_s)
             start = plan.start_pos if plan is not None else 0
-            self.recorder.record("admit", rid=req.rid, slot=slot_id,
-                                 pages=len(pages), wait_s=wait_s,
-                                 shared_tokens=start)
+            self._record("admit", rid=req.rid, slot=slot_id,
+                         trace=getattr(req, "trace_id", None),
+                         pages=len(pages), wait_s=wait_s,
+                         shared_tokens=start)
             if self.watchdog is not None:
                 self.watchdog.note_pool_ok()   # re-arm the pool rule
             P = self.spec.page_size
@@ -414,8 +447,9 @@ class ContinuousBatcher:
             # the prefill logits readback above IS first-token delivery
             ttft_s = max(time.monotonic() - t_ref, 0.0)
             self.metrics.histogram("serving/ttft_s").observe(ttft_s)
-            self.recorder.record("prefill", rid=req.rid,
-                                 prompt_tokens=S, ttft_s=ttft_s)
+            self._record("prefill", rid=req.rid,
+                         trace=getattr(req, "trace_id", None),
+                         prompt_tokens=S, ttft_s=ttft_s)
             if self.watchdog is not None:
                 # the readback above was the fence — the rule sees only
                 # the host scalar it produced
@@ -457,9 +491,10 @@ class ContinuousBatcher:
         if self.drafter is not None:
             self.drafter.release(slot_id)
         slot.request, slot.pos, slot.last_tok = None, -1, 0
-        self.recorder.record("finish", rid=req.rid,
-                             reason=req.finish_reason,
-                             generated=len(req.generated))
+        self._record("finish", rid=req.rid,
+                     trace=getattr(req, "trace_id", None),
+                     reason=req.finish_reason,
+                     generated=len(req.generated))
         return req
 
     # multi-step dispatch caps: a tick of K steps amortizes the host
@@ -504,8 +539,10 @@ class ContinuousBatcher:
         toks_seq = np.asarray(toks_seq)  # sync-ok: scheduler consumes
         #                                  the sampled tokens [steps,slots]
         tick_s = time.monotonic() - t0   # real: the asarray fenced it
-        self.recorder.record("tick", steps=steps, active=n_active,
-                             tick_s=tick_s)
+        self._record("tick", steps=steps, active=n_active,
+                     tick_s=tick_s,
+                     traces=[s.request.trace_id for s in self.slots
+                             if s.active])
         m = self.metrics
         m.histogram("serving/tick_latency_s").observe(tick_s)
         m.histogram("serving/decode_latency_per_token_s").observe(
@@ -599,8 +636,10 @@ class ContinuousBatcher:
         # every slot's pos still points at its last committed token, so
         # a snapshot/restore sees only verified tokens
         faults.fire("serving_spec_verify", rows=V, active=n_active)
-        self.recorder.record("spec_round", rows=V, active=n_active,
-                             tick_s=tick_s)
+        self._record("spec_round", rows=V, active=n_active,
+                     tick_s=tick_s,
+                     traces=[self.slots[i].request.trace_id
+                             for i in active])
         m = self.metrics
         m.histogram("serving/tick_latency_s").observe(tick_s)
         m.histogram("serving/slot_utilization").observe(
@@ -692,18 +731,20 @@ class ContinuousBatcher:
                     self.drafter.release(slot_id)
                 slot.request, slot.pos, slot.last_tok = None, -1, 0
                 req.finish_reason = "aborted"
-                self.recorder.record("serving_abort", rid=req.rid,
-                                     slot=slot_id, where="slot",
-                                     generated=len(req.generated))
+                self._record("serving_abort", rid=req.rid,
+                             trace=getattr(req, "trace_id", None),
+                             slot=slot_id, where="slot",
+                             generated=len(req.generated))
                 self._note_pool()
                 return req
         for req in self.queue:
             if req.rid == request_id:
                 self.queue.remove(req)
                 req.finish_reason = "aborted"
-                self.recorder.record("serving_abort", rid=req.rid,
-                                     slot=None, where="queue",
-                                     generated=0)
+                self._record("serving_abort", rid=req.rid,
+                             trace=getattr(req, "trace_id", None),
+                             slot=None, where="queue",
+                             generated=0)
                 self.metrics.gauge("serving/queue_depth").set(
                     len(self.queue))
                 return req
@@ -736,6 +777,7 @@ class ContinuousBatcher:
         # and the drain-or-snapshot decision all live here
         faults.fire("serving_tick_end", tick=self.stats["ticks"],
                     pending=self.pending)
+        self._t_last_step_ts = time.time()   # /healthz fence age
         if self.elastic is not None:
             self.elastic.on_tick_end()
         return finished

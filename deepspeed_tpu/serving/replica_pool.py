@@ -44,7 +44,7 @@ import numpy as np
 
 from deepspeed_tpu.runtime.elastic.faults import SimulatedCrash
 from deepspeed_tpu.serving import elastic
-from deepspeed_tpu.serving.engine import Request
+from deepspeed_tpu.serving.engine import Request, ensure_trace_id
 from deepspeed_tpu.telemetry.recorder import default_recorder
 from deepspeed_tpu.utils.logging import logger
 
@@ -128,6 +128,10 @@ class ReplicaPool:
         rid = self._next_id
         self._next_id += 1
         cb = self.factory(rid)
+        # ISSUE 12: ring events from this batcher self-identify — the
+        # replicas share one process-wide recorder, and the stitched
+        # per-trace timeline needs to know which replica emitted what
+        cb.replica_id = rid
         self.replicas[rid] = cb
         wd = cb.watchdog
         self._trip_base[rid] = self._trips_of(wd)
@@ -169,6 +173,7 @@ class ReplicaPool:
     # ----------------------------------------------------------- ledger
 
     def submit(self, request: Request) -> None:
+        ensure_trace_id(request)   # before the ledger doc freezes it
         self._ledger[request.rid] = _req_to_doc(request)
         self._attempts.setdefault(request.rid, 0)
         self._dispatch(request)
@@ -192,6 +197,7 @@ class ReplicaPool:
             self.stats["lost"] += 1
             self.lost[rid] = doc
             self.recorder.record("serving_requeue", rid=rid,
+                                 trace=doc.get("trace_id"),
                                  outcome="dropped",
                                  attempts=self._attempts[rid])
             logger.warning(f"request {rid!r} dropped after "
@@ -203,6 +209,7 @@ class ReplicaPool:
                 * float(self._rng.uniform(0.5, 1.5))  # sync-ok: host rng
         self._resume_q.append((time.monotonic() + delay, doc))
         self.recorder.record("serving_requeue", rid=rid,
+                             trace=doc.get("trace_id"),
                              outcome="scheduled",
                              attempts=self._attempts[rid],
                              backoff_s=delay,
@@ -474,5 +481,68 @@ class ReplicaPool:
             "pending": self.pending,
             "done": len(self.done),
             "lost": len(self.lost),
+            **self.stats,
+        }
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """Pool-level aggregation of every replica's
+        ``metrics_snapshot()`` (ISSUE 12): pool TTFT percentiles over
+        the MERGED raw reservoirs (averaging per-replica percentiles
+        would be wrong under skewed load), per-replica slot
+        utilization / queue depth, and the pool's lost / retried /
+        recovered counters — the document the serving bench embeds and
+        a disaggregated router would schedule on."""
+        per_replica = {}
+        ttft, waits = [], []
+        active = slots = queued = 0
+        seen_regs = set()   # replicas may SHARE one registry (the
+        #                     bench's merged stream) — count it once
+        for rid, cb in self.replicas.items():
+            a = sum(s.active for s in cb.slots)
+            active += a
+            slots += len(cb.slots)
+            queued += len(cb.queue)
+            if id(cb.metrics) not in seen_regs:
+                seen_regs.add(id(cb.metrics))
+                # peek, don't histogram(): get-or-create would seed an
+                # idle replica's registry with phantom empty metrics
+                ttft += cb.metrics.peek_histogram_values(
+                    "serving/ttft_s")
+                waits += cb.metrics.peek_histogram_values(
+                    "serving/admission_wait_s")
+            per_replica[rid] = {
+                "active_slots": a,
+                "slots": len(cb.slots),
+                "slot_utilization": a / max(len(cb.slots), 1),
+                "queue_depth": len(cb.queue),
+                "draining": rid in self._draining,
+                "decode_tokens": cb.stats["decode_tokens"],
+                "dump_id": cb.watchdog.dump_id
+                if cb.watchdog is not None else 0,
+            }
+
+        def pct(vals):
+            if not vals:
+                return {"count": 0}
+            v = np.asarray(vals, np.float64)  # sync-ok: host reservoirs
+            return {"count": int(v.size),
+                    "mean": float(v.mean()),   # sync-ok: host reservoir
+                    "p50": float(np.percentile(v, 50)),   # sync-ok: host
+                    "p90": float(np.percentile(v, 90)),   # sync-ok: host
+                    "p99": float(np.percentile(v, 99))}   # sync-ok: host
+
+        return {
+            "replicas": len(self.replicas),
+            "per_replica": per_replica,
+            "pool_ttft_s": pct(ttft),
+            "pool_admission_wait_s": pct(waits),
+            "active_slots": active,
+            "total_slots": slots,
+            "slot_utilization": active / max(slots, 1),
+            "queue_depth": queued,
+            "pending": self.pending,
+            "done": len(self.done),
+            "lost": len(self.lost),
+            "retried": sum(1 for a in self._attempts.values() if a > 0),
             **self.stats,
         }

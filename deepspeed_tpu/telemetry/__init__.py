@@ -29,7 +29,12 @@ Layout:
   step-time / swap-stall outliers, TTFT blowup, page-pool exhaustion)
   that write one-shot JSONL dumps of the ring;
 - ``view``: ``python -m deepspeed_tpu.telemetry.view <dump.jsonl>``
-  renders a dump as per-step phase tables + per-request timelines.
+  renders a dump as per-step phase tables + per-request timelines;
+- ``cluster``: cross-rank aggregation (ISSUE 12) — a fixed fp32
+  metrics vector allgathered at existing fences, folded on rank 0
+  into ``cluster/*`` skew gauges + the ``rank_straggler`` rule;
+- ``serve``: the live ``/metrics`` + ``/healthz`` http endpoint
+  (``monitor.serve_port``), stdlib http.server in a daemon thread.
 """
 
 from deepspeed_tpu.telemetry.registry import (     # noqa: F401
@@ -40,3 +45,27 @@ from deepspeed_tpu.telemetry.spans import (        # noqa: F401
 from deepspeed_tpu.telemetry.recorder import (     # noqa: F401
     FlightRecorder, default_recorder)
 from deepspeed_tpu.telemetry.anomaly import Watchdog  # noqa: F401
+
+# cluster/serve resolve lazily (PEP 562, same trick as the package
+# root): cluster.py imports numpy at module level, and the dump
+# viewer's "pure stdlib, runs anywhere" contract covers machines
+# without numpy too — an eager import here would put numpy on
+# `python -m deepspeed_tpu.telemetry.view`'s import chain
+# (tests/test_metric_names.py poisons BOTH jax and numpy to pin this).
+_LAZY_ATTRS = {
+    "ClusterAggregator": ("deepspeed_tpu.telemetry.cluster",
+                          "ClusterAggregator"),
+    "CLUSTER_METRICS": ("deepspeed_tpu.telemetry.cluster",
+                        "CLUSTER_METRICS"),
+    "cluster_metric_names": ("deepspeed_tpu.telemetry.cluster",
+                             "cluster_metric_names"),
+    "cluster": ("deepspeed_tpu.telemetry.cluster", None),
+    "MetricsServer": ("deepspeed_tpu.telemetry.serve", "MetricsServer"),
+    "start_metrics_server": ("deepspeed_tpu.telemetry.serve",
+                             "start_metrics_server"),
+    "serve": ("deepspeed_tpu.telemetry.serve", None),
+}
+
+from deepspeed_tpu.utils.lazy import lazy_attrs  # noqa: E402
+
+__getattr__, __dir__ = lazy_attrs(__name__, _LAZY_ATTRS)

@@ -92,6 +92,71 @@ class RollingOutlierRule:
         return None
 
 
+class StragglerRule:
+    """Cluster rank-straggler rule (ISSUE 12): at each cluster fence it
+    sees the per-rank step-time vector (``None``/NaN = rank did not
+    measure this fence) and trips when one rank exceeds ``max(factor *
+    median_of_the_OTHER_ranks, min_value)`` for ``fences`` CONSECUTIVE
+    fences. The leave-one-out median matters at small world sizes: with
+    2 ranks a whole-cluster median includes the straggler itself, so a
+    rank 10x slower only reaches ~1.8x the median and a 2x factor would
+    never fire. Latched per rank per episode — a persistently slow rank
+    dumps once; a fence where it looks normal re-arms it."""
+
+    def __init__(self, factor=2.0, min_value=0.0, fences=3):
+        assert factor > 1.0, factor
+        self.factor = factor
+        self.min_value = min_value
+        self.fences = max(int(fences), 1)
+        self._streak = {}            # rank -> consecutive slow fences
+        self._tripped = set()        # latched ranks
+
+    def observe(self, per_rank):
+        """``per_rank``: sequence of step-time seconds (None/NaN for
+        unmeasured ranks). Returns a detail dict when the WORST newly
+        over-threshold-for-K-fences rank trips, else None (other
+        simultaneous stragglers latch silently this fence)."""
+        vals = {r: float(v) for r, v in enumerate(per_rank)
+                if v is not None and math.isfinite(v)}  # sync-ok: host
+        if len(vals) < 2:
+            # no comparison possible: CONSECUTIVE is broken for every
+            # rank — freezing the streaks here would let slow fences
+            # separated by arbitrary unmeasured gaps count as adjacent
+            self._streak.clear()
+            return None
+        for r, _v in enumerate(per_rank):
+            if r not in vals:
+                # a rank that skipped measurement this fence breaks its
+                # own consecutiveness (the latch stays: unmeasured is
+                # not evidence of normality, only a normal fence re-arms)
+                self._streak[r] = 0
+        trips = []
+        for r, v in vals.items():
+            others = sorted(x for q, x in vals.items() if q != r)
+            n = len(others)
+            med = others[n // 2] if n % 2 \
+                else (others[n // 2 - 1] + others[n // 2]) / 2.0
+            thr = max(self.factor * med, self.min_value)
+            if v > thr:
+                self._streak[r] = self._streak.get(r, 0) + 1
+                if self._streak[r] >= self.fences \
+                        and r not in self._tripped:
+                    trips.append({"rank": r, "value": v,
+                                  "threshold": thr,
+                                  "peer_median": med,
+                                  "consecutive_fences": self._streak[r],
+                                  "world": len(per_rank)})
+            else:
+                self._streak[r] = 0
+                self._tripped.discard(r)
+        if not trips:
+            return None
+        worst = max(trips, key=lambda t: t["value"])
+        for t in trips:              # every qualifying rank latches,
+            self._tripped.add(t["rank"])   # only the worst dumps
+        return worst
+
+
 class Watchdog:
     """Fence-point anomaly rules over the flight recorder, with
     one-shot JSONL dumps. One instance per subsystem (the engine builds
@@ -104,6 +169,8 @@ class Watchdog:
                  swap_stall_factor=4.0, swap_stall_min_s=0.05,
                  ttft_factor=4.0, ttft_min_s=1.0,
                  ckpt_stall_factor=4.0, ckpt_stall_min_s=0.25,
+                 straggler_factor=2.0, straggler_fences=3,
+                 straggler_min_s=0.0,
                  baseline_window=64, min_samples=8, check_nan=True,
                  max_dumps=0):
         self.dump_dir = dump_dir
@@ -142,6 +209,12 @@ class Watchdog:
                 min_value=ckpt_stall_min_s, window=baseline_window,
                 min_samples=min_samples),
         }
+        # ISSUE 12: per-rank straggler detection over cluster fences —
+        # fed by the ClusterAggregator's rank-0 fold, never by a new
+        # collective of its own
+        self._straggler = StragglerRule(
+            factor=straggler_factor, min_value=straggler_min_s,
+            fences=straggler_fences)
 
     @classmethod
     def from_config(cls, watchdog_cfg, recorder=None, registry=None,
@@ -159,6 +232,10 @@ class Watchdog:
             ttft_min_s=watchdog_cfg.ttft_min_s,
             ckpt_stall_factor=watchdog_cfg.ckpt_stall_factor,
             ckpt_stall_min_s=watchdog_cfg.ckpt_stall_min_s,
+            straggler_factor=getattr(watchdog_cfg, "straggler_factor",
+                                     2.0),
+            straggler_fences=getattr(watchdog_cfg, "straggler_fences", 3),
+            straggler_min_s=getattr(watchdog_cfg, "straggler_min_s", 0.0),
             baseline_window=watchdog_cfg.baseline_window,
             min_samples=watchdog_cfg.min_samples,
             check_nan=watchdog_cfg.check_nan,
@@ -232,6 +309,19 @@ class Watchdog:
             return None
         det["step"] = step
         return self._trigger("ckpt_stall_outlier", det)
+
+    def observe_rank_step_times(self, per_rank, step=None):
+        """Cluster rank-straggler check (ISSUE 12): ``per_rank`` is the
+        per-rank step-time vector the ClusterAggregator allgathered at
+        an EXISTING fence (the steps_per_print readback / a snapshot
+        commit fence) and folded on rank 0 — host floats only, the
+        collective already happened. Trips ``rank_straggler`` naming
+        the offending rank after K consecutive slow fences."""
+        det = self._straggler.observe(per_rank)
+        if det is None:
+            return None
+        det["step"] = step
+        return self._trigger("rank_straggler", det)
 
     def note_ckpt_corrupt(self, path, reason):
         """An elastic-resume candidate failed validation (torn

@@ -1,0 +1,182 @@
+"""Cross-rank telemetry aggregation (ISSUE 12 tentpole).
+
+Every telemetry surface below this module is process-local: the
+registry's gauges, the flight-recorder ring, the watchdog's rules. Once
+a run spans real process boundaries (PR 10's gloo collectives,
+``comm.hierarchy``) a straggler rank or a skewed per-link byte ledger
+is invisible — each process sees only itself. This module closes that
+gap under the same sync discipline as everything else in telemetry/:
+
+- **the exchange rides existing fences only.** Each rank packs a
+  fixed-size fp32 vector of its boundary metrics (window-mean step
+  time, swap stall, ckpt-commit stall, loss, host RSS, per-link-class
+  comm bytes) and allgathers it over the gloo process group — at the
+  ``steps_per_print`` loss readback and at snapshot commit fences,
+  where a host sync already exists and every rank arrives in SPMD
+  lockstep. It never adds a fence of its own (``test_sync_guard``
+  scans this module).
+- **rank 0 folds** the ``[world, n]`` matrix into
+  ``cluster/<metric>/{min,median,max,p99,argmax_rank}`` gauges plus a
+  per-rank skew table (``last_table``), records a compact
+  ``cluster_fence`` ring event, and feeds the per-rank step-time
+  vector to the watchdog's latched ``rank_straggler`` rule
+  (anomaly.StragglerRule) — the rule that names the slow rank after K
+  consecutive slow fences.
+- **single-process degenerates gracefully**: no collective, the local
+  vector folds as a world of one, so the ``cluster/*`` gauges (and the
+  /metrics endpoint that serves them) exist uniformly.
+
+The vector layout is FIXED (``CLUSTER_METRICS``): every rank packs the
+same slots in the same order, NaN meaning "not measured this fence"
+(no swap tier on this rank, first window still warming). Fold stats
+ignore NaNs per metric.
+"""
+
+import time
+
+import numpy as np
+
+from deepspeed_tpu.telemetry.recorder import default_recorder
+from deepspeed_tpu.telemetry.registry import default_registry
+
+# one fp32 slot per metric, packed in this order on every rank
+CLUSTER_METRICS = (
+    "step_time_s",    # window-mean step time of the closing fold
+    "swap_stall_s",   # host seconds this step blocked on swap I/O
+    "ckpt_stall_s",   # last snapshot-commit fence stall
+    "loss",           # the boundary loss readback
+    "host_rss_mb",    # host RSS high-water mark
+    "comm_intra_mb",  # fast-link (ICI-class) bytes of the last step
+    "comm_inter_mb",  # slow-link (DCN-class) bytes of the last step
+)
+
+CLUSTER_STATS = ("min", "median", "max", "p99", "argmax_rank")
+
+
+def cluster_metric_names():
+    """Every ``cluster/*`` gauge/counter name this module can emit —
+    the drift guard (tests/test_metric_names.py) checks this list
+    against docs/observability.md in BOTH directions."""
+    names = [f"cluster/{m}/{s}" for m in CLUSTER_METRICS
+             for s in CLUSTER_STATS]
+    names += ["cluster/world_size", "cluster/fences"]
+    return names
+
+
+def collect_local(registry=None, loss=None, overrides=None):
+    """One rank's metric dict for the next fence, read from the
+    registry's last observations (host scalars recorded at fences the
+    caller already paid). ``overrides`` (metric -> value or None) wins
+    over the registry — the engine passes its just-closed window's
+    step time directly so a previous engine's history in the
+    process-wide registry cannot leak in."""
+    reg = registry or default_registry()
+    nan = float("nan")  # sync-ok: a literal, not a readback
+
+    # peek, don't snapshot(): a full registry snapshot summarizes (and
+    # sorts the reservoir of) EVERY histogram in the process — paying
+    # that per fence just to read two last-values would dwarf the
+    # exchange itself once serving histograms share the registry
+
+    def last(name):
+        v = reg.peek_histogram_last(name)
+        return nan if v is None else v
+
+    def gauge(name, scale=1.0):
+        v = reg.peek_gauge(name)
+        return nan if v is None else v * scale
+
+    out = {
+        "step_time_s": last("train/step_time_s"),
+        "swap_stall_s": last("swap/stall_s"),
+        "ckpt_stall_s": last("ckpt/stall_s"),
+        "loss": nan if loss is None else float(loss),  # sync-ok: the
+        #                       boundary readback already produced this
+        "host_rss_mb": gauge("memory/host_max_rss_mb"),
+        "comm_intra_mb": gauge("comm/bytes_per_step/intra", 1 / 2**20),
+        "comm_inter_mb": gauge("comm/bytes_per_step/inter", 1 / 2**20),
+    }
+    for k, v in (overrides or {}).items():
+        out[k] = nan if v is None else float(v)  # sync-ok: host scalars
+    return out
+
+
+class ClusterAggregator:
+    """See module docstring. One per engine; rank and world size are
+    learned from the first :meth:`exchange`."""
+
+    def __init__(self, registry=None, recorder=None, watchdog=None):
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.recorder = recorder if recorder is not None \
+            else default_recorder()
+        self.watchdog = watchdog
+        self.rank = 0
+        self.world = 1
+        self.fences = 0
+        self.last_fence_ts = None     # wall clock of the last exchange
+        self.last_table = None        # rank-0 per-rank skew table
+
+    # ----------------------------------------------------------- exchange
+
+    def exchange(self, values, step=None):
+        """Allgather one fence's metric dict (see CLUSTER_METRICS) and
+        fold on rank 0. MUST be called at an aligned fence on every
+        rank (see utils.distributed.allgather_host_floats). Returns
+        the ``[world, n]`` matrix (every rank gets it — a caller that
+        wants its own skew view doesn't need to be rank 0)."""
+        from deepspeed_tpu.utils.distributed import allgather_host_floats
+        vec = np.asarray(  # sync-ok: host scalars packed for the fence
+            [values.get(m, float("nan")) for m in CLUSTER_METRICS],
+            np.float32)
+        mat, rank = allgather_host_floats(vec)
+        self.rank, self.world = int(rank), int(mat.shape[0])
+        self.fences += 1
+        self.last_fence_ts = time.time()
+        if self.rank == 0:
+            self._fold(mat, step)
+        return mat
+
+    def exchange_from_registry(self, registry=None, loss=None, step=None,
+                               overrides=None):
+        """``exchange(collect_local(...))`` — the engine's one-liner."""
+        return self.exchange(
+            collect_local(registry or self.registry, loss=loss,
+                          overrides=overrides), step=step)
+
+    # --------------------------------------------------------------- fold
+
+    def _fold(self, mat, step):
+        """Rank 0: per-metric cluster stats into gauges, the per-rank
+        skew table, the ring breadcrumb, and the straggler rule."""
+        reg = self.registry
+        reg.gauge("cluster/world_size").set(self.world)
+        reg.counter("cluster/fences").inc()
+        table = {"step": step, "world": self.world, "metrics": {}}
+        for i, m in enumerate(CLUSTER_METRICS):
+            col = np.asarray(  # sync-ok: host matrix from the allgather
+                mat[:, i], np.float64)
+            finite = np.isfinite(col)
+            table["metrics"][m] = [
+                float(v) if ok else None  # sync-ok: host matrix entries
+                for v, ok in zip(col, finite)]
+            if not finite.any():
+                continue
+            vals = col[finite]
+            reg.gauge(f"cluster/{m}/min").set(vals.min())
+            reg.gauge(f"cluster/{m}/median").set(np.median(vals))
+            reg.gauge(f"cluster/{m}/max").set(vals.max())
+            reg.gauge(f"cluster/{m}/p99").set(np.percentile(vals, 99))
+            reg.gauge(f"cluster/{m}/argmax_rank").set(
+                int(np.argmax(np.where(finite, col, -np.inf))))
+        self.last_table = table
+        st = table["metrics"]["step_time_s"]
+        self.recorder.record(
+            "cluster_fence", step=step, world=self.world,
+            step_time_per_rank=st,
+            loss_per_rank=table["metrics"]["loss"])
+        if self.watchdog is not None and any(v is not None for v in st):
+            # host floats the fence already produced — the rule that
+            # names a straggler rank after K consecutive slow fences
+            self.watchdog.observe_rank_step_times(st, step=step)
+        return table

@@ -93,6 +93,14 @@ class Histogram:
             self.max = max(self.max, v)
             self._values.append(v)
 
+    def values(self):
+        """Copy of the bounded reservoir (most-recent observations) —
+        cross-replica aggregation (ISSUE 12: ReplicaPool pool-level
+        TTFT percentiles) merges raw reservoirs instead of averaging
+        already-summarized percentiles."""
+        with self._lock:
+            return list(self._values)
+
     def summary(self):
         with self._lock:
             vals = sorted(self._values)
@@ -152,6 +160,33 @@ class MetricsRegistry:
             if h is None:
                 h = self._histograms[name] = Histogram(self._lock, maxlen)
             return h
+
+    def peek_gauge(self, name):
+        """Current gauge value WITHOUT creating the gauge (None when it
+        was never set) — per-fence readers (telemetry/cluster.py) must
+        neither pollute the registry with empty metrics nor pay a full
+        snapshot() to read three values."""
+        with self._lock:
+            g = self._gauges.get(name)
+            return None if g is None else g.value
+
+    def peek_histogram_last(self, name):
+        """Most recent observation of a histogram, or None when absent
+        or empty — same per-fence-reader rationale as peek_gauge."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None or not h._values:
+                return None
+            return h._values[-1]
+
+    def peek_histogram_values(self, name):
+        """Reservoir copy WITHOUT creating the histogram ([] when
+        absent) — cross-replica mergers (ReplicaPool.metrics_snapshot)
+        must not seed idle replicas' registries with phantom
+        zero-count metrics."""
+        with self._lock:
+            h = self._histograms.get(name)
+            return [] if h is None else list(h._values)
 
     def snapshot(self, prefix=None):
         """One JSON-able dict of everything (optionally filtered to

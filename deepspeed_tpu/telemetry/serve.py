@@ -1,0 +1,122 @@
+"""Live telemetry endpoint (ISSUE 12): a stdlib-only ``http.server``
+thread serving the current registry state, so a long run is observable
+without waiting for a watchdog dump or the next JSONL export.
+
+Routes:
+
+- ``/metrics`` — the Prometheus exposition dump
+  (``registry.prometheus_text``) of the attached registry; on rank 0
+  of a multi-process run the ``cluster/*`` gauges folded by
+  telemetry/cluster.py are part of that registry, so one scrape of
+  rank 0 sees the whole cluster's skew stats.
+- ``/healthz`` — JSON liveness: watchdog trip summary (rule -> count,
+  the last anomaly), the age of the last telemetry fence (seconds
+  since the engine last folded/exchanged — a stuck run shows as a
+  growing fence age long before any rule trips), and the server's own
+  clock.
+
+Everything here is pull-based and reads only host state the fences
+already produced — a scrape can never force a device sync
+(``test_sync_guard`` scans this module). Config: ``monitor.serve_port``
+(0 = off, the default) + ``monitor.serve_host`` (127.0.0.1); the
+training engine starts it on rank 0 only, ``serving.build_engine``
+starts one over the serving registry when the block asks for it.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from deepspeed_tpu.telemetry.registry import (default_registry,
+                                              prometheus_text)
+from deepspeed_tpu.utils.logging import logger
+
+
+class MetricsServer:
+    """One daemon http.server thread. ``port=0`` binds an ephemeral
+    port (tests); the bound port is ``self.port`` after construction.
+    ``fence_age_fn`` returns the wall-clock timestamp of the last
+    telemetry fence (or None before the first)."""
+
+    def __init__(self, port, registry=None, watchdog=None,
+                 fence_age_fn=None, host="127.0.0.1", extra_health_fn=None):
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.watchdog = watchdog
+        self.fence_age_fn = fence_age_fn
+        self.extra_health_fn = extra_health_fn
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):   # no stderr spam per scrape
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = prometheus_text(outer.registry).encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/healthz":
+                    body = json.dumps(outer.health(),
+                                      default=repr).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404, "try /metrics or /healthz")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dstpu-metrics",
+            daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def health(self):
+        """The /healthz document — host state only."""
+        age = None
+        if self.fence_age_fn is not None:
+            ts = self.fence_age_fn()
+            if ts:
+                age = max(time.time() - ts, 0.0)
+        wd = self.watchdog
+        doc = {
+            "ok": True,
+            "ts": time.time(),
+            "last_fence_age_s": age,
+            "watchdog": wd.snapshot() if wd is not None else None,
+            "watchdog_trips": sum(wd.trips.values())
+            if wd is not None else 0,
+        }
+        if self.extra_health_fn is not None:
+            try:
+                doc.update(self.extra_health_fn() or {})
+            except Exception as e:   # a scrape must never crash the run
+                doc["extra_error"] = str(e)
+        return doc
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def start_metrics_server(port, **kwargs):
+    """Build + start, degrading to None on a bind failure (a second
+    engine in the same process racing for the same port must not kill
+    training — the first one keeps serving)."""
+    try:
+        return MetricsServer(port, **kwargs).start()
+    except OSError as e:
+        logger.warning(f"telemetry /metrics endpoint unavailable "
+                       f"(port {port}): {e}")
+        return None

@@ -19,14 +19,23 @@ events — as:
   elastic-serving lifecycle (ISSUE 11): drain -> snapshot -> restore
   -> requeue, aborts, replica kills and pool scale events;
 - a swap-tier I/O summary per step (bytes in/out, drain waits);
+- request-scoped distributed traces (ISSUE 12): given N dump files
+  TOGETHER (``view.py dumpA.jsonl dumpB.jsonl``), events are merged,
+  deduplicated and stitched by ``trace_id`` into one cross-replica
+  timeline per request — "born on replica 0, killed mid-verify,
+  restored on replica 2, finished";
+- cluster fences (ISSUE 12): the per-rank step-time skew table the
+  cross-rank aggregation recorded at each fence;
 - the trailing raw events with ``--events N``.
 
 Pure stdlib + host-side JSON — the viewer never imports jax, so it runs
-anywhere the dump landed (a dev laptop, a CI artifact store).
+anywhere the dump landed (a dev laptop, a CI artifact store);
+tests/test_metric_names.py pins the import chain jax-free.
 """
 
 import argparse
 import json
+import os
 import sys
 from collections import OrderedDict, defaultdict
 
@@ -55,6 +64,32 @@ def load_dump(path):
             else:
                 events.append(obj)
     return header, events, skipped
+
+
+def load_dumps(paths):
+    """Merge N dumps into one event stream: events deduplicate on
+    ``(seq, ts, kind)`` (two dumps of the SAME recorder ring overlap —
+    e.g. a mid-run anomaly dump plus an end-of-run one) and sort by
+    wall clock then sequence, which also interleaves dumps from
+    DIFFERENT processes/replicas onto one timeline. Returns
+    ``(headers, events, skipped)`` with one (path, header) per file
+    that had one."""
+    headers, events, skipped = [], [], 0
+    seen = set()
+    for path in paths:
+        header, evs, sk = load_dump(path)
+        skipped += sk
+        if header is not None:
+            headers.append((path, header))
+        for ev in evs:
+            key = (ev.get("seq"), ev.get("ts"), ev.get("kind"))
+            if ev.get("seq") is not None:
+                if key in seen:
+                    continue
+                seen.add(key)
+            events.append(ev)
+    events.sort(key=lambda e: (e.get("ts") or 0.0, e.get("seq") or 0))
+    return headers, events, skipped
 
 
 def _fmt(v, width):
@@ -345,11 +380,139 @@ def render_swap(events, out):
     _table(headers, rows, out)
 
 
-def render(path, tail_events=0):
-    """The full report as a list of lines (the CLI joins and prints)."""
-    header, events, skipped = load_dump(path)
+# lifecycle kinds that carry a single ``trace`` field, and the batch
+# kinds whose ``traces`` list names every request they touched
+TRACE_POINT_KINDS = ("admit", "prefill", "finish", "serving_abort",
+                     "serving_requeue", "pool_exhausted")
+TRACE_SET_KINDS = ("serving_snapshot", "serving_restore")
+
+
+def trace_timelines(events):
+    """trace_id -> ordered event list (the stitching primitive the
+    tests drive directly): lifecycle events attach by their ``trace``
+    field, snapshot/restore events by membership in their ``traces``
+    list. Events without a trace are ignored — a request admitted
+    before tracing existed simply has no timeline."""
+    traces = OrderedDict()
+    for ev in events:
+        kind = ev.get("kind")
+        if kind in TRACE_POINT_KINDS and ev.get("trace") is not None:
+            traces.setdefault(ev["trace"], []).append(ev)
+        elif kind in TRACE_SET_KINDS:
+            for tid in ev.get("traces") or ():
+                if tid is not None:
+                    traces.setdefault(tid, []).append(ev)
+    return traces
+
+
+def _trace_outcome(evs):
+    for ev in reversed(evs):
+        if ev.get("kind") == "finish":
+            return f"finished ({ev.get('reason')})"
+        if ev.get("kind") == "serving_abort":
+            return "aborted"
+        if ev.get("kind") == "serving_requeue" \
+                and ev.get("outcome") == "dropped":
+            # the pool's retry budget ran out — a TERMINAL loss, the
+            # trace an operator is most likely hunting for
+            return f"lost (dropped after {ev.get('attempts', '?')} " \
+                   f"attempts)"
+    return "open"
+
+
+def render_traces(events, out):
+    """Request-scoped distributed traces (ISSUE 12): a summary row per
+    trace_id, then a stitched per-event timeline for every trace that
+    crossed a replica boundary or was requeued — the "born on replica
+    0, restored on replica 2, finished" story."""
+    traces = trace_timelines(events)
+    if not traces:
+        return
+    ts_all = [ev["ts"] for evs in traces.values() for ev in evs
+              if ev.get("ts") is not None]
+    t0 = min(ts_all) if ts_all else None
+    rel = (lambda t: (t - t0) if (t is not None and t0 is not None)
+           else None)
+    out.append("")
+    out.append(f"request traces ({len(traces)} trace_id(s) stitched "
+               f"across the given dumps):")
+    headers = ["trace", "rid", "replicas", "events", "requeues",
+               "outcome", "t_first", "t_last"]
+    rows = []
+    for tid, evs in traces.items():
+        rid = next((ev.get("rid") for ev in evs
+                    if ev.get("rid") is not None), None)
+        reps = sorted({ev["replica"] for ev in evs
+                       if ev.get("replica") is not None})
+        rows.append([
+            tid, rid, ",".join(str(r) for r in reps) or "-", len(evs),
+            sum(ev.get("kind") == "serving_requeue" for ev in evs),
+            _trace_outcome(evs),
+            rel(evs[0].get("ts")), rel(evs[-1].get("ts"))])
+    _table(headers, rows, out)
+    for tid, evs in traces.items():
+        reps = {ev["replica"] for ev in evs
+                if ev.get("replica") is not None}
+        crossed = len(reps) > 1 or any(
+            ev.get("kind") == "serving_requeue" for ev in evs)
+        if not crossed:
+            continue
+        out.append(f"  trace {tid} (rid "
+                   f"{next((ev.get('rid') for ev in evs if ev.get('rid') is not None), '?')!r}):")
+        for ev in evs:
+            kind = ev.get("kind")
+            rep = ev.get("replica")
+            where = f"replica {rep}" if rep is not None else "-"
+            bits = []
+            for k in ("slot", "prompt_tokens", "ttft_s", "reason",
+                      "generated", "outcome", "attempts", "committed",
+                      "remaining", "restored", "requeued", "tag"):
+                if ev.get(k) is not None:
+                    v = ev[k]
+                    bits.append(f"{k}={v:.4g}" if isinstance(v, float)
+                                else f"{k}={v}")
+            t = rel(ev.get("ts"))
+            out.append(f"    +{t:9.3f}s  {kind:<17} [{where}] "
+                       + ", ".join(bits)
+                       if t is not None else
+                       f"    {'':>10}   {kind:<17} [{where}] "
+                       + ", ".join(bits))
+
+
+def render_cluster(events, out):
+    """Cluster fences (ISSUE 12): the per-rank step-time skew table
+    the cross-rank aggregation recorded on rank 0 at each fence."""
+    fences = [ev for ev in events if ev.get("kind") == "cluster_fence"]
+    if not fences:
+        return
+    world = max(len(ev.get("step_time_per_rank") or ()) for ev in fences)
+    out.append("")
+    out.append(f"cluster fences (world {world}; per-rank step time, s):")
+    headers = ["step", "world"] + [f"rank{r}_step_s" for r in range(world)]         + ["loss_rank0"]
+    rows = []
+    for ev in fences:
+        st = list(ev.get("step_time_per_rank") or ())
+        st += [None] * (world - len(st))
+        losses = ev.get("loss_per_rank") or [None]
+        rows.append([ev.get("step"), ev.get("world")] + st + [losses[0]])
+    _table(headers, rows, out)
+
+
+def render(paths, tail_events=0):
+    """The full report as a list of lines (the CLI joins and prints).
+    ``paths`` may be one dump path (str or PathLike, the pre-ISSUE-12
+    signature) or a list of them — multiple dumps merge onto one
+    timeline (cross-replica trace stitching)."""
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    headers, events, skipped = load_dumps(paths)
     out = []
-    render_header(header, out)
+    if not headers:
+        out.append("no dump header (raw event stream)")
+    for path, header in headers:
+        if len(headers) > 1:
+            out.append(f"[{path}]")
+        render_header(header, out)
     if skipped:
         out.append(f"({skipped} unparseable line(s) skipped)")
     if not events:
@@ -357,6 +520,8 @@ def render(path, tail_events=0):
         return out
     render_steps(events, out)
     render_requests(events, out)
+    render_traces(events, out)
+    render_cluster(events, out)
     render_ckpt(events, out)
     render_swap(events, out)
     plans = [ev for ev in events
@@ -382,14 +547,17 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.telemetry.view",
         description=__doc__.splitlines()[0])
-    ap.add_argument("dump", help="flight-recorder dump (JSONL)")
+    ap.add_argument("dump", nargs="+",
+                    help="flight-recorder dump(s) (JSONL) — give several "
+                         "to merge them onto one timeline (cross-replica "
+                         "trace stitching)")
     ap.add_argument("--events", type=int, default=0, metavar="N",
                     help="also print the last N raw events")
     args = ap.parse_args(argv)
     try:
         lines = render(args.dump, tail_events=args.events)
     except OSError as e:
-        print(f"cannot read {args.dump}: {e}", file=sys.stderr)
+        print(f"cannot read {' '.join(args.dump)}: {e}", file=sys.stderr)
         return 2
     print("\n".join(lines))
     return 0

@@ -113,3 +113,26 @@ def _enable_cpu_cross_process_collectives(jax):
 
 def is_initialized():
     return _initialized
+
+
+def allgather_host_floats(vec):
+    """Allgather one small host fp32 vector across processes; returns
+    ``(matrix [world, n], process_index)``.
+
+    The cross-rank telemetry fence (ISSUE 12, telemetry/cluster.py):
+    every process must call this at the SAME aligned point (the
+    steps_per_print boundary / a snapshot commit fence — places every
+    rank reaches in SPMD lockstep), exactly like the preemption
+    agreement collective in runtime/engine._preempt_agreed. Single
+    process short-circuits to a reshape — no jax.distributed needed,
+    no collective compiled."""
+    import numpy as np
+
+    import jax
+    arr = np.asarray(vec, np.float32).reshape(-1)
+    if jax.process_count() == 1:
+        return arr[None, :], 0
+    from jax.experimental import multihost_utils
+    mat = multihost_utils.process_allgather(arr)
+    return (np.asarray(mat, np.float32).reshape(jax.process_count(), -1),
+            int(jax.process_index()))

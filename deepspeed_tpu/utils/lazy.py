@@ -1,0 +1,32 @@
+"""PEP 562 lazy-attribute machinery shared by the packages whose bare
+import must stay dependency-free (the root ``deepspeed_tpu/__init__``
+must not drag in jax, ``telemetry/__init__`` must not drag in numpy —
+the stdlib-only dump-viewer contract, pinned by
+tests/test_metric_names.py with poisoned stubs). This module itself
+imports nothing beyond importlib, so it sits below that chain."""
+
+
+def lazy_attrs(module_name, mapping):
+    """Build the ``(__getattr__, __dir__)`` pair for a lazily-resolved
+    module surface. ``mapping``: attribute name -> ``(target_module,
+    target_attr_or_None)`` (None = the module itself). Resolved values
+    are cached onto the requesting module, so each attribute pays the
+    import once."""
+    import sys
+
+    def __getattr__(name):
+        try:
+            target_module, attr = mapping[name]
+        except KeyError:
+            raise AttributeError(
+                f"module {module_name!r} has no attribute {name!r}")
+        import importlib
+        mod = importlib.import_module(target_module)
+        value = mod if attr is None else getattr(mod, attr)
+        setattr(sys.modules[module_name], name, value)
+        return value
+
+    def __dir__():
+        return sorted(set(vars(sys.modules[module_name])) | set(mapping))
+
+    return __getattr__, __dir__

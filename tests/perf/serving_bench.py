@@ -465,6 +465,10 @@ def run_serving_elastic_bench(n_requests=16, slots=2, seed=0,
     missing = n_requests - len(done)
     st = pool.snapshot_stats()
     n_recoveries = st["kills"] + st["preempts"]
+    # pool-level aggregation (ISSUE 12): merged-reservoir TTFT
+    # percentiles + per-replica utilization — the document a
+    # disaggregated router would schedule on
+    pool_telemetry = pool.metrics_snapshot()
     pool.close()
 
     # --- autoscale leg: burst overload, watchdog signal on vs off ---
@@ -511,6 +515,7 @@ def run_serving_elastic_bench(n_requests=16, slots=2, seed=0,
         "ttft_p99_s_fixed": fixed["ttft_p99_s"],
         "ttft_p99_s_autoscale": auto["ttft_p99_s"],
         "autoscale": {"fixed": fixed, "watchdog": auto},
+        "pool_telemetry": pool_telemetry,
     }
 
 

@@ -133,3 +133,29 @@ def test_compare_unreadable_file_is_a_usage_error(tmp_path):
     prior = _write(tmp_path, "prior.json", _bench_doc())
     with pytest.raises(SystemExit):
         _run(str(tmp_path / "nope.json"), prior)
+
+
+def test_provenance_stamp_and_compare_prints_both_sides(tmp_path,
+                                                        capsys):
+    """ISSUE 12 satellite: results carry meta.provenance (git sha,
+    hostname, cpu_count, jax/python versions) and --compare prints
+    both sides' — the ±25% box swing stops being rediscovered by hand.
+    The stamp itself must stay importable jax-free (the --candidate
+    path never imports jax)."""
+    prov = bench.provenance(jax_version="9.9.9-test")
+    assert set(prov) == {"git_sha", "hostname", "cpu_count",
+                         "jax_version", "python_version"}
+    assert prov["jax_version"] == "9.9.9-test"
+    assert prov["cpu_count"] >= 1 and prov["hostname"]
+
+    with_prov = dict(_bench_doc(), meta={"provenance": prov})
+    prior = _write(tmp_path, "prior.json", with_prov)
+    cand = _write(tmp_path, "cand.json", _bench_doc())
+    assert _run(prior, cand) == 0
+    out = capsys.readouterr().out
+    assert "prior provenance" in out and "9.9.9-test" in out
+    assert "candidate provenance: <none recorded>" in out
+
+    # driver-captured format: provenance beside "parsed" still found
+    driver = {"parsed": _bench_doc(), "meta": {"provenance": prov}}
+    assert bench._doc_provenance(driver)["hostname"] == prov["hostname"]

@@ -1,0 +1,312 @@
+"""Cluster telemetry plane (ISSUE 12): cross-rank aggregation fold,
+the rank_straggler watchdog rule, the engine fence integration, and
+the live /metrics endpoint.
+
+The 2-real-process proof leg (injected per-step sleep on rank 1 →
+exactly one latched dump naming rank 1) lives in
+tests/test_multiprocess_dist.py::test_rank_straggler_two_processes
+(slow); everything here is fast and in-process — the fold and rule
+logic are pure host code, so the single-process engine exercises the
+same code path minus the allgather.
+"""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.telemetry.anomaly import StragglerRule, Watchdog
+from deepspeed_tpu.telemetry.cluster import (CLUSTER_METRICS,
+                                             ClusterAggregator,
+                                             cluster_metric_names,
+                                             collect_local)
+from deepspeed_tpu.telemetry.recorder import FlightRecorder
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.telemetry.serve import (MetricsServer,
+                                           start_metrics_server)
+
+
+# ------------------------------------------------------ straggler rule
+
+def test_straggler_rule_leave_one_out_median():
+    """With 2 ranks a whole-cluster median includes the straggler and
+    a 10x-slow rank only reaches ~1.8x it — the leave-one-out median
+    is what lets factor=2 fire at world 2."""
+    rule = StragglerRule(factor=2.0, fences=1)
+    # rank 1 is 10x rank 0: vs the OTHER rank's median (0.05) -> trip
+    det = rule.observe([0.05, 0.5])
+    assert det is not None and det["rank"] == 1
+    assert det["peer_median"] == pytest.approx(0.05)
+    assert det["world"] == 2
+
+
+def test_straggler_rule_needs_consecutive_fences_latches_and_rearms():
+    rule = StragglerRule(factor=2.0, fences=3)
+    fast, slow = [0.01, 0.012, 0.011, 0.01], [0.01, 0.012, 0.2, 0.01]
+    assert rule.observe(fast) is None
+    assert rule.observe(slow) is None          # streak 1
+    assert rule.observe(slow) is None          # streak 2
+    det = rule.observe(slow)                   # streak 3 -> trip
+    assert det is not None and det["rank"] == 2
+    assert det["consecutive_fences"] == 3
+    assert rule.observe(slow) is None          # latched: no second trip
+    assert rule.observe(fast) is None          # normal fence re-arms
+    for _ in range(2):
+        assert rule.observe(slow) is None
+    det = rule.observe(slow)                   # fresh episode trips
+    assert det is not None and det["rank"] == 2
+
+
+def test_straggler_rule_unmeasured_fences_break_consecutiveness():
+    """A rank that skips measurement (NaN/None) resets its own streak,
+    and an uncomparable fence (<2 measured ranks) resets everyone's —
+    slow fences separated by unmeasured gaps must not count as
+    CONSECUTIVE (the commit-fence exchange deliberately reports
+    step_time as unmeasured for exactly this reason)."""
+    # per-rank reset: others still comparable, ONE rank unmeasured
+    rule = StragglerRule(factor=2.0, fences=2)
+    slow = [0.01, 0.012, 0.3]
+    assert rule.observe(slow) is None            # streak 1
+    assert rule.observe([0.01, 0.012, None]) is None  # rank 2 skips
+    assert rule.observe(slow) is None            # streak restarts at 1
+    assert rule.observe(slow) is not None        # NOW consecutive
+    # global reset: an uncomparable fence (<2 measured) clears everyone
+    rule2 = StragglerRule(factor=2.0, fences=2)
+    assert rule2.observe(slow) is None           # streak 1
+    assert rule2.observe(
+        [0.01, float("nan"), float("nan")]) is None   # uncomparable
+    assert rule2.observe(slow) is None           # streak restarted
+    assert rule2.observe(slow) is not None
+
+
+def test_straggler_rule_min_value_floor_and_small_world():
+    rule = StragglerRule(factor=2.0, min_value=0.05, fences=1)
+    # 3x skew but under the absolute floor: dispatch noise, no trip
+    for _ in range(5):
+        assert rule.observe([0.001, 0.003]) is None
+    # a single rank (or all-NaN peers) has nothing to compare against
+    assert StragglerRule(fences=1).observe([0.5]) is None
+    assert StragglerRule(fences=1).observe([0.5, None]) is None
+
+
+def test_watchdog_rank_straggler_dump_names_the_rank(tmp_path):
+    rec = FlightRecorder(capacity=64)
+    rec.record("step", step=1)
+    reg = MetricsRegistry()
+    wd = Watchdog(str(tmp_path), recorder=rec, registry=reg,
+                  source="train", straggler_factor=2.0,
+                  straggler_fences=2, straggler_min_s=0.05)
+    slow = [0.01, 0.3, 0.012, 0.011]
+    assert wd.observe_rank_step_times(slow, step=4) is None   # streak 1
+    path = wd.observe_rank_step_times(slow, step=8)           # trip
+    assert path is not None and "rank_straggler" in path
+    assert wd.observe_rank_step_times(slow, step=12) is None  # latched
+    files = [f for f in os.listdir(tmp_path) if "rank_straggler" in f]
+    assert len(files) == 1
+    header = json.loads(open(path).readline())
+    assert header["rule"] == "rank_straggler"
+    assert header["detail"]["rank"] == 1
+    assert header["detail"]["consecutive_fences"] == 2
+    assert reg.counter("watchdog/trips/rank_straggler").value == 1
+
+
+# ---------------------------------------------------------------- fold
+
+def test_cluster_fold_stats_skew_table_and_ring_event():
+    reg = MetricsRegistry()
+    rec = FlightRecorder(capacity=64)
+    wd = Watchdog("/tmp/_unused_dumps", recorder=rec, registry=reg,
+                  straggler_factor=2.0, straggler_fences=1,
+                  straggler_min_s=0.05, max_dumps=-1)
+    agg = ClusterAggregator(registry=reg, recorder=rec, watchdog=wd)
+    agg.world, agg.rank = 4, 0
+    n = len(CLUSTER_METRICS)
+    mat = np.full((4, n), np.nan, np.float32)
+    mat[:, 0] = [0.1, 0.1, 0.9, 0.1]        # step_time_s: rank 2 slow
+    mat[:, 3] = [2.0, 2.1, 1.9, 2.05]       # loss
+    # swap_stall_s stays all-NaN: no rank has a swap tier
+    agg._fold(mat, step=10)
+    g = reg.snapshot()["gauges"]
+    assert g["cluster/step_time_s/min"] == pytest.approx(0.1)
+    assert g["cluster/step_time_s/max"] == pytest.approx(0.9, rel=1e-5)
+    assert g["cluster/step_time_s/median"] == pytest.approx(0.1)
+    assert g["cluster/step_time_s/argmax_rank"] == 2
+    assert g["cluster/loss/argmax_rank"] == 1
+    assert "cluster/swap_stall_s/max" not in g          # all-NaN column
+    table = agg.last_table
+    assert table["metrics"]["swap_stall_s"] == [None] * 4
+    assert table["metrics"]["step_time_s"][2] == pytest.approx(
+        0.9, rel=1e-5)
+    evs = [e for e in rec.events() if e["kind"] == "cluster_fence"]
+    assert len(evs) == 1 and evs[0]["world"] == 4
+    # the watchdog rule rode the fold (fences=1 -> immediate trip)
+    assert wd.trips.get("rank_straggler") == 1
+
+
+def test_collect_local_reads_registry_and_overrides_win():
+    reg = MetricsRegistry()
+    reg.histogram("train/step_time_s").observe(0.2)
+    reg.gauge("memory/host_max_rss_mb").set(123.0)
+    reg.gauge("comm/bytes_per_step/inter").set(4 * 2**20)
+    vals = collect_local(reg, loss=1.5)
+    assert vals["step_time_s"] == pytest.approx(0.2)
+    assert vals["loss"] == 1.5
+    assert vals["host_rss_mb"] == 123.0
+    assert vals["comm_inter_mb"] == pytest.approx(4.0)
+    assert np.isnan(vals["swap_stall_s"])       # never observed
+    vals = collect_local(reg, overrides={"step_time_s": 0.7,
+                                         "swap_stall_s": None})
+    assert vals["step_time_s"] == 0.7
+    assert np.isnan(vals["swap_stall_s"])
+
+
+def test_single_process_exchange_degenerates_to_local_fold():
+    reg = MetricsRegistry()
+    agg = ClusterAggregator(registry=reg, recorder=FlightRecorder(64))
+    mat = agg.exchange({"step_time_s": 0.25, "loss": 3.0}, step=2)
+    assert mat.shape == (1, len(CLUSTER_METRICS))
+    g = reg.snapshot()["gauges"]
+    assert g["cluster/world_size"] == 1
+    assert g["cluster/step_time_s/min"] == g["cluster/step_time_s/max"] \
+        == pytest.approx(0.25)
+    assert g["cluster/step_time_s/argmax_rank"] == 0
+    assert reg.snapshot()["counters"]["cluster/fences"] == 1
+    assert agg.last_fence_ts is not None
+
+
+# -------------------------------------------------- engine integration
+
+def test_engine_boundary_folds_cluster_gauges_and_gate_off():
+    import deepspeed_tpu as dstpu
+    from tests.simple_model import SimpleModel, random_batch, base_config
+
+    cfg = base_config()
+    cfg["steps_per_print"] = 2
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel())
+    assert engine._cluster is not None          # default ON
+    # the registry is process-wide: earlier tests' engines may have
+    # folded fences already — assert the delta, not the absolute
+    base = engine.telemetry.snapshot("cluster/")["counters"].get(
+        "cluster/fences", 0)
+    batch = random_batch()
+    for _ in range(6):
+        engine.train_batch(batch)
+    snap = engine.telemetry.snapshot("cluster/")
+    assert snap["counters"]["cluster/fences"] == base + 3
+    g = snap["gauges"]
+    assert g["cluster/world_size"] == 1
+    # single-process: the fenced window mean is the packed step time
+    assert g["cluster/step_time_s/max"] == pytest.approx(
+        engine._tel_last_step_s)
+    assert g["cluster/loss/max"] > 0
+    # the skew table mirrors the fold
+    assert engine._cluster.last_table["world"] == 1
+    assert engine._tel_last_fence_ts is not None
+    # host-arrival component measured alongside the fenced window
+    h = engine.telemetry.snapshot()["histograms"]["train/host_step_s"]
+    assert h["count"] >= 1
+
+    # gate off: no aggregator, no cluster gauges from THIS engine
+    cfg2 = base_config()
+    cfg2["steps_per_print"] = 2
+    cfg2["monitor"] = {"enabled": False, "cluster": {"enabled": False}}
+    engine2, _, _, _ = dstpu.initialize(config=cfg2, model=SimpleModel())
+    assert engine2._cluster is None
+
+
+def test_serve_port_config_validation():
+    from deepspeed_tpu.config.config import (DeepSpeedConfigError,
+                                             MonitorConfig)
+    ok = MonitorConfig({"monitor": {"serve_port": 9100,
+                                    "cluster": {"enabled": False}}})
+    assert ok.serve_port == 9100 and not ok.cluster.enabled
+    assert MonitorConfig({}).serve_port == 0
+    assert MonitorConfig({}).cluster.enabled
+    with pytest.raises(DeepSpeedConfigError):
+        MonitorConfig({"monitor": {"serve_port": 123456}})
+    from deepspeed_tpu.config.config import WatchdogConfig
+    with pytest.raises(DeepSpeedConfigError):
+        WatchdogConfig({"watchdog": {"dump_dir": "/tmp/x",
+                                     "straggler_factor": 1.0}})
+    with pytest.raises(DeepSpeedConfigError):
+        WatchdogConfig({"watchdog": {"dump_dir": "/tmp/x",
+                                     "straggler_fences": 0}})
+
+
+# ------------------------------------------------------ live endpoint
+
+def _get(port, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10)
+
+
+def test_metrics_server_serves_prometheus_and_healthz(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("train/steps").inc(3)
+    agg = ClusterAggregator(registry=reg, recorder=FlightRecorder(64))
+    agg.exchange({"step_time_s": 0.1, "loss": 2.0}, step=4)
+    wd = Watchdog(str(tmp_path), recorder=FlightRecorder(64),
+                  registry=reg, straggler_fences=1, min_samples=1)
+    wd.observe_rank_step_times([0.1, 5.0], step=4)   # one trip on file
+    srv = MetricsServer(0, registry=reg, watchdog=wd,
+                        fence_age_fn=lambda: agg.last_fence_ts).start()
+    try:
+        r = _get(srv.port, "/metrics")
+        assert r.headers["Content-Type"].startswith("text/plain")
+        body = r.read().decode()
+        assert "# TYPE train_steps counter" in body
+        assert "cluster_step_time_s_max" in body
+        assert "watchdog_trips_rank_straggler 1" in body
+        h = json.loads(_get(srv.port, "/healthz").read())
+        assert h["ok"] is True
+        assert h["watchdog_trips"] == 1
+        assert h["watchdog"]["trips"]["rank_straggler"] == 1
+        assert 0 <= h["last_fence_age_s"] < 60
+        with pytest.raises(urllib.error.HTTPError):
+            _get(srv.port, "/nope")
+    finally:
+        srv.stop()
+
+
+def test_start_metrics_server_degrades_on_bind_conflict():
+    reg = MetricsRegistry()
+    first = start_metrics_server(0, registry=reg)
+    assert first is not None
+    try:
+        second = start_metrics_server(first.port, registry=reg)
+        assert second is None          # warns, returns None, run lives
+    finally:
+        first.stop()
+
+
+def test_trace_outcome_recognizes_terminal_drop():
+    """A request the pool dropped after max_retries is TERMINAL — the
+    viewer must not report it as 'open' (it is the trace an operator
+    hunts for)."""
+    from deepspeed_tpu.telemetry import view
+    evs = [{"kind": "admit", "trace": "t", "rid": 1},
+           {"kind": "serving_requeue", "trace": "t", "rid": 1,
+            "outcome": "dropped", "attempts": 4}]
+    assert view._trace_outcome(evs) == "lost (dropped after 4 attempts)"
+    assert view._trace_outcome(evs[:1]) == "open"
+
+
+def test_registry_peek_apis_never_create_metrics():
+    reg = MetricsRegistry()
+    assert reg.peek_gauge("x/y") is None
+    assert reg.peek_histogram_last("x/y") is None
+    assert reg.peek_histogram_values("x/y") == []
+    assert reg.snapshot() == {"counters": {}, "gauges": {},
+                              "histograms": {}}
+    reg.histogram("x/y").observe(1.5)
+    assert reg.peek_histogram_last("x/y") == 1.5
+    assert reg.peek_histogram_values("x/y") == [1.5]
+
+
+def test_cluster_metric_names_cover_the_fold():
+    names = set(cluster_metric_names())
+    assert "cluster/step_time_s/argmax_rank" in names
+    assert "cluster/world_size" in names and "cluster/fences" in names
+    assert len(names) == len(CLUSTER_METRICS) * 5 + 2

@@ -1,0 +1,253 @@
+"""Observability fast gate (ISSUE 12 satellites, wired into
+ci/telemetry_gate.sh):
+
+- metric-name drift guard: every metric name documented in
+  docs/observability.md's tables must still be emitted by the code,
+  and every ``cluster/*`` name the code can emit must be documented —
+  the docs stop rotting per PR;
+- prometheus_text grammar round-trip: the exposition page (HELP/TYPE
+  lines, escaped label values, histogram quantile gauges, the new
+  cluster gauges) must parse under the openmetrics line grammar a real
+  scraper applies;
+- viewer import guard: ``import deepspeed_tpu.telemetry.view`` must
+  succeed with jax IMPORT-POISONED — the viewer is documented as
+  stdlib-only ("runs anywhere the dump landed") and the lazy package
+  root (PEP 562) is what keeps that true; this test enforces it.
+
+Everything here is fast and accelerator-free.
+"""
+
+import os
+import pathlib
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+DOCS = REPO / "docs" / "observability.md"
+PKG = REPO / "deepspeed_tpu"
+
+# metric-name shape: subsystem/metric[/...], possibly with one-or-more
+# {a,b,c} alternation groups (the docs' compact row form)
+_NAME_RE = re.compile(r"^[a-z0-9_]+(/[a-z0-9_{},]+)+$")
+
+
+def _expand(name):
+    """`a/{b,c}/d` -> [`a/b/d`, `a/c/d`] (repeatedly)."""
+    m = re.search(r"\{([^{}]*)\}", name)
+    if not m:
+        return [name]
+    out = []
+    for alt in m.group(1).split(","):
+        out.extend(_expand(name[:m.start()] + alt + name[m.end():]))
+    return out
+
+
+def documented_metric_names():
+    """Backticked metric names from the first cell of every markdown
+    table row in docs/observability.md, alternations expanded."""
+    names = set()
+    for line in DOCS.read_text().splitlines():
+        if not line.startswith("| `"):
+            continue
+        first_cell = line.split("|")[1]
+        for tok in re.findall(r"`([^`]+)`", first_cell):
+            if _NAME_RE.match(tok):
+                names.update(_expand(tok))
+    assert names, "no metric tables found — did observability.md move?"
+    return names
+
+
+def _package_source():
+    return "\n".join(p.read_text() for p in sorted(PKG.rglob("*.py")))
+
+
+def test_documented_metric_names_are_emitted():
+    """Every documented name must appear in the package source — either
+    as the full literal, or (for the f-string-built families like
+    ``span/<tag>`` and ``memory/<key>``) as the literal tail after the
+    subsystem prefix. A doc row whose metric was renamed in code fails
+    here instead of rotting."""
+    src = _package_source()
+    missing = []
+    for name in sorted(documented_metric_names()):
+        if name.startswith("cluster/"):
+            continue   # pinned exactly (both directions) by the
+            #            programmatic test below — they are f-string
+            #            built, so no literal to find here
+        tail = name.split("/", 1)[1]
+        if name in src or tail in src:
+            continue
+        missing.append(name)
+    assert not missing, (
+        "documented in docs/observability.md but not found in the "
+        "code (renamed? removed?): " + ", ".join(missing))
+
+
+def test_cluster_metric_names_documented_both_directions():
+    """The ``cluster/*`` namespace is pinned EXACTLY: emitted ⊆
+    documented (an undocumented new gauge fails) and documented ⊆
+    emitted (a doc row for a dropped gauge fails). cluster.py is
+    importable jax-free, so this runs without an accelerator."""
+    from deepspeed_tpu.telemetry.cluster import cluster_metric_names
+    emitted = set(cluster_metric_names())
+    documented = {n for n in documented_metric_names()
+                  if n.startswith("cluster/")}
+    assert emitted - documented == set(), (
+        "emitted but undocumented cluster/* names — add them to the "
+        "docs/observability.md cluster table: "
+        + ", ".join(sorted(emitted - documented)))
+    assert documented - emitted == set(), (
+        "documented but no longer emitted cluster/* names: "
+        + ", ".join(sorted(documented - emitted)))
+
+
+# ------------------------------------------------------- prometheus page
+
+# the exposition-format line grammar a real scraper applies
+# (https://prometheus.io/docs/instrumenting/exposition_formats/):
+_PROM_METRIC_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_PROM_LABELS = r'\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*"' \
+               r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*")*\}'
+_PROM_VALUE = r"[-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|" \
+              r"[Nn]a[Nn]|[+-]?[Ii]nf)"
+SAMPLE_LINE = re.compile(
+    rf"^({_PROM_METRIC_NAME})(?:{_PROM_LABELS})? ({_PROM_VALUE})"
+    rf"(?: [0-9]+)?$")
+HELP_LINE = re.compile(rf"^# HELP ({_PROM_METRIC_NAME}) .*$")
+TYPE_LINE = re.compile(
+    rf"^# TYPE ({_PROM_METRIC_NAME}) "
+    rf"(counter|gauge|summary|histogram|untyped)$")
+
+
+def test_prometheus_text_roundtrips_the_openmetrics_grammar():
+    from deepspeed_tpu.telemetry.registry import (MetricsRegistry,
+                                                  prometheus_text)
+    from deepspeed_tpu.telemetry.cluster import (ClusterAggregator,
+                                                 cluster_metric_names)
+    reg = MetricsRegistry()
+    reg.counter("train/steps").inc(7)
+    reg.gauge("serving/page_pool_occupancy").set(0.25)
+    # histogram -> summary family with quantile label gauges
+    h = reg.histogram("serving/ttft_s")
+    for v in (0.1, 0.2, 0.4, 1.5):
+        h.observe(v)
+    # a name needing mangling + a digit-leading name
+    reg.gauge("weird-metric.name/with spaces").set(1.0)
+    reg.counter("0starts_with_digit/x").inc()
+    # the new cluster gauges via a real fold (world of 3, one NaN rank)
+    agg = ClusterAggregator(registry=reg)
+    agg.world = 3
+    agg.rank = 0
+    import numpy as np
+    mat = np.asarray(
+        [[0.1, 0.0, 0.0, 2.0, 100.0, 1.0, 0.5],
+         [0.3, 0.0, 0.0, 2.1, 110.0, 1.0, 0.5],
+         [np.nan, np.nan, np.nan, np.nan, np.nan, np.nan, np.nan]],
+        np.float32)
+    agg._fold(mat, step=4)
+
+    text = prometheus_text(reg)
+    families = {}
+    last_help = None
+    for line in text.strip().splitlines():
+        m = HELP_LINE.match(line)
+        if m:
+            last_help = m.group(1)
+            continue
+        m = TYPE_LINE.match(line)
+        if m:
+            # HELP must immediately precede TYPE for the same family
+            assert m.group(1) == last_help, line
+            families[m.group(1)] = m.group(2)
+            continue
+        m = SAMPLE_LINE.match(line)
+        assert m, f"line fails the exposition grammar: {line!r}"
+        base = re.sub(r"_(sum|count)$", "", m.group(1)) \
+            if m.group(1).endswith(("_sum", "_count")) else m.group(1)
+        assert base in families or m.group(1) in families, (
+            f"sample before its # TYPE header: {line!r}")
+    # quantile-labeled summary lines present and parseable
+    assert 'serving_ttft_s{quantile="0.5"}' in text
+    assert families["serving_ttft_s"] == "summary"
+    # cluster gauges made it onto the page, mangled names intact
+    assert "cluster_step_time_s_max" in families
+    n_cluster = sum(1 for f in families if f.startswith("cluster_"))
+    assert n_cluster >= len(cluster_metric_names()) - 1  # fences is a
+    #         counter emitted by exchange(), not _fold — tolerate ±1
+
+
+def test_prometheus_label_escaping_survives_a_scraper_regex():
+    from deepspeed_tpu.telemetry.registry import (_prom_escape_label,
+                                                  _prom_escape_help)
+    nasty = 'a"b\\c\nd'
+    esc = _prom_escape_label(nasty)
+    line = f'metric{{rule="{esc}"}} 1.0'
+    assert SAMPLE_LINE.match(line), line
+    assert "\n" not in esc
+    help_line = f"# HELP metric {_prom_escape_help(nasty)}"
+    assert HELP_LINE.match(help_line), help_line
+
+
+# ------------------------------------------------------ viewer jax-free
+
+def test_viewer_import_chain_is_stdlib_only(tmp_path):
+    """ISSUE 12 satellite: the dump viewer's documented stdlib-only
+    contract, ENFORCED — `import deepspeed_tpu.telemetry.view` in a
+    fresh interpreter with BOTH jax and numpy import-poisoned via
+    stubs first on sys.path ("runs anywhere the dump landed" includes
+    machines with neither). The package root AND telemetry/__init__
+    resolve their public surfaces lazily (PEP 562) precisely so this
+    passes; an eager jax/numpy import anywhere in the chain fails
+    here. telemetry.serve (stdlib http.server) must ride along;
+    telemetry.cluster legitimately needs numpy and is exempt."""
+    for name in ("jax", "numpy"):
+        (tmp_path / f"{name}.py").write_text(
+            f"raise ImportError('poisoned: the viewer must not import "
+            f"{name}')\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{tmp_path}{os.pathsep}{REPO}" \
+        + (os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import deepspeed_tpu.telemetry.view as v; "
+         "import deepspeed_tpu.telemetry.serve; "
+         "print('STDLIB_OK', callable(v.render))"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (
+        f"viewer import chain pulled jax/numpy (or crashed):\n{r.stderr}")
+    assert "STDLIB_OK True" in r.stdout
+
+
+def test_viewer_render_accepts_a_single_pathlike(tmp_path):
+    """The pre-ISSUE-12 render(path) signature keeps working for str
+    AND PathLike single arguments next to the new list form."""
+    import pathlib
+
+    from deepspeed_tpu.telemetry import view
+    p = tmp_path / "d.jsonl"
+    p.write_text('{"kind": "loss", "step": 1, "loss": 2.0, "ts": 1.0, '
+                 '"seq": 1}\n')
+    for arg in (str(p), pathlib.Path(p), [str(p)]):
+        out = "\n".join(view.render(arg))
+        assert "per-step phase attribution" in out
+
+
+def test_lazy_package_root_still_resolves_the_public_surface():
+    """The PEP 562 root must behave exactly like the old eager imports
+    for real users: attribute access resolves and caches."""
+    import deepspeed_tpu as dstpu
+    assert callable(dstpu.initialize)
+    assert callable(dstpu.add_config_arguments)
+    assert dstpu.DeepSpeedConfig is not None
+    assert dstpu.MeshConfig is not None
+    assert dstpu.zero is not None          # deepspeed.zero parity alias
+    # subpackage attributes the eager root implicitly bound must stay
+    # reachable (`d.parallel.mesh.make_mesh` was valid user code)
+    assert dstpu.parallel.mesh.make_mesh is not None
+    assert dstpu.config.config.DeepSpeedConfig is dstpu.DeepSpeedConfig
+    assert "DeepSpeedEngine" in dir(dstpu)
+    with pytest.raises(AttributeError):
+        dstpu.no_such_symbol_anywhere

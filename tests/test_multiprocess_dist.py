@@ -411,3 +411,108 @@ def test_hierarchical_compressed_allreduce_two_processes(tmp_path):
         # a short horizon — error feedback bounds the drift)
         assert abs(l_onebit - l_exact) \
             < 0.5 * max(abs(l_exact), 0.1) + 0.3, (l_onebit, l_exact)
+
+
+_STRAGGLER_WORKER = textwrap.dedent("""
+    import json, os, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from deepspeed_tpu.utils.distributed import init_distributed
+    init_distributed()
+
+    import numpy as np
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+    from tests.simple_model import SimpleModel, random_batch, base_config
+
+    dump_dir = sys.argv[1]
+    assert jax.process_count() == 2
+    mesh = make_mesh(MeshConfig(data=8))
+    cfg = base_config()
+    cfg["steps_per_print"] = 1      # every step is a cluster fence
+    cfg["monitor"] = {
+        "enabled": False,
+        # the local step-time rule must stay quiet (the injected sleep
+        # is a CLUSTER skew, not a local outlier) — only the straggler
+        # rule may dump
+        "watchdog": {"dump_dir": dump_dir, "step_time_factor": 1000.0,
+                     "swap_stall_factor": 1000.0, "check_nan": False,
+                     "straggler_factor": 2.0, "straggler_fences": 3,
+                     "straggler_min_s": 0.05},
+        "cluster": {"enabled": True},
+    }
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                       mesh=mesh)
+    batch = random_batch()
+    rank = jax.process_index()
+    for _ in range(10):
+        engine.train_batch(batch)
+        if rank == 1:
+            time.sleep(0.25)        # the injected per-step straggle
+    snap = engine.telemetry.snapshot("cluster/")
+    wd = engine.watchdog
+    dumps = sorted(os.listdir(dump_dir)) if os.path.isdir(dump_dir) \
+        else []
+    print("STRAGGLER", rank, json.dumps({
+        "gauges": snap["gauges"],
+        "fences": snap["counters"].get("cluster/fences", 0),
+        "agg_fences": engine._cluster.fences,
+        "trips": dict(wd.trips),
+        "dumps": dumps,
+        "table": engine._cluster.last_table,
+    }), flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_rank_straggler_two_processes(tmp_path):
+    """The ISSUE 12 proof leg: 2 real processes x 4 devices, rank 1
+    gets an injected 0.25 s per-step sleep. Rank 0's cluster fold must
+    (a) show cluster/step_time_s/max tracking the slow rank while the
+    min tracks the fast one (the per-rank HOST-arrival component — the
+    fenced wall time converges to the slowest rank in synchronous SPMD
+    and proves nothing), and (b) produce EXACTLY ONE latched
+    rank_straggler dump naming rank 1, via the gloo allgather riding
+    the existing steps_per_print fence."""
+    import json as _json
+    import re
+    dump_dir = tmp_path / "flight"
+    outs = spawn_workers(2, _STRAGGLER_WORKER, tmp_path,
+                         script_args=(dump_dir,), local_devices=4,
+                         timeout=300)
+    results = {}
+    for out in outs:
+        m = re.search(r"STRAGGLER (\d+) (\{.*\})", out)
+        assert m, out
+        results[int(m.group(1))] = _json.loads(m.group(2))
+
+    r0 = results[0]
+    # BOTH ranks took part in every exchange (the collective is
+    # aligned), but the fold — gauges, skew table, counter, rule —
+    # runs on rank 0 only
+    assert r0["agg_fences"] >= 8 and results[1]["agg_fences"] >= 8
+    assert r0["fences"] >= 8
+    assert results[1]["fences"] == 0
+    assert "cluster/step_time_s/max" not in results[1]["gauges"]
+
+    g = r0["gauges"]
+    assert g["cluster/world_size"] == 2
+    # max ~ the injected 0.25 s sleep, min ~ rank 0's dispatch time
+    assert g["cluster/step_time_s/argmax_rank"] == 1
+    assert g["cluster/step_time_s/max"] >= 0.2, g
+    assert g["cluster/step_time_s/min"] < 0.1, g
+    assert g["cluster/step_time_s/max"] > 3 * g["cluster/step_time_s/min"]
+    per_rank = r0["table"]["metrics"]["step_time_s"]
+    assert per_rank[1] > 3 * per_rank[0], per_rank
+
+    # exactly ONE latched rank_straggler dump, on rank 0, naming rank 1
+    assert r0["trips"].get("rank_straggler") == 1, r0["trips"]
+    assert results[1]["trips"] == {}, results[1]["trips"]
+    straggler_dumps = [d for d in r0["dumps"] if "rank_straggler" in d]
+    assert len(straggler_dumps) == 1, r0["dumps"]
+    assert [d for d in r0["dumps"] if "rank_straggler" not in d] == []
+    header = _json.loads(
+        open(dump_dir / straggler_dumps[0]).readline())
+    assert header["rule"] == "rank_straggler"
+    assert header["detail"]["rank"] == 1
+    assert header["detail"]["consecutive_fences"] == 3

@@ -593,3 +593,144 @@ def test_build_engine_wires_elastic_from_config(gpt2_el, tmp_path):
         assert not eng.preempted
     finally:
         eng.elastic.close()
+
+
+# ----------------------------------------- request tracing (ISSUE 12)
+
+
+def test_trace_id_stitches_kill_restore_across_replica_dumps(
+        gpt2_el, tmp_path):
+    """The ISSUE 12 tracing proof: requests born on one replica keep
+    their submit-time trace_id through kill -> snapshot-restore/requeue
+    -> finish on a survivor, and telemetry/view.py stitches the single
+    per-trace timeline out of TWO dump files (one taken at the kill,
+    one at the end — overlapping ring contents, deduplicated) with
+    zero orphaned events: every submitted trace appears, every
+    timeline closes with a finish."""
+    from deepspeed_tpu.telemetry import view
+
+    _cfg, _params, make = gpt2_el
+    reqs = _reqs(6, max_new=12, seed=12)
+    ref = _ref_streams(make, reqs, slots=2)
+    # the reference engine's own lifecycle events (with their own
+    # trace ids) must not leak into the dumps under test
+    default_recorder().clear()
+    pool = ReplicaPool(_pool_factory(make, tmp_path, interval_ticks=1),
+                       n_replicas=2, min_replicas=1, max_replicas=2,
+                       scale_signal="none")
+    wd = Watchdog(str(tmp_path / "trace_dumps"), source="pool")
+    try:
+        work = _clone(reqs)
+        for r in work:
+            pool.submit(r)
+        # every request got a trace id AT SUBMIT, frozen in the ledger
+        traces = {r.rid: r.trace_id for r in work}
+        assert all(traces.values())
+        assert len(set(traces.values())) == len(work)
+        for rid, doc in pool._ledger.items():
+            assert doc["trace_id"] == traces[rid]
+
+        for _ in range(3):
+            pool.step()
+        victim = next(iter(pool.replicas))
+        victims = {rid for rid, rep in pool._assign.items()
+                   if rep == victim and rid not in pool.done}
+        assert victims, "victim replica should hold requests"
+        pool.kill_replica(victim, reason="trace_test")
+        dump_a = wd.force_dump("mid_run")      # the at-the-kill dump
+
+        rounds = 0
+        while pool.pending and rounds < 800:
+            pool.step()
+            rounds += 1
+        dump_b = wd.force_dump("end_of_run")   # the end-of-run dump
+        done = pool.done
+        assert len(done) == len(reqs) and not pool.lost
+
+        # identity survived the handoff; streams are token-lossless
+        for rid, r in done.items():
+            assert r.trace_id == traces[rid], rid
+            assert r.tokens().tolist() == ref[rid], rid
+
+        # the viewer stitches the two dumps into per-trace timelines
+        headers, events, _ = view.load_dumps([dump_a, dump_b])
+        assert len(headers) == 2
+        seqs = [e["seq"] for e in events if "seq" in e]
+        assert len(seqs) == len(set(seqs)), "overlap not deduplicated"
+        timelines = view.trace_timelines(events)
+        # zero orphaned events: every stitched trace is one we
+        # submitted, every submitted trace shows up and closes
+        assert set(timelines) == set(traces.values())
+        for rid, tid in traces.items():
+            evs = timelines[tid]
+            assert view._trace_outcome(evs).startswith("finished"), rid
+            assert all(ev.get("trace") == tid or
+                       tid in (ev.get("traces") or ()) for ev in evs)
+        # at least one victim crossed replicas (direct restore lands
+        # its finish on the survivor; requeues re-admit there)
+        crossed = [rid for rid in victims
+                   if len({ev["replica"] for ev in timelines[traces[rid]]
+                           if ev.get("replica") is not None}) > 1]
+        assert crossed, "no victim trace shows two replicas"
+        text = "\n".join(view.render([dump_a, dump_b]))
+        assert "request traces" in text
+        assert f"trace {traces[crossed[0]]}" in text
+    finally:
+        pool.close()
+
+
+def test_restored_and_replayed_requests_keep_their_trace_id(
+        gpt2_el, tmp_path):
+    """Unit-level pin of the persistence contract: capture -> restore
+    rebuilds direct slots with the original trace_id, and the replay
+    path (resume_request) carries it through the requeue prompt."""
+    _cfg, _params, make = gpt2_el
+    from deepspeed_tpu.runtime.elastic.snapshot import AsyncSnapshotter
+    src = make(slots=2)
+    # budget large enough that nothing finishes before the snapshot
+    # (a finished request rightly never lands in one)
+    reqs = _reqs(3, max_new=40, seed=13)
+    for r in reqs:
+        src.submit(r)
+    src.step()
+    snap = AsyncSnapshotter(str(tmp_path / "snap"), fsync=False)
+    path = elastic.snapshot_serving(src, snap, "t0")
+    host, kv = elastic.load_serving_snapshot(path)
+    for doc in host["slots"] + host["queued"]:
+        assert doc["trace_id"] is not None
+    dst = make(slots=1)                 # forces the requeue path too
+    res = elastic.restore_serving(dst, host, kv)
+    by_rid = {r.rid: r for r in res["restored"] + res["requeued"]}
+    for r in reqs:
+        assert by_rid[r.rid].trace_id == r.trace_id, r.rid
+    # a fresh doc with no trace stays None-safe
+    doc = dict(elastic._req_doc(reqs[0]), trace_id=None)
+    assert elastic.resume_request(doc).trace_id is None
+
+
+def test_pool_metrics_snapshot_aggregates_replicas(gpt2_el, tmp_path):
+    """ReplicaPool.metrics_snapshot(): pool TTFT percentiles over the
+    replicas' merged raw reservoirs, per-replica utilization rows, and
+    the lost/retried/recovered counters (what the serving bench embeds
+    as pool_telemetry)."""
+    _cfg, _params, make = gpt2_el
+    pool = ReplicaPool(_pool_factory(make, tmp_path, interval_ticks=0),
+                       n_replicas=2, min_replicas=1, max_replicas=2,
+                       scale_signal="none")
+    try:
+        reqs = _reqs(6, max_new=6, seed=14)
+        done = _run_pool(pool, _clone(reqs))
+        assert len(done) == len(reqs)
+        snap = pool.metrics_snapshot()
+        assert snap["replicas"] == 2
+        assert set(snap["per_replica"]) == set(pool.replicas)
+        for row in snap["per_replica"].values():
+            assert 0.0 <= row["slot_utilization"] <= 1.0
+        # merged reservoirs: every admission's TTFT observation counted
+        assert snap["pool_ttft_s"]["count"] == len(reqs)
+        assert snap["pool_ttft_s"]["p99"] >= snap["pool_ttft_s"]["p50"]
+        assert snap["done"] == len(reqs)
+        assert snap["lost"] == 0 and snap["retried"] == 0
+        assert snap["slot_utilization"] == 0.0   # drained pool
+    finally:
+        pool.close()
