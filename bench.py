@@ -70,7 +70,8 @@ class SectionRunner:
 BENCH_SECTIONS = ("bert", "train", "sparse", "decode", "llama7b", "moe",
                   "zero3_prefetch", "onebit_comm", "aio", "nvme_param",
                   "elastic_ckpt", "serving", "serving_prefix",
-                  "serving_spec", "serving_elastic", "infinity6b", "xl")
+                  "serving_spec", "serving_elastic", "serving_disagg",
+                  "infinity6b", "xl")
 
 
 # ---------------------------------------------------------------------------
@@ -182,6 +183,11 @@ def headline_metrics(doc):
                 # one-model-call-per-token decode loop at b1
                 grab("serving.spec_decode_speedup", entry,
                      "spec_decode_speedup", +1)
+            elif name == "serving_disagg":
+                # ISSUE 14: the role split must keep beating colocated
+                # head-of-line TTFT on the deterministic mixed trace
+                grab("serving.disagg_ttft_p99", entry,
+                     "ttft_p99_s_disagg", -1)
             elif name == "serving_elastic":
                 # ISSUE 11: one replica kill + one graceful drain must
                 # keep recovering EVERY request (greedy replay makes
@@ -476,6 +482,11 @@ def main(argv=None):
     # recovery and watchdog-driven autoscale under burst overload
     decode["serving_elastic"] = runner.run(
         "serving_elastic", bench_serving_elastic, est_s=420)
+    jax.clear_caches()
+    # ISSUE 14: disaggregated prefill/decode + SLO router vs the
+    # colocated engine on the identical deterministic mixed trace
+    decode["serving_disagg"] = runner.run(
+        "serving_disagg", bench_serving_disagg, est_s=420)
     jax.clear_caches()
     moe = runner.run(
         "moe", lambda: bench_moe(dstpu, make_mesh, MeshConfig, dev),
@@ -915,6 +926,18 @@ def bench_serving_elastic():
     watchdog-trip autoscaler on vs off."""
     from tests.perf.serving_bench import run_serving_elastic_bench
     return run_serving_elastic_bench()
+
+
+def bench_serving_disagg():
+    """Disaggregated prefill/decode serving (ISSUE 14): the BENCH_r08
+    mixed-traffic trace served colocated vs through the DisaggRouter
+    (prefill-role + decode-role engines, in-process page-handoff
+    transport). Headline gate: ``ttft_p99_s_disagg`` (lower is better
+    — prompt admission decoupled from decode slot residency); the
+    colocated leg, the attribution breakdown, token parity and the
+    page-pool leak fence ride the detail."""
+    from tests.perf.serving_bench import run_disagg_bench
+    return run_disagg_bench()
 
 
 def bench_sparse_attention(jnp):

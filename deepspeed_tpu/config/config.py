@@ -864,14 +864,159 @@ class ServingAutoscaleConfig:
                 f"scale_signal={self.scale_signal!r})")
 
 
+class ServingDisaggregationConfig:
+    """``serving.disaggregation`` sub-block (ISSUE 14): the
+    prefill/decode role split. Presence enables; ``decode_replicas: 0``
+    (or ``enabled: false``) is the colocated fallback — the router
+    degrades to an SLO dispatcher over ``prefill_replicas`` colocated
+    engines with no handoff."""
+
+    def __init__(self, d):
+        if d is not None and not isinstance(d, dict):
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_DISAGG} must be a dict with keys "
+                f"[{C.SERVING_DISAGG_ENABLED}, "
+                f"{C.SERVING_DISAGG_PREFILL_REPLICAS}, "
+                f"{C.SERVING_DISAGG_DECODE_REPLICAS}, "
+                f"{C.SERVING_DISAGG_DEDUPE_PAGES}, "
+                f"{C.SERVING_DISAGG_TRANSPORT}], got {d!r}")
+        self.enabled = d is not None and bool(
+            d.get(C.SERVING_DISAGG_ENABLED,
+                  C.SERVING_DISAGG_ENABLED_DEFAULT))
+        d = d or {}
+
+        def _int(key, default, floor, what):
+            try:
+                v = int(d.get(key, default))
+            except (TypeError, ValueError):
+                raise DeepSpeedConfigError(
+                    f"serving.disaggregation.{key} must be an integer, "
+                    f"got {d.get(key)!r}")
+            if v < floor:
+                raise DeepSpeedConfigError(
+                    f"serving.disaggregation.{key} must be {what}, "
+                    f"got {v}")
+            return v
+
+        self.prefill_replicas = _int(
+            C.SERVING_DISAGG_PREFILL_REPLICAS,
+            C.SERVING_DISAGG_PREFILL_REPLICAS_DEFAULT, 1, ">= 1")
+        self.decode_replicas = _int(
+            C.SERVING_DISAGG_DECODE_REPLICAS,
+            C.SERVING_DISAGG_DECODE_REPLICAS_DEFAULT, 0,
+            ">= 0 (0 = colocated fallback)")
+        self.dedupe_pages = bool(d.get(
+            C.SERVING_DISAGG_DEDUPE_PAGES,
+            C.SERVING_DISAGG_DEDUPE_PAGES_DEFAULT))
+        self.transport = str(d.get(C.SERVING_DISAGG_TRANSPORT,
+                                   C.SERVING_DISAGG_TRANSPORT_DEFAULT))
+        if self.transport not in C.SERVING_DISAGG_TRANSPORT_MODES:
+            raise DeepSpeedConfigError(
+                f"serving.disaggregation.{C.SERVING_DISAGG_TRANSPORT} "
+                f"must be one of "
+                f"{list(C.SERVING_DISAGG_TRANSPORT_MODES)} (the "
+                f"cross-process transport is a planned drop-in), got "
+                f"{self.transport!r}")
+
+    def __repr__(self):
+        return (f"ServingDisaggregationConfig(enabled={self.enabled}, "
+                f"prefill={self.prefill_replicas}, "
+                f"decode={self.decode_replicas}, "
+                f"dedupe_pages={self.dedupe_pages}, "
+                f"transport={self.transport!r})")
+
+
+class ServingRouterConfig:
+    """``serving.router`` sub-block (ISSUE 14): policy knobs for the
+    SLO-aware multi-engine router. All knobs have live defaults — the
+    block only exists to tune them (presence alone changes nothing;
+    the router is built by ``serving.build_router`` /
+    ``serving.disaggregation``)."""
+
+    def __init__(self, d):
+        if d is not None and not isinstance(d, dict):
+            raise DeepSpeedConfigError(
+                f"serving.{C.SERVING_ROUTER} must be a dict with keys "
+                f"[{C.SERVING_ROUTER_PREFIX_ROUTING}, "
+                f"{C.SERVING_ROUTER_QUEUE_WEIGHT}, "
+                f"{C.SERVING_ROUTER_TTFT_WEIGHT}, "
+                f"{C.SERVING_ROUTER_TTFT_WINDOW}, "
+                f"{C.SERVING_ROUTER_MAX_HANDOFF_RETRIES}, "
+                f"{C.SERVING_ROUTER_DECODE_TICK_CAP}, "
+                f"{C.SERVING_ROUTER_MAX_INFLIGHT_PAGES}, "
+                f"{C.SERVING_ROUTER_DECODE_SCHEDULE}], got {d!r}")
+        d = d or {}
+
+        def _num(key, default, cast, what, floor):
+            try:
+                v = cast(d.get(key, default))
+            except (TypeError, ValueError):
+                raise DeepSpeedConfigError(
+                    f"serving.router.{key} must be {what}, got "
+                    f"{d.get(key)!r}")
+            if v < floor:
+                raise DeepSpeedConfigError(
+                    f"serving.router.{key} must be >= {floor}, got {v}")
+            return v
+
+        self.prefix_routing = bool(d.get(
+            C.SERVING_ROUTER_PREFIX_ROUTING,
+            C.SERVING_ROUTER_PREFIX_ROUTING_DEFAULT))
+        self.queue_weight = _num(
+            C.SERVING_ROUTER_QUEUE_WEIGHT,
+            C.SERVING_ROUTER_QUEUE_WEIGHT_DEFAULT, float, "a number", 0)
+        self.ttft_weight = _num(
+            C.SERVING_ROUTER_TTFT_WEIGHT,
+            C.SERVING_ROUTER_TTFT_WEIGHT_DEFAULT, float, "a number", 0)
+        self.ttft_window = _num(
+            C.SERVING_ROUTER_TTFT_WINDOW,
+            C.SERVING_ROUTER_TTFT_WINDOW_DEFAULT, int, "an integer", 1)
+        self.max_handoff_retries = _num(
+            C.SERVING_ROUTER_MAX_HANDOFF_RETRIES,
+            C.SERVING_ROUTER_MAX_HANDOFF_RETRIES_DEFAULT, int,
+            "an integer", 0)
+        self.decode_tick_cap = _num(
+            C.SERVING_ROUTER_DECODE_TICK_CAP,
+            C.SERVING_ROUTER_DECODE_TICK_CAP_DEFAULT, int,
+            "an integer", 1)
+        self.max_inflight_pages = _num(
+            C.SERVING_ROUTER_MAX_INFLIGHT_PAGES,
+            C.SERVING_ROUTER_MAX_INFLIGHT_PAGES_DEFAULT, int,
+            "an integer (0 = 2x the decode pools' allocatable total)",
+            0)
+        self.decode_schedule = str(d.get(
+            C.SERVING_ROUTER_DECODE_SCHEDULE,
+            C.SERVING_ROUTER_DECODE_SCHEDULE_DEFAULT))
+        if self.decode_schedule not in \
+                C.SERVING_ROUTER_DECODE_SCHEDULE_MODES:
+            raise DeepSpeedConfigError(
+                f"serving.router.{C.SERVING_ROUTER_DECODE_SCHEDULE} "
+                f"must be one of "
+                f"{list(C.SERVING_ROUTER_DECODE_SCHEDULE_MODES)}, got "
+                f"{self.decode_schedule!r}")
+
+    def __repr__(self):
+        return (f"ServingRouterConfig(prefix_routing="
+                f"{self.prefix_routing}, "
+                f"queue_weight={self.queue_weight}, "
+                f"ttft_weight={self.ttft_weight}, "
+                f"ttft_window={self.ttft_window}, "
+                f"max_handoff_retries={self.max_handoff_retries}, "
+                f"decode_tick_cap={self.decode_tick_cap}, "
+                f"max_inflight_pages={self.max_inflight_pages}, "
+                f"decode_schedule={self.decode_schedule!r})")
+
+
 class ServingConfig:
     """tpu-native ``serving`` block: the continuous-batching engine with
     a paged KV cache (deepspeed_tpu/serving). Presence of the block
     enables it; geometry maps 1:1 onto PagedCacheSpec. Optional
     sub-blocks: ``prefix_cache`` (COW prefix page sharing),
     ``speculative`` (drafter-based speculative decoding), ``elastic``
-    (drain-or-snapshot preemption tolerance) and ``autoscale``
-    (replica-pool bounds + scale signal)."""
+    (drain-or-snapshot preemption tolerance), ``autoscale``
+    (replica-pool bounds + scale signal), ``disaggregation`` (the
+    prefill/decode role split, ISSUE 14) and ``router`` (the SLO-aware
+    multi-engine router's policy knobs)."""
 
     def __init__(self, param_dict):
         d = param_dict.get(C.SERVING, None)
@@ -886,6 +1031,10 @@ class ServingConfig:
             d.get(C.SERVING_ELASTIC, None))
         self.autoscale = ServingAutoscaleConfig(
             d.get(C.SERVING_AUTOSCALE, None))
+        self.disaggregation = ServingDisaggregationConfig(
+            d.get(C.SERVING_DISAGG, None))
+        self.router = ServingRouterConfig(
+            d.get(C.SERVING_ROUTER, None))
         self.slots = int(d.get(C.SERVING_SLOTS, C.SERVING_SLOTS_DEFAULT))
         self.page_size = int(d.get(C.SERVING_PAGE_SIZE,
                                    C.SERVING_PAGE_SIZE_DEFAULT))

@@ -665,3 +665,42 @@ SERVING_AUTOSCALE_MAX_REPLICAS_DEFAULT = 1
 SERVING_AUTOSCALE_SCALE_SIGNAL = "scale_signal"
 SERVING_AUTOSCALE_SCALE_SIGNAL_DEFAULT = "watchdog"
 SERVING_AUTOSCALE_SCALE_SIGNAL_MODES = ("watchdog", "none")
+
+# serving.disaggregation — prefill/decode role split (ISSUE 14):
+# dedicated prefill-role engines admit + prefill, a page-handoff
+# transport moves the request, decode-role engines adopt the pages
+# and tick. decode_replicas 0 = colocated fallback (role="both").
+SERVING_DISAGG = "disaggregation"
+SERVING_DISAGG_ENABLED = "enabled"
+SERVING_DISAGG_ENABLED_DEFAULT = True          # presence enables
+SERVING_DISAGG_PREFILL_REPLICAS = "prefill_replicas"
+SERVING_DISAGG_PREFILL_REPLICAS_DEFAULT = 1
+SERVING_DISAGG_DECODE_REPLICAS = "decode_replicas"
+SERVING_DISAGG_DECODE_REPLICAS_DEFAULT = 1
+SERVING_DISAGG_DEDUPE_PAGES = "dedupe_pages"
+SERVING_DISAGG_DEDUPE_PAGES_DEFAULT = True     # prefix-index re-share
+SERVING_DISAGG_TRANSPORT = "transport"
+SERVING_DISAGG_TRANSPORT_DEFAULT = "inproc"
+SERVING_DISAGG_TRANSPORT_MODES = ("inproc",)   # cross-process later
+
+# serving.router — the SLO-aware multi-engine router over the role
+# split (ISSUE 14): prefix-locality admission, decode-page
+# reservations, live TTFT/queue-depth scoring
+SERVING_ROUTER = "router"
+SERVING_ROUTER_PREFIX_ROUTING = "prefix_routing"
+SERVING_ROUTER_PREFIX_ROUTING_DEFAULT = True
+SERVING_ROUTER_QUEUE_WEIGHT = "queue_weight"
+SERVING_ROUTER_QUEUE_WEIGHT_DEFAULT = 1.0
+SERVING_ROUTER_TTFT_WEIGHT = "ttft_weight"
+SERVING_ROUTER_TTFT_WEIGHT_DEFAULT = 1.0
+SERVING_ROUTER_TTFT_WINDOW = "ttft_window"
+SERVING_ROUTER_TTFT_WINDOW_DEFAULT = 16
+SERVING_ROUTER_MAX_HANDOFF_RETRIES = "max_handoff_retries"
+SERVING_ROUTER_MAX_HANDOFF_RETRIES_DEFAULT = 3
+SERVING_ROUTER_DECODE_TICK_CAP = "decode_tick_cap"
+SERVING_ROUTER_DECODE_TICK_CAP_DEFAULT = 4
+SERVING_ROUTER_MAX_INFLIGHT_PAGES = "max_inflight_pages"
+SERVING_ROUTER_MAX_INFLIGHT_PAGES_DEFAULT = 0   # 0 = 2x decode pools
+SERVING_ROUTER_DECODE_SCHEDULE = "decode_schedule"
+SERVING_ROUTER_DECODE_SCHEDULE_DEFAULT = "lpt"
+SERVING_ROUTER_DECODE_SCHEDULE_MODES = ("lpt", "fifo")

@@ -17,7 +17,10 @@ failure at exactly that point:
   ``serving_spec_verify`` (the verify dispatch ran, nothing committed
   — the mid-spec-verify window), ``serving_tick_end`` (the scheduler's
   step boundary, where :func:`kill_at_serving_tick` delivers a real
-  SIGTERM mid-serve).
+  SIGTERM mid-serve), and ``serving_handoff`` (ISSUE 14: the request
+  is extracted from its prefill engine but not yet delivered to a
+  decode engine — the page transport dying with the bytes in flight,
+  via :func:`crash_during_handoff`).
 
 Post-commit corruptions (a torn manifest, a rotted shard) are plain
 file edits — :func:`tear_manifest` / :func:`rot_shard` — because they
@@ -119,6 +122,27 @@ def crash_replica_mid_prefill(match_rid=None, times=1):
             f"injected crash at serving_admit (rid={rid})")
 
     return inject("serving_admit", _fn)
+
+
+def crash_during_handoff(match_rid=None, times=1):
+    """Context manager: crash at ``serving_handoff`` — the request was
+    EXTRACTED from its prefill-role engine (pages decreffed, gathered
+    bytes only in the in-flight packet) but never delivered to a
+    decode engine: the transport died with the bytes. The router must
+    replay the request from its wire doc (ISSUE 14). Same knobs as
+    :func:`crash_replica_mid_prefill`."""
+    fired = [0]
+
+    def _fn(rid=None, **_kw):
+        if match_rid is not None and rid != match_rid:
+            return
+        if times is not None and fired[0] >= times:
+            return
+        fired[0] += 1
+        raise SimulatedCrash(
+            f"injected crash at serving_handoff (rid={rid})")
+
+    return inject("serving_handoff", _fn)
 
 
 def crash_replica_mid_spec_verify(at_round=1):

@@ -175,7 +175,23 @@ def _verify_append_ids(pos, pt, K, page, maxp):
     return pt[batch, bidx], posf % page, posf
 
 
-def _pick_next(logits, r, temps):
+def _sample_keys(seeds, idxs):
+    """Per-slot stateless sampling keys: fold the request's persistent
+    ``sample_key`` and its GLOBAL token index (committed tokens before
+    this one, across restores) into one base key. The key depends only
+    on (request, position) — never on engine-global rng state — so a
+    snapshot/restore or a prefill->decode handoff replays a sampled
+    request token-for-token (ISSUE 14 satellite; the PR-11 fresh-rng
+    caveat)."""
+    base = jax.random.PRNGKey(0)
+
+    def one(s, i):
+        return jax.random.fold_in(jax.random.fold_in(base, s), i)
+
+    return jax.vmap(one)(seeds, idxs)
+
+
+def _pick_next(logits, seeds, idxs, temps):
     """Greedy/per-slot-temperature sampling; the Gumbel pass only runs
     when some slot actually asked for it (same cond-not-where rule as
     the dense decode loops)."""
@@ -184,11 +200,25 @@ def _pick_next(logits, r, temps):
 
     def _sampled():
         t = jnp.maximum(temps, 1e-6)[:, None]
-        s = jax.random.categorical(r, logits32 / t, axis=-1)
+        keys = _sample_keys(seeds, idxs)
+        s = jax.vmap(jax.random.categorical)(keys, logits32 / t)
         return jnp.where(temps > 0, s, greedy)
 
     return jax.lax.cond(jnp.max(temps) > 0.0, _sampled, lambda: greedy), \
         logits32
+
+
+def sample_token(logits32, seed, idx, temperature):
+    """One-row invocation of the tick's sampling rule — the HOST-side
+    prefill pick for sampled requests. Same fold_in key schedule and
+    categorical as `_pick_next`, so a replayed request whose next token
+    falls at prefill (admission) samples the token the uninterrupted
+    run's decode tick would have produced."""
+    tok, _ = _pick_next(
+        jnp.asarray(logits32, jnp.float32)[None, :],
+        jnp.asarray([seed], jnp.uint32), jnp.asarray([idx], jnp.int32),
+        jnp.asarray([temperature], jnp.float32))
+    return int(tok[0])   # sync-ok: the scheduler consumes the sample
 
 
 def _gather_blocks(pt, pos, page):
@@ -280,7 +310,7 @@ class GPT2ServingAdapter:
                     + b.astype(jnp.float32)).astype(x.dtype)
 
         @functools.partial(jax.jit, donate_argnums=(2,))
-        def tick(p, blk, pool, toks, pos, pt, r, temps):
+        def tick(p, blk, pool, toks, pos, pt, seeds, idxs0, temps):
             wte = jnp.asarray(p["wte"]).astype(cfg.dtype)
             wpe = jnp.asarray(p["wpe"]).astype(cfg.dtype)
             Wq, Wp = blk["attn_qkvw"][wkey], blk["attn_ow"][wkey]
@@ -298,7 +328,7 @@ class GPT2ServingAdapter:
             s1, s2 = _wscale(blk["inter_w"]), _wscale(blk["output_w"])
             B = toks.shape[0]
 
-            def one(carry, rk):
+            def one(carry, t):
                 pool, toks, pos, _ = carry
                 x = wte[toks] + wpe[jnp.clip(pos, 0,
                                              cfg.n_positions - 1)]
@@ -334,13 +364,14 @@ class GPT2ServingAdapter:
                 logits = jnp.einsum(
                     "be,ve->bv",
                     _ln_f(x, p["ln_f"]["scale"], p["ln_f"]["bias"]), wte)
-                nxt, logits32 = _pick_next(logits, rk, temps)
+                nxt, logits32 = _pick_next(logits, seeds, idxs0 + t,
+                                           temps)
                 return (pool, nxt, pos + 1, logits32), nxt
 
             logits0 = jnp.zeros((B, cfg.vocab_size), jnp.float32)
             (pool, _, _, logits32), toks_seq = jax.lax.scan(
                 one, (pool, toks, pos, logits0),
-                jax.random.split(r, steps))
+                jnp.arange(steps, dtype=jnp.int32))
             return pool, toks_seq, logits32
 
         self._fns[key] = tick
@@ -621,11 +652,14 @@ class GPT2ServingAdapter:
 
     # -- engine-facing calls -----------------------------------------------
 
-    def tick(self, pool, toks, pos, pt, rng, temps, steps=1):
-        """Run ``steps`` decode steps in ONE dispatch. Returns
-        (pool, tokens [steps, B], last-step logits [B, V])."""
+    def tick(self, pool, toks, pos, pt, seeds, idxs, temps, steps=1):
+        """Run ``steps`` decode steps in ONE dispatch. ``seeds``/
+        ``idxs`` [B] drive the per-slot stateless sampling keys (global
+        token index of each slot's NEXT token — greedy slots pass
+        zeros). Returns (pool, tokens [steps, B], last-step logits
+        [B, V])."""
         return self._tick_fn(steps)(self._p, self._blk, pool, toks, pos,
-                                    pt, rng, temps)
+                                    pt, seeds, idxs, temps)
 
     def prefill(self, pool, ids, length, pages):
         return self._prefill_fn(ids.shape[1] // self.spec.page_size)(
@@ -729,7 +763,7 @@ class LlamaServingAdapter:
             return (n * w.astype(jnp.float32)).astype(x.dtype)
 
         @functools.partial(jax.jit, donate_argnums=(2,))
-        def tick(p, blk, pool, toks, pos, pt, r, temps):
+        def tick(p, blk, pool, toks, pos, pt, seeds, idxs0, temps):
             embed = p["embed"].astype(cfg.dtype)
             head = p["head"].astype(cfg.dtype)
             Wq, sq = _weights(blk, "qkv_w", Lyr)
@@ -741,7 +775,7 @@ class LlamaServingAdapter:
             n2 = blk["norm2"].reshape(Lyr, 1, E)
             B = toks.shape[0]
 
-            def one(carry, rk):
+            def one(carry, t):
                 pool, toks, pos, _ = carry
                 x = embed[toks]
                 blk_ids, rows = _gather_blocks(pt, pos, P)
@@ -787,13 +821,14 @@ class LlamaServingAdapter:
                     layer, (x, pool), jnp.arange(Lyr, dtype=jnp.int32))
                 logits = jnp.einsum("be,ve->bv",
                                     _rms(x, p["norm_scale"]), head)
-                nxt, logits32 = _pick_next(logits, rk, temps)
+                nxt, logits32 = _pick_next(logits, seeds, idxs0 + t,
+                                           temps)
                 return (pool, nxt, pos + 1, logits32), nxt
 
             logits0 = jnp.zeros((B, cfg.vocab_size), jnp.float32)
             (pool, _, _, logits32), toks_seq = jax.lax.scan(
                 one, (pool, toks, pos, logits0),
-                jax.random.split(r, steps))
+                jnp.arange(steps, dtype=jnp.int32))
             return pool, toks_seq, logits32
 
         self._fns[key] = tick
@@ -1053,11 +1088,12 @@ class LlamaServingAdapter:
         self._fns[key] = verify
         return verify
 
-    def tick(self, pool, toks, pos, pt, rng, temps, steps=1):
-        """Run ``steps`` decode steps in ONE dispatch. Returns
+    def tick(self, pool, toks, pos, pt, seeds, idxs, temps, steps=1):
+        """Run ``steps`` decode steps in ONE dispatch (see the GPT-2
+        twin for the seeds/idxs sampling contract). Returns
         (pool, tokens [steps, B], last-step logits [B, V])."""
         return self._tick_fn(steps)(self._p, self._blk, pool, toks, pos,
-                                    pt, rng, temps)
+                                    pt, seeds, idxs, temps)
 
     def prefill(self, pool, ids, length, pages):
         return self._prefill_fn(ids.shape[1] // self.spec.page_size)(

@@ -150,13 +150,13 @@ class ModelDrafter:
     aligned = True    # KV state: commits are capped at the drafted rows
     #                   so the drafter cache never claims unwritten rows
 
-    def __init__(self, adapter, rng=None):
+    def __init__(self, adapter):
         self.adapter = adapter
         self.cache = adapter.make_cache()
         slots = adapter.spec.slots
         self.pos = np.full(slots, -1, np.int64)
         self.last = np.zeros(slots, np.int64)
-        self._rng = rng if rng is not None else jax.random.PRNGKey(17)
+        # drafting is greedy-only: no rng anywhere in this class
         self._temps = np.zeros(slots, np.float32)
 
     def admit(self, slot: int, prompt: np.ndarray, first_tok: int,
@@ -227,10 +227,14 @@ class ModelDrafter:
         import jax.numpy as jnp
         toks = np.asarray(self.last, np.int32)  # sync-ok: host ints
         pos = np.asarray(self.pos, np.int32)    # sync-ok: host ints
-        self._rng, sub = jax.random.split(self._rng)
+        B = len(self.pos)
+        # greedy drafting: the per-slot sampling seeds are never used
+        # (temps stay 0), zeros keep the compiled tick signature shared
+        # with the target engine's
         pool, toks_seq, _ = self.adapter.tick(
             self.cache.pool, jnp.asarray(toks), jnp.asarray(pos),
-            jnp.asarray(self.cache.page_table), sub,
+            jnp.asarray(self.cache.page_table),
+            jnp.zeros((B,), jnp.uint32), jnp.zeros((B,), jnp.int32),
             jnp.asarray(self._temps), steps=k)
         self.cache.pool = pool
         toks_seq = np.asarray(toks_seq)   # sync-ok: drafts feed the

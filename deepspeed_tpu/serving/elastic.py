@@ -78,6 +78,16 @@ def _req_doc(req):
         # requeue handoffs — the stitched cross-replica timeline hangs
         # off this field
         "trace_id": getattr(req, "trace_id", None),
+        # ISSUE 14 (PR-11 caveat fix): the sampling identity. With
+        # sample_key + the CUMULATIVE committed-token count persisted,
+        # a sampled (temperature > 0) request restores/replays with the
+        # same per-token fold_in keys the uninterrupted run uses — not
+        # fresh rng. committed_total counts across incarnations (a
+        # replay folds generated into the prompt; the index must not
+        # reset with it).
+        "sample_key": getattr(req, "sample_key", None),
+        "committed_total": int(getattr(req, "resumed_committed", 0) or 0)
+        + len(req.generated),
     }
 
 
@@ -223,8 +233,13 @@ def resume_request(doc):
     req = Request(doc["rid"], prompt, max_new_tokens=rem,
                   eos_token_id=doc.get("eos_token_id"),  # sync-ok: host
                   temperature=float(doc.get("temperature", 0.0)),
-                  trace_id=doc.get("trace_id"))
-    req.resumed_committed = len(doc["generated"])
+                  trace_id=doc.get("trace_id"),
+                  sample_key=doc.get("sample_key"))
+    # cumulative committed count — the sampling-index base AND the
+    # prompt/generated split marker (older docs carry only this
+    # incarnation's generated list; that is the right base for them)
+    req.resumed_committed = int(doc.get("committed_total",
+                                        len(doc["generated"])))
     return req
 
 
@@ -356,8 +371,15 @@ def restore_serving(cb, host, kv, requeue_overflow=True):
                       max_new_tokens=int(sd["max_new_tokens"]),  # host
                       eos_token_id=sd.get("eos_token_id"),  # snapshot doc
                       temperature=float(sd.get("temperature", 0.0)),
-                      trace_id=sd.get("trace_id"))
+                      trace_id=sd.get("trace_id"),
+                      sample_key=sd.get("sample_key"))
         req.generated = [int(t) for t in sd["generated"]]
+        # sampling-index base: committed_total counts THROUGH this
+        # incarnation's generated list, which the direct rebuild keeps
+        # as generated (nothing folds into the prompt)
+        req.resumed_committed = int(
+            sd.get("committed_total", len(sd["generated"]))) \
+            - len(sd["generated"])
         req._t_submit = now
         slot = cb.slots[slot_id]
         slot.request = req

@@ -28,7 +28,6 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.runtime.elastic import faults
@@ -57,6 +56,12 @@ class Request:
     # request from N dump files. Never re-stamped: a replayed or
     # restored request keeps the identity it was born with.
     trace_id: Optional[str] = None
+    # ISSUE 14: per-request sampling identity (temperature > 0 only).
+    # Stamped once at first submit and persisted through snapshot /
+    # restore / handoff docs; every sampled token's key is
+    # fold_in(sample_key, global_token_index), so replays regenerate
+    # the identical sampled stream instead of drawing fresh rng.
+    sample_key: Optional[int] = None
     # filled by the engine:
     generated: List[int] = dataclasses.field(default_factory=list)
     finish_reason: Optional[str] = None
@@ -99,14 +104,23 @@ class ContinuousBatcher:
     everything (each call runs at most one admission sweep + one tick).
     """
 
-    def __init__(self, adapter, rng: Optional[jax.Array] = None,
+    def __init__(self, adapter,
                  registry: Optional[MetricsRegistry] = None,
                  recorder=None, watchdog=None, prefix_cache: bool = False,
                  prefix_cow: bool = True, drafter=None,
-                 spec_tokens: int = 3):
+                 spec_tokens: int = 3, role: str = "both"):
         self.adapter = adapter
         self.spec = adapter.spec
         self.cache: PagedKVCache = adapter.make_cache()
+        # ISSUE 14 (disaggregation): a "prefill"-role engine admits and
+        # prefills but NEVER runs a decode program — its active slots
+        # are handoff candidates the router exports; a "decode"-role
+        # engine only receives handoffs (its queue stays empty). "both"
+        # is the colocated engine every pre-disagg config builds.
+        assert role in ("both", "prefill", "decode"), role
+        self.role = role
+        assert not (role == "prefill" and drafter is not None), \
+            "a prefill-role engine never decodes — no drafter"
         # ISSUE 9 (a): copy-on-write prefix page sharing — admission
         # consults the refcounted prefix index before allocating, and a
         # hit skips both the pages AND the prefill compute for the
@@ -125,14 +139,17 @@ class ContinuousBatcher:
         self.spec_tokens = int(spec_tokens)
         self.slots = [_Slot() for _ in range(self.spec.slots)]
         self.queue: deque = deque()
-        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        # sampling is STATELESS per request (fold_in(sample_key, index)
+        # — ISSUE 14); the only engine-held rng is the host stream that
+        # stamps fresh requests' sample keys at submit
         self._host_rng = np.random.RandomState(0)
         self.last_logits = None       # [slots, V] of the latest tick
         self.stats = {"ticks": 0, "tick_steps": 0, "decode_tokens": 0,
                       "prefills": 0, "prefill_tokens": 0,
                       "spec_rounds": 0, "spec_proposed": 0,
                       "spec_accepted": 0, "prefix_tokens_shared": 0,
-                      "prefix_tokens_prompt": 0, "prefix_pages_saved": 0}
+                      "prefix_tokens_prompt": 0, "prefix_pages_saved": 0,
+                      "handoffs_out": 0, "handoffs_in": 0}
         # per-engine metrics registry (serving/* names) — pass the
         # process-wide default_registry() to merge into one JSONL
         # stream with a training engine. All recording is host-side;
@@ -163,6 +180,10 @@ class ContinuousBatcher:
         self.replica_id = None
         self._t_last_step_ts = None
         self.metrics_server = None
+        # ISSUE 14: a router sets this while prompts/handoffs are
+        # pending so a decode-role engine's multi-step ticks stay short
+        # enough to interleave with prefill work on one host thread
+        self.tick_step_cap = None
 
     def _record(self, kind, **fields):
         """Ring event with the replica identity stamped (ISSUE 12):
@@ -199,6 +220,22 @@ class ContinuousBatcher:
         m.gauge("serving/page_pool_occupancy").set(occ)
         m.gauge("serving/page_pool_occupancy_hwm").set_max(occ)
 
+    def _note_first_decode_tick(self, req, now) -> None:
+        """TTFT attribution tail (ISSUE 14): time from first-token
+        delivery (prefill readback — or handoff completion on a decode
+        engine) to the request's first committed decode-tick token.
+        Observed once per request."""
+        if getattr(req, "_first_tick_noted", False):
+            return
+        req._first_tick_noted = True
+        base = getattr(req, "_t_handoff_done", None)
+        if base is None:
+            base = getattr(req, "_t_first_tok", None)
+        if base is not None:
+            self.metrics.histogram(
+                "serving/first_decode_tick_s").observe(
+                max(now - base, 0.0))
+
     def metrics_snapshot(self) -> Dict[str, Any]:
         """One JSON-able dict of the serving observables: queue depth,
         admission wait, time-to-first-token, per-tick decode latency,
@@ -216,6 +253,7 @@ class ContinuousBatcher:
         st = self.stats
         prompt_toks = st["prefix_tokens_prompt"]
         return {
+            "role": self.role,
             "queue_depth": len(self.queue),
             "active_slots": sum(s.active for s in self.slots),
             "slots": len(self.slots),
@@ -250,6 +288,20 @@ class ContinuousBatcher:
             "admission_wait_s": hists.get("serving/admission_wait_s",
                                           {"count": 0}),
             "ttft_s": hists.get("serving/ttft_s", {"count": 0}),
+            # TTFT attribution (ISSUE 14 satellite): the head-of-line
+            # gap decomposed — queue-wait + prefill sum to ttft_s;
+            # handoff + first-decode-tick are the post-first-token path
+            # a disaggregated request additionally crosses
+            "ttft_breakdown": {
+                "queue_wait_s": hists.get("serving/ttft_queue_wait_s",
+                                          {"count": 0}),
+                "prefill_s": hists.get("serving/ttft_prefill_s",
+                                       {"count": 0}),
+                "handoff_s": hists.get("serving/handoff_s",
+                                       {"count": 0}),
+                "first_decode_tick_s": hists.get(
+                    "serving/first_decode_tick_s", {"count": 0}),
+            },
             "tick_latency_s": hists.get("serving/tick_latency_s",
                                         {"count": 0}),
             "decode_latency_per_token_s": hists.get(
@@ -310,6 +362,12 @@ class ContinuousBatcher:
             f"{self.spec.page_size} fit the model's "
             f"{self.adapter.max_prompt_len()}-position budget")
         ensure_trace_id(request)
+        if request.temperature and request.temperature > 0 \
+                and request.sample_key is None:
+            # per-request sampling identity (idempotent: a restored /
+            # replayed request arrives with the key it was born with)
+            request.sample_key = int(
+                self._host_rng.randint(0, 2 ** 31 - 1))  # sync-ok: host
         request._t_submit = time.monotonic()
         self.queue.append(request)
         self.metrics.gauge("serving/queue_depth").set(len(self.queue))
@@ -329,13 +387,20 @@ class ContinuousBatcher:
         return pow2_page_bucket(
             need, self.adapter.max_prompt_len() // self.spec.page_size)
 
-    def _pick_token(self, logits: np.ndarray, temperature: float) -> int:
-        if temperature and temperature > 0:
-            z = logits.astype(np.float64) / max(temperature, 1e-6)
-            z -= z.max()
-            p = np.exp(z)
-            p /= p.sum()
-            return int(self._host_rng.choice(p.shape[0], p=p))
+    @staticmethod
+    def _sample_base(req) -> int:
+        """Global token index of the request's FIRST not-yet-sampled
+        token minus len(generated): tokens committed in previous
+        incarnations (folded into a replay prompt) shift the sampling
+        index so a restored request keeps drawing the same stream."""
+        return int(getattr(req, "resumed_committed", 0) or 0)
+
+    def _pick_token(self, logits: np.ndarray, req: Request) -> int:
+        if req.temperature and req.temperature > 0:
+            from deepspeed_tpu.serving.adapters import sample_token
+            idx = self._sample_base(req) + len(req.generated)
+            return sample_token(logits, req.sample_key or 0, idx,
+                                req.temperature)
         return int(np.argmax(logits))
 
     def _admit(self, now: Optional[float]) -> List[Request]:
@@ -386,6 +451,13 @@ class ContinuousBatcher:
             wait_s = max(t_admit - t_ref, 0.0)
             self.metrics.histogram("serving/admission_wait_s").observe(
                 wait_s)
+            # TTFT attribution (ISSUE 14 satellite): queue-wait ends
+            # here, the prefill component starts — the two sum to the
+            # colocated ttft_s; handoff / first-decode-tick components
+            # land later (zero on a colocated engine's TTFT)
+            self.metrics.histogram("serving/ttft_queue_wait_s").observe(
+                wait_s)
+            t_pf0 = time.monotonic()
             start = plan.start_pos if plan is not None else 0
             self._record("admit", rid=req.rid, slot=slot_id,
                          trace=getattr(req, "trace_id", None),
@@ -442,11 +514,16 @@ class ContinuousBatcher:
                 m.counter("serving/prefix_pages_saved").inc(n_shared)
             tok = self._pick_token(
                 np.asarray(logits, np.float32),  # sync-ok: scheduler
-                req.temperature)                 # consumes the sample
+                req)                             # consumes the sample
             req.generated.append(tok)
             # the prefill logits readback above IS first-token delivery
-            ttft_s = max(time.monotonic() - t_ref, 0.0)
+            t_tok = time.monotonic()
+            ttft_s = max(t_tok - t_ref, 0.0)
             self.metrics.histogram("serving/ttft_s").observe(ttft_s)
+            self.metrics.histogram("serving/ttft_prefill_s").observe(
+                max(t_tok - t_pf0, 0.0))
+            req._t_first_tok = t_tok   # base for the first-decode-tick
+            #                            (and handoff) TTFT components
             self._record("prefill", rid=req.rid,
                          trace=getattr(req, "trace_id", None),
                          prompt_tokens=S, ttft_s=ttft_s)
@@ -514,6 +591,8 @@ class ContinuousBatcher:
         cap = self.max_eos_tick_steps if any(
             r.eos_token_id is not None for r in active) \
             else self.max_tick_steps
+        if self.tick_step_cap:
+            cap = min(cap, self.tick_step_cap)
         k = 1
         while k * 2 <= min(rem, cap):  # pow2 bucket → few compiles
             k *= 2
@@ -528,12 +607,20 @@ class ContinuousBatcher:
         temps = np.array(
             [s.request.temperature if s.active else 0.0
              for s in self.slots], np.float32)
-        self._rng, sub = jax.random.split(self._rng)
+        # per-slot stateless sampling identity: (request sample_key,
+        # global index of the slot's next token) — engine rng state
+        # plays no part, so restores/handoffs replay sampled streams
+        seeds = np.array(
+            [(s.request.sample_key or 0) if s.active else 0
+             for s in self.slots], np.uint32)
+        idxs = np.array(
+            [(self._sample_base(s.request) + len(s.request.generated))
+             if s.active else 0 for s in self.slots], np.int32)
         t0 = time.monotonic()
         pool, toks_seq, logits = self.adapter.tick(
             self.cache.pool, jnp.asarray(toks), jnp.asarray(pos),
-            jnp.asarray(self.cache.page_table), sub, jnp.asarray(temps),
-            steps=steps)
+            jnp.asarray(self.cache.page_table), jnp.asarray(seeds),
+            jnp.asarray(idxs), jnp.asarray(temps), steps=steps)
         self.cache.pool = pool
         self.last_logits = logits
         toks_seq = np.asarray(toks_seq)  # sync-ok: scheduler consumes
@@ -553,9 +640,11 @@ class ContinuousBatcher:
         self.stats["tick_steps"] += steps
         finished = []
         tokens_before = self.stats["decode_tokens"]
+        t_commit = time.monotonic()
         for i, slot in enumerate(self.slots):
             if not slot.active:
                 continue
+            self._note_first_decode_tick(slot.request, t_commit)
             for t in range(steps):
                 self.stats["decode_tokens"] += 1
                 tok = int(toks_seq[t, i])   # sync-ok: host array already
@@ -654,8 +743,10 @@ class ContinuousBatcher:
         finished = []
         tokens_before = self.stats["decode_tokens"]
         last_row = np.zeros(B, np.int32)
+        t_commit = time.monotonic()
         for i in active:
             slot = self.slots[i]
+            self._note_first_decode_tick(slot.request, t_commit)
             g, d = greedy[i], toks[i]
             a = 0
             while a < V - 1 and d[a + 1] == g[a]:
@@ -763,13 +854,66 @@ class ContinuousBatcher:
             out.append(self.abort(self.queue[0].rid))
         return out
 
+    # ----------------------------------------------------------- handoff
+
+    def export_slot(self, slot_id: int):
+        """Detach an active slot for a prefill→decode page handoff
+        (ISSUE 14): the request leaves WITHOUT a finish event and its
+        pages decref NOW — the caller (serving/router.py) must already
+        hold a device-side gather of the slot's data pages. Returns
+        ``(request, pos, last_tok)``."""
+        slot = self.slots[slot_id]
+        req, pos, last_tok = slot.request, slot.pos, slot.last_tok
+        assert req is not None, f"slot {slot_id} idle"
+        self.cache.release(slot_id)
+        slot.request, slot.pos, slot.last_tok = None, -1, 0
+        self.stats["handoffs_out"] += 1
+        self.metrics.counter("serving/handoffs_out").inc()
+        self._record("handoff_out", rid=req.rid,
+                     trace=getattr(req, "trace_id", None),
+                     slot=slot_id, pos=pos,
+                     generated=len(req.generated))
+        self._note_pool()
+        return req, pos, last_tok
+
+    def adopt_request(self, slot_id: int, req: Request, pos: int,
+                      last_tok: int) -> None:
+        """Install an already-prefilled request into a free slot (the
+        receiving half of a handoff / elastic restore): the caller has
+        already mapped the request's pages into ``slot_id``'s page
+        table (cache ``admit``/``admit_prefix`` + scatter) — this
+        rebuilds the host slot state and realigns any drafter."""
+        slot = self.slots[slot_id]
+        assert slot.request is None, f"slot {slot_id} busy"
+        slot.request, slot.pos, slot.last_tok = req, pos, last_tok
+        if self.drafter is not None:
+            prompt_np = np.asarray(req.prompt, np.int32)  # sync-ok: host
+            self.drafter.restore_slot(
+                slot_id, prompt_np, req.generated,
+                len(prompt_np) + req.max_new_tokens)
+        self.stats["handoffs_in"] += 1
+        self.metrics.counter("serving/handoffs_in").inc()
+        t_done = time.monotonic()
+        t_first = getattr(req, "_t_first_tok", None)
+        if t_first is not None:
+            req._t_handoff_done = t_done
+            self.metrics.histogram("serving/handoff_s").observe(
+                max(t_done - t_first, 0.0))
+        self._record("handoff_in", rid=req.rid,
+                     trace=getattr(req, "trace_id", None),
+                     slot=slot_id, pos=pos,
+                     generated=len(req.generated))
+        self._note_pool()
+
     def step(self, now: Optional[float] = None) -> List[Request]:
         """One scheduler iteration: admit whatever fits, then one decode
         tick (or speculative verify round) over the active slots.
         Returns requests finished this step (including any that finished
-        at prefill with max_new_tokens=1)."""
+        at prefill with max_new_tokens=1). A prefill-role engine skips
+        the decode dispatch — its active slots wait for the router's
+        handoff sweep."""
         finished = self._admit(now) if self._admitting else []
-        if any(s.active for s in self.slots):
+        if self.role != "prefill" and any(s.active for s in self.slots):
             finished.extend(self._decode_step())
         # fault point + elastic policy (ISSUE 11): the tick boundary is
         # the only place slot state is consistent (no speculation in

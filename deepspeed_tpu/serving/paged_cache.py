@@ -364,6 +364,35 @@ class PagedKVCache:
             if not peers:
                 self._partial_index.pop(ent.chain, None)
 
+    # ------------------------------------- page transport (handoff/restore)
+
+    def gather_block_kv(self, blocks: List[int]):
+        """DEVICE-side gather of ``blocks``' bytes, one array per pool
+        component (``[Lyr, n_blocks, ...]``) — the sending half of a
+        page handoff (ISSUE 14). Stays on device: the in-process
+        transport never round-trips through the host (a cross-process
+        transport would ``np.asarray`` the result — that is the whole
+        difference, which is what makes it a drop-in)."""
+        sel = jnp.asarray(np.asarray(blocks, np.int32))  # sync-ok: host
+        #                                                  block-id list
+        return tuple(comp[:, sel] for comp in self.pool)
+
+    def scatter_block_kv(self, blocks: List[int], comps,
+                         src_offset: int = 0) -> None:
+        """Write gathered component arrays into this pool at
+        ``blocks`` — the receiving half of a page handoff. ``comps``
+        is ``gather_block_kv``'s tuple (device or host arrays);
+        ``src_offset`` skips leading source pages the target already
+        holds (a prefix-index dedupe hit)."""
+        if not blocks:
+            return
+        dst = jnp.asarray(np.asarray(blocks, np.int32))  # sync-ok: host
+        n = len(blocks)
+        self.pool = tuple(
+            comp.at[:, dst].set(jnp.asarray(
+                c[:, src_offset:src_offset + n]))
+            for comp, c in zip(self.pool, comps))
+
     # ------------------------------------------- elastic snapshot/restore
 
     def take_blocks(self, n: int) -> Optional[List[int]]:

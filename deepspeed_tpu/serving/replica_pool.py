@@ -56,6 +56,32 @@ def _req_to_doc(req):
     return dict(elastic._req_doc(req), generated=[])
 
 
+def percentile_summary(vals):
+    """count/mean/p50/p90/p99 of a raw host reservoir — ONE percentile
+    rule shared by the pool's and the router's metrics_snapshot so the
+    two aggregation documents can't drift."""
+    if not vals:
+        return {"count": 0}
+    v = np.asarray(vals, np.float64)       # sync-ok: host reservoirs
+    return {"count": int(v.size),
+            "mean": float(v.mean()),                  # sync-ok: host
+            "p50": float(np.percentile(v, 50)),       # sync-ok: host
+            "p90": float(np.percentile(v, 90)),       # sync-ok: host
+            "p99": float(np.percentile(v, 99))}       # sync-ok: host
+
+
+def merged_reservoir(engines, name):
+    """Concatenate one histogram's raw values across engines, counting
+    a SHARED registry once (the bench's merged-stream case)."""
+    vals, seen = [], set()
+    for cb in engines:
+        if id(cb.metrics) in seen:
+            continue
+        seen.add(id(cb.metrics))
+        vals += cb.metrics.peek_histogram_values(name)
+    return vals
+
+
 class ReplicaPool:
     """See module docstring. ``factory(replica_id)`` builds one
     batcher — give each replica its OWN elastic snapshot dir (e.g.
@@ -493,23 +519,17 @@ class ReplicaPool:
         recovered counters — the document the serving bench embeds and
         a disaggregated router would schedule on."""
         per_replica = {}
-        ttft, waits = [], []
         active = slots = queued = 0
-        seen_regs = set()   # replicas may SHARE one registry (the
-        #                     bench's merged stream) — count it once
+        # peek, don't histogram(): get-or-create would seed an idle
+        # replica's registry with phantom empty metrics
+        ttft = merged_reservoir(self.replicas.values(), "serving/ttft_s")
+        waits = merged_reservoir(self.replicas.values(),
+                                 "serving/admission_wait_s")
         for rid, cb in self.replicas.items():
             a = sum(s.active for s in cb.slots)
             active += a
             slots += len(cb.slots)
             queued += len(cb.queue)
-            if id(cb.metrics) not in seen_regs:
-                seen_regs.add(id(cb.metrics))
-                # peek, don't histogram(): get-or-create would seed an
-                # idle replica's registry with phantom empty metrics
-                ttft += cb.metrics.peek_histogram_values(
-                    "serving/ttft_s")
-                waits += cb.metrics.peek_histogram_values(
-                    "serving/admission_wait_s")
             per_replica[rid] = {
                 "active_slots": a,
                 "slots": len(cb.slots),
@@ -521,21 +541,11 @@ class ReplicaPool:
                 if cb.watchdog is not None else 0,
             }
 
-        def pct(vals):
-            if not vals:
-                return {"count": 0}
-            v = np.asarray(vals, np.float64)  # sync-ok: host reservoirs
-            return {"count": int(v.size),
-                    "mean": float(v.mean()),   # sync-ok: host reservoir
-                    "p50": float(np.percentile(v, 50)),   # sync-ok: host
-                    "p90": float(np.percentile(v, 90)),   # sync-ok: host
-                    "p99": float(np.percentile(v, 99))}   # sync-ok: host
-
         return {
             "replicas": len(self.replicas),
             "per_replica": per_replica,
-            "pool_ttft_s": pct(ttft),
-            "pool_admission_wait_s": pct(waits),
+            "pool_ttft_s": percentile_summary(ttft),
+            "pool_admission_wait_s": percentile_summary(waits),
             "active_slots": active,
             "total_slots": slots,
             "slot_utilization": active / max(slots, 1),

@@ -381,9 +381,16 @@ def render_swap(events, out):
 
 
 # lifecycle kinds that carry a single ``trace`` field, and the batch
-# kinds whose ``traces`` list names every request they touched
+# kinds whose ``traces`` list names every request they touched.
+# ISSUE 14: the disaggregated lifecycle rides the same stitching —
+# router_route (admission decision) -> admit/prefill on the
+# prefill-role replica -> handoff_out -> handoff_in on the decode-role
+# replica -> ticks -> finish; router_block marks admissions deferred
+# on decode-pool pressure.
 TRACE_POINT_KINDS = ("admit", "prefill", "finish", "serving_abort",
-                     "serving_requeue", "pool_exhausted")
+                     "serving_requeue", "pool_exhausted",
+                     "router_route", "router_block", "handoff_out",
+                     "handoff_in")
 TRACE_SET_KINDS = ("serving_snapshot", "serving_restore")
 
 
@@ -466,7 +473,8 @@ def render_traces(events, out):
             bits = []
             for k in ("slot", "prompt_tokens", "ttft_s", "reason",
                       "generated", "outcome", "attempts", "committed",
-                      "remaining", "restored", "requeued", "tag"):
+                      "remaining", "restored", "requeued", "tag",
+                      "engine", "pos"):
                 if ev.get(k) is not None:
                     v = ev[k]
                     bits.append(f"{k}={v:.4g}" if isinstance(v, float)
@@ -477,6 +485,35 @@ def render_traces(events, out):
                        if t is not None else
                        f"    {'':>10}   {kind:<17} [{where}] "
                        + ", ".join(bits))
+
+
+def render_disagg(events, out):
+    """Disaggregated-serving summary (ISSUE 14): routing decisions by
+    reason, handoff volume, transport requeues, and admissions the
+    router deferred on decode-pool pressure — per-trace detail rides
+    the stitched timelines above (prefill→handoff→decode crosses a
+    replica boundary, so every handed-off trace prints there)."""
+    routed = defaultdict(int)
+    handoffs = requeues = blocked = 0
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "router_route":
+            routed[ev.get("reason") or "?"] += 1
+        elif kind == "handoff_in":
+            handoffs += 1
+        elif kind == "router_block":
+            blocked += 1
+        elif kind == "serving_requeue" \
+                and ev.get("outcome") == "scheduled":
+            requeues += 1
+    if not routed and not handoffs:
+        return
+    out.append("")
+    by_reason = ", ".join(f"{n} by {r}" for r, n in sorted(routed.items()))
+    out.append(f"disaggregated serving: {sum(routed.values())} prompts "
+               f"routed ({by_reason}), {handoffs} prefill→decode "
+               f"handoffs, {requeues} requeues, {blocked} admissions "
+               f"deferred on decode-pool pressure")
 
 
 def render_cluster(events, out):
@@ -520,6 +557,7 @@ def render(paths, tail_events=0):
         return out
     render_steps(events, out)
     render_requests(events, out)
+    render_disagg(events, out)
     render_traces(events, out)
     render_cluster(events, out)
     render_ckpt(events, out)

@@ -193,3 +193,73 @@ def test_comm_hierarchy_validation_errors():
                                           {"min_bucket_bytes": "64k"}}})
     with pytest.raises(DeepSpeedConfigError):
         DeepSpeedConfig({**base, "comm": []})
+
+
+def test_serving_disaggregation_section():
+    from deepspeed_tpu.config.config import ServingConfig
+    sc = ServingConfig({"serving": {"disaggregation": {
+        "prefill_replicas": 2, "decode_replicas": 3,
+        "dedupe_pages": False}}})
+    dg = sc.disaggregation
+    assert dg.enabled and dg.prefill_replicas == 2
+    assert dg.decode_replicas == 3 and not dg.dedupe_pages
+    assert dg.transport == "inproc"
+    # absent block: disabled, colocated defaults
+    off = ServingConfig({"serving": {}}).disaggregation
+    assert not off.enabled
+    # decode_replicas 0 is the documented colocated fallback
+    colo = ServingConfig({"serving": {"disaggregation": {
+        "decode_replicas": 0}}}).disaggregation
+    assert colo.enabled and colo.decode_replicas == 0
+
+
+def test_serving_disaggregation_validation_errors():
+    from deepspeed_tpu.config.config import ServingConfig
+
+    def cfg(d):
+        return ServingConfig({"serving": {"disaggregation": d}})
+
+    with pytest.raises(DeepSpeedConfigError):
+        cfg("prefill")                           # not a dict
+    with pytest.raises(DeepSpeedConfigError):
+        cfg({"prefill_replicas": 0})             # >= 1
+    with pytest.raises(DeepSpeedConfigError):
+        cfg({"decode_replicas": -1})             # >= 0
+    with pytest.raises(DeepSpeedConfigError):
+        cfg({"prefill_replicas": "many"})        # not an int
+    with pytest.raises(DeepSpeedConfigError):
+        cfg({"transport": "grpc"})               # inproc only (so far)
+
+
+def test_serving_router_section_and_validation_errors():
+    from deepspeed_tpu.config.config import ServingConfig
+    rt = ServingConfig({"serving": {"router": {
+        "prefix_routing": False, "queue_weight": 2.0,
+        "ttft_weight": 0.5, "ttft_window": 8,
+        "max_handoff_retries": 1, "decode_tick_cap": 2,
+        "max_inflight_pages": 64,
+        "decode_schedule": "fifo"}}}).router
+    assert not rt.prefix_routing and rt.queue_weight == 2.0
+    assert rt.ttft_window == 8 and rt.max_handoff_retries == 1
+    assert rt.decode_tick_cap == 2 and rt.max_inflight_pages == 64
+    assert rt.decode_schedule == "fifo"
+    # defaults without the block
+    d = ServingConfig({"serving": {}}).router
+    assert d.prefix_routing and d.decode_schedule == "lpt"
+    assert d.max_inflight_pages == 0        # 0 = 2x decode pools
+
+    def cfg(r):
+        return ServingConfig({"serving": {"router": r}})
+
+    with pytest.raises(DeepSpeedConfigError):
+        cfg(["lpt"])                             # not a dict
+    with pytest.raises(DeepSpeedConfigError):
+        cfg({"ttft_window": 0})                  # >= 1
+    with pytest.raises(DeepSpeedConfigError):
+        cfg({"queue_weight": "heavy"})           # not a number
+    with pytest.raises(DeepSpeedConfigError):
+        cfg({"max_handoff_retries": -1})         # >= 0
+    with pytest.raises(DeepSpeedConfigError):
+        cfg({"decode_tick_cap": 0})              # >= 1
+    with pytest.raises(DeepSpeedConfigError):
+        cfg({"decode_schedule": "sjf"})          # lpt|fifo

@@ -231,7 +231,7 @@ class _StubAdapter:
     def prefill(self, pool, ids, length, pages):
         return pool, np.zeros((16,), np.float32)
 
-    def tick(self, pool, toks, pos, pt, rng, temps, steps=1):
+    def tick(self, pool, toks, pos, pt, seeds, idxs, temps, steps=1):
         return pool, np.ones((steps, self.spec.slots), np.int32), None
 
 

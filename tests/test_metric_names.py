@@ -104,6 +104,37 @@ def test_cluster_metric_names_documented_both_directions():
         + ", ".join(sorted(documented - emitted)))
 
 
+def test_router_metric_names_documented_both_directions():
+    """The ``router/*`` namespace (ISSUE 14) is pinned EXACTLY like
+    cluster/*: emitted ⊆ documented and documented ⊆ emitted, against
+    ``serving.router.router_metric_names()``."""
+    from deepspeed_tpu.serving.router import router_metric_names
+    emitted = set(router_metric_names())
+    documented = {n for n in documented_metric_names()
+                  if n.startswith("router/")}
+    assert emitted - documented == set(), (
+        "emitted but undocumented router/* names — add them to the "
+        "docs/observability.md router table: "
+        + ", ".join(sorted(emitted - documented)))
+    assert documented - emitted == set(), (
+        "documented but no longer emitted router/* names: "
+        + ", ".join(sorted(documented - emitted)))
+
+
+def test_handoff_serving_metric_names_documented():
+    """The handoff/TTFT-attribution additions to the serving/*
+    namespace (ISSUE 14) must be documented — and stay emitted (the
+    generic documented→source test covers the reverse direction)."""
+    documented = documented_metric_names()
+    for name in ("serving/ttft_queue_wait_s", "serving/ttft_prefill_s",
+                 "serving/handoff_s", "serving/first_decode_tick_s",
+                 "serving/handoffs_out", "serving/handoffs_in"):
+        assert name in documented, (
+            f"{name} missing from the docs/observability.md serving "
+            f"table")
+        assert name in _package_source(), name
+
+
 # ------------------------------------------------------- prometheus page
 
 # the exposition-format line grammar a real scraper applies

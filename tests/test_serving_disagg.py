@@ -1,0 +1,445 @@
+"""Disaggregated prefill/decode serving + SLO router (ISSUE 14).
+
+Covers the role split end to end:
+
+- greedy token parity colocated vs disaggregated, with the page-pool
+  leak fence held across 100+ handoffs (every engine's pool drains to
+  num_blocks - 1 after the workload + a prefix sweep);
+- prefix-locality routing: a prompt whose prefix chain lives on
+  replica B routes to B (even when B is the more loaded choice) and
+  produces hit_pages > 0 there;
+- decode-pool pressure: an exhausted decode pool queues prompts AT THE
+  ROUTER (router/decode_blocked) — no engine ever trips
+  pool_exhausted mid-flight;
+- handoff dedupe: a second request sharing a prompt prefix re-shares
+  the decode pool's resident pages (incref, no copy) through the
+  refcounted allocator;
+- kill-during-handoff: the transport dying between extract and deliver
+  replays the request from its wire doc, and the viewer stitches the
+  prefill→handoff→decode timeline across per-role dump files with
+  zero orphaned traces;
+- TTFT attribution: queue-wait/prefill/handoff/first-decode-tick
+  components in metrics_snapshot();
+- sampled (temperature > 0) parity across the handoff — the persisted
+  sample_key replays the identical sampled stream;
+- build_router config wiring + colocated fallback.
+"""
+
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import deepspeed_tpu.serving as serving
+from deepspeed_tpu.runtime.elastic import faults
+from deepspeed_tpu.serving.engine import ContinuousBatcher
+from deepspeed_tpu.serving.router import (DisaggRouter,
+                                          router_metric_names)
+from deepspeed_tpu.telemetry.recorder import (FlightRecorder,
+                                              default_recorder)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    default_recorder().configure(enabled=True, capacity=4096)
+    default_recorder().clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def gpt2_dis():
+    """(cfg, params, adapter_for): engines over shared per-geometry
+    adapters (compiled programs live on the adapter — tier-1 budget)."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    cfg = GPT2Config(vocab_size=256, n_positions=128, n_embd=64,
+                     n_layer=2, n_head=4, dtype=jnp.float32,
+                     param_dtype=jnp.float32, scan_layers=True)
+    params = jax.jit(GPT2LMHeadModel(cfg).init)(
+        jax.random.PRNGKey(0), np.zeros((1, 8), np.int32))["params"]
+    adapters = {}
+
+    def adapter_for(slots=2, **sv_kw):
+        sv = {"slots": slots, "page_size": 8, "max_pages_per_slot": 8}
+        sv.update(sv_kw)
+        key = tuple(sorted(sv.items()))
+        if key not in adapters:
+            adapters[key] = serving.build_engine(
+                "gpt2", cfg, params, config={"serving": sv}).adapter
+        return adapters[key]
+
+    return cfg, params, adapter_for
+
+
+def _mk_router(adapter, n_prefill=1, n_decode=1, **kw):
+    pes = [ContinuousBatcher(adapter, role="prefill", prefix_cache=True)
+           for _ in range(n_prefill)]
+    des = [ContinuousBatcher(adapter, role="decode", prefix_cache=True)
+           for _ in range(n_decode)]
+    return DisaggRouter(pes, des, **kw)
+
+
+def _reqs(n, max_new=8, seed=0, temperature=0.0):
+    rs = np.random.RandomState(seed)
+    lens = rs.choice([5, 9, 14, 21], n)
+    return [serving.Request(
+        i, rs.randint(0, 256, size=(int(lens[i]),)).astype(np.int32),
+        max_new_tokens=max_new, temperature=temperature)
+        for i in range(n)]
+
+
+def _clone(reqs):
+    return [serving.Request(r.rid, r.prompt,
+                            max_new_tokens=r.max_new_tokens,
+                            eos_token_id=r.eos_token_id,
+                            temperature=r.temperature,
+                            arrival_time=r.arrival_time) for r in reqs]
+
+
+def _ref_streams(adapter, reqs):
+    eng = ContinuousBatcher(adapter)
+    return {rid: r.tokens().tolist()
+            for rid, r in eng.serve(_clone(reqs)).items()}
+
+
+# --------------------------------------------------- parity + leak fence
+
+
+def test_disagg_parity_and_leak_fence_100_handoffs(gpt2_dis):
+    """Greedy outputs are token-for-token identical across the
+    prefill→decode handoff, and after 100+ handoffs every engine's
+    page pool drains to num_blocks - 1 (the acceptance criterion's
+    leak fence)."""
+    _cfg, _params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2)
+    reqs = _reqs(104, max_new=2, seed=1)
+    ref = _ref_streams(adapter, reqs)
+    router = _mk_router(adapter, n_prefill=1, n_decode=2)
+    done = router.run(_clone(reqs))
+    assert len(done) == len(reqs) and not router.lost
+    assert router.stats["handoffs"] >= 100
+    for rid, toks in ref.items():
+        assert done[rid].tokens().tolist() == toks, rid
+    for cb in router.prefill_engines + router.decode_engines:
+        cb.cache.sweep_prefix_cache()
+        assert cb.cache.free_pages == cb.cache.num_blocks - 1, \
+            cb.replica_id
+    snap = router.metrics_snapshot()
+    assert snap["mode"] == "disaggregated"
+    assert snap["handoffs"] == router.stats["handoffs"]
+    # decode engines never ran a prefill program; prefill engines
+    # never committed a decode-tick token
+    for dcb in router.decode_engines:
+        assert dcb.stats["prefills"] == 0
+    for pcb in router.prefill_engines:
+        assert pcb.stats["decode_tokens"] == 0
+        assert pcb.stats["ticks"] == 0
+
+
+def test_disagg_sampled_parity_across_handoff(gpt2_dis):
+    """temperature > 0: the persisted per-request sample_key makes the
+    handed-off continuation identical to the colocated run's — the
+    stateless fold_in(sample_key, token_index) keys don't care which
+    engine draws them."""
+    _cfg, _params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2)
+    reqs = _reqs(4, max_new=10, seed=2, temperature=0.8)
+    ref = _ref_streams(adapter, reqs)
+    router = _mk_router(adapter, n_prefill=1, n_decode=1)
+    done = router.run(_clone(reqs))
+    assert len(done) == len(reqs)
+    for rid, toks in ref.items():
+        assert done[rid].tokens().tolist() == toks, rid
+
+
+# ------------------------------------------------------ routing policy
+
+
+def test_router_prefix_locality_routes_to_matching_replica(gpt2_dis):
+    """A prompt whose prefix chain lives on replica B must route to B
+    — even when B is the MORE loaded SLO choice — and produce
+    hit_pages > 0 there (the locality skip of the shared span's
+    prefill)."""
+    _cfg, _params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2)
+    router = _mk_router(adapter, n_prefill=2, n_decode=1)
+    rs = np.random.RandomState(7)
+    shared = rs.randint(0, 256, size=(19,)).astype(np.int32)
+    mk = (lambda rid: serving.Request(
+        rid, np.concatenate([shared, rs.randint(0, 256, size=(4,))
+                             .astype(np.int32)]), max_new_tokens=4))
+    # warm: the first shared-prefix request routes by SLO (cold
+    # indexes, equal load → engine 0) and registers the chain there
+    done = router.run([mk("warm")])
+    assert len(done) == 1
+    evs = [e for e in default_recorder().events()
+           if e["kind"] == "router_route" and e["rid"] == "warm"]
+    assert evs and evs[0]["reason"] == "slo"
+    home = evs[0]["engine"]
+    home_cb = next(cb for cb in router.prefill_engines
+                   if cb.replica_id == home)
+    other_cb = next(cb for cb in router.prefill_engines
+                    if cb.replica_id != home)
+    # load the HOME engine with an unrelated prompt, then submit the
+    # prefix request in the same round: SLO would pick the idle
+    # engine; locality must still pick home
+    filler = serving.Request(
+        "filler", rs.randint(0, 256, size=(9,)).astype(np.int32),
+        max_new_tokens=4)
+    router.submit(filler)
+    hot = mk("hot")
+    router.submit(hot)
+    before = home_cb.cache.prefix_stats["hit_pages"]
+    while router.pending:
+        router.step()
+    evs = {e["rid"]: e for e in default_recorder().events()
+           if e["kind"] == "router_route"}
+    assert evs["hot"]["reason"] == "prefix"
+    assert evs["hot"]["engine"] == home
+    assert home_cb.cache.prefix_stats["hit_pages"] > before
+    assert other_cb.cache.prefix_stats["hit_pages"] == 0
+    assert router.done["hot"].tokens().tolist()[:19] == shared.tolist()
+
+
+def test_router_queues_on_decode_pool_pressure(gpt2_dis):
+    """An exhausted decode pool queues prompts AT THE ROUTER (no
+    admission — router/decode_blocked counts) instead of tripping
+    pool_exhausted mid-flight: with 8 allocatable decode pages and
+    ~4-page requests only two can be resident, the packet backlog hits
+    the in-flight KV bound, and later prompts wait unadmitted. The
+    queue drains as finishes free slots and every request completes
+    token-identically."""
+    _cfg, _params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2, num_blocks=9)
+    reqs = _reqs(6, max_new=12, seed=3)       # ~4 pages each
+    ref = _ref_streams(adapter, reqs)
+    default_recorder().clear()   # the (page-starved) reference engine
+    #                              legitimately tripped pool_exhausted
+    router = _mk_router(adapter, n_prefill=1, n_decode=1,
+                        max_inflight_pages=4)
+    done = router.run(_clone(reqs))
+    assert len(done) == len(reqs) and not router.lost
+    assert router.stats["decode_blocked"] > 0
+    kinds = [e["kind"] for e in default_recorder().events()]
+    assert "router_block" in kinds
+    assert "pool_exhausted" not in kinds      # never mid-flight
+    for rid, toks in ref.items():
+        assert done[rid].tokens().tolist() == toks, rid
+
+
+def test_handoff_dedupe_reshares_decode_pages(gpt2_dis):
+    """Two requests sharing a prompt prefix, served one after the
+    other: the second handoff re-shares the decode pool's resident
+    prompt pages (admit_prefix incref — hit_pages > 0 on the DECODE
+    cache) instead of copying them again."""
+    _cfg, _params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2)
+    rs = np.random.RandomState(11)
+    shared = rs.randint(0, 256, size=(17,)).astype(np.int32)
+    mk = (lambda rid: serving.Request(
+        rid, np.concatenate([shared, rs.randint(0, 256, size=(3,))
+                             .astype(np.int32)]), max_new_tokens=4))
+    router = _mk_router(adapter, n_prefill=1, n_decode=1)
+    dcb = router.decode_engines[0]
+    router.run([mk("a")])
+    assert dcb.cache.prefix_stats["hit_pages"] == 0
+    router.run([mk("b")])
+    assert dcb.cache.prefix_stats["hit_pages"] > 0
+    # fence still holds with shared resident pages
+    for cb in router.prefill_engines + router.decode_engines:
+        cb.cache.sweep_prefix_cache()
+        assert cb.cache.free_pages == cb.cache.num_blocks - 1
+
+
+# --------------------------------------------- transport crash + viewer
+
+
+def test_kill_during_handoff_zero_orphaned_traces(gpt2_dis, tmp_path):
+    """The transport dies between extract and deliver (the gathered
+    bytes are lost): the router replays the request from its wire doc
+    token-for-token, and telemetry/view.py stitches the full
+    prefill→handoff→decode timeline per trace_id across PER-ROLE dump
+    files with zero orphaned traces — every submitted trace appears
+    and closes with a finish."""
+    from deepspeed_tpu.telemetry import view
+
+    _cfg, _params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2)
+    reqs = _reqs(6, max_new=6, seed=5)
+    ref = _ref_streams(adapter, reqs)
+    default_recorder().clear()
+    # per-role recorders: the prefill side's ring (plus the router's
+    # routing/requeue events) and the decode side's ring dump to
+    # SEPARATE files — the multi-dump merge is what stitches them
+    rec_p = FlightRecorder(capacity=4096)
+    rec_d = FlightRecorder(capacity=4096)
+    pes = [ContinuousBatcher(adapter, role="prefill",
+                             prefix_cache=True, recorder=rec_p)]
+    des = [ContinuousBatcher(adapter, role="decode",
+                             prefix_cache=True, recorder=rec_d)]
+    router = DisaggRouter(pes, des, recorder=rec_p)
+    work = _clone(reqs)
+    for r in work:
+        router.submit(r)
+    traces = {r.rid: r.trace_id for r in work}
+    assert all(traces.values())
+    with faults.crash_during_handoff(times=2):
+        rounds = 0
+        while router.pending and rounds < 500:
+            router.step()
+            rounds += 1
+    done = router.done
+    assert len(done) == len(reqs) and not router.lost
+    assert router.stats["handoff_requeues"] == 2
+    for rid, toks in ref.items():
+        assert done[rid].tokens().tolist() == toks, rid
+
+    dump_p = tmp_path / "prefill.jsonl"
+    dump_d = tmp_path / "decode.jsonl"
+    for path, rec in ((dump_p, rec_p), (dump_d, rec_d)):
+        with open(path, "w") as fh:
+            for ev in rec.events():
+                fh.write(json.dumps(ev, default=repr) + "\n")
+    _headers, events, _ = view.load_dumps([str(dump_p), str(dump_d)])
+    timelines = view.trace_timelines(events)
+    # zero orphans: every submitted trace appears and closes finished
+    assert set(timelines) == set(traces.values())
+    for rid, tid in traces.items():
+        evs = timelines[tid]
+        assert view._trace_outcome(evs).startswith("finished"), rid
+        kinds = [e["kind"] for e in evs]
+        assert "router_route" in kinds
+        assert "handoff_out" in kinds and "handoff_in" in kinds
+        # the handoff crossed a replica boundary: prefill + decode ids
+        reps = {e.get("replica") for e in evs
+                if e.get("replica") is not None}
+        assert len(reps) >= 2, (rid, reps)
+    # the crashed requests show the replay chain
+    requeued = [tid for tid, evs in timelines.items()
+                if any(e["kind"] == "serving_requeue" for e in evs)]
+    assert len(requeued) == 2
+    text = "\n".join(view.render([str(dump_p), str(dump_d)]))
+    assert "disaggregated serving:" in text
+    assert "handoff_out" in text and "handoff_in" in text
+
+
+def test_handoff_retry_budget_drops_poisoned_request(gpt2_dis):
+    """A request whose every handoff crashes is dropped after
+    max_handoff_retries (bounded) — the rest of the traffic
+    completes."""
+    _cfg, _params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2)
+    reqs = _reqs(3, max_new=4, seed=6)
+    router = _mk_router(adapter, n_prefill=1, n_decode=1,
+                        max_handoff_retries=2)
+    with faults.crash_during_handoff(match_rid=0, times=None):
+        done = router.run(_clone(reqs))
+    assert 0 in router.lost and 0 not in done
+    assert sorted(done) == [1, 2]
+    assert router.stats["lost"] == 1
+    evs = [e for e in default_recorder().events()
+           if e["kind"] == "serving_requeue"
+           and e.get("outcome") == "dropped"]
+    assert len(evs) == 1
+    # the poisoned request's pages all came back
+    for cb in router.prefill_engines + router.decode_engines:
+        cb.cache.sweep_prefix_cache()
+        assert cb.cache.free_pages == cb.cache.num_blocks - 1
+
+
+# ------------------------------------------------- attribution + config
+
+
+def test_ttft_breakdown_components(gpt2_dis):
+    """metrics_snapshot decomposes TTFT: colocated engines record
+    queue-wait + prefill (no handoff); disaggregated runs additionally
+    record handoff + first-decode-tick for every handed-off request."""
+    _cfg, _params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2)
+    reqs = _reqs(4, max_new=6, seed=8)
+    eng = ContinuousBatcher(adapter)
+    eng.serve(_clone(reqs))
+    bd = eng.metrics_snapshot()["ttft_breakdown"]
+    assert bd["queue_wait_s"]["count"] == len(reqs)
+    assert bd["prefill_s"]["count"] == len(reqs)
+    assert bd["handoff_s"]["count"] == 0
+    assert bd["first_decode_tick_s"]["count"] == len(reqs)
+
+    router = _mk_router(adapter, n_prefill=1, n_decode=1)
+    router.run(_clone(reqs))
+    bd = router.metrics_snapshot()["ttft_breakdown"]
+    assert bd["queue_wait_s"]["count"] == len(reqs)
+    assert bd["prefill_s"]["count"] == len(reqs)
+    assert bd["handoff_s"]["count"] == len(reqs)
+    assert bd["first_decode_tick_s"]["count"] == len(reqs)
+
+
+def test_build_router_from_config_and_colocated_fallback(gpt2_dis):
+    """build_router wires the serving.disaggregation/.router blocks;
+    decode_replicas 0 (or enabled false) degrades to colocated
+    engines behind the same API with identical outputs."""
+    cfg, params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2)
+    reqs = _reqs(3, max_new=5, seed=9)
+    ref = _ref_streams(adapter, reqs)
+    sv = {"slots": 2, "page_size": 8, "max_pages_per_slot": 8}
+    router = serving.build_router(
+        "gpt2", cfg, params,
+        config={"serving": {
+            **sv,
+            "disaggregation": {"prefill_replicas": 1,
+                               "decode_replicas": 2},
+            "router": {"decode_tick_cap": 2,
+                       "max_handoff_retries": 1}}})
+    assert len(router.prefill_engines) == 1
+    assert len(router.decode_engines) == 2
+    assert router.decode_tick_cap == 2
+    assert router.max_handoff_retries == 1
+    done = router.run(_clone(reqs))
+    for rid, toks in ref.items():
+        assert done[rid].tokens().tolist() == toks, rid
+
+    # blocks build_router would silently drop must raise instead
+    with pytest.raises(ValueError, match="speculative"):
+        serving.build_router(
+            "gpt2", cfg, params,
+            config={"serving": {**sv, "disaggregation": {},
+                                "speculative": {}}})
+    with pytest.raises(ValueError, match="elastic"):
+        serving.build_router(
+            "gpt2", cfg, params,
+            config={"serving": {
+                **sv, "disaggregation": {},
+                "elastic": {"snapshot_path": "/tmp/x"}}})
+
+    colo = serving.build_router(
+        "gpt2", cfg, params,
+        config={"serving": {
+            **sv, "disaggregation": {"decode_replicas": 0,
+                                     "prefill_replicas": 2}}})
+    assert colo.colocated and not colo.decode_engines
+    assert all(cb.role == "both" for cb in colo.prefill_engines)
+    done = colo.run(_clone(reqs))
+    assert colo.stats["handoffs"] == 0
+    for rid, toks in ref.items():
+        assert done[rid].tokens().tolist() == toks, rid
+    snap = colo.metrics_snapshot()
+    assert snap["mode"] == "colocated"
+
+
+def test_router_metric_names_cover_emissions():
+    """Every router/* literal the router records must be declared in
+    router_metric_names() (the docs pin rides
+    tests/test_metric_names.py)."""
+    import pathlib
+    import re
+    src = (pathlib.Path(serving.__file__).parent
+           / "router.py").read_text()
+    emitted = set(re.findall(r'"(router/[a-z0-9_]+)"', src))
+    # the f-string family router/{prefix,slo}_routed
+    emitted.discard("router/")
+    emitted |= {"router/prefix_routed", "router/slo_routed"}
+    assert emitted == set(router_metric_names())

@@ -260,6 +260,48 @@ def test_restore_preserves_prefix_hit_rate(gpt2_el, tmp_path):
     assert done[2].tokens().tolist() == ref[2]   # and stays lossless
 
 
+def test_sampled_snapshot_restore_is_deterministic(gpt2_el, tmp_path):
+    """ISSUE 14 satellite (the PR-11 caveat fix): SAMPLED
+    (temperature > 0) requests restore deterministically. The per-
+    request sample_key + cumulative committed-token count persisted in
+    the snapshot docs make every token's sampling key
+    fold_in(sample_key, global_index) — so both the direct slot
+    rebuild AND the replay requeue regenerate the uninterrupted run's
+    exact sampled stream (previously they drew fresh rng)."""
+    _cfg, _params, make = gpt2_el
+    from deepspeed_tpu.runtime.elastic.snapshot import AsyncSnapshotter
+    reqs = [serving.Request(r.rid, r.prompt, max_new_tokens=14,
+                            temperature=0.8) for r in _reqs(4, seed=21)]
+    ref = _ref_streams(make, reqs, slots=2)
+    # sanity: the streams are actually sampled, not greedy
+    greedy = _ref_streams(
+        make, [serving.Request(r.rid, r.prompt, max_new_tokens=14)
+               for r in reqs], slots=2)
+    assert any(ref[i] != greedy[i] for i in ref)
+
+    src = make(slots=2)
+    done = {}
+    for r in _clone(reqs):
+        src.submit(r)
+    for _ in range(4):
+        for r in src.step():
+            done[r.rid] = r
+    snap = AsyncSnapshotter(str(tmp_path / "snaps"), fsync=False)
+    path = elastic.snapshot_serving(src, snap, "t1")
+    host, kv = elastic.load_serving_snapshot(path)
+    assert host["slots"], "something must still be in flight"
+    for doc in host["slots"] + host["queued"]:
+        assert doc["sample_key"] is not None      # persisted identity
+        assert doc["committed_total"] == len(doc["generated"])
+    # 1-slot target: direct rebuild AND replay requeue paths both run
+    target = make(slots=1)
+    merged = dict(done)
+    elastic.restore_serving(target, host, kv)
+    _drive(target, merged)
+    for rid, toks in ref.items():
+        assert merged[rid].tokens().tolist() == toks, rid
+
+
 # --------------------------------------------------- SIGTERM mid-serve
 
 
