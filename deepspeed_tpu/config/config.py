@@ -480,6 +480,56 @@ class SnapshotConfig:
                         f"snapshot.signals: unknown signal {name!r}")
 
 
+class FaultToleranceConfig:
+    """``fault_tolerance`` block (ISSUE 15): the collective hang
+    watchdog + heartbeat inside every worker (runtime/elastic/hang.py)
+    and the rendezvous-retry knobs the supervisor exports to children.
+    Presence of the block enables the in-process watchdog thread; the
+    heartbeat file only appears when a directory is configured (or the
+    supervisor provided one via ``DSTPU_HEARTBEAT_DIR``)."""
+
+    def __init__(self, param_dict):
+        d = param_dict.get(C.FAULT_TOLERANCE, None)
+        self.enabled = d is not None and bool(
+            d.get(C.FT_ENABLED, C.FT_ENABLED_DEFAULT))
+        d = d or {}
+        self.hang_deadline_s = float(d.get(C.FT_HANG_DEADLINE_S,
+                                           C.FT_HANG_DEADLINE_S_DEFAULT))
+        self.hang_poll_s = float(d.get(C.FT_HANG_POLL_S,
+                                       C.FT_HANG_POLL_S_DEFAULT))
+        self.heartbeat_dir = d.get(C.FT_HEARTBEAT_DIR,
+                                   C.FT_HEARTBEAT_DIR_DEFAULT)
+        self.heartbeat_interval_s = float(
+            d.get(C.FT_HEARTBEAT_INTERVAL_S,
+                  C.FT_HEARTBEAT_INTERVAL_S_DEFAULT))
+        self.rendezvous_retries = int(
+            d.get(C.FT_RENDEZVOUS_RETRIES, C.FT_RENDEZVOUS_RETRIES_DEFAULT))
+        self.rendezvous_backoff_s = float(
+            d.get(C.FT_RENDEZVOUS_BACKOFF_S,
+                  C.FT_RENDEZVOUS_BACKOFF_S_DEFAULT))
+        if self.enabled:
+            if not self.hang_deadline_s > 0:
+                raise DeepSpeedConfigError(
+                    f"fault_tolerance.hang_deadline_s must be > 0, got "
+                    f"{self.hang_deadline_s!r}")
+            if self.hang_poll_s < 0:
+                raise DeepSpeedConfigError(
+                    f"fault_tolerance.hang_poll_s must be >= 0 (0 = "
+                    f"deadline/10), got {self.hang_poll_s!r}")
+            if not self.heartbeat_interval_s > 0:
+                raise DeepSpeedConfigError(
+                    f"fault_tolerance.heartbeat_interval_s must be > 0, "
+                    f"got {self.heartbeat_interval_s!r}")
+            if self.rendezvous_retries < 0:
+                raise DeepSpeedConfigError(
+                    f"fault_tolerance.rendezvous_retries must be >= 0, "
+                    f"got {self.rendezvous_retries!r}")
+            if not self.rendezvous_backoff_s > 0:
+                raise DeepSpeedConfigError(
+                    f"fault_tolerance.rendezvous_backoff_s must be > 0, "
+                    f"got {self.rendezvous_backoff_s!r}")
+
+
 class ProfilingConfig:
     """``profiling`` block: the programmatic XLA trace window.
     ``trace_dir`` + ``trace_steps: [start, stop)`` capture that range
@@ -1266,6 +1316,7 @@ class DeepSpeedConfig:
         self.monitor_config = MonitorConfig(pd)
         self.profiling_config = ProfilingConfig(pd)
         self.snapshot_config = SnapshotConfig(pd)
+        self.fault_tolerance_config = FaultToleranceConfig(pd)
         self.sparse_attention_config = SparseAttentionConfig(pd)
         self.pipeline_config = PipelineConfig(pd)
         self.mesh_config = MeshConfigSection(pd)

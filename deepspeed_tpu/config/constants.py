@@ -582,6 +582,29 @@ SNAPSHOT_SIGNALS = "signals"
 SNAPSHOT_SIGNALS_DEFAULT = ("SIGTERM",)
 
 #############################################
+# Fault tolerance (runtime/elastic/{hang,supervisor}.py, ISSUE 15):
+# the collective hang watchdog + per-rank heartbeat inside every
+# worker, and the knobs the launcher-level supervisor exports into
+# child environments (heartbeat dir, rendezvous retry). Presence of
+# the block enables the in-process watchdog thread.
+#############################################
+FAULT_TOLERANCE = "fault_tolerance"
+FT_ENABLED = "enabled"
+FT_ENABLED_DEFAULT = True             # presence of the block enables it
+FT_HANG_DEADLINE_S = "hang_deadline_s"    # blocked-in-collective limit
+FT_HANG_DEADLINE_S_DEFAULT = 300.0
+FT_HANG_POLL_S = "hang_poll_s"        # 0 → deadline/10, clamped
+FT_HANG_POLL_S_DEFAULT = 0.0
+FT_HEARTBEAT_DIR = "heartbeat_dir"    # "" → DSTPU_HEARTBEAT_DIR env
+FT_HEARTBEAT_DIR_DEFAULT = ""
+FT_HEARTBEAT_INTERVAL_S = "heartbeat_interval_s"
+FT_HEARTBEAT_INTERVAL_S_DEFAULT = 1.0
+FT_RENDEZVOUS_RETRIES = "rendezvous_retries"
+FT_RENDEZVOUS_RETRIES_DEFAULT = 8
+FT_RENDEZVOUS_BACKOFF_S = "rendezvous_backoff_s"
+FT_RENDEZVOUS_BACKOFF_S_DEFAULT = 0.5
+
+#############################################
 # Serving (continuous batching + paged KV cache) [tpu]
 #############################################
 SERVING = "serving"

@@ -9,6 +9,17 @@ distributed.py) picks these up and calls `jax.distributed.initialize`.
 Kept from the reference: base64 world-info decoding, SIGINT/SIGTERM
 propagation to children, non-zero-exit fail-fast monitoring
 (launch.py:128-168).
+
+``--supervise`` (ISSUE 15) upgrades fail-fast into self-healing for
+single-node worlds: the worker runs under the fault-tolerance
+supervisor (runtime/elastic/supervisor.py) — child liveness +
+heartbeat monitoring, bounded jittered-backoff restarts from the
+latest valid snapshot, one latched ``crash_loop`` dump when the budget
+is spent. Multi-node worlds keep fail-fast here: a per-host launcher
+cannot re-rendezvous a world whose other hosts it does not own — run
+the supervisor CLI (``python -m deepspeed_tpu.runtime.elastic.
+supervisor``) on the coordinator host for the local multi-process
+shape instead.
 """
 
 import base64
@@ -36,6 +47,22 @@ def parse_args(args=None):
                         default=DEFAULT_COORDINATOR_PORT)
     parser.add_argument("--world_info", type=str, default="None",
                         help="base64-encoded {host: [chip ids]} dict")
+    parser.add_argument("--supervise", action="store_true",
+                        help="single-node worlds only: run the worker "
+                        "under the fault-tolerance supervisor (ISSUE "
+                        "15) — restart on crash/hang from the latest "
+                        "valid snapshot, bounded by --max_restarts")
+    parser.add_argument("--max_restarts", type=int, default=3)
+    parser.add_argument("--hang_deadline", type=float, default=300.0,
+                        help="supervisor heartbeat-staleness deadline "
+                        "(workers' in-collective deadline comes from "
+                        "their fault_tolerance config block)")
+    parser.add_argument("--heartbeat_dir", type=str, default="",
+                        help="per-rank heartbeat directory (default: "
+                        "./.dstpu_supervisor)")
+    parser.add_argument("--dump_dir", type=str, default="",
+                        help="supervisor watchdog dump directory "
+                        "(rank_dead / crash_loop incident dumps)")
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=REMAINDER)
     return parser.parse_args(args=args)
@@ -76,6 +103,30 @@ def main(args=None):
 
     cmd = [sys.executable, "-u", args.training_script] \
         + args.training_script_args
+
+    if args.supervise:
+        if nnodes != 1:
+            raise ValueError(
+                "--supervise needs a single-node world: this per-host "
+                "launcher cannot re-rendezvous hosts it does not own "
+                "(use the supervisor CLI on the coordinator host, or "
+                "drop --supervise for fail-fast)")
+        from deepspeed_tpu.runtime.elastic.supervisor import Supervisor
+        hb_dir = args.heartbeat_dir or os.path.join(
+            os.getcwd(), ".dstpu_supervisor")
+        sup = Supervisor(
+            cmd, world=1, heartbeat_dir=hb_dir,
+            dump_dir=args.dump_dir or None,
+            hang_deadline_s=args.hang_deadline,
+            max_restarts=args.max_restarts,
+            env=env)
+
+        # keep the fail-fast path's signal contract: SIGTERM/SIGINT to
+        # the launcher must tear the supervised worker down (Python's
+        # default disposition would kill us mid-run() and orphan it)
+        sup.install_signal_handlers()
+        sys.exit(sup.run())
+
     processes = []
     last_return_code = None
 

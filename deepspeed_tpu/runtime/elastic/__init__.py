@@ -1,5 +1,6 @@
-"""Elastic preemption-tolerant training (ISSUE 7) — the reference's
-``elasticity/`` module grown into a runtime fault-tolerance subsystem:
+"""Elastic fault-tolerant training (ISSUE 7 + ISSUE 15) — the
+reference's ``elasticity/`` module grown into a runtime fault-tolerance
+subsystem:
 
 - ``snapshot``: periodic ASYNC checkpoints whose shard writes ride the
   swap tier's dedicated write-behind aio handle; the drain fence + a
@@ -13,27 +14,61 @@
   elasticity HCN ladder re-solves micro/grad-accum so the effective
   batch (and the loss trajectory) is preserved;
 - ``faults``: the deterministic fault-injection harness the tests
-  drive end-to-end (kill-at-step, torn manifest, rotted checksum,
-  crash-between-renames).
+  drive end-to-end (kill-at-step, SIGKILL, in-collective hang, torn
+  manifest, rotted checksum, crash-between-renames);
+- ``hang`` (ISSUE 15): the collective hang watchdog — a daemon thread
+  that converts a collective blocked past
+  ``fault_tolerance.hang_deadline_s`` into one latched ``rank_dead``
+  dump + a distinct ``EXIT_HANG`` exit, and writes the per-rank
+  heartbeat file the supervisor monitors;
+- ``supervisor`` (ISSUE 15): the launcher-level supervisor — spawn the
+  world, watch liveness + heartbeats, tear down survivors on any rank
+  death, restart the HCN-valid shrunk world from the latest valid
+  snapshot with jittered backoff, bounded by ``max_restarts``.
+
+Resolution is lazy (PEP 562, like the package root): ``faults``,
+``hang`` and ``supervisor`` are stdlib-side and must stay importable in
+a launcher process that never initializes a jax backend (libtpu takes
+an exclusive per-process lock — launcher/runner.py:_local_chip_count),
+while ``snapshot``/``resume`` legitimately import jax.
 """
 
-from deepspeed_tpu.runtime.elastic import faults  # stdlib-only, no cycle
-from deepspeed_tpu.runtime.elastic.snapshot import (
-    AsyncSnapshotter,
-    FileLeaf,
-    SnapshotCorrupt,
-    SnapshotError,
-    SnapshotReader,
-    is_snapshot_dir,
-)
-from deepspeed_tpu.runtime.elastic.preemption import PreemptionHandler
-from deepspeed_tpu.runtime.elastic.resume import (
-    elastic_resume,
-    load_latest_valid,
-)
+from deepspeed_tpu.utils.lazy import lazy_attrs
 
-__all__ = [
-    "AsyncSnapshotter", "FileLeaf", "SnapshotCorrupt", "SnapshotError",
-    "SnapshotReader", "is_snapshot_dir", "PreemptionHandler",
-    "elastic_resume", "load_latest_valid", "faults",
-]
+_LAZY = {
+    "AsyncSnapshotter": ("deepspeed_tpu.runtime.elastic.snapshot",
+                         "AsyncSnapshotter"),
+    "FileLeaf": ("deepspeed_tpu.runtime.elastic.snapshot", "FileLeaf"),
+    "SnapshotCorrupt": ("deepspeed_tpu.runtime.elastic.snapshot",
+                        "SnapshotCorrupt"),
+    "SnapshotError": ("deepspeed_tpu.runtime.elastic.snapshot",
+                      "SnapshotError"),
+    "SnapshotReader": ("deepspeed_tpu.runtime.elastic.snapshot",
+                       "SnapshotReader"),
+    "is_snapshot_dir": ("deepspeed_tpu.runtime.elastic.snapshot",
+                        "is_snapshot_dir"),
+    "PreemptionHandler": ("deepspeed_tpu.runtime.elastic.preemption",
+                          "PreemptionHandler"),
+    "elastic_resume": ("deepspeed_tpu.runtime.elastic.resume",
+                       "elastic_resume"),
+    "load_latest_valid": ("deepspeed_tpu.runtime.elastic.resume",
+                          "load_latest_valid"),
+    "HangWatchdog": ("deepspeed_tpu.runtime.elastic.hang",
+                     "HangWatchdog"),
+    "EXIT_HANG": ("deepspeed_tpu.runtime.elastic.hang", "EXIT_HANG"),
+    "Supervisor": ("deepspeed_tpu.runtime.elastic.supervisor",
+                   "Supervisor"),
+    "EXIT_CRASH_LOOP": ("deepspeed_tpu.runtime.elastic.supervisor",
+                        "EXIT_CRASH_LOOP"),
+    # submodules resolved as attributes (`elastic.faults.fire(...)`)
+    "faults": ("deepspeed_tpu.runtime.elastic.faults", None),
+    "hang": ("deepspeed_tpu.runtime.elastic.hang", None),
+    "supervisor": ("deepspeed_tpu.runtime.elastic.supervisor", None),
+    "snapshot": ("deepspeed_tpu.runtime.elastic.snapshot", None),
+    "preemption": ("deepspeed_tpu.runtime.elastic.preemption", None),
+    "resume": ("deepspeed_tpu.runtime.elastic.resume", None),
+}
+
+__all__ = sorted(_LAZY)
+
+__getattr__, __dir__ = lazy_attrs(__name__, _LAZY)

@@ -17,10 +17,22 @@ failure at exactly that point:
   ``serving_spec_verify`` (the verify dispatch ran, nothing committed
   — the mid-spec-verify window), ``serving_tick_end`` (the scheduler's
   step boundary, where :func:`kill_at_serving_tick` delivers a real
-  SIGTERM mid-serve), and ``serving_handoff`` (ISSUE 14: the request
+  SIGTERM mid-serve), ``serving_handoff`` (ISSUE 14: the request
   is extracted from its prefill engine but not yet delivered to a
   decode engine — the page transport dying with the bytes in flight,
-  via :func:`crash_during_handoff`).
+  via :func:`crash_during_handoff`), and ``serving_deliver`` (ISSUE
+  15: the decode engine has ADMITTED the packet's pages but the
+  scatter/adoption never ran — the delivery-side crash whose unwind
+  path must decref the just-admitted pages instead of leaking them,
+  via :func:`crash_during_delivery`);
+- ``collective_enter`` (engine.train_batch, immediately before the
+  step dispatch that executes the cross-process collectives — ISSUE
+  15): :func:`hang_in_collective` parks one rank here so its PEERS
+  block inside the boundary exchange, the exact eternal-hang shape a
+  SIGKILLed/hung rank inflicts on its survivors. The sleeping rank's
+  heartbeat thread keeps beating (daemon threads survive a main-thread
+  sleep), so only the survivors' blocked-in-dispatch watchdog can see
+  this — which is the point.
 
 Post-commit corruptions (a torn manifest, a rotted shard) are plain
 file edits — :func:`tear_manifest` / :func:`rot_shard` — because they
@@ -35,6 +47,7 @@ jax (or a sibling elastic module) into those import graphs.
 import contextlib
 import os
 import signal
+import time
 
 _HOOKS = {}   # point name -> list of callables
 
@@ -83,6 +96,47 @@ def kill_at_step(at_step, sig=signal.SIGTERM):
             os.kill(os.getpid(), sig)
 
     return inject("step_end", _fn)
+
+
+def sigkill_at_step(at_step):
+    """Context manager: SIGKILL this process the first time the engine
+    finishes step ``at_step`` (ISSUE 15) — the hard-death scenario
+    (OOM killer, node loss, ``kill -9``): no handler runs, no final
+    snapshot, no goodbye. Survivor ranks block forever inside their
+    next collective unless the hang watchdog (runtime/elastic/hang.py)
+    converts the stall into an exit; the launcher-level supervisor
+    (runtime/elastic/supervisor.py) sees the death and restarts the
+    shrunk world."""
+    return kill_at_step(at_step, sig=signal.SIGKILL)
+
+
+def exit_at_step(at_step, code=1):
+    """Context manager: hard ``os._exit(code)`` at step ``at_step`` —
+    the deterministic crash-LOOP ingredient (every restarted epoch dies
+    the same way until the supervisor's ``max_restarts`` bound trips).
+    ``os._exit`` skips atexit/finally exactly like a crash would."""
+    def _fn(step=None, **_kw):
+        if step == at_step:
+            os._exit(code)
+
+    return inject("step_end", _fn)
+
+
+def hang_in_collective(at_step, hang_s=3600.0):
+    """Context manager: park this rank for ``hang_s`` seconds at the
+    ``collective_enter`` point of step ``at_step`` — it never dispatches
+    the step, so every PEER rank blocks inside the boundary collective
+    (the in-collective hang, ISSUE 15). The peers' hang watchdog must
+    detect the stall within ``fault_tolerance.hang_deadline_s`` and
+    exit with the distinct hang code instead of hanging forever."""
+    fired = []
+
+    def _fn(step=None, **_kw):
+        if step == at_step and not fired:
+            fired.append(True)
+            time.sleep(hang_s)
+
+    return inject("collective_enter", _fn)
 
 
 def kill_at_serving_tick(at_tick, sig=signal.SIGTERM):
@@ -143,6 +197,30 @@ def crash_during_handoff(match_rid=None, times=1):
             f"injected crash at serving_handoff (rid={rid})")
 
     return inject("serving_handoff", _fn)
+
+
+def crash_during_delivery(match_rid=None, times=1):
+    """Context manager: crash at ``serving_deliver`` — the decode
+    engine already ADMITTED the packet's pages (allocated/increffed
+    through the refcounted allocator) but the KV scatter and slot
+    adoption never happened (ISSUE 15 satellite, the delivery-side
+    crash PR 14's review flagged). ``deliver_handoff`` must unwind the
+    admission — decref the just-admitted pages — and the router
+    replays the request from its wire doc; the leak-fence test pins
+    that the pool drains back to full. Same knobs as
+    :func:`crash_during_handoff`."""
+    fired = [0]
+
+    def _fn(rid=None, **_kw):
+        if match_rid is not None and rid != match_rid:
+            return
+        if times is not None and fired[0] >= times:
+            return
+        fired[0] += 1
+        raise SimulatedCrash(
+            f"injected crash at serving_deliver (rid={rid})")
+
+    return inject("serving_deliver", _fn)
 
 
 def crash_replica_mid_spec_verify(at_round=1):

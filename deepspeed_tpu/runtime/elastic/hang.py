@@ -1,0 +1,191 @@
+"""Collective hang watchdog + per-rank heartbeat (ISSUE 15).
+
+A hard rank death (SIGKILL, OOM, node loss) leaves every SURVIVOR
+blocked forever inside its next cross-process collective: on this
+backend the collectives execute synchronously inside the step-dispatch
+call, so the survivor's main thread parks in C with no Python signal
+delivery and no timeout. Nothing inside the process can unblock it —
+but a daemon thread can still OBSERVE it, because the blocked
+collective holds no GIL.
+
+:class:`HangWatchdog` is that thread. The engine brackets every region
+that can block on a peer — the step dispatch (the same interval the
+``train/host_step_s`` blocked-in-dispatch accounting already measures,
+ISSUE 12) and the boundary exchanges (cluster allgather, preemption
+agreement, snapshot commit fence) — with ``enter_dispatch(kind,
+step)`` / ``exit_dispatch()``: two plain attribute stores, no lock, no
+syscall. The daemon thread polls; a region blocked past
+``fault_tolerance.hang_deadline_s`` becomes:
+
+1. one ``rank_hang`` flight-recorder event + one LATCHED ``rank_dead``
+   watchdog dump (telemetry/anomaly.py) carrying the ring history that
+   led into the stall;
+2. ``os._exit(EXIT_HANG)`` — a DISTINCT exit code, because a normal
+   exit path would run atexit hooks and jax teardown that themselves
+   block on the dead collective. The supervisor
+   (runtime/elastic/supervisor.py) reads the code as "a peer of this
+   rank is gone/stuck", tears the world down and restarts it shrunk.
+
+The FIRST guarded region of each kind gets ``first_deadline_factor``
+(10×) the deadline instead of it: it contains the XLA compile (minutes
+on a cold cache), which is a stall with a progress bar, not a hang —
+but a peer that dies BEFORE this rank's first boundary region must
+still be detected eventually, so the first occurrence is slack, never
+exempt. From the second occurrence on, the plain deadline applies.
+
+The same thread writes this rank's **heartbeat file**
+(``<dir>/hb_rank<N>``) every ``heartbeat_interval_s``. The heartbeat
+covers the failure the dispatch guard cannot: a process frozen as a
+whole (SIGSTOP, a wedged interpreter) stops beating, and the
+supervisor's staleness check catches it. Conversely an in-collective
+hang KEEPS beating (the daemon thread is alive) — which is exactly why
+the blocked-in-dispatch guard exists. The two detectors are
+complementary, not redundant (docs/fault_tolerance.md has the matrix).
+
+Stdlib-only on purpose: the supervisor imports this module for the
+exit-code contract and must never pull jax into the launcher process
+(libtpu takes an exclusive per-process lock — see
+launcher/runner.py:_local_chip_count).
+"""
+
+import os
+import threading
+import time
+
+# Distinct process exit code for "collective stalled past the hang
+# deadline": the supervisor classifies it as a peer-loss incident
+# (this rank is a healthy DETECTOR, not the casualty). 40-range to
+# stay clear of shell (1/2/126/127) and signal (128+N) conventions.
+EXIT_HANG = 43
+
+
+def heartbeat_path(directory, rank):
+    return os.path.join(directory, f"hb_rank{int(rank)}")
+
+
+class HangWatchdog:
+    """See module docstring. Construct-and-forget: the daemon thread
+    starts immediately; ``stop()`` joins it (tests, clean shutdown —
+    a production trip never returns)."""
+
+    def __init__(self, deadline_s, poll_s=None, rank=0, world=1,
+                 watchdog=None, recorder=None, registry=None,
+                 heartbeat_dir=None, heartbeat_interval_s=1.0,
+                 restart_epoch=0, exit_fn=None,
+                 first_deadline_factor=10.0):
+        assert deadline_s > 0, deadline_s
+        self.deadline_s = float(deadline_s)  # sync-ok: host config scalar
+        # poll fast enough that detection lands well inside
+        # deadline + grace, slow enough to stay invisible in `top`
+        self.poll_s = float(poll_s) if poll_s \
+            else min(max(self.deadline_s / 10.0, 0.05),
+                     1.0)  # sync-ok: host config scalar
+        self.rank = int(rank)
+        self.world = int(world)
+        self.watchdog = watchdog
+        self.recorder = recorder
+        self.registry = registry
+        self.heartbeat_dir = heartbeat_dir or None
+        self.heartbeat_interval_s = float(
+            heartbeat_interval_s)  # sync-ok: host config scalar
+        self.restart_epoch = int(restart_epoch)
+        self.first_deadline_factor = max(
+            float(first_deadline_factor), 1.0)  # sync-ok: host cfg
+        self._exit_fn = exit_fn if exit_fn is not None else os._exit
+        self._dispatch = None        # (t_enter, kind, step, occurrence)
+        self._counts = {}            # kind -> occurrences seen
+        self.tripped = None          # detail dict once tripped
+        self._stop = threading.Event()
+        self._last_beat = 0.0
+        if self.heartbeat_dir:
+            os.makedirs(self.heartbeat_dir, exist_ok=True)
+            self._beat()             # exists before the first poll
+        self._thread = threading.Thread(
+            target=self._loop, name="dstpu-hang-watchdog", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------- engine-side marks
+    # Plain attribute stores (GIL-atomic): these run once per step on
+    # the hot path, so they must cost nothing measurable.
+
+    def enter_dispatch(self, kind="step", step=None):
+        n = self._counts.get(kind, 0) + 1
+        self._counts[kind] = n
+        self._dispatch = (time.monotonic(), kind, step, n)
+
+    def exit_dispatch(self):
+        self._dispatch = None
+
+    # --------------------------------------------------------- the loop
+
+    def _loop(self):
+        while not self._stop.wait(self.poll_s):
+            now = time.monotonic()
+            if self.heartbeat_dir and \
+                    now - self._last_beat >= self.heartbeat_interval_s:
+                self._beat()
+            d = self._dispatch
+            if d is None:
+                continue
+            t0, kind, step, occurrence = d
+            # the first region of each kind bears the XLA compile:
+            # slack (factor× deadline), never exempt — a peer dead
+            # before OUR first boundary region must still be caught
+            limit = self.deadline_s * (self.first_deadline_factor
+                                       if occurrence <= 1 else 1.0)
+            blocked = now - t0
+            if blocked > limit:
+                self._trip(kind, step, blocked, limit)
+                return
+
+    def _beat(self):
+        self._last_beat = time.monotonic()
+        try:
+            with open(heartbeat_path(self.heartbeat_dir, self.rank),
+                      "w") as fh:
+                fh.write(f"{time.time()} {os.getpid()} "
+                         f"{self.restart_epoch}\n")
+        except OSError:
+            pass                    # a torn hb dir must not kill training
+
+    def _trip(self, kind, step, blocked_s, limit_s=None):
+        """Latched conversion of an eternal hang into a reportable exit
+        (runs exactly once — the thread returns after)."""
+        limit_s = limit_s if limit_s is not None else self.deadline_s
+        self.tripped = {"kind": kind, "step": step,
+                        "blocked_s": blocked_s,
+                        "deadline_s": limit_s,
+                        "rank": self.rank,
+                        "restart_epoch": self.restart_epoch}
+        if self.recorder is not None:
+            self.recorder.record(
+                "rank_hang", rank=self.rank, step=step, region=kind,
+                blocked_s=blocked_s, deadline_s=limit_s,
+                restart_epoch=self.restart_epoch)
+        if self.registry is not None:
+            self.registry.counter("fault/hangs_detected").inc()
+        if self.watchdog is not None:
+            # the latched rank_dead dump: the ring history that led
+            # into the stall, written by THIS rank (the survivor) —
+            # the dead/hung peer can't write anything
+            self.watchdog.note_rank_dead(
+                rank=self.rank, reason="collective_hang", step=step,
+                blocked_s=blocked_s, deadline_s=limit_s,
+                restart_epoch=self.restart_epoch, world=self.world)
+        # drop the heartbeat so the supervisor can't mistake the
+        # window between our exit and its poll for a live rank
+        self._remove_heartbeat()
+        self._exit_fn(EXIT_HANG)
+
+    def _remove_heartbeat(self):
+        if self.heartbeat_dir:
+            try:
+                os.remove(heartbeat_path(self.heartbeat_dir, self.rank))
+            except OSError:
+                pass
+
+    def stop(self, remove_heartbeat=True):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        if remove_heartbeat:
+            self._remove_heartbeat()

@@ -1,0 +1,562 @@
+"""Launcher-level fault-tolerance supervisor (ISSUE 15).
+
+PR 7 made a *graceful* SIGTERM survivable (final snapshot inside the
+grace budget, elastic resume at a different world size). Everything
+harder — ``kill -9``, OOM, node loss, a rank wedged inside a
+collective — still ended the job: the dead rank's peers block forever
+inside gloo and nothing restarts them. This module closes that gap,
+the training-side twin of PR 11's replica-pool recovery:
+
+- :class:`Supervisor` spawns the world over the launcher env contract
+  (the same ``DSTPU_*`` rendezvous variables launcher/launch.py and
+  the PR-10 ``spawn_workers`` harness use, plus ``DSTPU_HEARTBEAT_DIR``
+  and ``DSTPU_RESTART_EPOCH``), then monitors two signals:
+
+  1. **child liveness** — a nonzero/killed exit is a rank death; the
+     distinct ``EXIT_HANG`` code (runtime/elastic/hang.py) marks a
+     HEALTHY rank that detected a peer stuck in a collective;
+  2. **heartbeat staleness** — each rank's hang-watchdog thread
+     rewrites ``hb_rank<N>`` every ``heartbeat_interval_s``; a file
+     gone stale past ``heartbeat_stale_s`` means the whole process
+     froze (SIGSTOP, wedged interpreter) without exiting.
+
+- on any incident it **tears down the survivors** (SIGTERM, then
+  SIGKILL after ``grace_kill_s`` — a rank blocked inside a dead
+  collective never runs its Python SIGTERM handler, and a rank parked
+  in ``time.sleep`` swallows it via the PreemptionHandler's flag-only
+  handler + PEP 475 retry, so the escalation is mandatory, not
+  polish), clears the heartbeat files, and **restarts the shrunk
+  world**: the next world size comes from the elasticity HCN ladder's
+  valid chip counts (``valid_worlds_from_elasticity``), so the
+  respawned engines' configs re-solve micro/grad-accum for W' and
+  PR 7's ``load_latest_valid``/``elastic_resume`` (snapshot
+  ``auto_resume``) continues the loss trajectory step-for-step.
+
+- restarts are **bounded**: jittered exponential backoff between
+  epochs, and after ``max_restarts`` incidents the supervisor writes
+  exactly one latched ``crash_loop`` watchdog dump and exits
+  ``EXIT_CRASH_LOOP`` — a world that dies every epoch must page a
+  human, not spin.
+
+Every transition lands in the flight recorder (``supervisor_spawn``,
+``rank_exit``, ``world_down``, ``restart``, ``crash_loop``) stamped
+with the ``restart_epoch``, so ``telemetry/view.py`` renders the
+die → detect → shrink → resume timeline from the supervisor's dump
+next to the workers' own ``rank_hang``/``resume`` events.
+
+This module must stay importable WITHOUT touching a jax backend: it
+runs in the launcher process, and on a TPU-VM libtpu takes an
+exclusive per-process lock (see launcher/runner.py:_local_chip_count)
+— a supervisor that initialized a backend would starve every worker it
+spawns. Imports are stdlib + the jax-free telemetry/elasticity planes.
+"""
+
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+from deepspeed_tpu.runtime.elastic.hang import (EXIT_HANG,
+                                                heartbeat_path)
+from deepspeed_tpu.utils.distributed import jittered_backoff
+from deepspeed_tpu.utils.logging import logger
+
+# the supervisor's own terminal exit: restart budget exhausted (or no
+# feasible world remains) — distinct from any worker code
+EXIT_CRASH_LOOP = 44
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def solve_next_world(world, lost, valid_worlds=None, min_world=1):
+    """The shrink policy: lose ``lost`` ranks, keep the largest world
+    the elasticity ladder can still batch for.
+
+    Returns the next world size, or None when nothing >= ``min_world``
+    is feasible (the supervisor treats that as terminal). Without a
+    ``valid_worlds`` list any size >= ``min_world`` is acceptable —
+    and a world already at the floor retries AT the floor (transient
+    single-host failures should not kill a 1-host job; the
+    ``max_restarts`` bound is what stops a deterministic crash)."""
+    target = world - max(int(lost), 1)
+    if valid_worlds is None:
+        return max(target, min_world)
+    cands = sorted({int(w) for w in valid_worlds
+                    if min_world <= int(w)})
+    below = [w for w in cands if w <= target]
+    if below:
+        return below[-1]
+    # nothing fits the shrunk target: retry at the largest valid size
+    # that the CURRENT world could run (in-place retry — the failure
+    # may be transient; the restart budget bounds the loop)
+    at_or_below = [w for w in cands if w <= world]
+    return at_or_below[-1] if at_or_below else None
+
+
+def valid_worlds_from_elasticity(param_dict, local_devices=1):
+    """Valid PROCESS counts for a ds-config with an ``elasticity``
+    block: the HCN ladder's valid chip counts divided by the chips
+    each process owns. Returns None (no constraint) when the block is
+    absent/disabled — the supervisor then shrinks arithmetically."""
+    from deepspeed_tpu import elasticity as el
+    if not el.elasticity_enabled(param_dict):
+        return None
+    _final, valid_chips = el.compute_elastic_config(param_dict)
+    n = max(int(local_devices), 1)
+    worlds = sorted({c // n for c in valid_chips if c % n == 0 and c >= n})
+    return worlds or None
+
+
+class Supervisor:
+    """See module docstring. ``cmd`` is the full worker argv (e.g.
+    ``[sys.executable, "train.py", ...]``); the supervisor adds only
+    environment, never arguments, so any script the PR-10
+    ``spawn_workers`` harness could run is supervisable unchanged."""
+
+    def __init__(self, cmd, world, *,
+                 heartbeat_dir, min_world=1, valid_worlds=None,
+                 hang_deadline_s=300.0, heartbeat_interval_s=1.0,
+                 heartbeat_stale_s=None, grace_kill_s=5.0,
+                 max_restarts=3, backoff_base_s=0.5, backoff_max_s=30.0,
+                 poll_s=0.1, coordinator_addr="127.0.0.1",
+                 local_devices=None, env=None, cwd=None, log_dir=None,
+                 rendezvous_retries=None, rendezvous_backoff_s=None,
+                 dump_dir=None, watchdog=None, recorder=None,
+                 registry=None, seed=0):
+        assert cmd, "need a worker command"
+        assert world >= 1, world
+        self.cmd = [str(c) for c in cmd]
+        self.world = int(world)
+        self.min_world = int(min_world)
+        self.valid_worlds = list(valid_worlds) if valid_worlds else None
+        self.heartbeat_dir = str(heartbeat_dir)
+        self.hang_deadline_s = float(hang_deadline_s)  # sync-ok: host cfg
+        self.heartbeat_interval_s = float(
+            heartbeat_interval_s)  # sync-ok: host config scalar
+        # staleness must tolerate a worker whose beat thread is starved
+        # by a GIL-holding compile — tie the default to the hang
+        # deadline, not the beat interval
+        self.heartbeat_stale_s = float(heartbeat_stale_s) \
+            if heartbeat_stale_s is not None \
+            else self.hang_deadline_s \
+            + 3 * self.heartbeat_interval_s  # sync-ok: host cfg
+        self.grace_kill_s = float(grace_kill_s)  # sync-ok: host cfg
+        self.max_restarts = int(max_restarts)
+        self.backoff_base_s = float(backoff_base_s)  # sync-ok: host cfg
+        self.backoff_max_s = float(backoff_max_s)  # sync-ok: host cfg
+        self.poll_s = float(poll_s)  # sync-ok: host cfg
+        self.coordinator_addr = coordinator_addr
+        self.local_devices = local_devices
+        self.env = dict(os.environ if env is None else env)
+        self.cwd = cwd
+        self.log_dir = log_dir or os.path.join(self.heartbeat_dir, "logs")
+        self.rendezvous_retries = rendezvous_retries
+        self.rendezvous_backoff_s = rendezvous_backoff_s
+        if recorder is None:
+            from deepspeed_tpu.telemetry.recorder import default_recorder
+            recorder = default_recorder()
+        self.recorder = recorder
+        if registry is None:
+            from deepspeed_tpu.telemetry.registry import default_registry
+            registry = default_registry()
+        self.registry = registry
+        if watchdog is None and dump_dir:
+            from deepspeed_tpu.telemetry.anomaly import Watchdog
+            watchdog = Watchdog(dump_dir, recorder=self.recorder,
+                                registry=self.registry,
+                                source="supervisor")
+        self.watchdog = watchdog
+        self._rng = random.Random(seed)
+        self.restart_epoch = 0
+        self.restarts = 0
+        self.incidents = []          # one dict per detected incident
+        self.log_paths = {}          # (epoch, rank) -> log file path
+        self.procs = {}              # rank -> Popen (current epoch)
+        self._logs_open = []
+        os.makedirs(self.heartbeat_dir, exist_ok=True)
+        os.makedirs(self.log_dir, exist_ok=True)
+
+    # ------------------------------------------------------------- spawn
+
+    def _child_env(self, rank, world, port):
+        env = dict(self.env)
+        env.update({
+            "DSTPU_COORDINATOR_ADDR": self.coordinator_addr,
+            "DSTPU_COORDINATOR_PORT": str(port),
+            "DSTPU_NUM_PROCESSES": str(world),
+            "DSTPU_PROCESS_ID": str(rank),
+            "DSTPU_HEARTBEAT_DIR": self.heartbeat_dir,
+            "DSTPU_RESTART_EPOCH": str(self.restart_epoch),
+        })
+        env.pop("DSTPU_LOCAL_DEVICE_IDS", None)
+        if self.rendezvous_retries is not None:
+            env["DSTPU_RENDEZVOUS_RETRIES"] = str(self.rendezvous_retries)
+        if self.rendezvous_backoff_s is not None:
+            env["DSTPU_RENDEZVOUS_BACKOFF_S"] = \
+                str(self.rendezvous_backoff_s)
+        if self.local_devices:
+            # CPU-harness shape (the spawn_workers contract): N virtual
+            # devices per process; a real TPU host ignores this. Any
+            # inherited device-count flag is REPLACED — the parent's
+            # harness count (e.g. conftest's 8) times the world would
+            # otherwise inflate the global mesh
+            import re
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "",
+                env.get("XLA_FLAGS", ""))
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{self.local_devices}").strip()
+        return env
+
+    def _spawn(self, world):
+        port = _free_port()
+        self.procs = {}
+        for rank in range(world):
+            log_path = os.path.join(
+                self.log_dir,
+                f"epoch{self.restart_epoch}_rank{rank}.log")
+            self.log_paths[(self.restart_epoch, rank)] = log_path
+            fh = open(log_path, "w")
+            self._logs_open.append(fh)
+            self.procs[rank] = subprocess.Popen(
+                self.cmd, env=self._child_env(rank, world, port),
+                cwd=self.cwd, stdout=fh, stderr=subprocess.STDOUT)
+        self.recorder.record(
+            "supervisor_spawn", world=world,
+            restart_epoch=self.restart_epoch, port=port,
+            pids=[p.pid for p in self.procs.values()])
+        self.registry.gauge("fault/restart_epoch").set(self.restart_epoch)
+        self.registry.gauge("fault/world_size").set(world)
+        logger.info(f"[supervisor] epoch {self.restart_epoch}: spawned "
+                    f"world={world} (coordinator :{port})")
+
+    # ----------------------------------------------------------- monitor
+
+    @staticmethod
+    def _classify(rc):
+        if rc == EXIT_HANG:
+            return "hang_detected"
+        if rc < 0:
+            return f"signal:{-rc}"
+        return f"exit:{rc}"
+
+    def _stale_ranks(self, live):
+        """Ranks whose heartbeat file exists but stopped moving. A
+        worker that never wrote one (fault_tolerance off) is simply
+        unmonitored — absence is not evidence of death."""
+        now = time.time()
+        stale = []
+        for rank in live:
+            path = heartbeat_path(self.heartbeat_dir, rank)
+            try:
+                age = now - os.path.getmtime(path)
+            except OSError:
+                continue
+            if age > self.heartbeat_stale_s:
+                stale.append((rank, age))
+        return stale
+
+    def _teardown(self, survivors):
+        """SIGTERM → grace → SIGKILL → reap. The escalation is
+        load-bearing: a survivor blocked inside a dead collective
+        never runs a Python signal handler, and the engine's
+        PreemptionHandler swallows SIGTERM into a flag (PEP 475
+        restarts the interrupted sleep), so SIGTERM alone can strand
+        both shapes forever."""
+        t0 = time.time()
+        alive = [p for p in survivors if p.poll() is None]
+        if not alive:
+            return    # nothing to tear down: the run()-exit sweep on a
+            #           clean/already-reaped world must not feed a ~0s
+            #           sample into the per-INCIDENT teardown histogram
+        for p in alive:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        deadline = time.time() + self.grace_kill_s
+        while time.time() < deadline and \
+                any(p.poll() is None for p in alive):
+            time.sleep(min(self.poll_s, 0.05))
+        for p in alive:
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+        for p in alive:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                logger.warning(f"[supervisor] pid {p.pid} survived "
+                               f"SIGKILL reap window")
+        self.registry.histogram("fault/teardown_s").observe(
+            time.time() - t0)
+
+    def _clear_heartbeats(self):
+        try:
+            names = os.listdir(self.heartbeat_dir)
+        except OSError:
+            return
+        for name in names:
+            if not name.startswith("hb_rank"):
+                continue
+            try:
+                # per-file: one racing unlink (a straggler child dying
+                # mid-sweep) must not abandon the rest — a stale file
+                # left behind would fake a heartbeat_stale incident
+                # against the NEXT epoch's healthy rank
+                os.remove(os.path.join(self.heartbeat_dir, name))
+            except OSError:
+                pass
+
+    def _close_logs(self):
+        for fh in self._logs_open:
+            try:
+                fh.close()
+            except OSError:
+                pass
+        self._logs_open = []
+
+    def emergency_teardown(self, signum=None):
+        """Public signal-time teardown: kill the world, clear the
+        heartbeat files, close log handles — the one sequence both the
+        supervisor CLI's and launcher/launch.py's SIGTERM/SIGINT
+        handlers invoke (one copy, so it cannot diverge). Returns the
+        conventional 128+signum exit code (or 1)."""
+        self._teardown(list(self.procs.values()))
+        self._clear_heartbeats()
+        self._close_logs()
+        return 128 + signum if signum else 1
+
+    def install_signal_handlers(self):
+        """SIGTERM/SIGINT → emergency_teardown + exit. Call from the
+        process that OWNS this supervisor (the CLI, a supervising
+        launcher) — not from library/test embedders, which keep their
+        own handlers."""
+        def _forward(signum, _frame):
+            logger.warning(f"[supervisor] signal {signum}: tearing "
+                           f"the world down")
+            sys.exit(self.emergency_teardown(signum))
+
+        signal.signal(signal.SIGTERM, _forward)
+        signal.signal(signal.SIGINT, _forward)
+
+    # --------------------------------------------------------------- run
+
+    def run(self, deadline_s=None):
+        """Supervise until the world exits clean (returns 0) or the
+        restart budget is spent (returns ``EXIT_CRASH_LOOP``).
+        ``deadline_s`` bounds the whole supervision wall clock — on
+        expiry everything is torn down and TimeoutError raises (a
+        harness guard; production runs leave it None)."""
+        t_start = time.time()
+        self._spawn(self.world)
+        try:
+            while True:
+                time.sleep(self.poll_s)
+                if deadline_s is not None \
+                        and time.time() - t_start > deadline_s:
+                    raise TimeoutError(
+                        f"supervision exceeded {deadline_s}s "
+                        f"(epoch {self.restart_epoch})")
+                rcs = {r: p.poll() for r, p in self.procs.items()}
+                dead = [(r, rc) for r, rc in rcs.items()
+                        if rc is not None and rc != 0]
+                if not dead:
+                    if all(rc == 0 for rc in rcs.values()):
+                        self._clear_heartbeats()
+                        logger.info(
+                            f"[supervisor] world exited clean after "
+                            f"{self.restarts} restart(s)")
+                        return 0
+                    live = [r for r, rc in rcs.items() if rc is None]
+                    stale = self._stale_ranks(live)
+                    if not stale:
+                        continue
+                    dead = [(r, None) for r, _age in stale]
+                    reasons = {r: f"heartbeat_stale:{age:.1f}s"
+                               for r, age in stale}
+                else:
+                    reasons = {r: self._classify(rc) for r, rc in dead}
+                code = self._incident(dead, reasons)
+                if code is not None:
+                    return code
+        finally:
+            # whatever path exits, never leave orphans or stale state
+            self._teardown(list(self.procs.values()))
+            self._clear_heartbeats()
+            self._close_logs()
+
+    def _incident(self, dead, reasons):
+        """One rank-death/hang/freeze incident: record, tear down,
+        shrink, back off, respawn — or, past the budget, latch the
+        ``crash_loop`` dump and return the terminal exit code."""
+        detect_ts = time.time()
+        for rank, rc in dead:
+            self.recorder.record(
+                "rank_exit", rank=rank, exit_code=rc,
+                reason=reasons[rank], restart_epoch=self.restart_epoch,
+                world=len(self.procs))
+            logger.warning(f"[supervisor] rank {rank} down "
+                           f"({reasons[rank]}), epoch "
+                           f"{self.restart_epoch}")
+        # casualties: ranks genuinely lost. A rank exiting EXIT_HANG is
+        # a healthy DETECTOR reporting a stuck peer — if only detectors
+        # exited, exactly the undetected peer(s) are the loss, floor 1.
+        casualties = [r for r, _ in dead
+                      if not reasons[r].startswith("hang_detected")]
+        n_lost = len(casualties) if casualties else 1
+        self.registry.counter("fault/rank_deaths").inc(n_lost)
+        first = casualties[0] if casualties else dead[0][0]
+        if self.watchdog is not None:
+            self.watchdog.note_rank_dead(
+                rank=first, reason=reasons[first],
+                exit_code=dict(dead).get(first),
+                restart_epoch=self.restart_epoch,
+                world=len(self.procs))
+        survivors = [p for r, p in self.procs.items() if p.poll() is None]
+        self._teardown(list(self.procs.values()))
+        self.recorder.record(
+            "world_down", restart_epoch=self.restart_epoch,
+            survivors_torn_down=len(survivors), lost=n_lost)
+        self._clear_heartbeats()
+        self._close_logs()
+        world_now = len(self.procs)
+        incident = {"epoch": self.restart_epoch, "dead": dict(dead),
+                    "reasons": dict(reasons), "lost": n_lost,
+                    "detect_ts": detect_ts, "world": world_now}
+        self.incidents.append(incident)
+
+        next_world = solve_next_world(
+            world_now, n_lost, valid_worlds=self.valid_worlds,
+            min_world=self.min_world)
+        if self.restarts >= self.max_restarts or next_world is None:
+            why = "no_feasible_world" if next_world is None \
+                else reasons[dead[0][0]]
+            self.recorder.record(
+                "crash_loop", restarts=self.restarts,
+                max_restarts=self.max_restarts, world=world_now,
+                last_reason=why)
+            if self.watchdog is not None:
+                self.watchdog.note_crash_loop(
+                    restarts=self.restarts,
+                    max_restarts=self.max_restarts, world=world_now,
+                    last_reason=why)
+            logger.error(
+                f"[supervisor] crash loop: {self.restarts} restart(s) "
+                f"spent (max {self.max_restarts}), last incident "
+                f"{why}; giving up")
+            return EXIT_CRASH_LOOP
+
+        backoff = jittered_backoff(self.backoff_base_s, self.restarts,
+                                   cap_s=self.backoff_max_s,
+                                   rng=self._rng.random)
+        self.restarts += 1
+        self.restart_epoch += 1
+        self.recorder.record(
+            "restart", restart_epoch=self.restart_epoch,
+            world_from=world_now, world_to=next_world,
+            backoff_s=backoff, restarts=self.restarts,
+            reason=reasons[dead[0][0]])
+        self.registry.counter("fault/restarts").inc()
+        self.registry.histogram("fault/backoff_s").observe(backoff)
+        logger.warning(
+            f"[supervisor] restarting: world {world_now} -> "
+            f"{next_world}, epoch {self.restart_epoch}, backoff "
+            f"{backoff:.2f}s ({self.restarts}/{self.max_restarts})")
+        time.sleep(backoff)
+        if self.watchdog is not None:
+            self.watchdog.note_world_ok()   # next incident = new episode
+        self.world = next_world
+        self._spawn(next_world)
+        return None
+
+
+def main(argv=None):
+    import argparse
+    import json
+    ap = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.runtime.elastic.supervisor",
+        description="fault-tolerant multi-process training supervisor "
+        "(ISSUE 15): spawn a local world over the DSTPU env contract, "
+        "restart it shrunk-and-resumed on rank death/hang, bounded by "
+        "--max_restarts")
+    ap.add_argument("--world", type=int, default=1)
+    ap.add_argument("--min_world", type=int, default=1)
+    ap.add_argument("--max_restarts", type=int, default=3)
+    ap.add_argument("--hang_deadline", type=float, default=300.0)
+    ap.add_argument("--heartbeat_dir", type=str, required=True)
+    ap.add_argument("--dump_dir", type=str, default="")
+    ap.add_argument("--grace_kill", type=float, default=5.0)
+    ap.add_argument("--backoff_base", type=float, default=0.5)
+    ap.add_argument("--backoff_max", type=float, default=30.0)
+    ap.add_argument("--local_devices", type=int, default=0,
+                    help="devices each process owns: on the CPU "
+                    "harness it also sets the per-process virtual "
+                    "device count; on a real host pass the chips per "
+                    "worker so the elasticity shrink ladder counts "
+                    "CHIPS, not processes (unset + --ds_config → "
+                    "unconstrained arithmetic shrink, with a warning)")
+    ap.add_argument("--ds_config", type=str, default="",
+                    help="ds-config JSON: its elasticity block "
+                    "constrains the shrink ladder, its fault_tolerance "
+                    "block supplies rendezvous-retry knobs for workers")
+    ap.add_argument("training_script", type=str)
+    ap.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+
+    valid = None
+    rdv_retries = rdv_backoff = None
+    if args.ds_config:
+        with open(args.ds_config) as fh:
+            pd = json.load(fh)
+        if args.local_devices:
+            valid = valid_worlds_from_elasticity(
+                pd, local_devices=args.local_devices)
+        else:
+            # the ladder counts CHIPS; without the per-process chip
+            # count a process-world ladder would be wrong on any
+            # multi-chip host (world 6 × 4 chips = 24 is not on a
+            # {1,2,3,4,6,8,12} ladder) — shrink arithmetically and let
+            # the engines' own elasticity solve reject infeasible
+            # worlds loudly
+            logger.warning(
+                "--ds_config given without --local_devices: cannot "
+                "derive the chip-valid shrink ladder (unknown chips "
+                "per process); restarts shrink arithmetically")
+        ft = pd.get("fault_tolerance") or {}
+        rdv_retries = ft.get("rendezvous_retries")
+        rdv_backoff = ft.get("rendezvous_backoff_s")
+
+    sup = Supervisor(
+        [sys.executable, "-u", args.training_script]
+        + args.training_script_args,
+        args.world, min_world=args.min_world, valid_worlds=valid,
+        heartbeat_dir=args.heartbeat_dir,
+        dump_dir=args.dump_dir or None,
+        hang_deadline_s=args.hang_deadline,
+        grace_kill_s=args.grace_kill,
+        max_restarts=args.max_restarts,
+        backoff_base_s=args.backoff_base,
+        backoff_max_s=args.backoff_max,
+        local_devices=args.local_devices or None,
+        rendezvous_retries=rdv_retries,
+        rendezvous_backoff_s=rdv_backoff)
+
+    sup.install_signal_handlers()
+    return sup.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -375,6 +375,40 @@ class DeepSpeedEngine:
                 grace_s=self._snap_cfg.grace_secs,
                 recorder=self.flight_recorder)
 
+        # -- collective hang watchdog + heartbeat (runtime/elastic/hang,
+        # ISSUE 15): a daemon thread riding the same blocked-in-dispatch
+        # interval the train/host_step_s accounting measures — a
+        # collective stalled past fault_tolerance.hang_deadline_s
+        # becomes one latched rank_dead dump + a distinct EXIT_HANG
+        # exit instead of an eternal hang; the thread also rewrites
+        # this rank's heartbeat file for the launcher-level supervisor.
+        # restart_epoch (stamped by the supervisor into child envs) is
+        # breadcrumbed into the ring so view.py can stitch the
+        # die → detect → shrink → resume timeline across epochs.
+        self._hangdog = None
+        self._fence_ref = None   # the last step's loss array: the
+        #                          pre-boundary-collective fence target
+        self._fenced_step = None  # step already fenced (once per step)
+        self._restart_epoch = int(
+            os.environ.get("DSTPU_RESTART_EPOCH", "0") or 0)
+        if self._restart_epoch:
+            self.flight_recorder.record(
+                "restart_epoch", epoch=self._restart_epoch,
+                world=jax.process_count())
+        ftc = self._config.fault_tolerance_config
+        if ftc.enabled:
+            from deepspeed_tpu.runtime.elastic.hang import HangWatchdog
+            hb_dir = ftc.heartbeat_dir \
+                or os.environ.get("DSTPU_HEARTBEAT_DIR") or None
+            self._hangdog = HangWatchdog(
+                deadline_s=ftc.hang_deadline_s,
+                poll_s=ftc.hang_poll_s or None,
+                rank=_process_index(), world=jax.process_count(),
+                watchdog=self.watchdog, recorder=self.flight_recorder,
+                registry=self.telemetry, heartbeat_dir=hb_dir,
+                heartbeat_interval_s=ftc.heartbeat_interval_s,
+                restart_epoch=self._restart_epoch)
+
         # ZeRO-Offload: optimizer state + fp32 master on host (cpu) or NVMe
         self._offload_cfg = self._config.zero_config.offload_optimizer
         self._host_runner = None
@@ -802,6 +836,76 @@ class DeepSpeedEngine:
         self.telemetry.histogram("swap/park_s").observe(
             time.perf_counter() - t0)
 
+    # -- collective hang guard (runtime/elastic/hang, ISSUE 15) ------------
+    # Every region that can block on a PEER process — the step dispatch
+    # plus the boundary exchanges (cluster allgather, preemption
+    # agreement) — is bracketed so the hang watchdog can tell "blocked
+    # on a dead/stuck peer" from "idle between steps". Two attribute
+    # stores per call; the first region of each kind is compile-exempt.
+
+    def _guard_enter(self, kind, step=None):
+        if self._hangdog is not None:
+            self._hangdog.enter_dispatch(kind, step)
+
+    def _guard_exit(self):
+        if self._hangdog is not None:
+            self._hangdog.exit_dispatch()
+
+    def stop_fault_tolerance(self):
+        """Stop the hang-watchdog daemon thread and remove this rank's
+        heartbeat file. Engines have no general teardown hook, so a
+        process that builds SEVERAL fault_tolerance-enabled engines
+        (sequential jobs, test loops) should call this on each retired
+        engine — otherwise every retired engine's thread keeps polling
+        and rewriting the same heartbeat file. Called automatically
+        when a preemption finalizes (the engine trains no further)."""
+        if self._hangdog is not None:
+            self._hangdog.stop()
+            self._hangdog = None
+
+    def _fence_step_program(self):
+        """Multi-process only: block until the just-dispatched step
+        program — and with it every IN-program cross-process collective
+        — has completed, before any OUT-of-program collective runs at
+        the boundary (the preemption agreement, the snapshot barriers).
+        Two XLA programs' gloo ops interleave on the same TCP pair when
+        the first is still in flight as the second dispatches (observed
+        as ``gloo EnforceNotMet: op.preamble.length <= op.nbytes`` —
+        one rank's boundary allgather recv met the peer's still-flowing
+        step psum). The loss output alone is NOT a sufficient fence:
+        output buffers become ready per-chain and the loss chain does
+        not depend on the grad allreduce, so waiting on the loss can
+        pass while the update collectives still flow — the fence waits
+        on the UPDATED state leaves (each downstream of its own grad
+        exchange) plus the loss. Leaves already parked/donated are
+        skipped: their chains completed before the park could run. The
+        wait itself is guarded — a peer that died mid-step parks us
+        HERE, and the hang watchdog must see it. Latched per step:
+        a boundary that is commit + agreement + cluster exchange at
+        once fences the leaf tree exactly once."""
+        if jax.process_count() == 1:
+            return
+        if self._fenced_step == self.global_steps:
+            return
+        self._fenced_step = self.global_steps
+        self._guard_enter("fence", self.global_steps)
+        try:
+            leaves = [] if self.state is None else \
+                jax.tree_util.tree_leaves(
+                    (self.state.params, self.state.opt_state))
+            if self._fence_ref is not None:
+                leaves.append(self._fence_ref)
+            for leaf in leaves:
+                if getattr(leaf, "is_deleted", None) \
+                        and leaf.is_deleted():
+                    continue
+                try:
+                    jax.block_until_ready(leaf)  # sync-ok: boundary
+                except Exception:                # fence
+                    pass    # a just-donated buffer's chain is done
+        finally:
+            self._guard_exit()
+
     # -- elastic snapshots + preemption (runtime/elastic, ISSUE 7) ---------
     def _make_snapshotter(self):
         """The async snapshotter, on its OWN dedicated write-behind aio
@@ -893,6 +997,10 @@ class DeepSpeedEngine:
         if not self._snap_cfg.enabled:
             return
         if self._snapshotter is not None and self._snapshotter.in_flight:
+            # the finalize path's _sync barriers (and the commit-fence
+            # cluster exchange below) are OUT-of-program collectives:
+            # the just-dispatched step program must be done first
+            self._fence_step_program()
             _, stall = self._snapshotter.finalize()
             # stall observations happen ONLY at commit fences: feeding
             # zeros on the 99 in-between steps would pin the watchdog's
@@ -915,10 +1023,14 @@ class DeepSpeedEngine:
                 # K-CONSECUTIVE-fences debounce by itself (the rule
                 # skips NaN ranks). This fence aggregates the fresh
                 # ckpt stall; step-time skew belongs to boundaries.
-                self._cluster.exchange_from_registry(
-                    step=self.global_steps,
-                    overrides={"step_time_s": None,
-                               "ckpt_stall_s": stall})
+                self._guard_enter("exchange", self.global_steps)
+                try:
+                    self._cluster.exchange_from_registry(
+                        step=self.global_steps,
+                        overrides={"step_time_s": None,
+                                   "ckpt_stall_s": stall})
+                finally:
+                    self._guard_exit()
                 self._tel_last_fence_ts = time.time()
                 # NO window re-stamp here (unlike the boundary
                 # exchange): this fence sits mid-window and moving t0
@@ -949,6 +1061,11 @@ class DeepSpeedEngine:
             preempt_now = self._preemption is not None \
                 and self._preemption.requested
         else:
+            if at_boundary:
+                # the agreement allgather + the snapshot path's _sync
+                # barriers must not race the step program's own gloo
+                # ops (see _fence_step_program)
+                self._fence_step_program()
             preempt_now = at_boundary and self._preempt_agreed()
         if preempt_now:
             self._preempt_finalize()
@@ -973,8 +1090,12 @@ class DeepSpeedEngine:
         if pre is None:
             return False
         from jax.experimental import multihost_utils
-        flags = multihost_utils.process_allgather(  # sync-ok: boundary
-            np.asarray([pre.requested], np.float64))   # agreement
+        self._guard_enter("exchange", self.global_steps)
+        try:
+            flags = multihost_utils.process_allgather(  # sync-ok: boundary
+                np.asarray([pre.requested], np.float64))   # agreement
+        finally:
+            self._guard_exit()
         agreed = bool(np.any(flags))
         if agreed:
             if not pre.requested:
@@ -1017,6 +1138,8 @@ class DeepSpeedEngine:
             logger.warning("preemption grace budget already spent; "
                            "keeping the previous snapshot")
         self.preempted = True
+        self.stop_fault_tolerance()   # no further training: retire the
+        #                               watchdog thread + heartbeat
         self.flight_recorder.record(
             "preempt", step=self.global_steps, snapshotted=snapshotted,
             tag=tag, source=pre.source, remaining_s=pre.remaining())
@@ -2466,23 +2589,35 @@ class DeepSpeedEngine:
         # straggler's delay (backends that execute cross-process
         # collectives synchronously block right here), so host_step_s
         # excludes it — what remains is rank-attributable host work.
+        # ISSUE 15: the hang_in_collective fault point sits BEFORE the
+        # dispatch guard — the injected rank models "stuck elsewhere"
+        # (its own watchdog sees no dispatch, its heartbeat keeps
+        # beating), while its PEERS block inside the collective below
+        # and their guard converts the stall into EXIT_HANG.
+        _faults.fire("collective_enter", step=step_idx, engine=self)
         _t_disp = time.perf_counter()
-        with tel_span("train/step_dispatch", self.telemetry):
-            if self._host_runner is not None:
-                metrics = self._host_offload_step(batch)
-            elif self.wall_clock_breakdown() and not (
-                    self._compressed_comm_active()
-                    or self._sparse_grad_active()
-                    or self._overlap_comm_active()
-                    or self._prefetch_active()):
-                # (1-bit / CSR / overlap paths keep their fused shard_map
-                # programs — their comm scheduling lives inside the step
-                # and cannot be split into phase programs)
-                metrics = self._train_batch_instrumented(batch)
-            else:
-                self.state, metrics = self._jit_train_batch(
-                    self.state, batch, self._next_rng())
+        self._guard_enter("step", step_idx)
+        try:
+            with tel_span("train/step_dispatch", self.telemetry):
+                if self._host_runner is not None:
+                    metrics = self._host_offload_step(batch)
+                elif self.wall_clock_breakdown() and not (
+                        self._compressed_comm_active()
+                        or self._sparse_grad_active()
+                        or self._overlap_comm_active()
+                        or self._prefetch_active()):
+                    # (1-bit / CSR / overlap paths keep their fused
+                    # shard_map programs — their comm scheduling lives
+                    # inside the step and cannot be split into phase
+                    # programs)
+                    metrics = self._train_batch_instrumented(batch)
+                else:
+                    self.state, metrics = self._jit_train_batch(
+                        self.state, batch, self._next_rng())
+        finally:
+            self._guard_exit()
         self._tel_window_dispatch_s += time.perf_counter() - _t_disp
+        self._fence_ref = metrics["loss"]
         self.tput_timer.stop()
 
         gas = self.gradient_accumulation_steps()
@@ -2850,7 +2985,17 @@ class DeepSpeedEngine:
         batch = self._globalize_batch(batch)
         if self.wall_clock_breakdown():
             self.timers(FORWARD_MICRO_TIMER).start()
-        loss, grads = self._jit_micro_grads(self.state, batch, self._next_rng())
+        # the micro program's loss reduction is a cross-process
+        # collective on a dp mesh — guard it like the fused dispatch
+        # (a dead peer parks this call forever otherwise, ISSUE 15).
+        # Own kind: each jitted program gets its own first-occurrence
+        # compile allowance
+        self._guard_enter("micro", self.global_steps)
+        try:
+            loss, grads = self._jit_micro_grads(self.state, batch,
+                                                self._next_rng())
+        finally:
+            self._guard_exit()
         if self.wall_clock_breakdown():
             self.timers(FORWARD_MICRO_TIMER).stop()
         self._pending_loss = loss
@@ -2890,13 +3035,20 @@ class DeepSpeedEngine:
         self.flight_recorder.set_step(self.global_steps)
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).start()
-        if self._host_runner is not None:
-            metrics = self._host_apply_grads(self._pending_grads,
-                                             self._accum_loss)
-        else:
-            self.state, metrics = self._jit_apply_grads(self.state,
-                                                        self._pending_grads,
-                                                        self._accum_loss)
+        # own kind ("apply", not "step"): _jit_apply_grads compiles on
+        # ITS first dispatch — sharing the fused path's kind would
+        # spend the compile allowance on the wrong program
+        self._guard_enter("apply", self.global_steps)
+        try:
+            if self._host_runner is not None:
+                metrics = self._host_apply_grads(self._pending_grads,
+                                                 self._accum_loss)
+            else:
+                self.state, metrics = self._jit_apply_grads(
+                    self.state, self._pending_grads, self._accum_loss)
+        finally:
+            self._guard_exit()
+        self._fence_ref = metrics["loss"]
         self._pending_grads = None
         self._accum_loss = None
         self.global_steps += 1
@@ -3125,10 +3277,20 @@ class DeepSpeedEngine:
         # just-closed window's step time is threaded directly (the
         # process-wide registry may hold another engine's history).
         if self._cluster is not None:
-            self._cluster.exchange_from_registry(
-                loss=lval, step=self.global_steps,
-                overrides={"step_time_s": self._cluster_step_value(),
-                           "swap_stall_s": stall if have_swap else None})
+            # the loss readback above is NOT a sufficient fence for the
+            # exchange: the loss chain is independent of the grad
+            # allreduces, so the step program's collectives can still
+            # be in flight (see _fence_step_program)
+            self._fence_step_program()
+            self._guard_enter("exchange", self.global_steps)
+            try:
+                self._cluster.exchange_from_registry(
+                    loss=lval, step=self.global_steps,
+                    overrides={"step_time_s": self._cluster_step_value(),
+                               "swap_stall_s": stall if have_swap
+                               else None})
+            finally:
+                self._guard_exit()
             # re-open the window AFTER the exchange (same rule as the
             # fold's MFU-pricing re-stamp): the allgather blocks until
             # the SLOWEST rank arrives, and charging that wait to the

@@ -56,7 +56,11 @@ fault point — the gathered bytes died with the transport) replays the
 request from its wire doc: the committed stream becomes the admission
 prompt, so greedy (and, with PR-14's persisted ``sample_key``, sampled)
 decoding regenerates the identical continuation. Bounded by
-``max_handoff_retries``.
+``max_handoff_retries``. A crash INSIDE delivery (``serving_deliver``,
+ISSUE 15 satellite — the decode pool already admitted the packet's
+pages) additionally unwinds the admission in ``deliver_handoff``
+before the same replay, so the pool never leaks the pages of a
+half-delivered request.
 """
 
 import time
@@ -158,24 +162,43 @@ def deliver_handoff(dcb, packet: HandoffPacket,
     n_data = int(doc["n_data_pages"])
     shared = 0
     cache = dcb.cache
+    plan = None
     if dedupe and dcb.prefix_cache:
         plan = cache.admit_prefix(slot_id, prompt_np, total, cow=False)
         if plan is None:
             return None
         pages = plan.pages
         shared = plan.start_pos // cache.spec.page_size
-        cache.register_prefix(slot_id, prompt_np, hashes=plan.hashes)
     else:
         pages = cache.admit(slot_id, total)
         if pages is None:
             return None
-    # one scatter per pool component writes the non-shared data pages
-    cache.scatter_block_kv(pages[shared:n_data], packet.kv,
-                           src_offset=shared)
-    req = packet.req if packet.req is not None \
-        else elastic.resume_request(doc)
-    dcb.adopt_request(slot_id, req, int(doc["pos"]),
-                      int(doc["last_tok"]))
+    # From here pages are ADMITTED (allocated/increffed into slot_id's
+    # table): any failure before adoption completes must UNWIND the
+    # admission — decref the pages and clear the slot — or the pool
+    # leaks them until restart (the PR-14 review bug, ISSUE 15
+    # satellite). The ``serving_deliver`` fault point models the
+    # delivery side dying right inside that window. Prefix
+    # registration happens only AFTER the scatter wrote the blocks, so
+    # an unwound delivery can never leave index entries pointing at
+    # never-written pages.
+    try:
+        faults.fire("serving_deliver", rid=packet.rid, slot=slot_id)
+        # one scatter per pool component writes the non-shared data
+        # pages
+        cache.scatter_block_kv(pages[shared:n_data], packet.kv,
+                               src_offset=shared)
+        if plan is not None:
+            cache.register_prefix(slot_id, prompt_np, hashes=plan.hashes)
+        req = packet.req if packet.req is not None \
+            else elastic.resume_request(doc)
+        dcb.adopt_request(slot_id, req, int(doc["pos"]),
+                          int(doc["last_tok"]))
+    except BaseException:
+        cache.release(slot_id)
+        slot = dcb.slots[slot_id]
+        slot.request, slot.pos, slot.last_tok = None, -1, 0
+        raise
     return slot_id
 
 
@@ -451,19 +474,28 @@ class DisaggRouter:
                 range(len(self.decode_engines)), key=lambda i:
                 -self.decode_engines[i].cache.available_pages)
             slot = None
+            crashed = None
             for di in order:
-                # no crash modeling here: the serving_handoff fault
-                # point fires at extract (the bytes-in-flight window);
-                # a failure INSIDE delivery would have to unwind the
-                # pages admit already allocated — the cross-process
-                # transport owes that path when it lands
-                slot = deliver_handoff(self.decode_engines[di], packet,
-                                       dedupe=self.dedupe_pages)
+                # the serving_deliver fault point (ISSUE 15 satellite)
+                # fires INSIDE delivery, after the decode pool admitted
+                # the packet's pages — deliver_handoff unwinds the
+                # admission before re-raising, so the pool cannot leak;
+                # the router replays the request from its wire doc like
+                # a transport crash (the gathered bytes are suspect)
+                try:
+                    slot = deliver_handoff(self.decode_engines[di],
+                                           packet,
+                                           dedupe=self.dedupe_pages)
+                except faults.SimulatedCrash as e:
+                    crashed = e
+                    break
                 if slot is not None:
                     self.stats["handoffs"] += 1
                     self.metrics.counter("router/handoffs").inc()
                     break
-            if slot is None:
+            if crashed is not None:
+                self._requeue_lost_packet(packet, crashed)
+            elif slot is None:
                 still.append(packet)   # waiting on a decode slot/pages
         self._packets = still
         self._note_inflight()

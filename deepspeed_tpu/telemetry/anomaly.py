@@ -23,7 +23,12 @@ existing fence point:
 - ``observe_ckpt_stall(s)`` / ``note_ckpt_corrupt()`` /
   ``note_preempt()`` — the elastic snapshot layer (ISSUE 7): the
   commit-fence stall timer the engine already keeps, resume-time
-  validation failures, and the preemption incident itself.
+  validation failures, and the preemption incident itself;
+- ``note_rank_dead()`` / ``note_crash_loop()`` — the fault-tolerance
+  plane (ISSUE 15): a rank's hard death or hung collective as
+  observed by the hang watchdog (runtime/elastic/hang.py) or the
+  launcher-level supervisor (runtime/elastic/supervisor.py), and the
+  terminal exhausted-restart-budget incident.
 
 Outlier rules keep a rolling baseline of recent NORMAL observations
 (anomalous values never pollute their own baseline) and trip when a
@@ -189,6 +194,8 @@ class Watchdog:
         self._pool_tripped = False
         self._ckpt_corrupt_tripped = False
         self._preempt_tripped = False
+        self._rank_dead_tripped = False
+        self._crash_loop_tripped = False
         self._rules = {
             "step_time_outlier": RollingOutlierRule(
                 "step_time_outlier", factor=step_time_factor,
@@ -355,6 +362,52 @@ class Watchdog:
         a SECOND kill later in the same process must dump again —
         unlike training, where one preemption ends the process)."""
         self._preempt_tripped = False
+
+    def note_rank_dead(self, rank=None, reason=None, step=None,
+                       exit_code=None, blocked_s=None, deadline_s=None,
+                       restart_epoch=None, world=None):
+        """A rank left the world uncleanly (ISSUE 15): a hard death the
+        supervisor observed (SIGKILL/OOM/node loss, ``reason``
+        carrying the exit classification), or — fired from INSIDE a
+        surviving rank by the collective hang watchdog
+        (runtime/elastic/hang.py) — a collective stalled past the hang
+        deadline (``reason="collective_hang"``, ``blocked_s``). Latched
+        per incident: one dump however many ranks die together (the
+        supervisor's teardown makes the survivors exit nonzero too,
+        and each of those must not re-dump); a successful restart
+        re-arms it (``note_world_ok``)."""
+        if self._rank_dead_tripped:
+            return None
+        self._rank_dead_tripped = True
+        return self._trigger("rank_dead",
+                             {"rank": rank, "reason": reason,
+                              "step": step, "exit_code": exit_code,
+                              "blocked_s": blocked_s,
+                              "deadline_s": deadline_s,
+                              "restart_epoch": restart_epoch,
+                              "world": world})
+
+    def note_world_ok(self):
+        """Re-arm the rank-dead latch after the supervisor respawned a
+        healthy world — the NEXT incident is a new episode and must
+        dump again."""
+        self._rank_dead_tripped = False
+
+    def note_crash_loop(self, restarts=None, max_restarts=None,
+                        world=None, last_reason=None):
+        """The supervisor's restart budget is exhausted (ISSUE 15): a
+        world that dies every epoch stopped being restarted. Latched
+        and NEVER re-armed — the condition is terminal for this
+        supervisor, so there is exactly one ``crash_loop`` dump per
+        process however the exit path replays."""
+        if self._crash_loop_tripped:
+            return None
+        self._crash_loop_tripped = True
+        return self._trigger("crash_loop",
+                             {"restarts": restarts,
+                              "max_restarts": max_restarts,
+                              "world": world,
+                              "last_reason": last_reason})
 
     # -------------------------------------------------------------- dump
 

@@ -15,9 +15,13 @@ events — as:
   finish, with waits and reasons;
 - a checkpoint / restore / preempt timeline (ISSUE 7): snapshot
   begin/commit pairs with the commit-fence wait, corruption fallbacks,
-  the preemption signal + final snapshot, elastic resumes — and the
+  the preemption signal + final snapshot, elastic resumes — the
   elastic-serving lifecycle (ISSUE 11): drain -> snapshot -> restore
-  -> requeue, aborts, replica kills and pool scale events;
+  -> requeue, aborts, replica kills and pool scale events — and the
+  fault-tolerant training lifecycle (ISSUE 15): give the supervisor's
+  dump and the workers' dumps together and the same table stitches
+  die -> detect (rank_exit/rank_hang) -> teardown -> shrunk restart ->
+  resume, stamped with each epoch's restart_epoch;
 - a swap-tier I/O summary per step (bytes in/out, drain waits);
 - request-scoped distributed traces (ISSUE 12): given N dump files
   TOGETHER (``view.py dumpA.jsonl dumpB.jsonl``), events are merged,
@@ -264,7 +268,14 @@ def render_ckpt(events, out):
              # replica-pool scale/kill incidents ride the same timeline
              "serving_drain", "serving_snapshot", "serving_restore",
              "serving_requeue", "serving_abort", "replica_scale",
-             "replica_kill")
+             "replica_kill",
+             # fault-tolerant training lifecycle (ISSUE 15): the
+             # die -> detect -> shrink -> resume chain — supervisor
+             # events (spawn/rank_exit/world_down/restart/crash_loop)
+             # merged with the workers' own rank_hang/restart_epoch
+             # breadcrumbs onto one timeline
+             "supervisor_spawn", "rank_exit", "rank_hang", "world_down",
+             "restart", "crash_loop", "restart_epoch")
     rows = []
     t0 = None
     for ev in events:
@@ -330,6 +341,35 @@ def render_ckpt(events, out):
                      f"micro {ev.get('micro')} gas {ev.get('grad_accum')}"
             if ev.get("fell_back"):
                 detail += f", {ev['fell_back']} corrupt skipped"
+        elif kind == "supervisor_spawn":
+            detail = (f"world {ev.get('world')}, epoch "
+                      f"{ev.get('restart_epoch')}, coordinator "
+                      f":{ev.get('port')}")
+        elif kind == "rank_exit":
+            detail = (f"rank {ev.get('rank')} down: "
+                      f"{ev.get('reason', '?')} (epoch "
+                      f"{ev.get('restart_epoch')})")
+        elif kind == "rank_hang":
+            detail = (f"rank {ev.get('rank')} blocked "
+                      f"{ev.get('blocked_s', 0):.4g}s in "
+                      f"{ev.get('region', '?')} (deadline "
+                      f"{ev.get('deadline_s', '?')}s)")
+        elif kind == "world_down":
+            detail = (f"{ev.get('survivors_torn_down', 0)} survivors "
+                      f"torn down, {ev.get('lost', '?')} rank(s) lost")
+        elif kind == "restart":
+            detail = (f"world {ev.get('world_from')}→"
+                      f"{ev.get('world_to')}, epoch "
+                      f"{ev.get('restart_epoch')}, backoff "
+                      f"{ev.get('backoff_s', 0):.4g}s "
+                      f"({ev.get('reason', '')})")
+        elif kind == "crash_loop":
+            detail = (f"{ev.get('restarts')} restart(s) spent (max "
+                      f"{ev.get('max_restarts')}), last "
+                      f"{ev.get('last_reason', '?')}")
+        elif kind == "restart_epoch":
+            detail = (f"worker up in epoch {ev.get('epoch')}, world "
+                      f"{ev.get('world')}")
         rows.append([
             None if t0 is None or ev.get("ts") is None
             else ev["ts"] - t0,

@@ -17,11 +17,84 @@ The reference resolves RANK/WORLD_SIZE/MASTER_ADDR from the environment
 """
 
 import os
+import random
+import time
 from typing import Optional, Sequence
 
-from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.logging import log_dist, logger
 
 _initialized = False
+
+# connection-flavored failure markers worth retrying (ISSUE 15): under
+# a supervisor restart the coordinator (rank 0) races every other
+# rank's dial — "refused" for the first second of every epoch is the
+# EXPECTED shape, not an error. Config/usage errors never match and
+# raise immediately.
+_RETRYABLE_MARKERS = ("unavailable", "deadline_exceeded", "deadline",
+                      "connection refused", "failed to connect",
+                      "connection reset", "timed out", "timeout")
+
+
+def jittered_backoff(base_s, attempt, cap_s=None, rng=None):
+    """Full-upward-jitter exponential backoff delay (ISSUE 15):
+    ``min(base·2^attempt, cap) · (1 + U[0,1))`` — restarted/retrying
+    peers must not re-dial in sync. Shared by the rendezvous retry
+    below and the supervisor's restart loop (serving/replica_pool.py
+    predates this helper with a deliberately different ±50% jitter;
+    its retry cadence is pinned by tests, so it keeps its own)."""
+    rng = rng if rng is not None else random.random
+    delay = base_s * (2 ** attempt)
+    if cap_s is not None:
+        delay = min(delay, cap_s)
+    return delay * (1.0 + rng())
+
+
+def _rendezvous_retry_env(environ=None):
+    """(retries, backoff_s) from the supervisor's env contract
+    (``DSTPU_RENDEZVOUS_RETRIES``/``DSTPU_RENDEZVOUS_BACKOFF_S`` — the
+    ``fault_tolerance`` config block's knobs, exported by
+    runtime/elastic/supervisor.py), with defaults that make a bare
+    multi-process launch survive a slow-starting coordinator."""
+    from deepspeed_tpu.config import constants as C   # stdlib-safe;
+    #   ONE source of truth with the fault_tolerance config block
+    env = os.environ if environ is None else environ
+    try:
+        retries = int(env.get("DSTPU_RENDEZVOUS_RETRIES", "")
+                      or C.FT_RENDEZVOUS_RETRIES_DEFAULT)
+    except ValueError:
+        retries = C.FT_RENDEZVOUS_RETRIES_DEFAULT
+    try:
+        backoff = float(env.get("DSTPU_RENDEZVOUS_BACKOFF_S", "")
+                        or C.FT_RENDEZVOUS_BACKOFF_S_DEFAULT)
+    except ValueError:
+        backoff = C.FT_RENDEZVOUS_BACKOFF_S_DEFAULT
+    return max(retries, 0), max(backoff, 0.0)
+
+
+def _retry_rendezvous(connect, retries, backoff_s, cap_s=10.0,
+                      sleep=time.sleep, rng=None):
+    """Run ``connect()`` with jittered exponential backoff on
+    connection-flavored failures (up to ``retries`` retries). Anything
+    that does not look like a transport failure — a config error, a
+    rank mismatch — raises immediately: retrying those would turn a
+    5-second crash into a 5-minute one."""
+    rng = rng if rng is not None else random.random
+    attempt = 0
+    while True:
+        try:
+            return connect()
+        except Exception as e:
+            msg = str(e).lower()
+            retryable = any(m in msg for m in _RETRYABLE_MARKERS)
+            if attempt >= retries or not retryable:
+                raise
+            delay = jittered_backoff(backoff_s, attempt, cap_s=cap_s,
+                                     rng=rng)
+            logger.warning(
+                f"rendezvous attempt {attempt + 1}/{retries + 1} failed "
+                f"({str(e)[:120]}); retrying in {delay:.2f}s")
+            sleep(delay)
+            attempt += 1
 
 
 def discover_rendezvous(environ=None, auto_mpi_discovery=True):
@@ -63,9 +136,20 @@ def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None,
                      local_device_ids: Optional[Sequence[int]] = None,
-                     auto_mpi_discovery: bool = True):
+                     auto_mpi_discovery: bool = True,
+                     rendezvous_retries: Optional[int] = None,
+                     rendezvous_backoff_s: Optional[float] = None):
     """Idempotent multi-host init; single-process is a no-op. Explicit
-    arguments always win; env discovery fills in only the missing fields."""
+    arguments always win; env discovery fills in only the missing fields.
+
+    Rendezvous retries (ISSUE 15): a supervisor restart makes the
+    coordinator-not-up-yet race routine (rank 0 of the NEW epoch may be
+    milliseconds behind its peers), so connection-flavored
+    ``jax.distributed.initialize`` failures retry with jittered
+    exponential backoff instead of crashing the fresh epoch on first
+    refusal. Knobs: explicit args > ``DSTPU_RENDEZVOUS_RETRIES``/
+    ``DSTPU_RENDEZVOUS_BACKOFF_S`` env (what the supervisor exports
+    from the ``fault_tolerance`` config block) > defaults (8, 0.5s)."""
     global _initialized
     if _initialized:
         return
@@ -81,10 +165,18 @@ def init_distributed(coordinator_address: Optional[str] = None,
     if coordinator_address and num_processes and num_processes > 1:
         import jax
         _enable_cpu_cross_process_collectives(jax)
-        jax.distributed.initialize(coordinator_address=coordinator_address,
-                                   num_processes=num_processes,
-                                   process_id=process_id,
-                                   local_device_ids=local_device_ids)
+        env_retries, env_backoff = _rendezvous_retry_env()
+        retries = rendezvous_retries if rendezvous_retries is not None \
+            else env_retries
+        backoff = rendezvous_backoff_s \
+            if rendezvous_backoff_s is not None else env_backoff
+        _retry_rendezvous(
+            lambda: jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                local_device_ids=local_device_ids),
+            retries=retries, backoff_s=backoff)
         # log only AFTER initialize: rank-aware logging touches the backend,
         # and jax.distributed.initialize must precede any backend init
         log_dist(f"jax.distributed.initialize({coordinator_address}, "

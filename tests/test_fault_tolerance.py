@@ -1,0 +1,679 @@
+"""Self-healing multi-process training (ISSUE 15): rank-failure
+supervisor, collective hang watchdog, bounded elastic restart.
+
+Fast legs (tier-1, the ci/fault_gate.sh set): the fault-injection
+registry's new points, the hang watchdog's trip/exemption/heartbeat
+semantics, the supervisor state machine over REAL (stdlib, jax-free)
+child processes — rank crash → shrink → resume, crash-loop bound with
+exactly one ``crash_loop`` dump and zero orphans/stale heartbeats,
+heartbeat-staleness detection, hang-exit classification — plus the
+shrink-policy/elasticity solvers, the rendezvous retry helper, the new
+watchdog rules' latch semantics, config validation, and the viewer's
+fault timeline.
+
+Slow legs (the acceptance criteria, over 2 real engine processes):
+SIGKILL of rank 1 mid-training auto-recovers to a smaller valid world
+from the latest snapshot with the loss trajectory preserved
+step-for-step and exactly one latched ``rank_dead`` dump; an injected
+in-collective hang is detected within ``hang_deadline_s`` + grace and
+restarted (no eternal hang).
+"""
+
+import glob
+import json
+import os
+import signal
+import sys
+import textwrap
+import time
+
+import pytest
+
+from deepspeed_tpu.runtime.elastic import faults
+from deepspeed_tpu.runtime.elastic.hang import (EXIT_HANG, HangWatchdog,
+                                                heartbeat_path)
+from deepspeed_tpu.runtime.elastic.supervisor import (
+    EXIT_CRASH_LOOP, Supervisor, solve_next_world,
+    valid_worlds_from_elasticity)
+from deepspeed_tpu.telemetry.anomaly import Watchdog
+from deepspeed_tpu.telemetry.recorder import FlightRecorder
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _dumps(d, rule=None):
+    out = sorted(glob.glob(os.path.join(d, "flight_*.jsonl")))
+    if rule is not None:
+        out = [p for p in out if rule in os.path.basename(p)]
+    return out
+
+
+# ------------------------------------------------ fault injection registry
+
+
+def test_fault_injection_new_points(monkeypatch):
+    """sigkill_at_step delivers SIGKILL exactly at its step through the
+    real step_end point; exit_at_step hard-exits; hang_in_collective
+    sleeps only at its step at collective_enter; crash_during_delivery
+    raises at serving_deliver with rid filtering."""
+    kills = []
+    monkeypatch.setattr(os, "kill", lambda pid, sig: kills.append(
+        (pid, sig)))
+    with faults.sigkill_at_step(3):
+        faults.fire("step_end", step=2)
+        assert kills == []
+        faults.fire("step_end", step=3)
+        faults.fire("step_end", step=3)          # once only
+    assert kills == [(os.getpid(), signal.SIGKILL)]
+
+    exits = []
+    monkeypatch.setattr(os, "_exit", lambda code: exits.append(code))
+    with faults.exit_at_step(1, code=7):
+        faults.fire("step_end", step=0)
+        faults.fire("step_end", step=1)
+    assert exits == [7]
+
+    slept = []
+    monkeypatch.setattr(time, "sleep", lambda s: slept.append(s))
+    with faults.hang_in_collective(2, hang_s=123.0):
+        faults.fire("collective_enter", step=1)
+        assert slept == []
+        faults.fire("collective_enter", step=2)
+        faults.fire("collective_enter", step=2)  # once only
+    assert slept == [123.0]
+
+    with faults.crash_during_delivery(match_rid=5, times=1):
+        faults.fire("serving_deliver", rid=4)    # filtered out
+        with pytest.raises(faults.SimulatedCrash):
+            faults.fire("serving_deliver", rid=5)
+        faults.fire("serving_deliver", rid=5)    # budget spent
+    faults.fire("serving_deliver", rid=5)        # unregistered
+
+
+# --------------------------------------------------------- hang watchdog
+
+
+def test_hang_watchdog_trips_with_one_rank_dead_dump(tmp_path):
+    """A dispatch blocked past the deadline becomes: one rank_hang ring
+    event, one LATCHED rank_dead dump carrying the ring, heartbeat
+    removed, and the distinct EXIT_HANG code through exit_fn."""
+    rec = FlightRecorder()
+    rec.record("step", step=7)                   # pre-hang ring history
+    dump_dir = str(tmp_path / "dumps")
+    wd = Watchdog(dump_dir, recorder=rec, source="train")
+    hb_dir = str(tmp_path / "hb")
+    exits = []
+    hw = HangWatchdog(0.3, poll_s=0.05, rank=0, world=2, watchdog=wd,
+                      recorder=rec, heartbeat_dir=hb_dir,
+                      heartbeat_interval_s=0.05, restart_epoch=2,
+                      exit_fn=exits.append)
+    assert os.path.exists(heartbeat_path(hb_dir, 0))
+    hw.enter_dispatch("step", 0)                 # first: compile-exempt
+    time.sleep(0.6)
+    assert exits == [] and hw.tripped is None
+    hw.exit_dispatch()
+    hw.enter_dispatch("step", 1)
+    t0 = time.time()
+    while not exits and time.time() - t0 < 5:
+        time.sleep(0.02)
+    assert exits == [EXIT_HANG]
+    assert hw.tripped["step"] == 1 and hw.tripped["blocked_s"] > 0.3
+    # the latched rank_dead dump, exactly one, with the pre-hang ring
+    dumps = _dumps(dump_dir, "rank_dead")
+    assert len(dumps) == 1
+    lines = [json.loads(x) for x in open(dumps[0])]
+    assert lines[0]["rule"] == "rank_dead"
+    assert lines[0]["detail"]["reason"] == "collective_hang"
+    assert lines[0]["detail"]["restart_epoch"] == 2
+    assert any(ev.get("kind") == "step" and ev.get("step") == 7
+               for ev in lines[1:])
+    assert any(ev.get("kind") == "rank_hang" for ev in rec.events())
+    # heartbeat removed at trip: the supervisor cannot mistake the
+    # exit window for a live rank
+    assert not os.path.exists(heartbeat_path(hb_dir, 0))
+
+
+def test_hang_watchdog_first_region_slack_per_kind(tmp_path):
+    """The compile allowance is per KIND and is SLACK, not exemption:
+    the first step dispatch and the first boundary exchange each
+    tolerate factor× the deadline (both compile), the second
+    occurrence of either is held to the plain deadline — and a first
+    occurrence blocked past factor× the deadline still trips (a peer
+    dead before this rank's first boundary must be caught)."""
+    exits = []
+    hw = HangWatchdog(0.2, poll_s=0.05, exit_fn=exits.append,
+                      first_deadline_factor=10.0)
+    for kind in ("step", "exchange"):
+        hw.enter_dispatch(kind, 0)
+        time.sleep(0.45)                 # past deadline, inside 10x
+        assert exits == [], kind
+        hw.exit_dispatch()
+    hw.enter_dispatch("exchange", 1)
+    t0 = time.time()
+    while not exits and time.time() - t0 < 5:
+        time.sleep(0.02)
+    assert exits == [EXIT_HANG]
+    assert hw.tripped["kind"] == "exchange"
+    assert hw.tripped["deadline_s"] == pytest.approx(0.2)
+
+    # never-exempt: a FIRST occurrence blocked past factor x deadline
+    # trips too (with the applied 3x limit in the detail)
+    exits2 = []
+    hw2 = HangWatchdog(0.1, poll_s=0.03, exit_fn=exits2.append,
+                       first_deadline_factor=3.0)
+    hw2.enter_dispatch("exchange", 0)    # occurrence 1
+    t0 = time.time()
+    while not exits2 and time.time() - t0 < 5:
+        time.sleep(0.02)
+    assert exits2 == [EXIT_HANG]
+    assert hw2.tripped["deadline_s"] == pytest.approx(0.3)
+    assert hw2.tripped["blocked_s"] > 0.3
+
+
+def test_heartbeat_keeps_beating_and_stop_cleans_up(tmp_path):
+    hb_dir = str(tmp_path)
+    hw = HangWatchdog(60.0, poll_s=0.03, rank=3, heartbeat_dir=hb_dir,
+                      heartbeat_interval_s=0.05)
+    path = heartbeat_path(hb_dir, 3)
+    m0 = os.path.getmtime(path)
+    t0 = time.time()
+    while os.path.getmtime(path) == m0 and time.time() - t0 < 5:
+        time.sleep(0.02)
+    assert os.path.getmtime(path) > m0           # it beats
+    hw.stop()
+    assert not os.path.exists(path)              # and cleans up
+
+
+# ------------------------------------------------- watchdog rule latches
+
+
+def test_rank_dead_latches_and_world_ok_rearms(tmp_path):
+    wd = Watchdog(str(tmp_path), recorder=FlightRecorder())
+    assert wd.note_rank_dead(rank=1, reason="signal:9") is not None
+    assert wd.note_rank_dead(rank=0, reason="exit:1") is None  # latched
+    wd.note_world_ok()
+    assert wd.note_rank_dead(rank=1, reason="signal:9") is not None
+    assert wd.trips["rank_dead"] == 2
+
+
+def test_crash_loop_latches_terminally(tmp_path):
+    wd = Watchdog(str(tmp_path), recorder=FlightRecorder())
+    assert wd.note_crash_loop(restarts=3, max_restarts=3) is not None
+    assert wd.note_crash_loop(restarts=3, max_restarts=3) is None
+    wd.note_world_ok()                           # does NOT re-arm it
+    assert wd.note_crash_loop(restarts=3, max_restarts=3) is None
+    assert len(_dumps(str(tmp_path), "crash_loop")) == 1
+
+
+# ------------------------------------------------------- shrink policy
+
+
+def test_solve_next_world_policy():
+    # unconstrained: arithmetic shrink, floored, in-place retry at min
+    assert solve_next_world(8, 1) == 7
+    assert solve_next_world(8, 3) == 5
+    assert solve_next_world(1, 1) == 1
+    assert solve_next_world(2, 5, min_world=1) == 1
+    # HCN-constrained: largest valid world <= survivors
+    assert solve_next_world(8, 1, valid_worlds=[1, 2, 4, 8]) == 4
+    assert solve_next_world(4, 1, valid_worlds=[1, 2, 4, 8]) == 2
+    # nothing fits the shrunk target -> in-place retry at largest
+    # valid size the current world could run
+    assert solve_next_world(2, 2, valid_worlds=[2]) == 2
+    # nothing >= min_world at all -> terminal
+    assert solve_next_world(2, 1, valid_worlds=[4, 8]) is None
+    assert solve_next_world(4, 1, valid_worlds=[1, 2],
+                            min_world=3) is None
+
+
+def test_valid_worlds_from_elasticity():
+    ecfg = {"elasticity": {"enabled": True, "max_train_batch_size": 24,
+                           "micro_batch_sizes": [1, 2, 4],
+                           "min_chips": 1, "max_chips": 16,
+                           "version": 0.1}}
+    # chips {1,2,3,4,6,8,12} / 4 local devices -> process worlds 1,2,3
+    assert valid_worlds_from_elasticity(ecfg, local_devices=4) \
+        == [1, 2, 3]
+    assert 8 in valid_worlds_from_elasticity(ecfg, local_devices=1)
+    assert valid_worlds_from_elasticity({}, local_devices=1) is None
+
+
+# ---------------------------------------------------- rendezvous retry
+
+
+def test_rendezvous_retry_backoff_and_giveup():
+    from deepspeed_tpu.utils.distributed import (_rendezvous_retry_env,
+                                                 _retry_rendezvous)
+    calls, sleeps = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 4:
+            raise RuntimeError("UNAVAILABLE: failed to connect to "
+                               "coordinator")
+        return "up"
+
+    assert _retry_rendezvous(flaky, retries=8, backoff_s=0.25,
+                             sleep=sleeps.append,
+                             rng=lambda: 0.0) == "up"
+    assert len(calls) == 4
+    assert sleeps == [0.25, 0.5, 1.0]            # exponential, jitter=0
+
+    # non-connection errors never retry
+    def config_error():
+        calls.append(1)
+        raise ValueError("num_processes mismatch: 3 != 2")
+    calls.clear()
+    with pytest.raises(ValueError):
+        _retry_rendezvous(config_error, retries=8, backoff_s=0.01,
+                          sleep=sleeps.append)
+    assert len(calls) == 1
+
+    # budget exhaustion re-raises the last connection error
+    def always_down():
+        raise OSError("connection refused")
+    with pytest.raises(OSError):
+        _retry_rendezvous(always_down, retries=2, backoff_s=0.0,
+                          sleep=lambda s: None)
+
+    # env contract (what the supervisor exports)
+    assert _rendezvous_retry_env({}) == (8, 0.5)
+    assert _rendezvous_retry_env(
+        {"DSTPU_RENDEZVOUS_RETRIES": "3",
+         "DSTPU_RENDEZVOUS_BACKOFF_S": "1.5"}) == (3, 1.5)
+    assert _rendezvous_retry_env(
+        {"DSTPU_RENDEZVOUS_RETRIES": "garbage"}) == (8, 0.5)
+
+
+# -------------------------------------------------- config validation
+
+
+def test_fault_tolerance_config_validation():
+    from deepspeed_tpu.config.config import (DeepSpeedConfig,
+                                             DeepSpeedConfigError)
+    base = {"train_batch_size": 8}
+    cfg = DeepSpeedConfig(dict(base), world_size=1)
+    assert not cfg.fault_tolerance_config.enabled     # absent block
+    good = dict(base, fault_tolerance={"hang_deadline_s": 15.0,
+                                       "rendezvous_retries": 2})
+    ftc = DeepSpeedConfig(good, world_size=1).fault_tolerance_config
+    assert ftc.enabled and ftc.hang_deadline_s == 15.0
+    assert ftc.rendezvous_retries == 2
+    for bad in ({"hang_deadline_s": 0},
+                {"hang_poll_s": -1},
+                {"heartbeat_interval_s": 0},
+                {"rendezvous_retries": -1},
+                {"rendezvous_backoff_s": 0}):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig(dict(base, fault_tolerance=bad),
+                            world_size=1)
+    off = dict(base, fault_tolerance={"enabled": False,
+                                      "hang_deadline_s": 0})
+    assert not DeepSpeedConfig(
+        off, world_size=1).fault_tolerance_config.enabled
+
+
+# --------------------------------------- supervisor over real processes
+# stdlib workers: the state machine is exercised over REAL child
+# processes (spawn, kill, reap) without paying a jax import per child.
+
+
+def _write_worker(tmp_path, body):
+    path = tmp_path / "worker.py"
+    path.write_text(textwrap.dedent(body))
+    return str(path)
+
+
+def _mk_sup(script, world, tmp_path, **kw):
+    kw.setdefault("grace_kill_s", 2.0)
+    kw.setdefault("backoff_base_s", 0.05)
+    kw.setdefault("backoff_max_s", 0.1)
+    kw.setdefault("poll_s", 0.05)
+    kw.setdefault("dump_dir", str(tmp_path / "sup_dumps"))
+    return Supervisor([sys.executable, script], world,
+                      heartbeat_dir=str(tmp_path / "hb"),
+                      recorder=FlightRecorder(), **kw)
+
+
+def test_supervisor_rank_crash_shrinks_and_resumes(tmp_path):
+    """Rank 1 of 2 crashes: the survivor is torn down, the world
+    restarts at 1 with the epoch stamped into the child env, exactly
+    one rank_dead dump is written, and success leaves no stale
+    heartbeat files."""
+    script = _write_worker(tmp_path, """
+        import os, sys, time
+        rank = int(os.environ["DSTPU_PROCESS_ID"])
+        epoch = int(os.environ["DSTPU_RESTART_EPOCH"])
+        world = int(os.environ["DSTPU_NUM_PROCESSES"])
+        print(f"UP rank={rank} epoch={epoch} world={world}", flush=True)
+        if epoch == 0 and rank == 1:
+            time.sleep(0.2); sys.exit(3)
+        if epoch >= 1:
+            sys.exit(0)
+        time.sleep(60)
+    """)
+    sup = _mk_sup(script, 2, tmp_path)
+    assert sup.run(deadline_s=60) == 0
+    assert sup.restarts == 1
+    assert sup.incidents[0]["reasons"][1] == "exit:3"
+    assert sup.incidents[0]["lost"] == 1
+    assert len(_dumps(sup.watchdog.dump_dir, "rank_dead")) == 1
+    # the restarted epoch saw the shrunk world + bumped epoch
+    assert "epoch=1 world=1" in open(sup.log_paths[(1, 0)]).read()
+    # clean end state: no orphans, no stale heartbeats
+    assert all(p.poll() is not None for p in sup.procs.values())
+    assert not glob.glob(os.path.join(str(tmp_path / "hb"), "hb_*"))
+    kinds = [e["kind"] for e in sup.recorder.events()]
+    for k in ("supervisor_spawn", "rank_exit", "world_down", "restart"):
+        assert k in kinds, kinds
+
+
+def test_supervisor_crash_loop_bounded(tmp_path):
+    """The ISSUE 15 satellite: a rank that dies every restart exhausts
+    max_restarts — the supervisor exits nonzero with exactly one
+    crash_loop dump, and no orphan children or stale heartbeat files
+    remain."""
+    script = _write_worker(tmp_path, """
+        import sys, time
+        time.sleep(0.05)
+        sys.exit(7)
+    """)
+    sup = _mk_sup(script, 2, tmp_path, max_restarts=2)
+    rc = sup.run(deadline_s=60)
+    assert rc == EXIT_CRASH_LOOP and rc != 0
+    assert sup.restarts == 2                     # the full budget
+    assert len(_dumps(sup.watchdog.dump_dir, "crash_loop")) == 1
+    assert all(p.poll() is not None for p in sup.procs.values())
+    assert not glob.glob(os.path.join(str(tmp_path / "hb"), "hb_*"))
+    kinds = [e["kind"] for e in sup.recorder.events()]
+    assert kinds.count("crash_loop") == 1
+    # every epoch is visible on the timeline: 3 spawns, 2 restarts
+    assert kinds.count("supervisor_spawn") == 3
+    assert kinds.count("restart") == 2
+
+
+def test_supervisor_detects_stale_heartbeat(tmp_path):
+    """A process frozen without exiting (it beats once, then stops)
+    is detected through heartbeat staleness and restarted."""
+    script = _write_worker(tmp_path, """
+        import os, sys, time
+        rank = int(os.environ["DSTPU_PROCESS_ID"])
+        epoch = int(os.environ["DSTPU_RESTART_EPOCH"])
+        if epoch >= 1:
+            sys.exit(0)
+        hb = os.path.join(os.environ["DSTPU_HEARTBEAT_DIR"],
+                          f"hb_rank{rank}")
+        open(hb, "w").write("beat once\\n")
+        time.sleep(60)                           # frozen: never beats again
+    """)
+    sup = _mk_sup(script, 1, tmp_path, heartbeat_stale_s=0.6)
+    assert sup.run(deadline_s=60) == 0
+    assert sup.restarts == 1
+    assert sup.incidents[0]["reasons"][0].startswith("heartbeat_stale")
+
+
+def test_supervisor_classifies_hang_exit(tmp_path):
+    """A rank exiting EXIT_HANG is a healthy DETECTOR: the casualty
+    count stays at the (unknown, floor-1) stuck peer, and teardown must
+    SIGKILL a survivor that swallows SIGTERM — exactly what a rank
+    parked in a dead collective or a PEP 475-retried sleep does."""
+    script = _write_worker(tmp_path, f"""
+        import os, signal, sys, time
+        rank = int(os.environ["DSTPU_PROCESS_ID"])
+        epoch = int(os.environ["DSTPU_RESTART_EPOCH"])
+        if epoch >= 1:
+            sys.exit(0)
+        if rank == 0:
+            time.sleep(0.3)
+            os._exit({EXIT_HANG})                # the hang detector
+        signal.signal(signal.SIGTERM, lambda *a: None)   # swallower
+        time.sleep(60)                           # the stuck peer
+    """)
+    sup = _mk_sup(script, 2, tmp_path, grace_kill_s=0.5)
+    assert sup.run(deadline_s=60) == 0
+    assert sup.incidents[0]["reasons"][0] == "hang_detected"
+    assert sup.incidents[0]["lost"] == 1         # the stuck peer, not 2
+    assert sup.world == 1
+
+
+# ------------------------------------------------------- view timeline
+
+
+def test_view_renders_fault_timeline_synthetic(tmp_path):
+    """The die → detect → shrink → resume timeline from the supervisor
+    + worker event kinds, no jax, no engine."""
+    from deepspeed_tpu.telemetry import view
+    evs = [
+        {"kind": "supervisor_spawn", "ts": 1.0, "seq": 1, "world": 2,
+         "restart_epoch": 0, "port": 1234},
+        {"kind": "rank_exit", "ts": 2.0, "seq": 2, "rank": 1,
+         "exit_code": -9, "reason": "signal:9", "restart_epoch": 0},
+        {"kind": "rank_hang", "ts": 2.5, "seq": 3, "rank": 0,
+         "region": "step", "blocked_s": 6.2, "deadline_s": 6.0},
+        {"kind": "world_down", "ts": 3.0, "seq": 4, "restart_epoch": 0,
+         "survivors_torn_down": 1, "lost": 1},
+        {"kind": "restart", "ts": 4.0, "seq": 5, "restart_epoch": 1,
+         "world_from": 2, "world_to": 1, "backoff_s": 0.7,
+         "restarts": 1, "reason": "signal:9"},
+        {"kind": "restart_epoch", "ts": 5.0, "seq": 6, "epoch": 1,
+         "world": 1},
+        {"kind": "resume", "ts": 6.0, "seq": 7, "step": 2,
+         "tag": "global_step2", "from_dp": 8, "to_dp": 4, "micro": 2,
+         "grad_accum": 3, "fell_back": 0},
+        {"kind": "crash_loop", "ts": 7.0, "seq": 8, "restarts": 3,
+         "max_restarts": 3, "last_reason": "exit:7"},
+    ]
+    path = tmp_path / "d.jsonl"
+    path.write_text("\n".join(json.dumps(e) for e in evs) + "\n")
+    out = "\n".join(view.render(str(path)))
+    assert "checkpoint / restore / preempt timeline" in out
+    for needle in ("supervisor_spawn", "rank 1 down: signal:9",
+                   "blocked 6.2s in step", "world 2→1",
+                   "worker up in epoch 1", "dp 8→4",
+                   "3 restart(s) spent"):
+        assert needle in out, (needle, out)
+
+
+# --------------------------------------------- slow: 2-process acceptance
+
+_TRAIN_WORKER = textwrap.dedent("""
+    import json, os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from deepspeed_tpu.utils.distributed import init_distributed
+    init_distributed()
+
+    import numpy as np
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.runtime.elastic import faults
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+    from tests.simple_model import SimpleModel
+
+    snap_dir, dump_dir, total, fault = sys.argv[1:5]
+    total = int(total)
+    rank = jax.process_index()
+    epoch = int(os.environ.get("DSTPU_RESTART_EPOCH", "0"))
+    ndev = len(jax.devices())
+    mesh = make_mesh(MeshConfig(data=ndev))
+    cfg = {
+        "steps_per_print": 1000,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2},
+        # the PR-7 HCN ladder recipe: batch 24 factors for dp 2 (micro
+        # 4, gas 3) and dp 1 (micro 4, gas 6) — the shrink re-solves
+        # BOTH micro partitioning and accumulation depth
+        "elasticity": {"enabled": True, "max_train_batch_size": 24,
+                       "micro_batch_sizes": [1, 2, 4], "min_chips": 1,
+                       "max_chips": 16, "version": 0.1},
+        "snapshot": {"path": snap_dir, "interval_steps": 1,
+                     "grace_secs": 20.0},
+        "fault_tolerance": {"hang_deadline_s": 8.0,
+                            "heartbeat_interval_s": 0.2},
+        "monitor": {"enabled": False,
+                    "watchdog": {"dump_dir": dump_dir,
+                                 "step_time_factor": 1000.0,
+                                 "swap_stall_factor": 1000.0,
+                                 "ckpt_stall_factor": 1000.0,
+                                 "check_nan": False}},
+    }
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=SimpleModel(),
+                                       mesh=mesh)
+    rs = np.random.RandomState(0)
+    batch = (rs.randn(24, 8).astype(np.float32),
+             rs.randint(0, 4, (24,)).astype(np.int32))
+    _fault_cm = None                  # keep the CM alive: a dropped
+    if epoch == 0 and rank == 1:      # reference GC-closes the
+        if fault == "sigkill":        # generator and UNREGISTERS it
+            _fault_cm = faults.sigkill_at_step(3)
+        elif fault == "hang":
+            _fault_cm = faults.hang_in_collective(3, hang_s=600.0)
+        if _fault_cm is not None:
+            _fault_cm.__enter__()
+    losses = {}
+    while engine.global_steps < total:
+        loss = float(engine.train_batch(batch))
+        losses[engine.global_steps] = loss
+    print("TRAJ", rank, epoch,
+          json.dumps({str(k): v for k, v in losses.items()}), flush=True)
+""")
+
+
+def _reference_trajectory(total):
+    """The uninterrupted dp=2 run in THIS process (2 of the virtual
+    devices — the same dp the supervised world starts at): elasticity
+    preserves the effective batch across world sizes, so the
+    supervised run's post-restart dp=1 losses must match these
+    step-for-step."""
+    import jax
+    import numpy as np
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.parallel.mesh import MeshConfig, make_mesh
+    from tests.simple_model import SimpleModel
+    cfg = {
+        "steps_per_print": 1000,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2},
+        "elasticity": {"enabled": True, "max_train_batch_size": 24,
+                       "micro_batch_sizes": [1, 2, 4], "min_chips": 1,
+                       "max_chips": 16, "version": 0.1},
+    }
+    engine, _, _, _ = dstpu.initialize(
+        config=cfg, model=SimpleModel(),
+        mesh=make_mesh(MeshConfig(data=2), devices=jax.devices()[:2]))
+    rs = np.random.RandomState(0)
+    batch = (rs.randn(24, 8).astype(np.float32),
+             rs.randint(0, 4, (24,)).astype(np.int32))
+    return {s + 1: float(engine.train_batch(batch))
+            for s in range(total)}
+
+
+def _run_supervised(tmp_path, fault, total=6, deadline_s=480):
+    script = tmp_path / "train_worker.py"
+    script.write_text(_TRAIN_WORKER)
+    snap = str(tmp_path / "snaps")
+    wdump = str(tmp_path / "worker_dumps")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO_ROOT + os.pathsep
+                + os.environ.get("PYTHONPATH", "")})
+    sup = Supervisor(
+        [sys.executable, str(script), snap, wdump, str(total), fault],
+        2, heartbeat_dir=str(tmp_path / "hb"),
+        dump_dir=str(tmp_path / "sup_dumps"),
+        valid_worlds=valid_worlds_from_elasticity(
+            {"elasticity": {"enabled": True, "max_train_batch_size": 24,
+                            "micro_batch_sizes": [1, 2, 4],
+                            "min_chips": 1, "max_chips": 16,
+                            "version": 0.1}}, local_devices=1),
+        hang_deadline_s=8.0, grace_kill_s=3.0, max_restarts=2,
+        backoff_base_s=0.2, backoff_max_s=0.5, poll_s=0.1,
+        local_devices=1, env=env, cwd=REPO_ROOT,
+        recorder=FlightRecorder())
+    rc = sup.run(deadline_s=deadline_s)
+    return sup, rc, wdump
+
+
+def _traj_from_log(path):
+    import re
+    text = open(path).read()
+    m = re.search(r"TRAJ (\d+) (\d+) (\{.*\})", text)
+    assert m, text
+    return {int(k): v for k, v in json.loads(m.group(3)).items()}
+
+
+@pytest.mark.slow
+def test_sigkill_rank1_auto_recovers_two_processes(tmp_path):
+    """THE acceptance leg: SIGKILL of rank 1 mid-training (2 real
+    processes, dp2, ZeRO-2). The supervisor detects the death, tears
+    down the survivor, restarts at the HCN-valid shrunk world (1
+    process, dp1 — micro stays 4, gas re-solves 3 → 6, effective batch
+    24 preserved), auto-resumes from the latest committed snapshot,
+    and the post-restart loss trajectory matches the uninterrupted
+    dp2 run step-for-step. Exactly one latched rank_dead dump.
+
+    (One device per process on purpose: multi-device-per-process GSPMD
+    programs over the gloo transport nondeterministically interleave
+    their independent psums on one TCP pair — a pre-existing backend
+    bug this PR documents in ROADMAP.md, reproducible on the seed
+    tree without any fault-tolerance code.)"""
+    import numpy as np
+    total = 6
+    sup, rc, wdump = _run_supervised(tmp_path, "sigkill", total=total)
+    assert rc == 0
+    assert sup.restarts == 1
+    assert sup.incidents[0]["reasons"][1] == "signal:9"
+    # shrink: 2 procs (dp2) -> 1 proc (dp1), the HCN-valid world
+    assert sup.world == 1
+    # exactly ONE latched rank_dead dump (the supervisor's); the
+    # workers were torn down before their own deadline could dump
+    assert len(_dumps(sup.watchdog.dump_dir, "rank_dead")) == 1
+    assert _dumps(wdump, "rank_dead") == []
+    # resumed from the last committed snapshot (global_step2): the
+    # restarted epoch's first completed step is 3
+    traj = _traj_from_log(sup.log_paths[(1, 0)])
+    assert min(traj) == 3 and max(traj) == total
+    # loss trajectory preserved step-for-step vs the uninterrupted run
+    ref = _reference_trajectory(total)
+    for s in sorted(traj):
+        np.testing.assert_allclose(traj[s], ref[s], rtol=2e-5)
+    # no orphans, no stale heartbeats
+    assert all(p.poll() is not None for p in sup.procs.values())
+    assert not glob.glob(os.path.join(str(tmp_path / "hb"), "hb_*"))
+
+
+@pytest.mark.slow
+def test_hang_in_collective_detected_and_restarted(tmp_path):
+    """The hang acceptance leg: rank 1 parks inside the boundary
+    exchange (sleep at collective_enter), so rank 0 blocks inside the
+    step dispatch with NO process death. Rank 0's hang watchdog
+    converts the stall into one rank_dead dump + EXIT_HANG within
+    hang_deadline_s + grace; the supervisor classifies the exit,
+    SIGKILLs the sleeper, restarts the shrunk world, and training
+    completes — no eternal hang."""
+    total = 6
+    sup, rc, wdump = _run_supervised(tmp_path, "hang", total=total)
+    assert rc == 0
+    assert sup.restarts == 1
+    # rank 0 exited with the distinct hang code
+    assert sup.incidents[0]["reasons"][0] == "hang_detected"
+    assert sup.incidents[0]["lost"] == 1
+    # the WORKER-side latched rank_dead dump names the blocked region
+    # and stays within deadline + grace
+    dumps = _dumps(wdump, "rank_dead")
+    assert len(dumps) == 1
+    header = json.loads(open(dumps[0]).readline())
+    det = header["detail"]
+    assert det["reason"] == "collective_hang"
+    assert 8.0 < det["blocked_s"] < 8.0 + 6.0
+    # the restarted epoch completed the run from the last committed
+    # snapshot
+    traj = _traj_from_log(sup.log_paths[(1, 0)])
+    assert max(traj) == total and min(traj) == 3
+    assert all(p.poll() is not None for p in sup.procs.values())

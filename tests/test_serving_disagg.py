@@ -326,6 +326,55 @@ def test_kill_during_handoff_zero_orphaned_traces(gpt2_dis, tmp_path):
     assert "handoff_out" in text and "handoff_in" in text
 
 
+def test_delivery_crash_unwinds_admitted_pages(gpt2_dis):
+    """ISSUE 15 satellite (the bug PR 14's review flagged): a crash at
+    ``serving_deliver`` — AFTER the decode pool admitted the packet's
+    pages, before scatter/adoption — must unwind the admission instead
+    of leaking the pages. The router replays the request from its wire
+    doc token-for-token, and the leak fence holds: every engine's pool
+    drains back to num_blocks - 1."""
+    _cfg, _params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2)
+    reqs = _reqs(6, max_new=4, seed=9)
+    ref = _ref_streams(adapter, reqs)
+    router = _mk_router(adapter, n_prefill=1, n_decode=1)
+    with faults.crash_during_delivery(times=2):
+        done = router.run(_clone(reqs))
+    assert len(done) == len(reqs) and not router.lost
+    assert router.stats["handoff_requeues"] == 2
+    for rid, toks in ref.items():
+        assert done[rid].tokens().tolist() == toks, rid
+    # the leak fence: the unwound admissions returned every page (and
+    # left no refcounts or prefix-index entries pointing at
+    # never-written blocks)
+    for cb in router.prefill_engines + router.decode_engines:
+        cb.cache.sweep_prefix_cache()
+        assert cb.cache.free_pages == cb.cache.num_blocks - 1, \
+            cb.replica_id
+        assert not cb.cache._block_entry, cb.replica_id
+    evs = [e for e in default_recorder().events()
+           if e["kind"] == "serving_requeue"]
+    assert len([e for e in evs if e.get("outcome") == "scheduled"]) == 2
+
+
+def test_delivery_crash_every_attempt_bounded_no_leak(gpt2_dis):
+    """A request whose every DELIVERY crashes is dropped after
+    max_handoff_retries with the pool intact — the delivery-side twin
+    of the poisoned-handoff budget test."""
+    _cfg, _params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2)
+    reqs = _reqs(3, max_new=4, seed=10)
+    router = _mk_router(adapter, n_prefill=1, n_decode=1,
+                        max_handoff_retries=2)
+    with faults.crash_during_delivery(match_rid=0, times=None):
+        done = router.run(_clone(reqs))
+    assert 0 in router.lost and 0 not in done
+    assert sorted(done) == [1, 2]
+    for cb in router.prefill_engines + router.decode_engines:
+        cb.cache.sweep_prefix_cache()
+        assert cb.cache.free_pages == cb.cache.num_blocks - 1
+
+
 def test_handoff_retry_budget_drops_poisoned_request(gpt2_dis):
     """A request whose every handoff crashes is dropped after
     max_handoff_retries (bounded) — the rest of the traffic
