@@ -24,7 +24,11 @@
 # BENCH_r09.json or newer to arm it), and since r10
 # ``serving.elastic_recovered_fraction`` (ISSUE 11: every request
 # survives one replica kill + one graceful drain, must stay 1.0) —
-# gate against BENCH_r10.json or newer to arm that one.
+# gate against BENCH_r10.json or newer to arm that one. Since r15 it
+# includes ``zero3_hier.inter_bytes_reduction`` (ISSUE 16: the
+# link-aware ZeRO-3 prefetch stream's modeled slow-hop bytes vs the
+# FLAT single-ring baseline, >= 2x at 2x4 — gate against
+# BENCH_r15.json or newer to arm it).
 #
 # The --candidate path never imports jax and finishes in <2 s, so this
 # runs on artifact files on any CI box. Typical wiring:

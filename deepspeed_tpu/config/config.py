@@ -1452,6 +1452,15 @@ class DeepSpeedConfig:
     def _do_sanity_check(self):
         if self.fp16_enabled and self.bf16_enabled:
             raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+        hcfg = self.comm_config.hierarchy
+        if hcfg.enabled and self.zero_config.stage3_prefetch \
+                and self.zero_config.stage3_prefetch_gather == "fused":
+            raise DeepSpeedConfigError(
+                "comm.hierarchy composes with zero_optimization."
+                "stage3_prefetch only under explicit collectives "
+                "(stage3_prefetch_gather 'ring' or 'fused_matmul'): "
+                "'fused' hands the gather schedule to XLA, which cannot "
+                "honor the two-level link split")
         if self.zero_enabled and self.optimizer_name is not None:
             if self.optimizer_name not in C.DEEPSPEED_OPTIMIZERS + ["sgd"]:
                 logger.warning(

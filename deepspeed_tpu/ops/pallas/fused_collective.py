@@ -68,6 +68,22 @@ from jax.experimental.pallas import tpu as pltpu
 # ---------------------------------------------------------------------------
 
 @dataclasses.dataclass(frozen=True)
+class RingHierarchy:
+    """Two-level split of the collective axis for link-aware lowering
+    (ISSUE 16): the flat ring of ``inter * intra`` devices becomes
+    ``inter`` slow-link blocks (DCN-class, ``inter_axis``) of ``intra``
+    fast-link devices (ICI-class, ``intra_axis``) each. Frozen/hashable
+    so it can ride `CollectiveMatmulConfig` through the custom-VJP
+    builder cache. Axis names must be bound by the enclosing shard_map
+    (the `mesh.split_data_axis` view); the flat data axis name does NOT
+    exist on that mesh, so a hierarchical call never touches it."""
+    inter_axis: str
+    intra_axis: str
+    inter: int
+    intra: int
+
+
+@dataclasses.dataclass(frozen=True)
 class CollectiveMatmulConfig:
     """Static per-train-fn configuration (hashable: keys custom-VJP
     builder caches and rides the trace-scoped gather context).
@@ -83,7 +99,13 @@ class CollectiveMatmulConfig:
     everywhere except a real TPU backend).
     ``vmem_budget_bytes``: ceiling on the contracting kernel's chunk
     stash (it holds the FULL weight in VMEM — see _ag_matmul_fused);
-    bigger weights take the lax ring under backend="auto"."""
+    bigger weights take the lax ring under backend="auto".
+    ``hierarchy``: optional two-level split — when set, both collective
+    ops run the link-aware schedule (ONE inter-block hop per operand,
+    the per-block ring over the fast axis; see _hier_ag_matmul) and the
+    per-block intra rings run the lax decomposed ring regardless of
+    ``backend`` (pallas remote DMA cannot address a two-named-axis
+    env — see _sub_cfg)."""
     axis_name: str = "data"
     axis_size: int = 1
     backend: str = "auto"
@@ -91,6 +113,7 @@ class CollectiveMatmulConfig:
     min_shard_bytes: int = 1 << 16
     interpret: Optional[bool] = None
     vmem_budget_bytes: int = 8 << 20
+    hierarchy: Optional[RingHierarchy] = None
 
 
 class _CtxState(threading.local):
@@ -580,6 +603,102 @@ def _mm_rs_fused(lhs, rhs, *, chunk_lhs, axis_name, n, tile_m, interpret,
 
 
 # ---------------------------------------------------------------------------
+# two-level link-aware lowering (ISSUE 16)
+# ---------------------------------------------------------------------------
+#
+# The flat ring pays every hop equally; on a multi-host slice ni of the
+# n ring edges are DCN-class, so a shard of c bytes costs an AVERAGE of
+# (n-1)·c·ni/n slow-link bytes per device. The two-level schedule pays
+# the slow links exactly once per operand: one lax.all_gather of the
+# RAW resting shard over the inter axis ((ni-1)·c slow bytes), then ni
+# per-block invocations of the flat dispatch over the intra axis — the
+# existing lax/pallas lowerings (and their backend="auto" feasibility
+# gates) serve each block unchanged. Block b of the full weight is the
+# contiguous run of intra-ring shards from inter group b, because the
+# split mesh is row-major (data index = inter_index·intra +
+# intra_index) — so per-block results concatenate (non-contracting) or
+# accumulate (contracting) in natural order and numerics match the
+# flat ring to fp32 partial-sum ordering.
+
+def _sub_cfg(cfg: CollectiveMatmulConfig, h: RingHierarchy):
+    # the per-block intra ring runs with BOTH split axes bound in the
+    # shard_map axis env, and pallas remote DMA (dma_start with LOGICAL
+    # device ids) refuses a >1-named-axis env in this jax version — so
+    # the intra hop always takes the lax decomposed ring; "fused" under
+    # a hierarchy means fused-at-the-flat-level only
+    return dataclasses.replace(cfg, axis_name=h.intra_axis,
+                               axis_size=h.intra, hierarchy=None,
+                               backend="lax")
+
+
+def _hier_ag_matmul(x2, w_shard, *, h, shard_dim, contracting,
+                    transpose_w, cfg, out_dtype, precision, site):
+    ni, k = h.inter, h.intra
+    sub = _sub_cfg(cfg, h)
+    # ONE slow hop: the ni same-intra-position shards; stacked[b] is the
+    # intra-position-t shard of full-weight block b
+    stacked = jax.lax.all_gather(w_shard, h.inter_axis)
+    _breadcrumb("all_gather_matmul", site, "two_level", fallback=None,
+                m=int(x2.shape[0]), shard_shape=tuple(w_shard.shape),
+                shard_dim=int(shard_dim), transpose_w=bool(transpose_w),
+                contracting=bool(contracting), inter=ni, intra=k)
+    if contracting:
+        ck = w_shard.shape[1] if transpose_w else w_shard.shape[0]
+        acc = None
+        for b in range(ni):
+            xs = jax.lax.slice_in_dim(x2, b * k * ck, (b + 1) * k * ck,
+                                      axis=1)
+            y = all_gather_matmul(xs, stacked[b], shard_dim=shard_dim,
+                                  axis_name=h.intra_axis, axis_size=k,
+                                  transpose_w=transpose_w, cfg=sub,
+                                  out_dtype=jnp.float32,
+                                  precision=precision,
+                                  site=site + f"/blk{b}")
+            acc = y if acc is None else acc + y
+        return acc.astype(out_dtype)
+    blocks = [all_gather_matmul(x2, stacked[b], shard_dim=shard_dim,
+                                axis_name=h.intra_axis, axis_size=k,
+                                transpose_w=transpose_w, cfg=sub,
+                                out_dtype=out_dtype, precision=precision,
+                                site=site + f"/blk{b}")
+              for b in range(ni)]
+    return jnp.concatenate(blocks, axis=1)
+
+
+def _hier_mm_rs(l2, r2, *, h, shard_dim, cfg, precision, site):
+    from deepspeed_tpu.parallel import overlap
+    ni, k = h.inter, h.intra
+    sub = _sub_cfg(cfg, h)
+    chunk_lhs = shard_dim == 0
+    _breadcrumb("matmul_reduce_scatter", site, "two_level", fallback=None,
+                m=int(l2.shape[0]), k=int(l2.shape[1]),
+                nn=int(r2.shape[1]), shard_dim=int(shard_dim),
+                inter=ni, intra=k)
+    blk = (l2.shape[1] if chunk_lhs else r2.shape[1]) // ni
+    parts = []
+    for b in range(ni):
+        if chunk_lhs:
+            ls = jax.lax.slice_in_dim(l2, b * blk, (b + 1) * blk, axis=1)
+            p = matmul_reduce_scatter(ls, r2, shard_dim=0,
+                                      axis_name=h.intra_axis, axis_size=k,
+                                      cfg=sub, precision=precision,
+                                      site=site + f"/blk{b}")
+        else:
+            rs = jax.lax.slice_in_dim(r2, b * blk, (b + 1) * blk, axis=1)
+            p = matmul_reduce_scatter(l2, rs, shard_dim=1,
+                                      axis_name=h.intra_axis, axis_size=k,
+                                      cfg=sub, precision=precision,
+                                      site=site + f"/blk{b}")
+        parts.append(p)
+    piece_shape = parts[0].shape
+    stack = jnp.stack([p.reshape(-1) for p in parts])   # [ni, piece]
+    # exact fp32 slow hop: device's inter index keeps its own block's
+    # piece, summed over the ni host groups
+    out = overlap.ring_reduce_scatter(stack.reshape(-1), h.inter_axis, ni)
+    return out.reshape(piece_shape)
+
+
+# ---------------------------------------------------------------------------
 # dispatch
 # ---------------------------------------------------------------------------
 
@@ -659,6 +778,15 @@ def all_gather_matmul(x, w_shard, *, shard_dim, axis_name, axis_size,
             precision=precision).astype(out_dtype)
         return y.reshape(lead + (y.shape[-1],))
     contracting = (shard_dim == 0) != bool(transpose_w)
+    if cfg is not None and cfg.hierarchy is not None:
+        h = cfg.hierarchy
+        assert h.inter * h.intra == n, (h, n)
+        y = _hier_ag_matmul(x2, w_shard, h=h, shard_dim=shard_dim,
+                            contracting=contracting,
+                            transpose_w=transpose_w, cfg=cfg,
+                            out_dtype=out_dtype, precision=precision,
+                            site=site)
+        return y.reshape(lead + (y.shape[-1],))
     cfg, backend, interpret = _resolve(cfg)
     fallback = None
     if backend == "fused" and cfg.backend == "auto":
@@ -700,6 +828,11 @@ def matmul_reduce_scatter(lhs, rhs, *, shard_dim, axis_name, axis_size,
             l2, r2, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision)
     chunk_lhs = shard_dim == 0
+    if cfg is not None and cfg.hierarchy is not None:
+        h = cfg.hierarchy
+        assert h.inter * h.intra == n, (h, n)
+        return _hier_mm_rs(l2, r2, h=h, shard_dim=shard_dim, cfg=cfg,
+                           precision=precision, site=site)
     cfg, backend, interpret = _resolve(cfg)
     fallback = None
     if backend == "fused" and cfg.backend == "auto":

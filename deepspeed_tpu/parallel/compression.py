@@ -149,6 +149,43 @@ def hierarchical_compressed_allreduce(buf, worker_error, server_error,
             we2, se2)
 
 
+def compressed_reduce_scatter_sum(buf, worker_error, axis_name):
+    """Error-compensated 1-bit reduce-scatter-SUM of ``buf`` over
+    ``axis_name`` (ISSUE 16): the worker half of `compressed_allreduce`
+    with no server leg — the output stays scattered, so there is nothing
+    to re-compress and gather back.
+
+    ``buf`` is the local [numel] fp32 buffer laid out piece-major: chunk
+    ``j`` (of ``numel // axis_size`` elements) is destined for axis peer
+    ``j``. Each worker compensates with its persistent ``worker_error``
+    ([numel], per-device), compresses to sign bits + one fp32 scale,
+    all-to-alls the sign chunks, and returns the weighted SUM (not mean —
+    the ZeRO-3 grad contract hands the caller fp32 sums, the 1/world
+    scale is applied downstream) of its own chunk over all peers:
+
+        chunk_sum[j] = sum_i  scale_i * sign(buf_i + err_i)[my chunk]
+
+    Returns (chunk_sum [numel/n], new_worker_error [numel]). ``numel``
+    must divide by 8*axis_size (pad via `padded_numel`). Slow-hop wire
+    cost per device: (n-1)/n of numel/8 sign bytes + n-1 scale floats —
+    vs (n-1)/n * numel * 4 bytes for the exact ring reduce-scatter."""
+    n = mesh_lib.axis_size(axis_name)
+    numel = buf.size
+    assert numel % (8 * n) == 0, (
+        f"1-bit RS buffer numel {numel} must divide by 8*axis={8 * n}")
+    chunk = numel // n
+
+    compensated = buf + worker_error
+    worker_scale = _scale_of(compensated)
+    new_worker_error = compensated - worker_scale * jnp.sign(compensated)
+    packed = pack_signs(compensated).reshape(n, chunk // 8)
+    recv = jax.lax.all_to_all(packed, axis_name, split_axis=0, concat_axis=0)
+    scales = jax.lax.all_gather(worker_scale, axis_name)   # [n]
+    signs = unpack_signs(recv.reshape(-1)).reshape(n, chunk)
+    chunk_sum = (signs * scales[:, None]).sum(axis=0)      # [chunk]
+    return chunk_sum, new_worker_error
+
+
 def padded_numel(numel, axis_size):
     """Smallest buffer size >= numel divisible by 8*axis_size."""
     q = 8 * axis_size

@@ -163,16 +163,24 @@ def _chunks_from_full(full, d, n):
     return jnp.moveaxis(full.reshape(shape), d, 0)
 
 
-def gather_leaf(shard, entry, axis_name: str, n: int, mode: str = "ring"):
+def gather_leaf(shard, entry, axis_name: str, n: int, mode: str = "ring",
+                hier=None):
     """All-gather one sharded leaf ((dim, size) entry) to its full shape.
     mode="ring": explicit ppermute ring (overlap.ring_all_gather);
     mode="fused": one ``lax.all_gather`` (XLA picks the algorithm);
     mode="fused_matmul" gathers like "ring" — leaves that reach this
-    function in that mode were NOT selected for fused streaming."""
+    function in that mode were NOT selected for fused streaming.
+    ``hier`` (an overlap.HierarchyPlan, ISSUE 16) replaces the flat ring
+    with the two-level schedule: ONE slow-hop all-gather of the raw
+    shard, fast intra ring for the rest — ``axis_name`` is then unused
+    (the split mesh binds the plan's axes instead)."""
     if entry is None or n == 1:
         return shard
-    mode = _collective_mode(mode)
     d, _ = entry
+    if hier is not None:
+        flat = overlap_lib.two_level_all_gather(shard.reshape(-1), hier)
+        return _full_from_chunks(flat.reshape((n,) + shard.shape), d)
+    mode = _collective_mode(mode)
     if mode == "fused":
         return jax.lax.all_gather(shard, axis_name, axis=d, tiled=True)
     flat = overlap_lib.ring_all_gather(shard.reshape(-1), axis_name, n)
@@ -180,14 +188,20 @@ def gather_leaf(shard, entry, axis_name: str, n: int, mode: str = "ring"):
 
 
 def scatter_grad(grad_full, entry, axis_name: str, n: int,
-                 mode: str = "ring"):
+                 mode: str = "ring", hier=None):
     """Reduce-scatter one full-leaf gradient back to this device's shard
-    (SUM over the axis), in fp32 — the transpose of ``gather_leaf``."""
+    (SUM over the axis), in fp32 — the transpose of ``gather_leaf``.
+    Under ``hier`` the slow hop is the EXACT two-level ring (the
+    compressed outer leg threads error state and lives in
+    `make_gathered_param_with_error` instead)."""
     if entry is None or n == 1:
         return grad_full
-    mode = _collective_mode(mode)
     d, _ = entry
     chunks = _chunks_from_full(grad_full.astype(jnp.float32), d, n)
+    if hier is not None:
+        return overlap_lib.two_level_reduce_scatter_sum(
+            chunks.reshape(n, -1), hier).reshape(chunks.shape[1:])
+    mode = _collective_mode(mode)
     if mode == "fused":
         return jax.lax.psum_scatter(chunks.reshape(-1), axis_name,
                                     scatter_dimension=0, tiled=True) \
@@ -196,10 +210,27 @@ def scatter_grad(grad_full, entry, axis_name: str, n: int,
         chunks.reshape(-1), axis_name, n).reshape(chunks.shape[1:])
 
 
-def _gather_groups(group_bufs, axis_name, n, mode):
+def scatter_grad_with_error(grad_full, entry, n: int, err, hier):
+    """Compressed-slow-hop counterpart of ``scatter_grad`` (ISSUE 16):
+    reduce-scatter a full-leaf gradient with error-compensated sign bits
+    on the inter-host hop. ``err`` is the persistent per-device
+    [`outer_error_numel(shard_numel, hier)`] fp32 residual. Returns
+    (grad_shard fp32 SUM, new_err)."""
+    d, _ = entry
+    chunks = _chunks_from_full(grad_full.astype(jnp.float32), d, n)
+    piece, new_err = overlap_lib.two_level_reduce_scatter_compressed(
+        chunks.reshape(n, -1), err, hier)
+    return piece.reshape(chunks.shape[1:]), new_err
+
+
+def _gather_groups(group_bufs, axis_name, n, mode, hier=None):
     """Per-group packed shard [K_g] → gathered [n, K_g] (row j = device
-    j's shard) — ONE collective per group per layer. fused_matmul mode
+    j's shard) — ONE collective per group per layer (two under ``hier``:
+    the slow-hop all-gather + the fast intra ring). fused_matmul mode
     gathers its residual (non-streamed) groups like ring."""
+    if hier is not None:
+        return tuple(overlap_lib.two_level_all_gather(buf, hier)
+                     for buf in group_bufs)
     mode = _collective_mode(mode)
     out = []
     for buf in group_bufs:
@@ -229,12 +260,19 @@ def _unpack_layer_full(gathered, shard_leaves, layer_plan: LayerPlan):
 
 
 def _scatter_layer_grads(grads_by_id, shard_leaves, layer_plan: LayerPlan,
-                         axis_name, n, mode):
+                         axis_name, n, mode, hier=None, errs_in=None):
     """Full per-layer grad leaves → per-leaf fp32 shard grads (dict id →
     array), SUM over the axis, packed so each layer costs one
-    reduce-scatter per dtype group."""
+    reduce-scatter per dtype group.
+
+    Under ``hier`` each group's exchange is the two-level schedule
+    (fast-axis fp32 partial sums, ONE slow hop); a group whose
+    ``errs_in`` entry is non-None compresses that slow hop to
+    error-compensated sign bits. Returns (out, errs_out) with
+    ``errs_out`` aligned per group (None where uncompressed)."""
     out = {}
-    for _, ids in layer_plan.groups:
+    errs_out = []
+    for g, (_, ids) in enumerate(layer_plan.groups):
         parts = []
         for i in ids:
             d, _ = layer_plan.plan[i]
@@ -242,12 +280,22 @@ def _scatter_layer_grads(grads_by_id, shard_leaves, layer_plan: LayerPlan,
                 grads_by_id[i].astype(jnp.float32), d, n)
                 .reshape(n, -1))
         flat = jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
-        if _collective_mode(mode) == "fused":
+        err = errs_in[g] if errs_in is not None else None
+        if hier is not None and err is not None:
+            shard, new_err = overlap_lib.two_level_reduce_scatter_compressed(
+                flat, err, hier)
+            errs_out.append(new_err)
+        elif hier is not None:
+            shard = overlap_lib.two_level_reduce_scatter_sum(flat, hier)
+            errs_out.append(None)
+        elif _collective_mode(mode) == "fused":
             shard = jax.lax.psum_scatter(flat.reshape(-1), axis_name,
                                          scatter_dimension=0, tiled=True)
+            errs_out.append(None)
         else:
             shard = overlap_lib.ring_reduce_scatter(
                 flat.reshape(-1), axis_name, n)
+            errs_out.append(None)
         off = 0
         for i in ids:
             shard_shape = shard_leaves[i].shape[1:]
@@ -255,7 +303,7 @@ def _scatter_layer_grads(grads_by_id, shard_leaves, layer_plan: LayerPlan,
             out[i] = jax.lax.dynamic_slice_in_dim(shard, off, m, 0) \
                 .reshape(shard_shape)
             off += m
-    return out
+    return out, tuple(errs_out)
 
 
 # ---------------------------------------------------------------------------
@@ -264,7 +312,7 @@ def _scatter_layer_grads(grads_by_id, shard_leaves, layer_plan: LayerPlan,
 
 def make_prefetched_scan(body: Callable, plan: Sequence, axis_name: str,
                          n: int, mode: str = "ring", fused_ids=(),
-                         fused_cfg=None):
+                         fused_cfg=None, hier=None):
     """Build ``scan_fn(x, layer_shards_tree) -> y`` running ``body(x,
     layer_params_tree)`` over the leading layer dim of
     ``layer_shards_tree`` with double-buffered parameter gathers.
@@ -284,6 +332,20 @@ def make_prefetched_scan(body: Callable, plan: Sequence, axis_name: str,
     (shard-shaped SUMS over the axis) — no _scatter_layer_grads pass.
     Remaining sharded leaves ride the packed ring gather.
 
+    ``hier`` (an overlap.HierarchyPlan, ISSUE 16): every packed gather
+    and per-layer grad reduce-scatter runs the two-level link-aware
+    schedule over the plan's split axes instead of the flat ring over
+    ``axis_name`` (fused leaves get theirs from ``fused_cfg.hierarchy``
+    inside the body's collective kernels). With ``hier`` set the
+    returned function takes a THIRD argument ``errs`` — a tuple aligned
+    with the packed dtype groups (see `plan_group_errors`) holding each
+    compressed group's persistent [L, E] slow-hop error state (None for
+    groups the policy leaves exact) — and its custom VJP returns the
+    NEW error state as the errs cotangent: the engine reads it back via
+    ``jax.grad(..., argnums=...)`` and carries it in opt_state, the
+    same state-through-cotangent shape the 1-bit optimizer uses for its
+    error feedback, here per layer per group.
+
     Custom VJP: the backward scan runs in reverse, re-gathering layer
     i-1 while layer i's VJP computes and reduce-scattering layer i's
     parameter gradients in the same iteration. Returns gradients for
@@ -300,6 +362,10 @@ def make_prefetched_scan(body: Callable, plan: Sequence, axis_name: str,
                          f"'fused_matmul', got {mode!r}")
     if fused_ids and mode != "fused_matmul":
         raise ValueError("fused_ids requires mode='fused_matmul'")
+    if hier is not None and mode == "fused":
+        raise ValueError(
+            "hier requires explicit collectives (mode 'ring' or "
+            "'fused_matmul') — mode='fused' hands the schedule to XLA")
     plan = tuple(tuple(e) if e is not None else None for e in plan)
     fused_ids = tuple(sorted(fused_ids))
 
@@ -358,7 +424,7 @@ def make_prefetched_scan(body: Callable, plan: Sequence, axis_name: str,
             if len(ids) > 1 else leaves[ids[0]].reshape(L, -1)
             for _, ids in lp.groups)
         g0 = _gather_groups(tuple(pg[0] for pg in packed_groups),
-                            axis_name, n, mode)
+                            axis_name, n, mode, hier=hier)
         # iteration i's scan input carries layer i+1's shards (the last
         # iteration re-gathers layer 0 — one redundant gather that
         # overlaps the final layer's compute and keeps the scan uniform)
@@ -367,7 +433,7 @@ def make_prefetched_scan(body: Callable, plan: Sequence, axis_name: str,
         def step(carry, inp):
             xc, g_cur = carry
             nxt_bufs, fused_i, repl_i = inp
-            g_nxt = _gather_groups(nxt_bufs, axis_name, n, mode)
+            g_nxt = _gather_groups(nxt_bufs, axis_name, n, mode, hier=hier)
             full = _unpack_layer_full(g_cur, leaves, lp)
             lt = _layer_tree(tdef, lp, leaves, full, fused_i, repl_i)
             with _scope():
@@ -382,7 +448,10 @@ def make_prefetched_scan(body: Callable, plan: Sequence, axis_name: str,
         y, res = _forward(x, layer_shards)
         return y, res
 
-    def _bwd(res, dy):
+    def _bwd_impl(res, dy, errs):
+        """Shared backward: returns (dx0, dtree, new_errs) — new_errs
+        aligned with ``errs`` (per packed group; identity when the group
+        is exact/absent)."""
         xs_saved, layer_shards = res
         leaves, tdef, lp = _prep(layer_shards)
         L = leaves[0].shape[0]
@@ -405,23 +474,28 @@ def make_prefetched_scan(body: Callable, plan: Sequence, axis_name: str,
                 bstep0, dy, (xs_saved, fused_stack, repl_stack),
                 reverse=True)
             dtree = jax.tree_util.tree_unflatten(tdef, list(dleaves))
-            return dx0, dtree
+            return dx0, dtree, errs
 
         packed_groups = tuple(
             jnp.concatenate([leaves[i].reshape(L, -1) for i in ids], axis=1)
             if len(ids) > 1 else leaves[ids[0]].reshape(L, -1)
             for _, ids in lp.groups)
         gL = _gather_groups(tuple(pg[-1] for pg in packed_groups),
-                            axis_name, n, mode)
+                            axis_name, n, mode, hier=hier)
         # backward iteration i consumes layer i's gathered buffer (in the
         # carry) and prefetches layer i-1's (the NEXT backward step);
         # iteration 0 redundantly re-gathers layer L-1, mirroring forward
         prev = tuple(jnp.roll(pg, 1, axis=0) for pg in packed_groups)
+        # per-layer error state rides the scan as xs (each layer owns
+        # its slice — no cross-layer dependence, so reverse order is
+        # immaterial) and the updated slice comes back as ys
+        err_xs = errs if errs is not None else (None,) * len(lp.groups)
 
         def bstep(carry, inp):
             dx, g_cur = carry
-            x_i, prev_bufs, fused_i, repl_i = inp
-            g_prev = _gather_groups(prev_bufs, axis_name, n, mode)
+            x_i, prev_bufs, fused_i, repl_i, err_i = inp
+            g_prev = _gather_groups(prev_bufs, axis_name, n, mode,
+                                    hier=hier)
             full = _unpack_layer_full(g_cur, leaves, lp)
             lt = _layer_tree(tdef, lp, leaves, full, fused_i, repl_i)
             dxi, dlt = layer_vjp(x_i, lt, dx)
@@ -432,16 +506,20 @@ def make_prefetched_scan(body: Callable, plan: Sequence, axis_name: str,
             # Fused leaves are absent here: their reduce-scatter already
             # happened INSIDE the body's matmul+RS kernels (d_leaves[i]
             # is the shard-shaped SUM).
-            d_shards = _scatter_layer_grads(d_by_id, leaves, lp,
-                                            axis_name, n, mode)
+            d_shards, errs_out = _scatter_layer_grads(
+                d_by_id, leaves, lp, axis_name, n, mode, hier=hier,
+                errs_in=err_i)
             ys = (tuple(d_shards[i] for i in lp.sharded_ids),
                   tuple(d_leaves[i] for i in lp.fused),
-                  tuple(d_leaves[j] for j in repl_ids))
+                  tuple(d_leaves[j] for j in repl_ids),
+                  errs_out)
             return (dxi, g_prev), ys
 
-        (dx0, _), (dshard_stack, dfused_stack, drepl_stack) = jax.lax.scan(
-            bstep, (dy, gL), (xs_saved, prev, fused_stack, repl_stack),
-            reverse=True)
+        (dx0, _), (dshard_stack, dfused_stack, drepl_stack, derr_stack) = \
+            jax.lax.scan(
+                bstep, (dy, gL),
+                (xs_saved, prev, fused_stack, repl_stack, err_xs),
+                reverse=True)
 
         out: List[Any] = [None] * len(leaves)
         for k, i in enumerate(lp.sharded_ids):
@@ -450,33 +528,137 @@ def make_prefetched_scan(body: Callable, plan: Sequence, axis_name: str,
             out[i] = dfused_stack[k]
         for k, j in enumerate(repl_ids):
             out[j] = drepl_stack[k]
-        return dx0, jax.tree_util.tree_unflatten(tdef, out)
+        return dx0, jax.tree_util.tree_unflatten(tdef, out), derr_stack
+
+    def _bwd(res, dy):
+        dx0, dtree, _ = _bwd_impl(res, dy, None)
+        return dx0, dtree
 
     scan_fn.defvjp(_fwd, _bwd)
-    return scan_fn
+    if hier is None:
+        return scan_fn
+
+    # hierarchical variant (ISSUE 16): the errs input exists so the
+    # backward's compressed slow hops can RETURN their updated error
+    # state — the errs "cotangent" is the new per-layer residual, not a
+    # derivative (forward never reads errs). The engine threads it into
+    # opt_state across steps.
+    @jax.custom_vjp
+    def scan_fn_h(x, layer_shards, errs):
+        y, _ = _forward(x, layer_shards)
+        return y
+
+    def _fwd_h(x, layer_shards, errs):
+        y, res = _forward(x, layer_shards)
+        return y, (res, errs)
+
+    def _bwd_h(res_errs, dy):
+        res, errs = res_errs
+        return _bwd_impl(res, dy, errs)
+
+    scan_fn_h.defvjp(_fwd_h, _bwd_h)
+    return scan_fn_h
 
 
 # ---------------------------------------------------------------------------
 # outer (non-layer) sharded params
 # ---------------------------------------------------------------------------
 
-def make_gathered_param(entry, axis_name: str, n: int, mode: str = "ring"):
+def make_gathered_param(entry, axis_name: str, n: int, mode: str = "ring",
+                        hier=None):
     """``g(shard) -> full`` for one non-layer sharded leaf (wte/wpe/...),
     with a custom VJP whose backward reduce-scatters the cotangent (SUM
     over the axis, fp32) instead of relying on transpose rules the
     legacy shard_map lowering lacks. Gathered once per step — these
     leaves are live for the whole step (embedding at the entry, head at
-    the exit), like the reference's persistent parameters."""
+    the exit), like the reference's persistent parameters. ``hier``
+    routes both directions through the two-level schedule (exact slow
+    hop — see `make_gathered_param_with_error` for the compressed
+    one)."""
 
     @jax.custom_vjp
     def g(shard):
-        return gather_leaf(shard, entry, axis_name, n, mode)
+        return gather_leaf(shard, entry, axis_name, n, mode, hier=hier)
 
     def fwd(shard):
         return g(shard), None
 
     def bwd(_, cot):
-        return (scatter_grad(cot, entry, axis_name, n, mode),)
+        return (scatter_grad(cot, entry, axis_name, n, mode, hier=hier),)
 
     g.defvjp(fwd, bwd)
     return g
+
+
+def make_gathered_param_with_error(entry, axis_name: str, n: int,
+                                   mode: str, hier):
+    """Compressed-slow-hop variant of `make_gathered_param` (ISSUE 16):
+    ``g(shard, err) -> full`` where the backward reduce-scatters the
+    cotangent with error-compensated sign bits on the inter-host hop and
+    RETURNS the new residual as the ``err`` input's cotangent (the
+    state-through-cotangent shape `make_prefetched_scan` uses for the
+    per-layer group legs). ``err`` is the persistent per-device
+    [`outer_error_numel(entry_shard_numel, hier)`] fp32 state."""
+    assert hier is not None
+
+    @jax.custom_vjp
+    def g(shard, err):
+        return gather_leaf(shard, entry, axis_name, n, mode, hier=hier)
+
+    def fwd(shard, err):
+        return g(shard, err), err
+
+    def bwd(err, cot):
+        return scatter_grad_with_error(cot, entry, n, err, hier)
+
+    g.defvjp(fwd, bwd)
+    return g
+
+
+def plan_group_errors(stacked_leaves, plan, n: int, fused_ids, hier):
+    """Static per-group compressed-slow-hop decision + error-state
+    shapes for the hierarchical per-layer grad leg (host-side; engine
+    allocation must agree with the traced scan, so this mirrors
+    `build_layer_plan`'s dtype grouping exactly). ``stacked_leaves`` are
+    the GLOBAL stacked params ([L, ...full dims]); each group's
+    per-device per-layer RS payload is its shard elements summed over
+    member leaves. Policy: the HierarchyPlan's compression knob, with
+    "auto" comparing the fp32 payload against ``min_bucket_bytes`` (the
+    `plan_bucket_compression` rule applied to the per-layer RS buffer).
+    Returns a list over packed groups: (L, E) error shape, or None for
+    groups whose slow hop stays exact."""
+    fused = set(fused_ids)
+    groups = {}
+    for i, (leaf, entry) in enumerate(zip(stacked_leaves, plan)):
+        if entry is None or i in fused:
+            continue
+        groups.setdefault(jnp.dtype(leaf.dtype), []).append(i)
+    out = []
+    for _, ids in groups.items():
+        m = sum(int(np.prod(stacked_leaves[i].shape[1:])) // n for i in ids)
+        if hier is None:
+            out.append(None)
+            continue
+        compress = hier.compression == "always" or (
+            hier.compression == "auto" and m * 4 >= hier.min_bucket_bytes)
+        if not compress:
+            out.append(None)
+        else:
+            L = int(stacked_leaves[ids[0]].shape[0])
+            out.append((L, overlap_lib.two_level_error_numel(m, hier)))
+    return out
+
+
+def outer_error_numel(shard_numel: int, hier) -> int:
+    """Error-state length for one compressed outer leaf's RS leg."""
+    return overlap_lib.two_level_error_numel(int(shard_numel), hier)
+
+
+def outer_compress(shard_numel: int, hier) -> bool:
+    """Whether an outer leaf's slow-hop RS compresses under the plan's
+    policy (same rule as `plan_group_errors`)."""
+    if hier is None:
+        return False
+    return hier.compression == "always" or (
+        hier.compression == "auto"
+        and shard_numel * 4 >= hier.min_bucket_bytes)

@@ -166,6 +166,30 @@ def derive_data_hierarchy(mesh, slow_axis=0, data_axis="data"):
                              source="process"), ""
 
 
+# flat-fallback warning latch (ISSUE 16 satellite): callers of
+# ``derive_data_hierarchy`` warn + drop a ``comm_hierarchy_fallback``
+# breadcrumb when the split fails, and a caller that re-derives per
+# step-build would flood the bounded flight-recorder ring with the same
+# event. Latched process-wide per (axis, reason) — same shape as the
+# router_block episode latch from the serving router.
+_FALLBACK_LATCH = set()
+
+
+def latch_fallback(axis, reason):
+    """True exactly once per distinct (axis, reason) fallback; False on
+    repeats. Callers gate their warning + breadcrumb on this."""
+    key = (str(axis), str(reason))
+    if key in _FALLBACK_LATCH:
+        return False
+    _FALLBACK_LATCH.add(key)
+    return True
+
+
+def reset_fallback_latch():
+    """Test hook: forget latched fallbacks (process-wide state)."""
+    _FALLBACK_LATCH.clear()
+
+
 def _prime_factors(N):
     """Prime factorization in increasing order (reference topology.py:230)."""
     if N <= 0:

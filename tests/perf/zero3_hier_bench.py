@@ -1,0 +1,149 @@
+"""Link-aware ZeRO-3 prefetch stream benchmark (ISSUE 16 acceptance:
+modeled inter-host bytes drop >= 2x with the compressed slow hop on a
+2 x (n/2) synthetic split).
+
+Three stage-3 prefetch engine variants over the same tiny GPT-2 and
+batch on one mesh, data axis split by the synthetic slow-axis override:
+
+  flat        no ``comm.hierarchy`` block — the pre-ISSUE-16 stream:
+              flat single-ring gathers and reduce-scatters, every hop
+              pays the full fp32 payload on whatever link it crosses
+  hier_exact  hierarchy on, compression "never" — every gather and
+              grad leg rescheduled two-level (ONE inter hop per chunk),
+              numerically a pure partial-sum reorder (the trajectory
+              parity floor and the fair step-time baseline)
+  hier_comp   hierarchy on, compression "always" — the grad
+              reduce-scatter legs additionally carry error-compensated
+              sign bits across the slow hop
+
+The headline is ``inter_bytes_reduction``: modeled slow-hop bytes of
+the FLAT single-ring schedule over the two-level compressed schedule
+(``inter_uncompressed / inter`` from the trace-time cost model behind
+the ``comm/bytes_on_wire/*`` counters — exact, because the prefetch
+plan and per-leg policy are static; NOTE the denominator semantics
+differ from onebit_comm's, see docs/observability.md). Step times ride
+along; on this CPU-emulated mesh every "link" is a memcpy, so the
+wire-byte ledger is the portable result and the step-time ratio is
+harness calibration (real multi-host slices derive the split from
+process boundaries — that path is pinned by tests/
+test_multiprocess_dist.py::test_stage3_prefetch_hierarchy_two_processes).
+Prints one JSON object.
+
+Run directly: python tests/perf/zero3_hier_bench.py [n_embd] [n_layer]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+
+def _build_engine(n, n_embd, n_layer, comm=None):
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.parallel.mesh import make_mesh, MeshConfig
+
+    model = GPT2LMHeadModel(GPT2Config(
+        vocab_size=512, n_positions=64, n_embd=n_embd, n_layer=n_layer,
+        n_head=2, dtype=jnp.float32, param_dtype=jnp.float32,
+        scan_layers=True))
+    cfg = {
+        "train_batch_size": n,
+        "steps_per_print": 10**9,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        # persistence 0: every leaf rides the gather stream, so the
+        # cost model covers the whole parameter volume
+        "zero_optimization": {"stage": 3, "stage3_prefetch": True,
+                              "stage3_prefetch_gather": "ring",
+                              "stage3_param_persistence_threshold": 0},
+    }
+    if comm is not None:
+        cfg["comm"] = comm
+    mesh = make_mesh(MeshConfig(data=n), devices=jax.devices())
+    engine, _, _, _ = dstpu.initialize(config=cfg, model=model,
+                                       mesh=mesh)
+    return engine
+
+
+def run_zero3_hier_bench(n_embd=128, n_layer=4, steps=8):
+    import numpy as np
+    import jax
+
+    n = len(jax.devices())
+    assert n >= 4 and n % 2 == 0, f"need an even mesh >= 4, got {n}"
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, 512, (n, 64)).astype(np.int32)}
+
+    variants = {
+        "flat": None,
+        "hier_exact": {"hierarchy": {"slow_axis": 2,
+                                     "compression": "never"}},
+        "hier_comp": {"hierarchy": {"slow_axis": 2,
+                                    "compression": "always"}},
+    }
+    result = {"devices": n, "split": f"2x{n // 2} (synthetic slow axis)",
+              "n_embd": n_embd, "n_layer": n_layer,
+              "step_time_s": {}, "final_loss": {}, "wire_model": {}}
+    for name, comm in variants.items():
+        engine = _build_engine(n, n_embd, n_layer, comm=comm)
+        for _ in range(2):   # compile + settle before the clock
+            loss = engine.train_batch(batch)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch(batch)
+        jax.block_until_ready(loss)
+        result["step_time_s"][name] = round(
+            (time.perf_counter() - t0) / steps, 6)
+        result["final_loss"][name] = round(float(loss), 6)
+        wire = getattr(engine, "_pf_wire_model", None)
+        if wire is not None:
+            result["wire_model"][name] = {k: int(v)
+                                          for k, v in wire.items()}
+        if name == "hier_comp":
+            result["counters"] = {
+                k: int(v) for k, v in engine.telemetry.snapshot(
+                    "comm/")["counters"].items()}
+        del engine
+        jax.clear_caches()
+
+    # the headline: FLAT single-ring slow-hop bytes over the two-level
+    # compressed schedule's — per step per device, static cost model
+    comp = result["wire_model"]["hier_comp"]
+    result["inter_bytes_reduction"] = round(
+        comp["inter_uncompressed"] / comp["inter"], 3)
+    # schedule-only share of the win (no compression), for calibration
+    exact = result["wire_model"]["hier_exact"]
+    result["inter_bytes_reduction_exact"] = round(
+        exact["inter_uncompressed"] / exact["inter"], 3)
+    result["hier_vs_flat_step_time"] = round(
+        result["step_time_s"]["flat"]
+        / result["step_time_s"]["hier_comp"], 3)
+    return result
+
+
+def main(n_embd=128, n_layer=4):
+    import jax
+    if "xla_force_host_platform_device_count" in \
+            os.environ.get("XLA_FLAGS", ""):
+        jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(run_zero3_hier_bench(n_embd=n_embd,
+                                          n_layer=n_layer), indent=2))
+
+
+if __name__ == "__main__":
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # re-exec with the multi-device CPU env (XLA_FLAGS is read at
+        # interpreter start)
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        os.execve(sys.executable, [sys.executable, __file__] + sys.argv[1:],
+                  env)
+    main(*(int(a) for a in sys.argv[1:]))
