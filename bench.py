@@ -189,6 +189,11 @@ def headline_metrics(doc):
                 # head-of-line TTFT on the deterministic mixed trace
                 grab("serving.disagg_ttft_p99", entry,
                      "ttft_p99_s_disagg", -1)
+                # ISSUE 17: the 2-real-process transport leg's TTFT
+                # tail (wire codec + collective hop in the handoff
+                # path) — gate against BENCH_r16.json or newer
+                grab("serving.disagg_xproc_ttft_p99", entry,
+                     "ttft_p99_s_disagg_xproc", -1)
             elif name == "serving_elastic":
                 # ISSUE 11: one replica kill + one graceful drain must
                 # keep recovering EVERY request (greedy replay makes
@@ -977,9 +982,21 @@ def bench_serving_disagg():
     transport). Headline gate: ``ttft_p99_s_disagg`` (lower is better
     — prompt admission decoupled from decode slot residency); the
     colocated leg, the attribution breakdown, token parity and the
-    page-pool leak fence ride the detail."""
-    from tests.perf.serving_bench import run_disagg_bench
-    return run_disagg_bench()
+    page-pool leak fence ride the detail.
+
+    Since r16 the section grows a ``transport: "process"`` leg
+    (ISSUE 17): the same roles split across 2 REAL ranked OS
+    processes, KV pages moving as versioned wire frames through the
+    gloo host-bytes collective. Its headline gate is
+    ``ttft_p99_s_disagg_xproc``; byte counters, the transport_s
+    attribution and the cross-process parity/leak fences ride the
+    ``xproc`` detail."""
+    from tests.perf.serving_bench import (run_disagg_bench,
+                                          run_disagg_xproc_bench)
+    out = run_disagg_bench()
+    out["xproc"] = xp = run_disagg_xproc_bench()
+    out["ttft_p99_s_disagg_xproc"] = xp["ttft_p99_s_disagg_xproc"]
+    return out
 
 
 def bench_fault_recovery():

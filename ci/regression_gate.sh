@@ -28,7 +28,11 @@
 # includes ``zero3_hier.inter_bytes_reduction`` (ISSUE 16: the
 # link-aware ZeRO-3 prefetch stream's modeled slow-hop bytes vs the
 # FLAT single-ring baseline, >= 2x at 2x4 — gate against
-# BENCH_r15.json or newer to arm it).
+# BENCH_r15.json or newer to arm it). Since r16 it includes
+# ``serving.disagg_xproc_ttft_p99`` (ISSUE 17: TTFT p99 of the
+# disaggregated trace with the handoff crossing 2 REAL OS processes as
+# versioned wire frames over the gloo host-bytes collective — gate
+# against BENCH_r16.json or newer to arm it).
 #
 # The --candidate path never imports jax and finishes in <2 s, so this
 # runs on artifact files on any CI box. Typical wiring:

@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Serving-transport fast gate (ISSUE 17 satellite): the cross-process
+# handoff fabric's seconds-scale regressions — a wire-codec change
+# that breaks byte-exact round-trips (or silently reads an
+# incompatible version instead of refusing it), a HandoffPacket golden
+# that drifts from the pool layout (fp32 and int8), a router/* or
+# serving/* metric rename that leaves docs/observability.md stale.
+# Wire it next to ci/fault_gate.sh (recovery machinery) and
+# ci/telemetry_gate.sh (instrumentation): this script gates the WIRE.
+# The 2-real-process acceptance legs (32-handoff parity + byte-counter
+# cost model; supervisor SIGKILL of a decode rank recovered
+# token-lossless) live in tests/test_serving_transport.py -m slow and
+# ride the full suite.
+#
+# Usage: ci/serving_gate.sh
+# Exit nonzero on any failure. Budget: < 10 s end to end.
+set -eu
+
+REPO_DIR=$(CDPATH= cd -- "$(dirname -- "$0")/.." && pwd)
+cd "${REPO_DIR}"
+export JAX_PLATFORMS=cpu
+
+echo "== [1/3] wire codec import guard (no jax backend touch)"
+# the codec runs in the LAUNCHER-adjacent bench/parse paths too; like
+# the supervisor (ci/fault_gate.sh), encoding/decoding frames must
+# never initialize a jax backend (transitive module import is
+# tolerated — a LIVE backend is not)
+python - <<'EOF'
+import sys
+from deepspeed_tpu.serving.transport import (FRAME_BASE_NBYTES,
+                                             WIRE_VERSION,
+                                             decode_frames,
+                                             encode_frame,
+                                             frame_nbytes)
+buf = encode_frame("done", {"rid": 7, "tokens": [1, 2, 3]},
+                   src=1, dst=0)
+(frame,) = decode_frames(buf)
+assert frame["doc"]["rid"] == 7 and frame_nbytes(frame) == len(buf)
+assert WIRE_VERSION == 1 and FRAME_BASE_NBYTES > 0
+backends = sys.modules.get("jax._src.xla_bridge")
+live = getattr(backends, "_backends", None) if backends else None
+assert not live, "codec round-trip initialized a jax backend"
+print("   ok (round-trip clean, no backend initialized)")
+EOF
+
+echo "== [2/3] wire format + HandoffPacket goldens (fp32/int8, prefix-shared)"
+python -m pytest tests/test_serving_transport.py -q -m "not slow" \
+    -p no:cacheprovider -p no:randomly
+
+echo "== [3/3] metric-name drift (router/* + serving/* vs docs)"
+python -m pytest tests/test_metric_names.py -q \
+    -k "router or handoff_serving" -p no:cacheprovider -p no:randomly
+
+echo "serving_gate: PASS"

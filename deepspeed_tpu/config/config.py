@@ -964,8 +964,11 @@ class ServingDisaggregationConfig:
             raise DeepSpeedConfigError(
                 f"serving.disaggregation.{C.SERVING_DISAGG_TRANSPORT} "
                 f"must be one of "
-                f"{list(C.SERVING_DISAGG_TRANSPORT_MODES)} (the "
-                f"cross-process transport is a planned drop-in), got "
+                f"{list(C.SERVING_DISAGG_TRANSPORT_MODES)} — "
+                f"\"inproc\" keeps the handoff on-device inside one "
+                f"process, \"process\" places roles on ranks over the "
+                f"cross-process fabric "
+                f"(serving.build_transport_node) — got "
                 f"{self.transport!r}")
 
     def __repr__(self):

@@ -704,7 +704,9 @@ SERVING_DISAGG_DEDUPE_PAGES = "dedupe_pages"
 SERVING_DISAGG_DEDUPE_PAGES_DEFAULT = True     # prefix-index re-share
 SERVING_DISAGG_TRANSPORT = "transport"
 SERVING_DISAGG_TRANSPORT_DEFAULT = "inproc"
-SERVING_DISAGG_TRANSPORT_MODES = ("inproc",)   # cross-process later
+SERVING_DISAGG_TRANSPORT_MODES = ("inproc", "process")  # ISSUE 17:
+#   "process" = per-role PROCESS placement over the gloo fabric (rank
+#   0 prefill+router, ranks >= 1 decode; serving/transport.py)
 
 # serving.router — the SLO-aware multi-engine router over the role
 # split (ISSUE 14): prefix-locality admission, decode-page

@@ -131,7 +131,7 @@ class Supervisor:
                  local_devices=None, env=None, cwd=None, log_dir=None,
                  rendezvous_retries=None, rendezvous_backoff_s=None,
                  dump_dir=None, watchdog=None, recorder=None,
-                 registry=None, seed=0):
+                 registry=None, seed=0, roles=None):
         assert cmd, "need a worker command"
         assert world >= 1, world
         self.cmd = [str(c) for c in cmd]
@@ -175,6 +175,13 @@ class Supervisor:
                                 registry=self.registry,
                                 source="supervisor")
         self.watchdog = watchdog
+        # ISSUE 17: serving replica worlds are ROLE-ASSIGNED by rank
+        # (0 = prefill+router, rest = decode). The supervisor exports
+        # each rank's role (DSTPU_SERVING_ROLE) and stamps it into
+        # rank_exit events/incidents, so a dead DECODE rank reads as
+        # one in the die → respawn timeline. None = training world.
+        self.roles = {int(r): str(name) for r, name in roles.items()} \
+            if roles else None
         self._rng = random.Random(seed)
         self.restart_epoch = 0
         self.restarts = 0
@@ -198,6 +205,8 @@ class Supervisor:
             "DSTPU_RESTART_EPOCH": str(self.restart_epoch),
         })
         env.pop("DSTPU_LOCAL_DEVICE_IDS", None)
+        if self.roles and rank in self.roles:
+            env["DSTPU_SERVING_ROLE"] = self.roles[rank]
         if self.rendezvous_retries is not None:
             env["DSTPU_RENDEZVOUS_RETRIES"] = str(self.rendezvous_retries)
         if self.rendezvous_backoff_s is not None:
@@ -404,13 +413,15 @@ class Supervisor:
         ``crash_loop`` dump and return the terminal exit code."""
         detect_ts = time.time()
         for rank, rc in dead:
+            role = self.roles.get(rank) if self.roles else None
             self.recorder.record(
                 "rank_exit", rank=rank, exit_code=rc,
                 reason=reasons[rank], restart_epoch=self.restart_epoch,
-                world=len(self.procs))
+                world=len(self.procs), role=role)
             logger.warning(f"[supervisor] rank {rank} down "
-                           f"({reasons[rank]}), epoch "
-                           f"{self.restart_epoch}")
+                           f"({reasons[rank]}"
+                           f"{', role ' + role if role else ''}), "
+                           f"epoch {self.restart_epoch}")
         # casualties: ranks genuinely lost. A rank exiting EXIT_HANG is
         # a healthy DETECTOR reporting a stuck peer — if only detectors
         # exited, exactly the undetected peer(s) are the loss, floor 1.
@@ -435,7 +446,9 @@ class Supervisor:
         world_now = len(self.procs)
         incident = {"epoch": self.restart_epoch, "dead": dict(dead),
                     "reasons": dict(reasons), "lost": n_lost,
-                    "detect_ts": detect_ts, "world": world_now}
+                    "detect_ts": detect_ts, "world": world_now,
+                    "roles": {r: self.roles.get(r) for r, _ in dead}
+                    if self.roles else None}
         self.incidents.append(incident)
 
         next_world = solve_next_world(

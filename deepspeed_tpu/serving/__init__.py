@@ -216,17 +216,23 @@ def build_router(family: str, model_config, params, config=None,
     pd = _param_dict(config)
     sc = _serving_section(pd)
     dg, rt = sc.disaggregation, sc.router
-    # loud, not silent: these blocks would be dropped on the floor —
-    # build_router wires neither drafters nor elastic controllers onto
-    # its engines yet (per-engine snapshot dirs and per-role drafter
-    # placement need design; build the engines + DisaggRouter by hand
-    # to compose them today)
-    if sc.speculative.enabled or sc.elastic.enabled:
+    # loud, not silent: a block that would be dropped on the floor
+    # must raise — build_router still wires no drafters onto its role
+    # engines (per-role drafter placement stays the follow-up; the
+    # serving.elastic lift landed with ISSUE 17: per-engine snapshot
+    # dirs below)
+    if sc.speculative.enabled:
         raise ValueError(
             "serving.build_router does not compose with the "
-            "serving.speculative / serving.elastic blocks yet — drop "
-            "them from the config, or construct the role engines and "
-            "DisaggRouter directly")
+            "serving.speculative block yet — drop it from the config, "
+            "or construct the role engines and DisaggRouter directly")
+    if dg.transport == "process":
+        raise ValueError(
+            "serving.disaggregation.transport \"process\" places "
+            "roles on RANKS, not on in-process engines — each process "
+            "builds its own role node with "
+            "serving.build_transport_node(...) (build_router builds "
+            "the in-process fabric only)")
     spec = cache_spec_from_config(model_config, family, pd, **overrides)
     qb = overrides.get("quantize_bits", sc.quantize_bits)
     if family == "gpt2":
@@ -253,7 +259,7 @@ def build_router(family: str, model_config, params, config=None,
         prefills = [mk("both", sc.prefix_cache.enabled)
                     for _ in range(max(dg.prefill_replicas, 1))]
         decodes = []
-    return DisaggRouter(
+    router = DisaggRouter(
         prefills, decodes, registry=registry, recorder=recorder,
         prefix_routing=rt.prefix_routing,
         dedupe_pages=dg.dedupe_pages,
@@ -263,3 +269,89 @@ def build_router(family: str, model_config, params, config=None,
         decode_tick_cap=rt.decode_tick_cap,
         max_inflight_pages=rt.max_inflight_pages or None,
         decode_schedule=rt.decode_schedule)
+    if sc.elastic.enabled:
+        # ISSUE 17 satellite: the serving.elastic lift. Each role
+        # engine snapshots into its OWN subdir of snapshot_path (keyed
+        # by the replica_id the router just assigned) — N engines
+        # writing one dir would race the commit-rename protocol. The
+        # installed signal handlers chain through preemption.py's
+        # lock-free chain, so one delivered SIGTERM drains every
+        # engine; DisaggRouter.close() retires them via release() (the
+        # pool discipline — restore() would drop later handlers).
+        import os as _os
+        e = sc.elastic
+        for cb in router.prefill_engines + router.decode_engines:
+            cb.attach_elastic(ElasticServingController(
+                cb, _os.path.join(e.snapshot_path, cb.replica_id),
+                grace_secs=e.grace_secs,
+                interval_ticks=e.interval_ticks, keep=e.keep,
+                fsync=e.fsync, signals=e.signals,
+                max_retries=e.max_retries, backoff_s=e.backoff_s))
+    return router
+
+
+def build_transport_node(family: str, model_config, params, config=None,
+                         registry=None, recorder=None, endpoint=None,
+                         on_tick=None, on_absorb=None, on_done=None,
+                         **overrides):
+    """This process's role node for the cross-process handoff fabric
+    (ISSUE 17, ``serving.disaggregation.transport: "process"``): roles
+    are assigned BY RANK — rank 0 builds the prefill engine(s) plus
+    the router (:class:`~deepspeed_tpu.serving.transport.PrefillNode`),
+    every other rank builds one decode engine
+    (:class:`~deepspeed_tpu.serving.transport.DecodeNode`). One device
+    per process, sequential collectives — the documented
+    gloo-flake-stable recipe (tests/test_multiprocess_dist.py).
+
+    Every rank must run the SAME config (the decode pool geometry the
+    router's backpressure default assumes is the one this rank would
+    build). ``endpoint`` defaults to the live
+    :class:`~deepspeed_tpu.serving.transport.ProcessEndpoint`; tests
+    pass :class:`~deepspeed_tpu.serving.transport.LoopbackFabric`
+    endpoints to run both roles in one process."""
+    from deepspeed_tpu.serving.transport import (DecodeNode,
+                                                 PrefillNode,
+                                                 ProcessEndpoint)
+    pd = _param_dict(config)
+    sc = _serving_section(pd)
+    dg, rt = sc.disaggregation, sc.router
+    if endpoint is None:
+        endpoint = ProcessEndpoint()
+    assert endpoint.world >= 2, (
+        f"the process transport needs >= 2 ranks (prefill + decode), "
+        f"got world={endpoint.world}")
+    spec = cache_spec_from_config(model_config, family, pd, **overrides)
+    qb = overrides.get("quantize_bits", sc.quantize_bits)
+    if family == "gpt2":
+        adapter = GPT2ServingAdapter(model_config, params, spec,
+                                     quantize_bits=qb)
+    else:
+        adapter = LlamaServingAdapter(model_config, params, spec,
+                                      quantize_bits=qb)
+    if endpoint.rank == 0:
+        prefills = []
+        for i in range(max(dg.prefill_replicas, 1)):
+            cb = ContinuousBatcher(
+                adapter, registry=registry, recorder=recorder,
+                prefix_cache=sc.prefix_cache.enabled or rt.prefix_routing,
+                prefix_cow=sc.prefix_cache.cow, role="prefill")
+            cb.replica_id = f"prefill{i}"
+            prefills.append(cb)
+        # default backpressure bound mirrors DisaggRouter's: 2x the
+        # decode pools' allocatable total (same spec on every rank)
+        alloc = prefills[0].cache.num_blocks - 1
+        bound = rt.max_inflight_pages \
+            or 2 * alloc * (endpoint.world - 1)
+        return PrefillNode(
+            prefills, endpoint, registry=registry, recorder=recorder,
+            max_inflight_pages=bound,
+            max_handoff_retries=rt.max_handoff_retries,
+            on_tick=on_tick, on_done=on_done)
+    cb = ContinuousBatcher(adapter, registry=registry, recorder=recorder,
+                           prefix_cache=dg.dedupe_pages,
+                           prefix_cow=sc.prefix_cache.cow, role="decode")
+    cb.replica_id = f"decode{endpoint.rank}"
+    return DecodeNode(cb, endpoint, registry=registry,
+                      recorder=recorder,
+                      decode_ticks=rt.decode_tick_cap,
+                      on_tick=on_tick, on_absorb=on_absorb)

@@ -299,6 +299,8 @@ class ContinuousBatcher:
                                        {"count": 0}),
                 "handoff_s": hists.get("serving/handoff_s",
                                        {"count": 0}),
+                "transport_s": hists.get("serving/transport_s",
+                                         {"count": 0}),
                 "first_decode_tick_s": hists.get(
                     "serving/first_decode_tick_s", {"count": 0}),
             },
@@ -894,9 +896,13 @@ class ContinuousBatcher:
         self.stats["handoffs_in"] += 1
         self.metrics.counter("serving/handoffs_in").inc()
         t_done = time.monotonic()
+        # always the first-decode-tick base — a request rebuilt from a
+        # cross-process wire doc arrives WITHOUT _t_first_tok (that
+        # monotonic stamp died with the sending process) but its
+        # first-tick latency on THIS engine is still well-defined
+        req._t_handoff_done = t_done
         t_first = getattr(req, "_t_first_tok", None)
         if t_first is not None:
-            req._t_handoff_done = t_done
             self.metrics.histogram("serving/handoff_s").observe(
                 max(t_done - t_first, 0.0))
         self._record("handoff_in", rid=req.rid,

@@ -140,6 +140,16 @@ class PagedKVCache:
     def pages_needed(self, total_tokens: int) -> int:
         return -(-total_tokens // self.spec.page_size)
 
+    @property
+    def page_nbytes(self) -> int:
+        """Raw bytes ONE block contributes to a page handoff, summed
+        over every pool component (fp: k+v rows; int8: codes + scales)
+        — the payload term of the transport's packet-size cost model
+        (``router/handoff_bytes_*`` counters, ISSUE 17)."""
+        return sum(int(np.prod(comp.shape, dtype=np.int64))
+                   // int(comp.shape[1]) * comp.dtype.itemsize
+                   for comp in self.pool)
+
     def _take_fresh(self, n: int) -> Optional[List[int]]:
         """Pop ``n`` blocks from the free list, evicting LRU refcount-0
         prefix entries to cover a shortfall. None (nothing taken) when

@@ -35,6 +35,7 @@ exactly like the single-engine scheduler's and the fault points fire
 at deterministic places (the property the recovery tests pin).
 """
 
+import json
 import os
 import time
 from collections import OrderedDict, deque
@@ -54,6 +55,31 @@ def _req_to_doc(req):
     fresh re-serve fallback when no snapshot covers it. Same schema as
     the snapshot's slot docs (ONE serializer, progress zeroed)."""
     return dict(elastic._req_doc(req), generated=[])
+
+
+def save_ledger(path, docs) -> None:
+    """Persist a ``{rid: submitted doc}`` ledger atomically (tmp +
+    rename — a SIGKILL mid-write leaves the previous valid file, never
+    a torn one). ISSUE 17: the supervisor-respawned router rank
+    re-serves the UNFINISHED slice of this ledger; greedy replay from
+    the submitted docs is token-lossless, the PR-11 pool-ledger
+    recovery rule applied across a process death."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump({str(rid): doc for rid, doc in docs.items()}, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def load_ledger(path):
+    """The saved ``{rid: doc}`` map (string rids — the caller's docs
+    carry the native rid in ``doc["rid"]``), or None when absent."""
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except OSError:
+        return None
 
 
 def percentile_summary(vals):

@@ -90,6 +90,8 @@ def router_metric_names():
         "router/decode_blocked",     # admissions deferred on pressure
         "router/prefix_routed",      # admissions routed by locality
         "router/slo_routed",         # admissions routed by SLO score
+        "router/handoff_bytes_sent",  # wire bytes extracted/sent
+        "router/handoff_bytes_recv",  # wire bytes delivered
     )
 
 
@@ -129,8 +131,12 @@ def extract_handoff(pcb, slot_id: int) -> HandoffPacket:
     n_data = cache.pages_needed(pos)
     pages = cache.slot_pages(slot_id)
     kv = cache.gather_block_kv(pages[:n_data])
+    # t_sent: wall clock (time.time, comparable ACROSS processes —
+    # monotonic bases aren't) stamped at extraction; the delivery side
+    # observes serving/transport_s against it
     doc = dict(elastic._req_doc(req), pos=int(pos),
-               last_tok=int(slot.last_tok), n_data_pages=int(n_data))
+               last_tok=int(slot.last_tok), n_data_pages=int(n_data),
+               t_sent=time.time())
     req_out, _pos, _last = pcb.export_slot(slot_id)
     return HandoffPacket(doc, kv, req_out)
 
@@ -194,6 +200,11 @@ def deliver_handoff(dcb, packet: HandoffPacket,
             else elastic.resume_request(doc)
         dcb.adopt_request(slot_id, req, int(doc["pos"]),
                           int(doc["last_tok"]))
+        if doc.get("t_sent") is not None:
+            # the wire/move segment of the handoff: extraction stamp to
+            # adoption, wall clock so it survives the process boundary
+            dcb.metrics.histogram("serving/transport_s").observe(
+                max(time.time() - float(doc["t_sent"]), 0.0))  # sync-ok: wall clock
     except BaseException:
         cache.release(slot_id)
         slot = dcb.slots[slot_id]
@@ -446,6 +457,12 @@ class DisaggRouter:
                 except faults.SimulatedCrash as e:
                     self._requeue_lost_packet(packet, e)
                     continue
+                # in-process, "bytes on the wire" = the payload the
+                # gather materialized (data pages x per-block bytes);
+                # the cross-process transport counts encoded frame
+                # lengths instead and recv == sent holds either way
+                self.metrics.counter("router/handoff_bytes_sent").inc(
+                    packet.doc["n_data_pages"] * pcb.cache.page_nbytes)
                 self._packets.append(packet)
         self._note_inflight()
 
@@ -492,6 +509,10 @@ class DisaggRouter:
                 if slot is not None:
                     self.stats["handoffs"] += 1
                     self.metrics.counter("router/handoffs").inc()
+                    self.metrics.counter(
+                        "router/handoff_bytes_recv").inc(
+                        packet.doc["n_data_pages"]
+                        * self.decode_engines[di].cache.page_nbytes)
                     break
             if crashed is not None:
                 self._requeue_lost_packet(packet, crashed)
@@ -603,6 +624,7 @@ class DisaggRouter:
                     merged(pe, "serving/ttft_queue_wait_s")),
                 "prefill_s": pct(merged(pe, "serving/ttft_prefill_s")),
                 "handoff_s": pct(merged(de, "serving/handoff_s")),
+                "transport_s": pct(merged(de, "serving/transport_s")),
                 "first_decode_tick_s": pct(
                     merged(pe + de, "serving/first_decode_tick_s")),
             },

@@ -228,3 +228,46 @@ def allgather_host_floats(vec):
     mat = multihost_utils.process_allgather(arr)
     return (np.asarray(mat, np.float32).reshape(jax.process_count(), -1),
             int(jax.process_index()))
+
+
+def allgather_host_bytes(buf, meta=None):
+    """Two-phase aligned byte allgather (ISSUE 17: the serving handoff
+    fabric's wire move); returns ``(per-rank bytes list, meta matrix
+    [world, len(meta)], process_index)``.
+
+    Phase 1 is one fixed-width :func:`allgather_host_floats` of
+    ``[nbytes, *meta]`` — the piggy-backed ``meta`` vector is how the
+    transport exchanges its backpressure counters without a second
+    fence. Phase 2 — entered by EVERY rank iff any rank has payload —
+    is one uint8 allgather padded to the max length. Both phases are
+    collectives at a single aligned call site, SEQUENTIAL (never
+    concurrent), one device per process: the documented gloo-flake-
+    stable recipe. The fp32 size word is exact below 2**24, asserted —
+    a serving handoff frame is KBs, nowhere near it. Single process
+    short-circuits like allgather_host_floats."""
+    import numpy as np
+
+    import jax
+    buf = bytes(buf)
+    assert len(buf) < 2 ** 24, (
+        f"{len(buf)}-byte buffer exceeds the fp32-exact size word")
+    meta = np.asarray([] if meta is None else meta,
+                      np.float32).reshape(-1)
+    mat, me = allgather_host_floats(
+        np.concatenate([np.float32([len(buf)]), meta]))
+    sizes = mat[:, 0].astype(np.int64)
+    world = mat.shape[0]
+    pad = int(sizes.max())
+    if pad == 0:
+        return [b""] * world, mat[:, 1:], me
+    arr = np.zeros(pad, np.uint8)
+    if buf:
+        arr[:len(buf)] = np.frombuffer(buf, np.uint8)
+    if jax.process_count() == 1:
+        rows = arr[None, :]
+    else:
+        from jax.experimental import multihost_utils
+        rows = np.asarray(
+            multihost_utils.process_allgather(arr)).reshape(world, pad)
+    return ([rows[r, :sizes[r]].tobytes() for r in range(world)],
+            mat[:, 1:], me)

@@ -688,16 +688,110 @@ def run_serving_elastic_bench(n_requests=16, slots=2, seed=0,
     }
 
 
+def run_disagg_xproc_bench(n_requests=32, max_new=6, timeout=420):
+    """``transport: "process"`` over 2 REAL ranked OS processes
+    (ISSUE 17): rank 0 = router + prefill engine (``PrefillNode``),
+    rank 1 = one decode engine (``DecodeNode``), KV pages crossing as
+    versioned wire frames through the gloo host-bytes allgather.
+    Reuses the PR-10 ``spawn_workers`` harness and
+    tests/xproc_serving_worker.py — the same module the 2-process
+    acceptance tests and the supervisor SIGKILL fault leg run — on the
+    tiny deterministic model, so the section prices the TRANSPORT
+    (frame encode → collective hop → decode → scatter → adopt), not a
+    big model's compute.
+
+    Headline: ``ttft_p99_s_disagg_xproc`` (TTFT is observed on the
+    PREFILL engine at first-token delivery, so the cross-process
+    placement can only show up in it through admission/handoff
+    stalls); the decode rank's ``transport_s`` summary attributes the
+    wire/move segment inside the breakdown, and the byte counters are
+    re-derived on both sides of the boundary (``sent == recv`` pins
+    the codec). Greedy parity vs an in-process colocated run of the
+    identical trace is asserted, as is the leak fence on BOTH pools."""
+    import pathlib
+    import tempfile
+    from tests.test_multiprocess_dist import spawn_workers
+    from tests.xproc_serving_worker import (build_model, build_requests,
+                                            serving_config)
+
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="dstpu_xproc_bench_"))
+    outs = spawn_workers(
+        2,
+        "import sys\n"
+        "from tests.xproc_serving_worker import main\n"
+        "main(['worker'] + sys.argv[1:])\n",
+        tmp, script_args=(tmp / "out", n_requests, max_new),
+        timeout=timeout)
+    met, res = {}, {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("MET "):
+                doc = json.loads(line[4:])
+                met[doc["rank"]] = doc
+            elif line.startswith("RES "):
+                _tag, rid, blob = line.split(" ", 2)
+                res[int(rid)] = json.loads(blob)
+    m0, m1 = met[0], met[1]
+
+    # in-process colocated reference over the IDENTICAL trace: greedy
+    # parity across the process boundary is the bench's correctness
+    # fence, same as the acceptance test's
+    import deepspeed_tpu.serving as serving
+    sv = {k: v for k, v in serving_config()["serving"].items()
+          if k != "disaggregation"}
+    cfg, params = build_model()
+    eng = serving.build_engine("gpt2", cfg, params,
+                               config={"serving": sv})
+    ref = eng.serve(build_requests(n_requests, max_new))
+    mismatches = sum(
+        res[rid]["tokens"] != ref[rid].tokens().tolist()
+        for rid in ref)
+
+    sent = int(m0["counters"].get("router/handoff_bytes_sent", 0))
+    recv = int(m1["counters"].get("router/handoff_bytes_recv", 0))
+    payload = int(m1["absorbed_pages"]) * int(m0["page_nbytes"])
+    fences = m0["leak_fence"] + m1["leak_fence"]
+
+    def pct(h):
+        return {k: (round(h[k], 6) if isinstance(h.get(k), float)
+                    else h.get(k))
+                for k in ("count", "mean", "p50", "p99", "max")}
+
+    ttft = m0["ttft_s"]
+    return {
+        "workload": {"world": 2, "n_requests": n_requests,
+                     "max_new_tokens": max_new,
+                     "transport": "process"},
+        "handoffs": m0["stats"]["handoffs"],
+        "handoff_bytes_sent": sent,
+        "handoff_bytes_recv": recv,
+        "kv_payload_bytes": payload,
+        "wire_overhead_bytes": sent - payload,
+        "bytes_counters_equal": sent == recv,
+        "ttft_p50_s": ttft.get("p50"),
+        "ttft_breakdown": {
+            "queue_wait_s": pct(m0["ttft_queue_wait_s"]),
+            "prefill_s": pct(m0["ttft_prefill_s"]),
+            # the wire/move segment, observed on the decode rank
+            "transport_s": pct(m1["transport_s"]),
+        },
+        "ttft_p99_s_disagg_xproc": ttft.get("p99"),
+        "token_mismatches": mismatches,
+        "leak_fence_ok": all(f["free"] == f["want"] for f in fences),
+    }
+
+
 if __name__ == "__main__":
     import argparse
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="poisson",
                     choices=["poisson", "hot_prefix", "spec_decode",
-                             "elastic", "disagg"])
+                             "elastic", "disagg", "disagg_xproc"])
     args = ap.parse_args()
     fn = {"poisson": run_serving_bench,
           "hot_prefix": run_hot_prefix_bench,
           "spec_decode": run_spec_decode_bench,
           "elastic": run_serving_elastic_bench,
-          "disagg": run_disagg_bench}[args.mode]
+          "disagg": run_disagg_bench,
+          "disagg_xproc": run_disagg_xproc_bench}[args.mode]
     print(json.dumps(fn(), indent=1))

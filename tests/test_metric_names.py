@@ -127,7 +127,8 @@ def test_handoff_serving_metric_names_documented():
     generic documented→source test covers the reverse direction)."""
     documented = documented_metric_names()
     for name in ("serving/ttft_queue_wait_s", "serving/ttft_prefill_s",
-                 "serving/handoff_s", "serving/first_decode_tick_s",
+                 "serving/handoff_s", "serving/transport_s",
+                 "serving/first_decode_tick_s",
                  "serving/handoffs_out", "serving/handoffs_in"):
         assert name in documented, (
             f"{name} missing from the docs/observability.md serving "

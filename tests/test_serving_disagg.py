@@ -415,6 +415,7 @@ def test_ttft_breakdown_components(gpt2_dis):
     assert bd["queue_wait_s"]["count"] == len(reqs)
     assert bd["prefill_s"]["count"] == len(reqs)
     assert bd["handoff_s"]["count"] == 0
+    assert bd["transport_s"]["count"] == 0   # no extract/deliver hop
     assert bd["first_decode_tick_s"]["count"] == len(reqs)
 
     router = _mk_router(adapter, n_prefill=1, n_decode=1)
@@ -423,10 +424,14 @@ def test_ttft_breakdown_components(gpt2_dis):
     assert bd["queue_wait_s"]["count"] == len(reqs)
     assert bd["prefill_s"]["count"] == len(reqs)
     assert bd["handoff_s"]["count"] == len(reqs)
+    # the wire/move segment (ISSUE 17): extraction stamp -> adoption,
+    # observed per delivered handoff even on the in-process fabric
+    assert bd["transport_s"]["count"] == len(reqs)
     assert bd["first_decode_tick_s"]["count"] == len(reqs)
 
 
-def test_build_router_from_config_and_colocated_fallback(gpt2_dis):
+def test_build_router_from_config_and_colocated_fallback(gpt2_dis,
+                                                         tmp_path):
     """build_router wires the serving.disaggregation/.router blocks;
     decode_replicas 0 (or enabled false) degrades to colocated
     engines behind the same API with identical outputs."""
@@ -457,12 +462,44 @@ def test_build_router_from_config_and_colocated_fallback(gpt2_dis):
             "gpt2", cfg, params,
             config={"serving": {**sv, "disaggregation": {},
                                 "speculative": {}}})
-    with pytest.raises(ValueError, match="elastic"):
+    # transport "process" needs a ranked world — build_router builds
+    # the in-process fabric only (build_transport_node is the entry)
+    with pytest.raises(ValueError, match="build_transport_node"):
         serving.build_router(
             "gpt2", cfg, params,
             config={"serving": {
-                **sv, "disaggregation": {},
-                "elastic": {"snapshot_path": "/tmp/x"}}})
+                **sv,
+                "disaggregation": {"transport": "process"}}})
+
+    # serving.elastic now COMPOSES (ISSUE 17 satellite): every role
+    # engine gets its own controller snapshotting into a per-replica
+    # subdir (N engines in one dir would race the commit-rename)
+    import os
+    snap_root = str(tmp_path / "snaps")
+    el = serving.build_router(
+        "gpt2", cfg, params,
+        config={"serving": {
+            **sv,
+            "disaggregation": {"prefill_replicas": 1,
+                               "decode_replicas": 2},
+            "elastic": {"snapshot_path": snap_root,
+                        "grace_secs": 5.0}}})
+    try:
+        engines = el.prefill_engines + el.decode_engines
+        assert all(cb.elastic is not None for cb in engines)
+        dirs = {cb.elastic.snapshot_dir for cb in engines}
+        assert len(dirs) == len(engines)
+        assert dirs == {os.path.join(snap_root, cb.replica_id)
+                        for cb in engines}
+        done = el.run(_clone(reqs))
+        for rid, toks in ref.items():
+            assert done[rid].tokens().tolist() == toks, rid
+    finally:
+        # LIFO close restores the pre-test signal table cleanly (the
+        # pool discipline's release() applies when OTHER replicas keep
+        # serving; here the whole world retires)
+        for cb in reversed(engines):
+            cb.elastic.close()
 
     colo = serving.build_router(
         "gpt2", cfg, params,
@@ -485,10 +522,159 @@ def test_router_metric_names_cover_emissions():
     tests/test_metric_names.py)."""
     import pathlib
     import re
-    src = (pathlib.Path(serving.__file__).parent
-           / "router.py").read_text()
+    pkg = pathlib.Path(serving.__file__).parent
+    src = ((pkg / "router.py").read_text()
+           + (pkg / "transport.py").read_text())
     emitted = set(re.findall(r'"(router/[a-z0-9_]+)"', src))
     # the f-string family router/{prefix,slo}_routed
     emitted.discard("router/")
     emitted |= {"router/prefix_routed", "router/slo_routed"}
     assert emitted == set(router_metric_names())
+
+
+# ---------------- cross-process transport: loopback fast siblings
+# (ISSUE 17). The 2-REAL-process acceptance legs live in
+# tests/test_serving_transport.py (slow tier); these run the SAME node
+# state machines and the SAME wire codec through LoopbackFabric in one
+# process, so tier-1 exercises every branch the acceptance legs do.
+
+
+def _mk_loopback(adapter, world=2, prefill_prefix=False, **pkw):
+    from deepspeed_tpu.serving.transport import (DecodeNode,
+                                                 LoopbackFabric,
+                                                 PrefillNode)
+    fab = LoopbackFabric(world)
+    pes = [ContinuousBatcher(adapter, role="prefill",
+                             prefix_cache=prefill_prefix)]
+    pnode = PrefillNode(pes, fab.endpoint(0), **pkw)
+    dnodes = [DecodeNode(ContinuousBatcher(adapter, role="decode",
+                                           prefix_cache=True),
+                         fab.endpoint(r)) for r in range(1, world)]
+    pnode.on_tick = lambda _n: [d.tick() for d in dnodes]
+    return pnode, dnodes
+
+
+def _fence_all(pnode, dnodes):
+    for cb in pnode.engines + [d.engine for d in dnodes]:
+        cb.cache.sweep_prefix_cache()
+        assert cb.cache.free_pages == cb.cache.num_blocks - 1, \
+            cb.replica_id
+
+
+def test_loopback_transport_parity_counters_and_fence(gpt2_dis):
+    """Fast sibling of the 2-process acceptance: every stream
+    token-identical to the colocated run across the encoded-frame
+    hop, ``handoff_bytes_sent == handoff_bytes_recv`` (sender counts
+    encoded lengths, receiver recomputes from decoded content), leak
+    fence clean on every pool."""
+    _cfg, _params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2)
+    reqs = _reqs(12, max_new=6, seed=4)
+    ref = _ref_streams(adapter, reqs)
+    pnode, dnodes = _mk_loopback(adapter, world=3)
+    done = pnode.serve(_clone(reqs), max_ticks=5000)
+    assert sorted(done) == sorted(ref) and not pnode.lost
+    for rid, toks in ref.items():
+        assert done[rid]["tokens"] == toks, rid
+    assert pnode.stats["handoffs"] >= len(reqs)
+    recv = sum(d.stats["bytes_recv"] for d in dnodes)
+    assert pnode.stats["bytes_sent"] == recv > 0
+    assert pnode.metrics.counter(
+        "router/handoff_bytes_sent").value == pnode.stats["bytes_sent"]
+    assert sum(d.metrics.counter("router/handoff_bytes_recv").value
+               for d in dnodes) == recv
+    _fence_all(pnode, dnodes)
+
+
+def test_loopback_dedupe_survives_process_boundary(gpt2_dis):
+    """The receiving pool's prefix index re-shares resident full
+    prompt pages: the SECOND identical prompt's delivery allocates
+    fewer fresh pages than the first — content-addressed dedupe
+    working across the (loopback) process boundary."""
+    _cfg, _params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2)
+    prompt = (np.arange(21, dtype=np.int32) * 3) % 256
+    reqs = [serving.Request(0, prompt, max_new_tokens=4),
+            serving.Request(1, prompt.copy(), max_new_tokens=4)]
+    ref = _ref_streams(adapter, reqs)
+    pnode, dnodes = _mk_loopback(adapter)
+    frees = []
+    dnodes[0].on_absorb = lambda n: frees.append(
+        n.engine.cache.free_pages)
+    before = dnodes[0].engine.cache.free_pages
+    done = pnode.serve(_clone(reqs), max_ticks=5000)
+    for rid, toks in ref.items():
+        assert done[rid]["tokens"] == toks, rid
+    assert len(frees) == 2
+    delta1 = before - frees[0]
+    delta2 = frees[0] - frees[1]
+    # 21-token prompt = 2 FULL pages re-shared by the second delivery
+    assert delta2 <= delta1 - 2, (delta1, delta2)
+    _fence_all(pnode, dnodes)
+
+
+def test_loopback_delivery_crash_nacks_and_replays(gpt2_dis):
+    """A delivery crash on the decode rank unwinds the admission
+    (serving_deliver fault point), NACKs with the wire doc, and the
+    router replays from the committed stream — bounded, token-lossless,
+    no leak."""
+    _cfg, _params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2)
+    reqs = _reqs(6, max_new=5, seed=11)
+    ref = _ref_streams(adapter, reqs)
+    pnode, dnodes = _mk_loopback(adapter)
+    with faults.crash_during_delivery(times=2):
+        done = pnode.serve(_clone(reqs), max_ticks=5000)
+    assert sum(d.stats["nacked"] for d in dnodes) == 2
+    assert pnode.stats["handoff_requeues"] == 2
+    assert not pnode.lost and sorted(done) == sorted(ref)
+    for rid, toks in ref.items():
+        assert done[rid]["tokens"] == toks, rid
+    _fence_all(pnode, dnodes)
+
+
+def test_loopback_retry_budget_drops_poisoned_request(gpt2_dis):
+    """A request whose delivery ALWAYS crashes is dropped after
+    max_handoff_retries — bounded, recorded, and the rest of the
+    workload still finishes token-identically."""
+    _cfg, _params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2)
+    reqs = _reqs(4, max_new=4, seed=13)
+    ref = _ref_streams(adapter, reqs)
+    pnode, dnodes = _mk_loopback(adapter, max_handoff_retries=2)
+    with faults.crash_during_delivery(match_rid=0, times=None):
+        done = pnode.serve(_clone(reqs), max_ticks=5000)
+    assert list(pnode.lost) == [0]
+    assert pnode.stats["lost"] == 1
+    assert sorted(done) == [1, 2, 3]
+    for rid in (1, 2, 3):
+        assert done[rid]["tokens"] == ref[rid], rid
+    _fence_all(pnode, dnodes)
+
+
+def test_loopback_backpressure_bounds_inflight_pages(gpt2_dis):
+    """``max_inflight_pages`` gates admission on the router rank from
+    the decode ranks' exchanged metrics: the latched
+    router/decode_blocked fires, the bound holds, and the workload
+    still completes token-identically."""
+    _cfg, _params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2)
+    reqs = _reqs(8, max_new=4, seed=5)
+    ref = _ref_streams(adapter, reqs)
+    pnode, dnodes = _mk_loopback(adapter, max_inflight_pages=8)
+    seen = []
+    orig_tick = pnode.on_tick
+
+    def spy(n):
+        seen.append(n._inflight_pages(n.endpoint.fabric._metrics))
+        orig_tick(n)
+
+    pnode.on_tick = spy
+    done = pnode.serve(_clone(reqs), max_ticks=5000)
+    assert pnode.stats["decode_blocked"] >= 1
+    assert pnode.metrics.counter("router/decode_blocked").value >= 1
+    assert max(seen) <= 8
+    assert sorted(done) == sorted(ref) and not pnode.lost
+    for rid, toks in ref.items():
+        assert done[rid]["tokens"] == toks, rid
+    _fence_all(pnode, dnodes)

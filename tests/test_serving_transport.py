@@ -1,0 +1,387 @@
+"""Cross-process KV page-handoff transport (ISSUE 17).
+
+Fast tier:
+
+- wire-codec goldens (``test_wire_*``, pure numpy — the subset
+  ci/serving_gate.sh runs): byte-exact round-trips for fp and int8
+  pool layouts, the versioned-header guard (an unknown version raises
+  LOUD instead of silently corrupting old packets/snapshots),
+  crc/truncation rejection, forward-compatible extra header keys, and
+  the receiver-side packet-size cost model;
+- ``test_golden_*``: REAL :class:`HandoffPacket`\\ s extracted from a
+  live prefill engine (fp32 + prefix-shared pages, and an int8
+  quantized pool) survive encode→decode bytes-exactly.
+
+Slow tier (2 REAL OS processes over the PR-10 ``spawn_workers``
+harness / the PR-15 ``Supervisor``; fast single-process loopback
+siblings live in tests/test_serving_disagg.py):
+
+- the acceptance leg: prefill-role rank 0 hands off to decode-role
+  rank 1, >= 32 cross-process handoffs token-identical to the
+  colocated greedy run, leak fence clean on BOTH pools, and the
+  ``router/handoff_bytes_{sent,recv}`` counters agreeing across the
+  process boundary (recv is recomputed from decoded content — the
+  canonical-encoding cost model);
+- the fault leg: SIGKILL of the decode-role process mid-stream → the
+  supervisor detects it (role-stamped incident), respawns the world,
+  and every request finishes token-lossless with exactly one latched
+  rank_dead dump and zero orphaned trace_ids across per-role dumps.
+"""
+
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.serving.transport import (
+    FRAME_BASE_NBYTES, WIRE_MAGIC, WIRE_VERSION, WireFormatError,
+    _HEAD, decode_frame, decode_frames, encode_frame, frame_nbytes,
+    payload_nbytes)
+
+# ------------------------------------------------------- codec goldens
+
+
+def _mk_comps():
+    rs = np.random.RandomState(7)
+    return [rs.randn(2, 3, 8, 4).astype(np.float32),
+            rs.randint(-128, 128, (2, 3, 8, 4)).astype(np.int8),
+            rs.randn(2, 3).astype(np.float16)]
+
+
+def test_wire_roundtrip_bytes_exact():
+    """encode(decode(b)) == b — the canonical-encoding property every
+    golden and the receiver-side cost model ride on."""
+    doc = {"rid": 3, "prompt": [1, 2, 3], "generated": [9],
+           "pos": 4, "last_tok": 9, "n_data_pages": 1,
+           "t_sent": 123.25, "trace_id": "abc"}
+    buf = encode_frame("packet", doc, _mk_comps(), src=0, dst=1)
+    frame, end = decode_frame(buf)
+    assert end == len(buf)
+    assert frame["kind"] == "packet"
+    assert frame["src"] == 0 and frame["dst"] == 1
+    assert frame["doc"] == doc
+    for a, b in zip(frame["comps"], _mk_comps()):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+    re_encoded = encode_frame(frame["kind"], frame["doc"],
+                              frame["comps"], frame["src"],
+                              frame["dst"])
+    assert re_encoded == buf
+    assert frame_nbytes(frame) == len(buf)
+
+
+def test_wire_int8_pool_layout_roundtrip():
+    """The quantized pool shape — int8 code blocks + float scale
+    rows — survives bytes-exactly (dtype/shape carried per component,
+    payloads raw)."""
+    rs = np.random.RandomState(3)
+    comps = [rs.randint(-128, 128, (2, 6, 8, 16)).astype(np.int8),
+             rs.randn(2, 6, 8, 1).astype(np.float32)]
+    buf = encode_frame("packet", {"n_data_pages": 6}, comps)
+    frame, _ = decode_frame(buf)
+    assert [c.dtype.str for c in frame["comps"]] == ["|i1", "<f4"]
+    for a, b in zip(frame["comps"], comps):
+        np.testing.assert_array_equal(a, b)
+    assert encode_frame(frame["kind"], frame["doc"], frame["comps"],
+                        frame["src"], frame["dst"]) == buf
+    assert payload_nbytes(frame["comps"]) == sum(c.nbytes for c in comps)
+
+
+def test_wire_unknown_version_raises_loud():
+    """The versioned-header contract: a field addition bumps
+    WIRE_VERSION and an old reader REFUSES — no silent corruption of
+    old packets or serving snapshots."""
+    buf = bytearray(encode_frame("done", {"rid": 1}))
+    head = _HEAD.unpack_from(buf, 0)
+    _HEAD.pack_into(buf, 0, head[0], WIRE_VERSION + 1, head[2], head[3])
+    with pytest.raises(WireFormatError, match="version"):
+        decode_frame(bytes(buf))
+    bad_magic = b"XXXX" + bytes(buf)[4:]
+    with pytest.raises(WireFormatError, match="magic"):
+        decode_frame(bad_magic)
+
+
+def test_wire_crc_and_truncation_rejected():
+    buf = encode_frame("packet", {"n_data_pages": 1},
+                       [np.arange(64, dtype=np.float32)])
+    # flip one payload byte -> component crc mismatch
+    corrupt = bytearray(buf)
+    corrupt[-1] ^= 0xFF
+    with pytest.raises(WireFormatError, match="crc"):
+        decode_frame(bytes(corrupt))
+    # flip one header byte -> header crc mismatch
+    corrupt = bytearray(buf)
+    corrupt[FRAME_BASE_NBYTES + 2] ^= 0xFF
+    with pytest.raises(WireFormatError, match="crc"):
+        decode_frame(bytes(corrupt))
+    for cut in (3, FRAME_BASE_NBYTES + 4, len(buf) - 8):
+        with pytest.raises(WireFormatError, match="truncated"):
+            decode_frame(buf[:cut])
+
+
+def test_wire_forward_compat_extra_header_keys_ignored():
+    """A SAME-version reader tolerates forward extensions: unknown
+    header keys decode cleanly and are dropped."""
+    import zlib
+    header = json.dumps(
+        {"v": WIRE_VERSION, "kind": "done", "src": 2, "dst": 0,
+         "doc": {"rid": 5}, "comps": [], "future_field": [1, 2]},
+        sort_keys=True, separators=(",", ":")).encode()
+    buf = _HEAD.pack(WIRE_MAGIC, WIRE_VERSION, len(header),
+                     zlib.crc32(header) & 0xFFFFFFFF) + header
+    frame, end = decode_frame(buf)
+    assert end == len(buf)
+    assert frame["kind"] == "done" and frame["doc"] == {"rid": 5}
+    assert frame["src"] == 2 and frame["comps"] == ()
+
+
+def test_wire_multiframe_buffer_and_kinds():
+    """Frames are self-delimiting: an exchange buffer concatenating a
+    packet, a done and a nack decodes back into exactly those three."""
+    frames_in = [
+        encode_frame("packet", {"rid": 0, "n_data_pages": 2},
+                     [np.ones((2, 2, 4), np.float32)], src=0, dst=1),
+        encode_frame("done", {"rid": 1, "tokens": [1, 2, 3],
+                              "finish_reason": "length"}, src=1, dst=0),
+        encode_frame("nack", {"rid": 2, "error": "boom"}, src=1, dst=0),
+    ]
+    out = decode_frames(b"".join(frames_in))
+    assert [f["kind"] for f in out] == ["packet", "done", "nack"]
+    assert sum(frame_nbytes(f) for f in out) == \
+        sum(len(b) for b in frames_in)
+
+
+# ------------------------------------------- real-packet goldens (jax)
+
+
+def _tiny_prefill(kv_cache_bits=0, prefix=True):
+    import jax.numpy as jnp  # noqa: F401  (lazy: keep module import light)
+    import deepspeed_tpu.serving as serving
+    from deepspeed_tpu.serving.engine import ContinuousBatcher
+    from tests.xproc_serving_worker import build_model
+    cfg, params = build_model()
+    sv = {"slots": 2, "page_size": 8, "max_pages_per_slot": 8}
+    if kv_cache_bits:
+        sv["kv_cache_bits"] = kv_cache_bits
+    adapter = serving.build_engine(
+        "gpt2", cfg, params, config={"serving": sv}).adapter
+    return ContinuousBatcher(adapter, role="prefill",
+                             prefix_cache=prefix)
+
+
+def _golden_roundtrip(pcb, reqs):
+    from deepspeed_tpu.serving.router import extract_handoff
+    from deepspeed_tpu.serving.transport import (encode_packet,
+                                                 packet_from_frame)
+    for r in reqs:
+        pcb.submit(r)
+    pcb.step()
+    packets = [extract_handoff(pcb, i)
+               for i, s in enumerate(pcb.slots) if s.active]
+    assert packets
+    for packet in packets:
+        buf = encode_packet(packet, src=0, dst=1)
+        frame, end = decode_frame(buf)
+        assert end == len(buf)
+        back = packet_from_frame(frame)
+        assert back.doc == packet.doc
+        assert back.req is None      # rebuilt from the doc on delivery
+        assert len(back.kv) == len(packet.kv)
+        for a, b in zip(back.kv, packet.kv):
+            got = np.asarray(a)
+            want = np.asarray(b)
+            assert got.dtype == want.dtype and got.shape == want.shape
+            np.testing.assert_array_equal(got, want)
+        # byte-exact re-encode: the golden property
+        assert encode_frame(frame["kind"], frame["doc"], frame["comps"],
+                            frame["src"], frame["dst"]) == buf
+        assert payload_nbytes(frame["comps"]) == \
+            packet.doc["n_data_pages"] * pcb.cache.page_nbytes
+    return packets
+
+
+def test_golden_handoff_packet_fp32_prefix_shared():
+    """A real fp32 packet — including one whose prompt pages are
+    PREFIX-SHARED in the sending pool — round-trips bytes-exactly,
+    and its payload equals n_data_pages * page_nbytes (the counters'
+    cost model)."""
+    import deepspeed_tpu.serving as serving
+    pcb = _tiny_prefill()
+    prompt = np.arange(17, dtype=np.int32) % 256
+    reqs = [serving.Request(0, prompt, max_new_tokens=4),
+            serving.Request(1, prompt.copy(), max_new_tokens=4)]
+    packets = _golden_roundtrip(pcb, reqs)
+    assert len(packets) == 2
+    # both packets carry the SAME prompt-page bytes (the second slot
+    # shared the first's full pages): gathers must agree exactly
+    for a, b in zip(packets[0].kv, packets[1].kv):
+        n_full = 17 // 8
+        np.testing.assert_array_equal(
+            np.asarray(a)[:, :n_full], np.asarray(b)[:, :n_full])
+
+
+def test_golden_handoff_packet_int8_pool():
+    """The int8-quantized pool layout (code blocks + scale components)
+    round-trips bytes-exactly through the same frame."""
+    import deepspeed_tpu.serving as serving
+    pcb = _tiny_prefill(kv_cache_bits=8, prefix=False)
+    assert any(np.dtype(c.dtype) == np.int8 for c in pcb.cache.pool)
+    reqs = [serving.Request(0, (np.arange(12, dtype=np.int32) * 7) % 256,
+                            max_new_tokens=4)]
+    _golden_roundtrip(pcb, reqs)
+
+
+# ------------------------------------- 2-real-process acceptance (slow)
+
+_XPROC_SCRIPT = """
+import sys
+from tests.xproc_serving_worker import main
+main(["worker"] + sys.argv[1:])
+"""
+
+
+def _parse_rank0(out):
+    res, met = {}, None
+    for line in out.splitlines():
+        if line.startswith("RES "):
+            _tag, rid, doc = line.split(" ", 2)
+            res[int(rid)] = json.loads(doc)
+        elif line.startswith("MET "):
+            met = json.loads(line[4:])
+    return res, met
+
+
+def _parse_met(out):
+    for line in out.splitlines():
+        if line.startswith("MET "):
+            return json.loads(line[4:])
+    return None
+
+
+def _colocated_reference(n_reqs, max_new):
+    from deepspeed_tpu.serving.engine import ContinuousBatcher
+    import deepspeed_tpu.serving as serving
+    from tests.xproc_serving_worker import (build_model, build_requests,
+                                            serving_config)
+    cfg, params = build_model()
+    sv = dict(serving_config()["serving"])
+    sv.pop("disaggregation")
+    adapter = serving.build_engine(
+        "gpt2", cfg, params, config={"serving": sv}).adapter
+    done = ContinuousBatcher(adapter).serve(
+        build_requests(n_reqs, max_new))
+    return {rid: r.tokens().tolist() for rid, r in done.items()}
+
+
+@pytest.mark.slow
+def test_two_process_handoff_acceptance(tmp_path):
+    """THE acceptance leg: 32+ handoffs prefill-rank -> decode-rank
+    over 2 REAL processes, token-identical to the colocated greedy
+    run, leak fence clean on both pools, byte counters agreeing
+    across the boundary."""
+    from tests.test_multiprocess_dist import spawn_workers
+    n_reqs, max_new = 32, 6
+    out_dir = tmp_path / "out"
+    outs = spawn_workers(2, _XPROC_SCRIPT, tmp_path,
+                         script_args=(str(out_dir), n_reqs, max_new),
+                         timeout=420)
+    res, met0 = _parse_rank0(outs[0])
+    met1 = _parse_met(outs[1])
+    assert met0 and met1, (outs[0][-2000:], outs[1][-2000:])
+    # every stream token-identical to the colocated run
+    ref = _colocated_reference(n_reqs, max_new)
+    assert sorted(res) == sorted(ref)
+    for rid, toks in ref.items():
+        assert res[rid]["tokens"] == toks, rid
+    # >= 32 real cross-process handoffs, none lost, none requeued
+    assert met0["stats"]["handoffs"] >= 32
+    assert met0["stats"]["lost"] == 0
+    assert met1["stats"]["delivered"] == met0["stats"]["handoffs"]
+    # leak fence on BOTH pools: every pool drains to num_blocks - 1
+    for met in (met0, met1):
+        for fence in met["leak_fence"]:
+            assert fence["free"] == fence["want"], (met["rank"], fence)
+    # byte counters match the packet-size cost model: the sender
+    # counts encoded frame lengths, the receiver RECOMPUTES each
+    # frame's size from its decoded content (canonical encoding) —
+    # equality across the process boundary pins both
+    sent = met0["counters"]["router/handoff_bytes_sent"]
+    recv = met1["counters"]["router/handoff_bytes_recv"]
+    assert sent == recv == met0["stats"]["bytes_sent"] > 0
+    # and the payload term: absorbed data pages x page_nbytes, plus a
+    # small per-frame header
+    payload = met1["absorbed_pages"] * met0["page_nbytes"]
+    assert payload < sent < payload + met0["stats"]["handoffs"] * 2048
+    # transport term observed on the decode rank for every delivery
+    assert met1["transport_s"]["count"] == met1["stats"]["delivered"]
+
+
+@pytest.mark.slow
+def test_supervisor_sigkill_decode_rank_recovers(tmp_path):
+    """The fault acceptance leg: the decode-role process SIGKILLs
+    itself mid-stream (after 2 deliveries, epoch 0). The supervisor
+    detects the death with its serving role attached, respawns the
+    2-rank world in place, and the respawned epoch re-serves ONLY the
+    unfinished rids from the ledger — every stream finishes
+    token-lossless, exactly one latched rank_dead dump, zero orphaned
+    trace_ids across the per-role dumps."""
+    from deepspeed_tpu.runtime.elastic.supervisor import Supervisor
+    from deepspeed_tpu.telemetry.recorder import FlightRecorder
+    from deepspeed_tpu.telemetry import view
+    n_reqs, max_new = 8, 6
+    out_dir = str(tmp_path / "out")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__)))
+                + os.pathsep + os.environ.get("PYTHONPATH", "")})
+    sup = Supervisor(
+        [sys.executable, os.path.join("tests", "xproc_serving_worker.py"),
+         out_dir, str(n_reqs), str(max_new), "2"],
+        2, heartbeat_dir=str(tmp_path / "hb"),
+        dump_dir=str(tmp_path / "sup_dumps"),
+        valid_worlds=[2],                 # serving worlds don't shrink:
+        roles={0: "prefill", 1: "decode"},  # respawn IN PLACE
+        hang_deadline_s=60.0, grace_kill_s=3.0, max_restarts=2,
+        backoff_base_s=0.2, backoff_max_s=0.5, poll_s=0.1,
+        local_devices=1, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        recorder=FlightRecorder())
+    rc = sup.run(deadline_s=480)
+    assert rc == 0
+    assert sup.restarts == 1 and sup.world == 2
+    # the incident names the dead rank's serving role
+    inc = sup.incidents[0]
+    reasons = inc["reasons"]
+    assert reasons.get(1, reasons.get("1")) == "signal:9"
+    roles = inc["roles"]
+    assert roles.get(1, roles.get("1")) == "decode"
+    # exactly ONE latched rank_dead dump (the supervisor's)
+    sup_dumps = glob.glob(
+        os.path.join(str(tmp_path / "sup_dumps"), "*rank_dead*"))
+    assert len(sup_dumps) == 1
+    assert glob.glob(os.path.join(out_dir, "*rank_dead*")) == []
+    # token-lossless: the final epoch's RES lines carry every request,
+    # identical to the colocated greedy run
+    res, met0 = _parse_rank0(open(sup.log_paths[(1, 0)]).read())
+    ref = _colocated_reference(n_reqs, max_new)
+    assert sorted(res) == sorted(ref)
+    for rid, toks in ref.items():
+        assert res[rid]["tokens"] == toks, rid
+    for fence in met0["leak_fence"]:
+        assert fence["free"] == fence["want"], fence
+    # zero orphaned traces: merge EVERY per-role worker dump; each
+    # trace that appears anywhere must close (the router rank is the
+    # completion authority — its "finish" events survive the kill)
+    dumps = sorted(glob.glob(os.path.join(out_dir, "flight_*.jsonl")))
+    assert dumps, os.listdir(out_dir)
+    _headers, events, _sk = view.load_dumps(dumps)
+    timelines = view.trace_timelines(events)
+    assert len(timelines) == n_reqs
+    outcomes = {t: view._trace_outcome(evs)
+                for t, evs in timelines.items()}
+    orphans = {t: o for t, o in outcomes.items() if o == "open"}
+    assert not orphans, orphans
