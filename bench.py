@@ -194,6 +194,12 @@ def headline_metrics(doc):
                 # path) — gate against BENCH_r16.json or newer
                 grab("serving.disagg_xproc_ttft_p99", entry,
                      "ttft_p99_s_disagg_xproc", -1)
+                # ISSUE 18: multi-decode scale-out — world-3 aggregate
+                # decode tok/s over world-2's single decode rank must
+                # keep >= 1.6x (LPT balancing holding both ranks near
+                # single-rank occupancy); gate vs BENCH_r18 or newer
+                grab("serving.decode_scaleout_tok_s_ratio", entry,
+                     "decode_scaleout_tok_s_ratio", +1)
             elif name == "serving_elastic":
                 # ISSUE 11: one replica kill + one graceful drain must
                 # keep recovering EVERY request (greedy replay makes
@@ -990,12 +996,32 @@ def bench_serving_disagg():
     gloo host-bytes collective. Its headline gate is
     ``ttft_p99_s_disagg_xproc``; byte counters, the transport_s
     attribution and the cross-process parity/leak fences ride the
-    ``xproc`` detail."""
+    ``xproc`` detail.
+
+    Since r18 the scale-out leg (ISSUE 18): the identical trace over
+    world=3 (2 decode ranks, targeted addressed frames, LPT
+    balancing). Headline gate: ``decode_scaleout_tok_s_ratio``
+    (world-3 aggregate decode tok/s over world-2's single rank,
+    higher is better, ~2x when the balancer holds per-rank occupancy);
+    the per-handoff wire-cost figures for both worlds, slot
+    utilization per role, and the per-rank delivery split ride the
+    ``xproc``/``xproc_w3`` details. The scale-out legs run a
+    saturation geometry (16 reqs x 24 new tokens) so both world-3
+    decode ranks hold single-rank slot occupancy; the ``xproc`` TTFT
+    leg keeps the BENCH_r16 geometry (32 x 6) so
+    ``ttft_p99_s_disagg_xproc`` stays comparable across runs."""
     from tests.perf.serving_bench import (run_disagg_bench,
+                                          run_disagg_scaleout_bench,
                                           run_disagg_xproc_bench)
     out = run_disagg_bench()
     out["xproc"] = xp = run_disagg_xproc_bench()
+    sc = run_disagg_scaleout_bench()
+    out["xproc_w2_scaleout"] = sc["xproc_w2"]
+    out["xproc_w3"] = sc["xproc_w3"]
     out["ttft_p99_s_disagg_xproc"] = xp["ttft_p99_s_disagg_xproc"]
+    out["decode_scaleout_tok_s_ratio"] = \
+        sc["decode_scaleout_tok_s_ratio"]
+    out["wire_cost_ratio_w3_over_w2"] = sc["wire_cost_ratio_w3_over_w2"]
     return out
 
 

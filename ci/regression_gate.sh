@@ -32,7 +32,11 @@
 # ``serving.disagg_xproc_ttft_p99`` (ISSUE 17: TTFT p99 of the
 # disaggregated trace with the handoff crossing 2 REAL OS processes as
 # versioned wire frames over the gloo host-bytes collective — gate
-# against BENCH_r16.json or newer to arm it).
+# against BENCH_r16.json or newer to arm it). Since r18 it includes
+# ``serving.decode_scaleout_tok_s_ratio`` (ISSUE 18: world-3
+# aggregate decode tok/s over world-2's single decode rank on the
+# LPT-balanced targeted transport, >= 1.6x — gate against
+# BENCH_r18.json or newer to arm it).
 #
 # The --candidate path never imports jax and finishes in <2 s, so this
 # runs on artifact files on any CI box. Typical wiring:

@@ -7,10 +7,15 @@
 # serving/* metric rename that leaves docs/observability.md stale.
 # Wire it next to ci/fault_gate.sh (recovery machinery) and
 # ci/telemetry_gate.sh (instrumentation): this script gates the WIRE.
-# The 2-real-process acceptance legs (32-handoff parity + byte-counter
-# cost model; supervisor SIGKILL of a decode rank recovered
-# token-lossless) live in tests/test_serving_transport.py -m slow and
-# ride the full suite.
+# Since ISSUE 18 step 2 also covers the addressed-frame codec
+# (dst-targeted vs broadcast delivery + wasted-bytes accounting over
+# the loopback fabric) and the N-rank LPT balancer fast tests
+# (least-loaded placement, per-rank inflight caps, per-episode
+# decode_blocked latching). The REAL-process acceptance legs
+# (32-handoff parity + byte-counter cost model; the 3-process
+# world-independent wire-cost pin; supervisor SIGKILL of a decode
+# rank in world=3 re-balanced onto the survivor token-lossless) live
+# in tests/test_serving_transport.py -m slow and ride the full suite.
 #
 # Usage: ci/serving_gate.sh
 # Exit nonzero on any failure. Budget: < 10 s end to end.

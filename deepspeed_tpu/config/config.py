@@ -929,7 +929,9 @@ class ServingDisaggregationConfig:
                 f"{C.SERVING_DISAGG_PREFILL_REPLICAS}, "
                 f"{C.SERVING_DISAGG_DECODE_REPLICAS}, "
                 f"{C.SERVING_DISAGG_DEDUPE_PAGES}, "
-                f"{C.SERVING_DISAGG_TRANSPORT}], got {d!r}")
+                f"{C.SERVING_DISAGG_TRANSPORT}, "
+                f"{C.SERVING_DISAGG_ADDRESSING}, "
+                f"{C.SERVING_DISAGG_PAYLOAD_TIMEOUT_S}], got {d!r}")
         self.enabled = d is not None and bool(
             d.get(C.SERVING_DISAGG_ENABLED,
                   C.SERVING_DISAGG_ENABLED_DEFAULT))
@@ -970,13 +972,42 @@ class ServingDisaggregationConfig:
                 f"cross-process fabric "
                 f"(serving.build_transport_node) — got "
                 f"{self.transport!r}")
+        self.addressing = str(d.get(C.SERVING_DISAGG_ADDRESSING,
+                                    C.SERVING_DISAGG_ADDRESSING_DEFAULT))
+        if self.addressing not in C.SERVING_DISAGG_ADDRESSING_MODES:
+            raise DeepSpeedConfigError(
+                f"serving.disaggregation.{C.SERVING_DISAGG_ADDRESSING} "
+                f"must be one of "
+                f"{list(C.SERVING_DISAGG_ADDRESSING_MODES)} — "
+                f"\"targeted\" moves destination-addressed frames "
+                f"point-to-point so a KV payload crosses the wire "
+                f"once, \"broadcast\" keeps the legacy all-rank "
+                f"allgather — got {self.addressing!r}")
+        try:
+            self.payload_timeout_s = float(d.get(
+                C.SERVING_DISAGG_PAYLOAD_TIMEOUT_S,
+                C.SERVING_DISAGG_PAYLOAD_TIMEOUT_S_DEFAULT))
+        except (TypeError, ValueError):
+            raise DeepSpeedConfigError(
+                f"serving.disaggregation."
+                f"{C.SERVING_DISAGG_PAYLOAD_TIMEOUT_S} must be a "
+                f"number of seconds, got "
+                f"{d.get(C.SERVING_DISAGG_PAYLOAD_TIMEOUT_S)!r}")
+        if self.payload_timeout_s <= 0:
+            raise DeepSpeedConfigError(
+                f"serving.disaggregation."
+                f"{C.SERVING_DISAGG_PAYLOAD_TIMEOUT_S} must be > 0 "
+                f"(a dead peer must fail loud, never hang), got "
+                f"{self.payload_timeout_s}")
 
     def __repr__(self):
         return (f"ServingDisaggregationConfig(enabled={self.enabled}, "
                 f"prefill={self.prefill_replicas}, "
                 f"decode={self.decode_replicas}, "
                 f"dedupe_pages={self.dedupe_pages}, "
-                f"transport={self.transport!r})")
+                f"transport={self.transport!r}, "
+                f"addressing={self.addressing!r}, "
+                f"payload_timeout_s={self.payload_timeout_s})")
 
 
 class ServingRouterConfig:
@@ -997,6 +1028,7 @@ class ServingRouterConfig:
                 f"{C.SERVING_ROUTER_MAX_HANDOFF_RETRIES}, "
                 f"{C.SERVING_ROUTER_DECODE_TICK_CAP}, "
                 f"{C.SERVING_ROUTER_MAX_INFLIGHT_PAGES}, "
+                f"{C.SERVING_ROUTER_MAX_INFLIGHT_PAGES_PER_RANK}, "
                 f"{C.SERVING_ROUTER_DECODE_SCHEDULE}], got {d!r}")
         d = d or {}
 
@@ -1037,6 +1069,11 @@ class ServingRouterConfig:
             C.SERVING_ROUTER_MAX_INFLIGHT_PAGES_DEFAULT, int,
             "an integer (0 = 2x the decode pools' allocatable total)",
             0)
+        self.max_inflight_pages_per_rank = _num(
+            C.SERVING_ROUTER_MAX_INFLIGHT_PAGES_PER_RANK,
+            C.SERVING_ROUTER_MAX_INFLIGHT_PAGES_PER_RANK_DEFAULT, int,
+            "an integer (0 = the aggregate bound split evenly across "
+            "decode ranks)", 0)
         self.decode_schedule = str(d.get(
             C.SERVING_ROUTER_DECODE_SCHEDULE,
             C.SERVING_ROUTER_DECODE_SCHEDULE_DEFAULT))
@@ -1057,6 +1094,8 @@ class ServingRouterConfig:
                 f"max_handoff_retries={self.max_handoff_retries}, "
                 f"decode_tick_cap={self.decode_tick_cap}, "
                 f"max_inflight_pages={self.max_inflight_pages}, "
+                f"max_inflight_pages_per_rank="
+                f"{self.max_inflight_pages_per_rank}, "
                 f"decode_schedule={self.decode_schedule!r})")
 
 

@@ -707,6 +707,15 @@ SERVING_DISAGG_TRANSPORT_DEFAULT = "inproc"
 SERVING_DISAGG_TRANSPORT_MODES = ("inproc", "process")  # ISSUE 17:
 #   "process" = per-role PROCESS placement over the gloo fabric (rank
 #   0 prefill+router, ranks >= 1 decode; serving/transport.py)
+SERVING_DISAGG_ADDRESSING = "addressing"
+SERVING_DISAGG_ADDRESSING_DEFAULT = "targeted"
+SERVING_DISAGG_ADDRESSING_MODES = ("targeted", "broadcast")  # ISSUE 18:
+#   "targeted" moves dst-addressed frames point-to-point (payload
+#   crosses the wire once, any world size); "broadcast" is the PR-17
+#   legacy all-rank allgather (O(world x payload), kept for A/B)
+SERVING_DISAGG_PAYLOAD_TIMEOUT_S = "payload_timeout_s"
+SERVING_DISAGG_PAYLOAD_TIMEOUT_S_DEFAULT = 60.0  # socket-leg deadline:
+#   a dead peer fails LOUD into the supervisor's rank-death path
 
 # serving.router — the SLO-aware multi-engine router over the role
 # split (ISSUE 14): prefix-locality admission, decode-page
@@ -726,6 +735,9 @@ SERVING_ROUTER_DECODE_TICK_CAP = "decode_tick_cap"
 SERVING_ROUTER_DECODE_TICK_CAP_DEFAULT = 4
 SERVING_ROUTER_MAX_INFLIGHT_PAGES = "max_inflight_pages"
 SERVING_ROUTER_MAX_INFLIGHT_PAGES_DEFAULT = 0   # 0 = 2x decode pools
+SERVING_ROUTER_MAX_INFLIGHT_PAGES_PER_RANK = "max_inflight_pages_per_rank"
+SERVING_ROUTER_MAX_INFLIGHT_PAGES_PER_RANK_DEFAULT = 0  # ISSUE 18:
+#   0 = the aggregate bound split evenly across decode ranks
 SERVING_ROUTER_DECODE_SCHEDULE = "decode_schedule"
 SERVING_ROUTER_DECODE_SCHEDULE_DEFAULT = "lpt"
 SERVING_ROUTER_DECODE_SCHEDULE_MODES = ("lpt", "fifo")

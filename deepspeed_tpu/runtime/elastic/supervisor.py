@@ -102,18 +102,38 @@ def solve_next_world(world, lost, valid_worlds=None, min_world=1):
     return at_or_below[-1] if at_or_below else None
 
 
-def valid_worlds_from_elasticity(param_dict, local_devices=1):
+def valid_worlds_from_elasticity(param_dict, local_devices=1,
+                                 roles=None):
     """Valid PROCESS counts for a ds-config with an ``elasticity``
     block: the HCN ladder's valid chip counts divided by the chips
     each process owns. Returns None (no constraint) when the block is
-    absent/disabled — the supervisor then shrinks arithmetically."""
+    absent/disabled — the supervisor then shrinks arithmetically.
+
+    ISSUE 18: a serving ``roles`` map (rank -> role name) contributes
+    the DECODE-COUNT ladder — every world that keeps all non-decode
+    ranks plus at least one decode rank is feasible, because losing a
+    decode rank only shrinks D (the router rank is positional rank 0
+    and the respawned world re-balances the ledger onto the
+    survivors). When both constraints apply they intersect; an empty
+    intersection returns None (terminal, by design loud)."""
     from deepspeed_tpu import elasticity as el
-    if not el.elasticity_enabled(param_dict):
-        return None
-    _final, valid_chips = el.compute_elastic_config(param_dict)
-    n = max(int(local_devices), 1)
-    worlds = sorted({c // n for c in valid_chips if c % n == 0 and c >= n})
-    return worlds or None
+    worlds = None
+    if el.elasticity_enabled(param_dict):
+        _final, valid_chips = el.compute_elastic_config(param_dict)
+        n = max(int(local_devices), 1)
+        worlds = sorted({c // n for c in valid_chips
+                         if c % n == 0 and c >= n}) or None
+    if roles:
+        n_fixed = sum(1 for name in roles.values()
+                      if str(name) != "decode")
+        # every world keeping the fixed (non-decode) ranks + >= 1
+        # decode rank, up to the configured full complement
+        ladder = list(range(max(n_fixed + 1, 2), len(roles) + 1))
+        if worlds is None:
+            worlds = ladder or None
+        else:
+            worlds = sorted(set(worlds) & set(ladder)) or None
+    return worlds
 
 
 class Supervisor:
@@ -194,6 +214,20 @@ class Supervisor:
 
     # ------------------------------------------------------------- spawn
 
+    def roles_for_world(self, world):
+        """Role map for a world of size ``world``. Roles are
+        POSITIONAL (rank 0 = the router/prefill rank, every other
+        rank = decode), so a shrunk or grown world RE-DERIVES the map
+        instead of inheriting dead ranks' entries: each surviving
+        rank keeps its configured role, ranks beyond the configured
+        map get the majority non-rank-0 role (``"decode"`` for a
+        serving world). None when this is a training world."""
+        if not self.roles:
+            return None
+        tail = [name for r, name in self.roles.items() if r != 0]
+        fill = max(set(tail), key=tail.count) if tail else "decode"
+        return {r: self.roles.get(r, fill) for r in range(int(world))}
+
     def _child_env(self, rank, world, port):
         env = dict(self.env)
         env.update({
@@ -205,8 +239,11 @@ class Supervisor:
             "DSTPU_RESTART_EPOCH": str(self.restart_epoch),
         })
         env.pop("DSTPU_LOCAL_DEVICE_IDS", None)
-        if self.roles and rank in self.roles:
-            env["DSTPU_SERVING_ROLE"] = self.roles[rank]
+        # roles re-derive per WORLD, not per configured map — a world
+        # shrunk from D=2 to D=1 must still mark its rank 1 "decode"
+        roles = self.roles_for_world(world)
+        if roles and rank in roles:
+            env["DSTPU_SERVING_ROLE"] = roles[rank]
         if self.rendezvous_retries is not None:
             env["DSTPU_RENDEZVOUS_RETRIES"] = str(self.rendezvous_retries)
         if self.rendezvous_backoff_s is not None:
@@ -412,8 +449,9 @@ class Supervisor:
         shrink, back off, respawn — or, past the budget, latch the
         ``crash_loop`` dump and return the terminal exit code."""
         detect_ts = time.time()
+        epoch_roles = self.roles_for_world(len(self.procs))
         for rank, rc in dead:
-            role = self.roles.get(rank) if self.roles else None
+            role = epoch_roles.get(rank) if epoch_roles else None
             self.recorder.record(
                 "rank_exit", rank=rank, exit_code=rc,
                 reason=reasons[rank], restart_epoch=self.restart_epoch,
@@ -447,8 +485,8 @@ class Supervisor:
         incident = {"epoch": self.restart_epoch, "dead": dict(dead),
                     "reasons": dict(reasons), "lost": n_lost,
                     "detect_ts": detect_ts, "world": world_now,
-                    "roles": {r: self.roles.get(r) for r, _ in dead}
-                    if self.roles else None}
+                    "roles": {r: epoch_roles.get(r) for r, _ in dead}
+                    if epoch_roles else None}
         self.incidents.append(incident)
 
         next_world = solve_next_world(

@@ -316,7 +316,11 @@ def build_transport_node(family: str, model_config, params, config=None,
     sc = _serving_section(pd)
     dg, rt = sc.disaggregation, sc.router
     if endpoint is None:
-        endpoint = ProcessEndpoint()
+        # ISSUE 18: addressing "targeted" (default) moves dst-addressed
+        # frames point-to-point, "broadcast" keeps the PR-17 legacy leg
+        endpoint = ProcessEndpoint(
+            addressing=dg.addressing,
+            payload_timeout_s=dg.payload_timeout_s)
     assert endpoint.world >= 2, (
         f"the process transport needs >= 2 ranks (prefill + decode), "
         f"got world={endpoint.world}")
@@ -345,6 +349,8 @@ def build_transport_node(family: str, model_config, params, config=None,
         return PrefillNode(
             prefills, endpoint, registry=registry, recorder=recorder,
             max_inflight_pages=bound,
+            max_inflight_pages_per_rank=(
+                rt.max_inflight_pages_per_rank or None),
             max_handoff_retries=rt.max_handoff_retries,
             on_tick=on_tick, on_done=on_done)
     cb = ContinuousBatcher(adapter, registry=registry, recorder=recorder,

@@ -301,6 +301,12 @@ class ContinuousBatcher:
                                        {"count": 0}),
                 "transport_s": hists.get("serving/transport_s",
                                          {"count": 0}),
+                "transport_encode_s": hists.get(
+                    "serving/transport_encode_s", {"count": 0}),
+                "transport_collective_s": hists.get(
+                    "serving/transport_collective_s", {"count": 0}),
+                "transport_decode_s": hists.get(
+                    "serving/transport_decode_s", {"count": 0}),
                 "first_decode_tick_s": hists.get(
                     "serving/first_decode_tick_s", {"count": 0}),
             },

@@ -92,6 +92,7 @@ def router_metric_names():
         "router/slo_routed",         # admissions routed by SLO score
         "router/handoff_bytes_sent",  # wire bytes extracted/sent
         "router/handoff_bytes_recv",  # wire bytes delivered
+        "router/handoff_wasted_bytes",  # wire bytes received unaddressed
     )
 
 
@@ -188,6 +189,7 @@ def deliver_handoff(dcb, packet: HandoffPacket,
     # registration happens only AFTER the scatter wrote the blocks, so
     # an unwound delivery can never leave index entries pointing at
     # never-written pages.
+    t_land = time.monotonic()
     try:
         faults.fire("serving_deliver", rid=packet.rid, slot=slot_id)
         # one scatter per pool component writes the non-shared data
@@ -200,6 +202,10 @@ def deliver_handoff(dcb, packet: HandoffPacket,
             else elastic.resume_request(doc)
         dcb.adopt_request(slot_id, req, int(doc["pos"]),
                           int(doc["last_tok"]))
+        # the landing segment of the transport: scatter + adopt on the
+        # receiver, monotonic (single-process span)
+        dcb.metrics.histogram("serving/transport_decode_s").observe(
+            time.monotonic() - t_land)
         if doc.get("t_sent") is not None:
             # the wire/move segment of the handoff: extraction stamp to
             # adoption, wall clock so it survives the process boundary
@@ -625,6 +631,16 @@ class DisaggRouter:
                 "prefill_s": pct(merged(pe, "serving/ttft_prefill_s")),
                 "handoff_s": pct(merged(de, "serving/handoff_s")),
                 "transport_s": pct(merged(de, "serving/transport_s")),
+                # ISSUE 18: the transport term split into attributable
+                # segments (encode at the sender, the aligned exchange,
+                # scatter/adopt at the receiver); in-process delivery
+                # observes only the landing segment
+                "transport_encode_s": pct(
+                    merged(pe, "serving/transport_encode_s")),
+                "transport_collective_s": pct(
+                    merged(pe + de, "serving/transport_collective_s")),
+                "transport_decode_s": pct(
+                    merged(de, "serving/transport_decode_s")),
                 "first_decode_tick_s": pct(
                     merged(pe + de, "serving/first_decode_tick_s")),
             },

@@ -26,21 +26,32 @@ golden-test property and the receiver-side cost model
 (:func:`frame_nbytes`) both ride on it. Pure numpy + stdlib: the codec
 never touches a jax backend.
 
-**Aligned exchange.** Frames move through
-:func:`deepspeed_tpu.utils.distributed.allgather_host_bytes`: phase 1
-is one fixed-width float allgather of ``[nbytes, *metrics]`` (the
-decode-side backpressure feed), phase 2 — entered by EVERY rank iff
-any rank has payload — one padded uint8 allgather. Both phases are
-collectives every rank calls at the same loop point (the
-``ClusterAggregator`` fence discipline), so the exchange cannot
-deadlock; the collectives are SEQUENTIAL with one device per process,
-the documented gloo-flake-stable recipe (tests/test_multiprocess_dist).
+**Aligned exchange.** The header leg keeps PR 17's fence discipline:
+one fixed-width float allgather of ``[sizes, *metrics]`` every rank
+calls at the same loop point (the ``ClusterAggregator`` fence), so the
+exchange cannot deadlock; the collectives are SEQUENTIAL with one
+device per process, the documented gloo-flake-stable recipe
+(tests/test_multiprocess_dist). ISSUE 18 splits the PAYLOAD off that
+fence: with ``addressing="targeted"`` (default) the header leg also
+carries the per-destination traffic matrix, destination-addressed
+frames (``dst >= 0`` — packets, done, nack) then move point-to-point
+over :func:`~deepspeed_tpu.utils.distributed
+.exchange_host_bytes_targeted`'s deterministic socket schedule, and
+only dst<0 traffic rides the padded broadcast allgather — a KV payload
+crosses the wire ONCE regardless of world size, where the PR-17
+broadcast paid O(world x payload). ``addressing="broadcast"`` keeps
+the legacy single-leg allgather; either way the bytes a rank received
+WITHOUT being addressed (filtered frames + broadcast padding) land in
+``router/handoff_wasted_bytes``, so the per-handoff wire cost is
+assertable from counters alone.
 
 **Role nodes.** Rank 0 runs :class:`PrefillNode` — the router lives on
 the prefill rank: admission (bounded by ``max_inflight_pages`` fed
 from the exchanged metrics), prefill engine steps, packet extraction
-(``gather_block_kv``) and send, "done"/"nack" intake, bounded
-nack replay from the wire doc. Ranks >= 1 run :class:`DecodeNode`:
+(``gather_block_kv``), LPT placement across EVERY decode rank (least
+exchanged remaining-decode estimate, per-rank inflight-pages caps —
+packets with no eligible rank queue HERE), "done"/"nack" intake,
+bounded nack replay from the wire doc. Ranks >= 1 run :class:`DecodeNode`:
 decode frames, land packets through
 :func:`~deepspeed_tpu.serving.router.deliver_handoff` (the receiving
 pool's prefix index re-shares resident full prompt pages — the
@@ -74,13 +85,15 @@ FRAME_BASE_NBYTES = _HEAD.size
 # rank at every exchange. Senders read the decode rows for backpressure
 # (free pages/slots, cumulative absorbed pages); everyone reads rank
 # 0's MV_STOP to leave the loop at the SAME aligned exchange.
-MV_LEN = 6
+MV_LEN = 7
 MV_ROLE = 0            # 0 = prefill/router rank, 1 = decode rank
 MV_FREE_PAGES = 1      # decode pool pages currently allocatable
 MV_FREE_SLOTS = 2      # decode slots currently free
 MV_ABSORBED_PAGES = 3  # cumulative data pages absorbed (delivered)
 MV_DONE = 4            # cumulative requests finished on this rank
 MV_STOP = 5            # rank 0 sets 1: drain done, leave after this tick
+MV_REMAINING = 6       # est. remaining decode tokens (active + waiting)
+#   — the LPT balancing signal the router minimizes over decode ranks
 
 
 class WireFormatError(ValueError):
@@ -215,12 +228,20 @@ class LoopbackFabric:
     """Single-process fabric: endpoints exchange ENCODED frames through
     an in-memory inbox, so the codec and both node state machines run
     for real with no collectives — the fast sibling of the
-    2-real-process path. Metrics rows update at each endpoint's
-    exchange (last-written wins, like the aligned gather's snapshot)."""
+    N-real-process path. Metrics rows update at each endpoint's
+    exchange (last-written wins, like the aligned gather's snapshot).
+    ``addressing="targeted"`` (default) routes each frame to its
+    destination only, mirroring the socket payload leg;
+    ``addressing="broadcast"`` copies every frame to every rank and
+    lets receivers filter — the PR-17 wire shape, so the
+    ``handoff_wasted_bytes`` accounting is testable without spawning
+    processes."""
 
-    def __init__(self, world: int):
+    def __init__(self, world: int, addressing: str = "targeted"):
         assert world >= 2, world
+        assert addressing in ("targeted", "broadcast"), addressing
         self.world = int(world)
+        self.addressing = addressing
         self._inbox = [deque() for _ in range(self.world)]
         self._metrics = np.zeros((self.world, MV_LEN), np.float32)
 
@@ -234,46 +255,111 @@ class LoopbackEndpoint:
         self.fabric = fabric
         self.rank = int(rank)
         self.world = fabric.world
+        self._wasted = 0
 
-    def exchange(self, out_bufs, metrics):
+    def take_wasted(self) -> int:
+        """Bytes this endpoint received without being addressed since
+        the last call — the ``router/handoff_wasted_bytes`` feed."""
+        w, self._wasted = self._wasted, 0
+        return w
+
+    def exchange(self, out, metrics):
         fab = self.fabric
         fab._metrics[self.rank] = np.asarray(  # sync-ok: host metrics vec
             metrics, np.float32).reshape(MV_LEN)
-        for buf in out_bufs:
+        for dst, buf in out:
             for frame in decode_frames(buf):
-                dsts = range(fab.world) if frame["dst"] < 0 \
-                    else (frame["dst"],)
+                if fab.addressing == "broadcast" or dst < 0:
+                    dsts = range(fab.world)
+                else:
+                    dsts = (int(dst),)
                 for r in dsts:
                     if r != self.rank:
                         fab._inbox[r].append(frame)
         inbox = fab._inbox[self.rank]
-        frames = [inbox.popleft() for _ in range(len(inbox))]
+        frames = []
+        for _ in range(len(inbox)):
+            frame = inbox.popleft()
+            if frame["dst"] < 0 or frame["dst"] == self.rank:
+                frames.append(frame)
+            else:
+                self._wasted += frame_nbytes(frame)
         return frames, fab._metrics.copy()
 
 
 class ProcessEndpoint:
     """The real thing: frames + metrics cross processes through the
-    two-phase aligned allgather (see module docstring). Every rank
-    MUST call :meth:`exchange` at the same loop point every tick —
-    the fence discipline is what makes the fabric deadlock-free."""
+    aligned exchange (see module docstring). Every rank MUST call
+    :meth:`exchange` at the same loop point every tick — the fence
+    discipline is what makes the fabric deadlock-free. ``out`` is a
+    list of ``(dst, frame bytes)``: with ``addressing="targeted"``
+    dst>=0 frames ride the point-to-point payload leg (lazy
+    :class:`~deepspeed_tpu.utils.distributed.PeerFabric`, created at
+    the first exchange — an aligned point every rank reaches
+    together); ``addressing="broadcast"`` is the PR-17 legacy
+    single-allgather shape."""
 
-    def __init__(self):
+    def __init__(self, addressing: str = "targeted",
+                 payload_timeout_s: float = 60.0):
         import jax
+        assert addressing in ("targeted", "broadcast"), addressing
         self.rank = int(jax.process_index())
         self.world = int(jax.process_count())
+        self.addressing = addressing
+        self.payload_timeout_s = float(payload_timeout_s)  # sync-ok: cfg
+        self._fabric = None
+        self._wasted = 0
 
-    def exchange(self, out_bufs, metrics):
-        from deepspeed_tpu.utils.distributed import allgather_host_bytes
-        bufs, mat, me = allgather_host_bytes(
-            b"".join(out_bufs),  # sync-ok: the cross-host hop itself
-            meta=np.asarray(metrics, np.float32).reshape(MV_LEN))
+    def take_wasted(self) -> int:
+        w, self._wasted = self._wasted, 0
+        return w
+
+    def _filter(self, bufs, me, pad):
+        """Broadcast-leg intake: keep frames addressed here (or to
+        all), count everything else — mis-addressed frames and the
+        padding peers forced onto this rank — as wasted wire bytes."""
         frames = []
         for r, buf in enumerate(bufs):
-            if r == me or not buf:
+            if r == me:
                 continue
+            self._wasted += max(pad - len(buf), 0)
             for frame in decode_frames(buf):
                 if frame["dst"] < 0 or frame["dst"] == me:
                     frames.append(frame)
+                else:
+                    self._wasted += frame_nbytes(frame)
+        return frames
+
+    def exchange(self, out, metrics):
+        meta = np.asarray(metrics, np.float32).reshape(
+            MV_LEN)   # sync-ok: metrics vector is host-built numpy
+        if self.addressing == "broadcast":
+            from deepspeed_tpu.utils.distributed import \
+                allgather_host_bytes
+            bufs, mat, me = allgather_host_bytes(
+                b"".join(buf for _dst, buf in out),  # sync-ok: wire hop
+                meta=meta)
+            pad = max((len(b) for b in bufs), default=0)
+            return self._filter(bufs, me, pad), mat
+        from deepspeed_tpu.utils.distributed import (
+            PeerFabric, exchange_host_bytes_targeted)
+        if self._fabric is None:
+            # collective construction (listener-address allgather) at
+            # the first exchange — a point every rank reaches together
+            self._fabric = PeerFabric(timeout_s=self.payload_timeout_s)
+        bcast, by_dst = [], {}
+        for dst, buf in out:
+            if dst < 0:
+                bcast.append(buf)
+            else:
+                assert dst != self.rank, "frame addressed to self"
+                by_dst[int(dst)] = by_dst.get(int(dst), b"") + buf
+        bufs, incoming, mat, me, pad = exchange_host_bytes_targeted(
+            b"".join(bcast), by_dst, meta=meta,  # sync-ok: wire hop
+            fabric=self._fabric)
+        frames = self._filter(bufs, me, pad)
+        for src in sorted(incoming):
+            frames.extend(decode_frames(incoming[src]))
         return frames, mat
 
 
@@ -301,10 +387,12 @@ class DecodeNode:
         self.on_tick = on_tick
         self.on_absorb = on_absorb
         self._waiting: deque = deque()   # packets waiting on a slot
-        self._out_bufs: List[bytes] = []
+        self._outbox: List = []          # (dst, frame bytes) pairs
         self.absorbed_pages = 0
         self.done_count = 0
-        self.stats = {"delivered": 0, "nacked": 0, "bytes_recv": 0}
+        self.stats = {"delivered": 0, "nacked": 0, "bytes_recv": 0,
+                      "wasted_bytes": 0, "decode_busy_s": 0.0,
+                      "slot_busy_ticks": 0, "slot_cap_ticks": 0}
 
     def _vec(self):
         cb = self.engine
@@ -314,7 +402,30 @@ class DecodeNode:
         v[MV_FREE_SLOTS] = sum(not s.active for s in cb.slots)
         v[MV_ABSORBED_PAGES] = self.absorbed_pages
         v[MV_DONE] = self.done_count
+        # remaining-decode estimate: tokens still owed by active slots
+        # plus everything parked in the waiting queue — what the
+        # router's LPT placement minimizes across decode ranks
+        rem = 0
+        for s in cb.slots:
+            if s.active and s.request is not None:
+                rem += max(int(s.request.max_new_tokens)
+                           - len(s.request.generated), 0)
+        for frame in self._waiting:
+            doc = frame["doc"]
+            rem += max(int(doc["max_new_tokens"])
+                       - len(doc["generated"]), 0)
+        v[MV_REMAINING] = rem
         return v
+
+    def _note_wasted(self):
+        take = getattr(self.endpoint, "take_wasted", None)
+        if take is None:
+            return
+        wasted = int(take())
+        if wasted:
+            self.stats["wasted_bytes"] += wasted
+            self.metrics.counter("router/handoff_wasted_bytes").inc(
+                wasted)
 
     def _try_deliver(self, frame, out_bufs) -> bool:
         """True when the packet landed or was nacked (consumed);
@@ -330,9 +441,9 @@ class DecodeNode:
             # gathered bytes are suspect — nack with the wire doc so
             # the router replays from the committed stream, bounded
             self.stats["nacked"] += 1
-            out_bufs.append(encode_frame(
+            out_bufs.append((frame["src"], encode_frame(
                 "nack", dict(packet.doc, error=str(e)),
-                src=self.endpoint.rank, dst=frame["src"]))
+                src=self.endpoint.rank, dst=frame["src"])))
             return True
         if slot is None:
             return False
@@ -347,8 +458,13 @@ class DecodeNode:
         exchanged metrics matrix (callers check ``mat[0, MV_STOP]``).
         :meth:`run` loops this, and the loopback tests drive it
         directly — same code path either way."""
-        frames, mat = self.endpoint.exchange(self._out_bufs, self._vec())
-        self._out_bufs = []
+        t_coll = time.monotonic()
+        frames, mat = self.endpoint.exchange(self._outbox, self._vec())
+        self.engine.metrics.histogram(
+            "serving/transport_collective_s").observe(
+            time.monotonic() - t_coll)
+        self._outbox = []
+        self._note_wasted()
         for frame in frames:
             if frame["kind"] != "packet":
                 continue
@@ -359,23 +475,42 @@ class DecodeNode:
         # deliver in arrival order; stop at the first packet the
         # pool cannot take yet (later ones would jump the queue)
         while self._waiting:
-            if not self._try_deliver(self._waiting[0], self._out_bufs):
+            if not self._try_deliver(self._waiting[0], self._outbox):
                 break
             self._waiting.popleft()
         cb = self.engine
+        # busy time is THIS THREAD's CPU seconds, not wall clock and
+        # not process CPU: on the shared-core harness several decode
+        # ranks time-slice one core, so a wall clock bills each rank
+        # for slices it spent descheduled, and process CPU bills the
+        # XLA pool threads' post-collective spin-wait (which grows
+        # with wall time, i.e. with world size). The scheduler thread
+        # drives every decode step, so its own CPU measures the
+        # per-rank capacity a one-host-per-rank deployment would see
+        t_busy = time.thread_time()
+        stepped = False
         for _tick in range(self.decode_ticks):
-            if not any(s.active for s in cb.slots):
+            active = sum(s.active for s in cb.slots)
+            self.stats["slot_busy_ticks"] += active
+            if not active:
                 break
+            stepped = True
             for req in cb.step():
                 self.done_count += 1
-                self._out_bufs.append(encode_frame(
+                self._outbox.append((0, encode_frame(
                     "done",
                     {"rid": req.rid,
                      "tokens": [int(t) for t in req.tokens()],
                      "finish_reason": req.finish_reason,
                      "trace_id": getattr(req, "trace_id", None),
                      "generated": len(req.generated)},
-                    src=self.endpoint.rank, dst=0))
+                    src=self.endpoint.rank, dst=0)))
+        # slot-utilization denominator counts the FULL decode budget of
+        # the tick (idle ticks show as low utilization — the bench's
+        # honesty signal), busy time only what actually stepped
+        self.stats["slot_cap_ticks"] += len(cb.slots) * self.decode_ticks
+        if stepped:
+            self.stats["decode_busy_s"] += time.thread_time() - t_busy
         if self.on_tick is not None:
             self.on_tick(self)
         return mat
@@ -402,6 +537,7 @@ class PrefillNode:
 
     def __init__(self, engines, endpoint, registry=None, recorder=None,
                  max_inflight_pages: Optional[int] = None,
+                 max_inflight_pages_per_rank: Optional[int] = None,
                  max_handoff_retries: int = 3, on_tick=None,
                  on_done=None):
         from deepspeed_tpu.telemetry.recorder import default_recorder
@@ -422,17 +558,32 @@ class PrefillNode:
         self.on_done = on_done
         self.decode_ranks = [r for r in range(endpoint.world)
                              if r != endpoint.rank]
+        # per-rank send-time backpressure: default = the aggregate
+        # bound split evenly across decode ranks, so one slow rank
+        # cannot monopolize the whole inflight budget
+        if max_inflight_pages_per_rank is not None:
+            self.max_inflight_pages_per_rank = int(
+                max_inflight_pages_per_rank)
+        elif self.max_inflight_pages is not None:
+            self.max_inflight_pages_per_rank = max(
+                self.max_inflight_pages // max(len(self.decode_ranks), 1),
+                1)
+        else:
+            self.max_inflight_pages_per_rank = None
         self.queue: deque = deque()
         self._packets: deque = deque()     # extracted, not yet sent
         self._attempts: Dict[Any, int] = {}
         self._sent_pages = {r: 0 for r in self.decode_ranks}
         self._submitted = 0
         self._block_latched = False
+        self._rank_blocked = {r: False for r in self.decode_ranks}
         self._host_rng = np.random.RandomState(0)
         self.done: Dict[Any, dict] = {}    # rid -> done doc
         self.lost: Dict[Any, dict] = {}
         self.stats = {"routed": 0, "handoffs": 0, "handoff_requeues": 0,
-                      "decode_blocked": 0, "lost": 0, "bytes_sent": 0}
+                      "decode_blocked": 0, "lost": 0, "bytes_sent": 0,
+                      "wasted_bytes": 0, "slot_busy_ticks": 0,
+                      "slot_cap_ticks": 0}
 
     # ------------------------------------------------------------ intake
 
@@ -542,26 +693,93 @@ class PrefillNode:
                     self._requeue(packet.doc, e)
                     continue
                 self._packets.append(packet)
-        # decode rank with the most estimated headroom takes each
-        # packet; a rank with no free slot still accepts the frame into
-        # its waiting queue (the pages stay counted as inflight here
-        # until its MV_ABSORBED_PAGES acknowledges the delivery)
-        while self._packets:
-            packet = self._packets.popleft()
-            dst = max(self.decode_ranks, key=lambda r: (
-                mat[r, MV_FREE_PAGES]
-                - (self._sent_pages[r] - mat[r, MV_ABSORBED_PAGES])))
+        # LPT placement (ISSUE 18): longest-remaining packet first onto
+        # the decode rank with the least estimated remaining work (the
+        # exchanged MV_REMAINING plus its sent-but-unacknowledged pages
+        # as the in-flight lag proxy), subject to the per-rank
+        # inflight-pages cap. A rank with no free slot still accepts a
+        # frame into its waiting queue (the pages stay counted as
+        # inflight here until MV_ABSORBED_PAGES acknowledges them); a
+        # packet NO rank can take stays queued HERE — per-rank
+        # backpressure at the router — and each refusing rank latches
+        # one decode_blocked per episode.
+        def _rem(p):
+            return max(int(p.doc["max_new_tokens"])
+                       - len(p.doc["generated"]), 0)
+
+        unabsorbed = {r: self._sent_pages[r]
+                      - int(mat[r, MV_ABSORBED_PAGES])
+                      for r in self.decode_ranks}
+        load = {r: float(mat[r, MV_REMAINING]) + unabsorbed[r]
+                for r in self.decode_ranks}   # sync-ok: mat is the
+        #                                       host metrics matrix
+        cap = self.max_inflight_pages_per_rank
+        held: deque = deque()
+        for packet in sorted(self._packets, key=_rem, reverse=True):
+            need = int(packet.doc["n_data_pages"])
+            if cap is None:
+                eligible = self.decode_ranks
+            else:
+                # an oversized packet (need > cap) may still go to a
+                # fully-acknowledged rank: the cap is backpressure,
+                # not a validator, and holding it forever would wedge
+                eligible = [r for r in self.decode_ranks
+                            if unabsorbed[r] + need <= cap
+                            or unabsorbed[r] == 0]
+            if not eligible:
+                for r in self.decode_ranks:
+                    self._latch_rank_block(r, packet, unabsorbed[r])
+                held.append(packet)
+                continue
+            dst = min(eligible, key=lambda r: (
+                load[r], -float(mat[r, MV_FREE_PAGES]),
+                r))   # sync-ok: host metrics matrix, no device read
+            self._rank_blocked[dst] = False   # headroom proven: re-arm
+            t_enc = time.monotonic()
             buf = encode_frame("packet", packet.doc, packet.kv,
                                src=self.endpoint.rank, dst=dst)
-            out_bufs.append(buf)
-            self._sent_pages[dst] += int(packet.doc["n_data_pages"])
+            self.engines[0].metrics.histogram(
+                "serving/transport_encode_s").observe(
+                time.monotonic() - t_enc)
+            out_bufs.append((dst, buf))
+            self._sent_pages[dst] += need
+            unabsorbed[dst] += need
+            load[dst] += _rem(packet)
             self.stats["handoffs"] += 1
             self.stats["bytes_sent"] += len(buf)
             self.metrics.counter("router/handoffs").inc()
             self.metrics.counter("router/handoff_bytes_sent").inc(
                 len(buf))
+        self._packets = held
         self.metrics.gauge("router/inflight_pages").set(
             self._inflight_pages(mat))
+
+    def _latch_rank_block(self, rank, packet, unabsorbed) -> None:
+        """One decode_blocked per REFUSING RANK per episode (the
+        admission latch's per-rank sibling): a held packet re-checks
+        every sweep, and counting each re-check would flood the
+        bounded ring at tick rate under sustained pressure."""
+        if self._rank_blocked[rank]:
+            return
+        self._rank_blocked[rank] = True
+        self.stats["decode_blocked"] += 1
+        self.metrics.counter("router/decode_blocked").inc()
+        self.recorder.record(
+            "router_block", rid=packet.doc["rid"],
+            trace=packet.doc.get("trace_id"), rank=rank,
+            need_pages=int(packet.doc["n_data_pages"]),
+            inflight_pages=int(unabsorbed),
+            queue_depth=len(self._packets))
+
+    def _note_wasted(self) -> None:
+        take = getattr(self.endpoint, "take_wasted", None)
+        if take is None:
+            return
+        wasted = int(take())
+        if wasted:
+            self.stats["wasted_bytes"] += wasted
+            self.metrics.counter("router/handoff_wasted_bytes").inc(
+                wasted)
 
     def _finish(self, doc) -> None:
         self.done[doc["rid"]] = doc
@@ -583,7 +801,7 @@ class PrefillNode:
         (max_new_tokens == 1 / instant EOS) complete locally."""
         for r in requests:
             self.submit(r)
-        out_bufs: List[bytes] = []
+        out_bufs: List = []   # (dst, frame bytes) pairs
         mat = np.zeros((self.endpoint.world, MV_LEN), np.float32)
         for _ in range(max_ticks):
             self._route_admissions(mat)
@@ -595,8 +813,19 @@ class PrefillNode:
                         "finish_reason": req.finish_reason,
                         "trace_id": getattr(req, "trace_id", None),
                         "generated": len(req.generated)})
+                # occupancy is sampled AFTER the step and BEFORE the
+                # sweep extracts the active slots into packets — the
+                # only point in the tick where prefill work is visible
+                self.stats["slot_busy_ticks"] += sum(
+                    s.active for s in pcb.slots)
+                self.stats["slot_cap_ticks"] += len(pcb.slots)
             self._sweep_and_send(mat, out_bufs)
+            t_coll = time.monotonic()
             frames, mat = self.endpoint.exchange(out_bufs, self._vec(0.0))
+            self.engines[0].metrics.histogram(
+                "serving/transport_collective_s").observe(
+                time.monotonic() - t_coll)
+            self._note_wasted()
             out_bufs = []
             for frame in frames:
                 if frame["kind"] == "done":

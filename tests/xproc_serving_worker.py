@@ -33,8 +33,16 @@ Env contract: the spawn_workers / Supervisor variables
 (``DSTPU_COORDINATOR_*``, ``DSTPU_PROCESS_ID`` ...) plus the
 supervisor's ``DSTPU_RESTART_EPOCH`` / ``DSTPU_HEARTBEAT_DIR`` /
 ``DSTPU_SERVING_ROLE``. argv: ``out_dir [n_reqs] [max_new]
-[kill_after]`` — ``kill_after >= 0`` arms a decode-rank self-SIGKILL
-after that many deliveries, EPOCH 0 ONLY (the fault under test).
+[kill_after] [slots] [num_blocks] [addressing] [tick_cap]`` —
+``kill_after >= 0`` arms a RANK-1 decode self-SIGKILL after that many
+deliveries, EPOCH 0 ONLY (the fault under test; pinned to rank 1 so a
+D>=2 world loses exactly one decode rank). ``slots``/``num_blocks``
+size the engine geometry per leg (ISSUE 18: the default 2-slot pool
+made the bench TTFT tail pure queue wait — benches must say which
+geometry they measured); ``addressing`` picks the wire mode
+(targeted|broadcast); ``tick_cap > 0`` overrides
+``serving.router.decode_tick_cap`` (the scale-out bench uses 1 so
+streams stay resident long enough to saturate every rank's slots).
 """
 
 import json
@@ -68,10 +76,17 @@ def build_model():
     return cfg, params
 
 
-def serving_config():
-    return {"serving": {"slots": 2, "page_size": 8,
-                        "max_pages_per_slot": 8,
-                        "disaggregation": {"transport": "process"}}}
+def serving_config(slots=2, num_blocks=0, addressing="targeted",
+                   tick_cap=0):
+    sv = {"slots": int(slots), "page_size": 8,
+          "max_pages_per_slot": 8,
+          "disaggregation": {"transport": "process",
+                             "addressing": str(addressing)}}
+    if int(num_blocks) > 0:
+        sv["num_blocks"] = int(num_blocks)
+    if int(tick_cap) > 0:
+        sv["router"] = {"decode_tick_cap": int(tick_cap)}
+    return {"serving": sv}
 
 
 def build_requests(n_reqs, max_new):
@@ -109,6 +124,10 @@ def main(argv):
     n_reqs = int(argv[2]) if len(argv) > 2 else 8
     max_new = int(argv[3]) if len(argv) > 3 else 6
     kill_after = int(argv[4]) if len(argv) > 4 else -1
+    slots = int(argv[5]) if len(argv) > 5 else 2
+    num_blocks = int(argv[6]) if len(argv) > 6 else 0
+    addressing = argv[7] if len(argv) > 7 else "targeted"
+    tick_cap = int(argv[8]) if len(argv) > 8 else 0
     os.makedirs(out_dir, exist_ok=True)
 
     init_distributed()
@@ -137,8 +156,16 @@ def main(argv):
 
     cfg, params = build_model()
     node = serving.build_transport_node(
-        "gpt2", cfg, params, config=serving_config(),
+        "gpt2", cfg, params,
+        config=serving_config(slots, num_blocks, addressing, tick_cap),
         registry=reg, recorder=rec)
+
+    def _hist(name):
+        return reg.histogram(name).summary()
+
+    def _slot_util(stats):
+        cap = stats.get("slot_cap_ticks", 0)
+        return (stats.get("slot_busy_ticks", 0) / cap) if cap else 0.0
 
     if rank == 0:
         ledger_path = os.path.join(out_dir, "ledger.json")
@@ -177,14 +204,17 @@ def main(argv):
                "stats": node.stats,
                "counters": reg.snapshot()["counters"],
                "ttft_s": reg.histogram("serving/ttft_s").summary(),
-               "ttft_queue_wait_s": reg.histogram(
-                   "serving/ttft_queue_wait_s").summary(),
-               "ttft_prefill_s": reg.histogram(
-                   "serving/ttft_prefill_s").summary(),
+               "ttft_queue_wait_s": _hist("serving/ttft_queue_wait_s"),
+               "ttft_prefill_s": _hist("serving/ttft_prefill_s"),
+               "transport_encode_s": _hist("serving/transport_encode_s"),
+               "transport_collective_s": _hist(
+                   "serving/transport_collective_s"),
+               "slot_util": _slot_util(node.stats),
+               "slots": slots,
                "page_nbytes": node.engines[0].cache.page_nbytes,
                "leak_fence": _fence(node.engines)}
     else:
-        if kill_after >= 0 and epoch == 0:
+        if kill_after >= 0 and epoch == 0 and rank == 1:
             def _boom(n):
                 if n.stats["delivered"] >= kill_after:
                     # mid-stream by construction: the request just
@@ -195,8 +225,13 @@ def main(argv):
         met = {"rank": rank, "epoch": epoch, "role": "decode",
                "stats": node.stats,
                "counters": reg.snapshot()["counters"],
-               "transport_s": reg.histogram(
-                   "serving/transport_s").summary(),
+               "transport_s": _hist("serving/transport_s"),
+               "transport_collective_s": _hist(
+                   "serving/transport_collective_s"),
+               "transport_decode_s": _hist("serving/transport_decode_s"),
+               "slot_util": _slot_util(node.stats),
+               "slots": slots,
+               "decode_tokens": node.engine.stats["decode_tokens"],
                "absorbed_pages": node.absorbed_pages,
                "done": node.done_count,
                "leak_fence": _fence([node.engine])}
