@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Regenerate the Perfetto-export golden triplet (ISSUE 19 satellite).
+
+Writes two synthetic per-rank flight-recorder dumps — fixed
+timestamps, fixed span ids, the same causal shape a 2-process
+disaggregated handoff produces (admit → prefill → handoff_out →
+transport_encode on rank 0, handoff_in → tick on rank 1) — and the
+exporter's output for them:
+
+    ci/perfetto_golden_dump_rank0.jsonl
+    ci/perfetto_golden_dump_rank1.jsonl
+    ci/perfetto_golden.json
+
+ci/telemetry_gate.sh round-trips the dumps through
+``view --format perfetto`` under poisoned jax/numpy stubs and
+byte-diffs against the golden JSON — a nondeterministic exporter, a
+jax import on the export path, or an unannounced schema change all
+fail the gate. Re-run THIS script (and eyeball the diff) when the
+trace-event mapping changes on purpose. The dump shape is mirrored by
+``_golden_dumps`` in tests/test_trace_plane.py.
+"""
+
+import json
+import os
+import sys
+
+CI_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(CI_DIR))
+
+RANK0 = [
+    {"kind": "dump_header", "rule": "worker_exit", "dump_id": 1,
+     "source": "rank0e0", "ts": 100.0,
+     "provenance": {"git_sha": "abc1234", "hostname": "hostA"},
+     "restart_epoch": 0},
+    {"ts": 100.0, "kind": "admit", "rid": 0, "trace": "t0",
+     "replica": 0, "span_id": "p0-1", "seq": 1},
+    {"ts": 100.2, "kind": "prefill", "rid": 0, "trace": "t0",
+     "replica": 0, "prefill_s": 0.15, "span_id": "p0-2",
+     "parent_span": "p0-1", "seq": 2},
+    {"ts": 100.3, "kind": "handoff_out", "rid": 0, "trace": "t0",
+     "replica": 0, "span_id": "p0-3", "parent_span": "p0-1",
+     "seq": 3},
+    {"ts": 100.31, "kind": "transport_encode", "rid": 0,
+     "trace": "t0", "dst": 1, "nbytes": 4096, "dur_s": 0.01,
+     "span_id": "p0-4", "parent_span": "p0-3", "seq": 4},
+    {"ts": 100.9, "kind": "finish", "rid": 0, "trace": "t0",
+     "replica": 0, "reason": "length", "span_id": "p0-5",
+     "parent_span": "p0-1", "seq": 5},
+]
+RANK1 = [
+    {"kind": "dump_header", "rule": "worker_exit", "dump_id": 1,
+     "source": "rank1e0", "ts": 100.0,
+     "provenance": {"git_sha": "abc1234", "hostname": "hostA"},
+     "restart_epoch": 0},
+    {"ts": 100.4, "kind": "handoff_in", "rid": 0, "trace": "t0",
+     "replica": 0, "span_id": "d1-1", "parent_span": "p0-4",
+     "seq": 1},
+    {"ts": 100.5, "kind": "tick", "steps": 1, "active": 1,
+     "tick_s": 0.05, "replica": 0, "seq": 2},
+]
+
+
+def main():
+    paths = []
+    for name, evs in (("perfetto_golden_dump_rank0.jsonl", RANK0),
+                      ("perfetto_golden_dump_rank1.jsonl", RANK1)):
+        p = os.path.join(CI_DIR, name)
+        with open(p, "w") as fh:
+            fh.write("\n".join(json.dumps(e) for e in evs) + "\n")
+        paths.append(p)
+    from deepspeed_tpu.telemetry import perfetto
+    doc = perfetto.export(paths)
+    assert perfetto.orphan_spans(
+        [e for evs in (RANK0, RANK1) for e in evs
+         if e["kind"] != "dump_header"]) == []
+    golden = os.path.join(CI_DIR, "perfetto_golden.json")
+    with open(golden, "w") as fh:
+        fh.write(perfetto.dumps(doc) + "\n")
+    for p in paths + [golden]:
+        print("wrote", os.path.relpath(p, os.path.dirname(CI_DIR)))
+
+
+if __name__ == "__main__":
+    main()

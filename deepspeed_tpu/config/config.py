@@ -381,6 +381,45 @@ class ClusterTelemetryConfig:
                                   C.CLUSTER_ENABLED_DEFAULT))
 
 
+class SloConfig:
+    """``monitor.slo`` sub-block (ISSUE 19): the windowed per-role SLO
+    plane (telemetry/slo.py) — rolling quantiles + error-budget burn
+    rate over TTFT/decode-tick/transport segments, exported as
+    ``slo/*`` gauges and distilled into the per-role scale
+    recommendation. Default ON (host floats only); the burn thresholds
+    must keep ``down_burn < up_burn`` or the hysteresis band inverts."""
+
+    def __init__(self, monitor_dict):
+        d = monitor_dict.get(C.MONITOR_SLO, {}) or {}
+        self.enabled = bool(d.get(C.SLO_ENABLED, C.SLO_ENABLED_DEFAULT))
+        self.window_s = float(d.get(C.SLO_WINDOW_S,
+                                    C.SLO_WINDOW_S_DEFAULT))
+        self.targets = dict(d.get(C.SLO_TARGETS, {}) or {})
+        self.budget = float(d.get(C.SLO_BUDGET, C.SLO_BUDGET_DEFAULT))
+        self.up_burn = float(d.get(C.SLO_UP_BURN, C.SLO_UP_BURN_DEFAULT))
+        self.down_burn = float(d.get(C.SLO_DOWN_BURN,
+                                     C.SLO_DOWN_BURN_DEFAULT))
+        self.min_samples = int(d.get(C.SLO_MIN_SAMPLES,
+                                     C.SLO_MIN_SAMPLES_DEFAULT))
+        if self.window_s <= 0:
+            raise DeepSpeedConfigError(
+                f"monitor.slo.window_s must be > 0, got {self.window_s!r}")
+        if not 0 < self.budget <= 1:
+            raise DeepSpeedConfigError(
+                f"monitor.slo.budget must be in (0, 1], got "
+                f"{self.budget!r}")
+        if not self.down_burn < self.up_burn:
+            raise DeepSpeedConfigError(
+                f"monitor.slo needs down_burn < up_burn (the scale "
+                f"hysteresis band), got {self.down_burn!r} >= "
+                f"{self.up_burn!r}")
+        for k, v in self.targets.items():
+            if not (isinstance(v, (int, float)) and v > 0):
+                raise DeepSpeedConfigError(
+                    f"monitor.slo.targets[{k!r}] must be a positive "
+                    f"latency in seconds, got {v!r}")
+
+
 class MonitorConfig:
     """``monitor`` block: the unified telemetry export gate
     (deepspeed_tpu/telemetry). Presence of the block enables the
@@ -425,6 +464,7 @@ class MonitorConfig:
         self.flight_recorder = FlightRecorderConfig(d)
         self.watchdog = WatchdogConfig(d)
         self.cluster = ClusterTelemetryConfig(d)
+        self.slo = SloConfig(d)
 
 
 class SnapshotConfig:

@@ -469,6 +469,30 @@ MONITOR_SERVE_HOST = "serve_host"
 MONITOR_SERVE_HOST_DEFAULT = "127.0.0.1"
 
 #############################################
+# Windowed SLO plane (monitor.slo sub-block, ISSUE 19 —
+# deepspeed_tpu/telemetry/slo.py). Rolling time-bucketed quantiles +
+# error-budget burn rate per serving ROLE, aggregated on rank 0 from
+# the transport metrics vector and exported as slo/* gauges; the
+# roles_signal() recommendation feeds role-aware autoscaling
+# (serving.autoscale.scale_signal: "slo"). Default ON when the monitor
+# block is present — the plane is a few host floats per tick.
+#############################################
+MONITOR_SLO = "slo"
+SLO_ENABLED = "enabled"
+SLO_ENABLED_DEFAULT = True
+SLO_WINDOW_S = "window_s"
+SLO_WINDOW_S_DEFAULT = 30.0
+SLO_TARGETS = "targets"          # {metric: target seconds} overrides
+SLO_BUDGET = "budget"            # error-budget fraction of the window
+SLO_BUDGET_DEFAULT = 0.1
+SLO_UP_BURN = "up_burn"          # burn rate >= this: role scales up
+SLO_UP_BURN_DEFAULT = 2.0
+SLO_DOWN_BURN = "down_burn"      # every burn <= this: role has slack
+SLO_DOWN_BURN_DEFAULT = 0.25
+SLO_MIN_SAMPLES = "min_samples"  # windowed samples before a signal
+SLO_MIN_SAMPLES_DEFAULT = 8
+
+#############################################
 # Programmatic XLA trace window (profiling.trace_dir + trace_steps):
 # wraps jax.profiler.start_trace/stop_trace around global steps
 # [trace_steps[0], trace_steps[1]) so span annotations land in
@@ -687,7 +711,9 @@ SERVING_AUTOSCALE_MAX_REPLICAS = "max_replicas"
 SERVING_AUTOSCALE_MAX_REPLICAS_DEFAULT = 1
 SERVING_AUTOSCALE_SCALE_SIGNAL = "scale_signal"
 SERVING_AUTOSCALE_SCALE_SIGNAL_DEFAULT = "watchdog"
-SERVING_AUTOSCALE_SCALE_SIGNAL_MODES = ("watchdog", "none")
+# "slo" (ISSUE 19): scale on the windowed per-role error-budget burn
+# rate the SLO plane (telemetry/slo.py) exports as slo/* gauges
+SERVING_AUTOSCALE_SCALE_SIGNAL_MODES = ("watchdog", "slo", "none")
 
 # serving.disaggregation — prefill/decode role split (ISSUE 14):
 # dedicated prefill-role engines admit + prefill, a page-handoff

@@ -214,19 +214,49 @@ class Supervisor:
 
     # ------------------------------------------------------------- spawn
 
-    def roles_for_world(self, world):
+    def roles_for_world(self, world, prefer=None):
         """Role map for a world of size ``world``. Roles are
         POSITIONAL (rank 0 = the router/prefill rank, every other
         rank = decode), so a shrunk or grown world RE-DERIVES the map
         instead of inheriting dead ranks' entries: each surviving
         rank keeps its configured role, ranks beyond the configured
         map get the majority non-rank-0 role (``"decode"`` for a
-        serving world). None when this is a training world."""
+        serving world). None when this is a training world.
+
+        ``prefer`` (ISSUE 19) biases the fill role for ranks BEYOND
+        the configured map — the hook the windowed SLO plane's
+        per-role recommendation (:func:`telemetry.slo.roles_signal`)
+        drives: a grown world whose decode burn rate is hot fills new
+        ranks with ``prefer="decode"`` instead of the historical
+        majority. Configured ranks are never re-roled (their engines'
+        ledgers and snapshots are role-shaped)."""
         if not self.roles:
             return None
         tail = [name for r, name in self.roles.items() if r != 0]
         fill = max(set(tail), key=tail.count) if tail else "decode"
+        if prefer:
+            fill = str(prefer)
         return {r: self.roles.get(r, fill) for r in range(int(world))}
+
+    def roles_preference(self):
+        """The SLO plane's fill-role bias for the NEXT respawn, read
+        purely from ``slo/*`` gauges on the supervisor's registry
+        (rank-0 exports them; a scraping supervisor mirrors them).
+        Returns the role to prefer, or None when no role is hot —
+        ``roles_for_world(world, prefer=self.roles_preference())`` is
+        the ladder step."""
+        if not self.roles:
+            return None
+        from deepspeed_tpu.telemetry.slo import roles_signal
+        rec = roles_signal(self.registry)
+        hot = sorted(r for r, a in rec.items() if a == "up")
+        if not hot:
+            return None
+        # rank 0's role is pinned; preferring it cannot change the
+        # fill — pick the first hot NON-rank-0-capable role instead
+        rank0 = self.roles.get(0)
+        tail_hot = [r for r in hot if r != rank0]
+        return tail_hot[0] if tail_hot else hot[0]
 
     def _child_env(self, rank, world, port):
         env = dict(self.env)
@@ -240,8 +270,11 @@ class Supervisor:
         })
         env.pop("DSTPU_LOCAL_DEVICE_IDS", None)
         # roles re-derive per WORLD, not per configured map — a world
-        # shrunk from D=2 to D=1 must still mark its rank 1 "decode"
-        roles = self.roles_for_world(world)
+        # shrunk from D=2 to D=1 must still mark its rank 1 "decode";
+        # the SLO plane's hot role (if any) biases the fill for ranks
+        # beyond the configured map (ISSUE 19)
+        roles = self.roles_for_world(world,
+                                     prefer=self.roles_preference())
         if roles and rank in roles:
             env["DSTPU_SERVING_ROLE"] = roles[rank]
         if self.rendezvous_retries is not None:

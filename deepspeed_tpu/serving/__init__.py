@@ -312,9 +312,14 @@ def build_transport_node(family: str, model_config, params, config=None,
     from deepspeed_tpu.serving.transport import (DecodeNode,
                                                  PrefillNode,
                                                  ProcessEndpoint)
+    from deepspeed_tpu.config import constants as C
     pd = _param_dict(config)
     sc = _serving_section(pd)
     dg, rt = sc.disaggregation, sc.router
+    mc = None
+    if C.MONITOR in pd:
+        from deepspeed_tpu.config.config import MonitorConfig
+        mc = MonitorConfig(pd)   # SLO plane + live endpoint gates
     if endpoint is None:
         # ISSUE 18: addressing "targeted" (default) moves dst-addressed
         # frames point-to-point, "broadcast" keeps the PR-17 legacy leg
@@ -346,13 +351,33 @@ def build_transport_node(family: str, model_config, params, config=None,
         alloc = prefills[0].cache.num_blocks - 1
         bound = rt.max_inflight_pages \
             or 2 * alloc * (endpoint.world - 1)
-        return PrefillNode(
+        node = PrefillNode(
             prefills, endpoint, registry=registry, recorder=recorder,
             max_inflight_pages=bound,
             max_inflight_pages_per_rank=(
                 rt.max_inflight_pages_per_rank or None),
             max_handoff_retries=rt.max_handoff_retries,
             on_tick=on_tick, on_done=on_done)
+        if mc is not None:
+            # ISSUE 19: the rank-0 SLO plane — windowed per-role
+            # quantiles + burn rate over the exchanged metrics vector,
+            # exported as slo/* gauges each tick
+            from deepspeed_tpu.telemetry.slo import SloPlane
+            node.slo = SloPlane.from_config(mc.slo)
+            if mc.serve_port:
+                # live /metrics + /healthz on the router rank; /healthz
+                # carries the targeted-transport fabric liveness
+                # (per-peer connected / last-payload age) so a
+                # half-dead socket mesh is visible BEFORE a
+                # payload_timeout_s trips (ISSUE 19 satellite)
+                from deepspeed_tpu.telemetry.serve import \
+                    start_metrics_server
+                node.metrics_server = start_metrics_server(
+                    mc.serve_port, host=mc.serve_host,
+                    registry=node.metrics,
+                    extra_health_fn=getattr(endpoint, "fabric_health",
+                                            None))
+        return node
     cb = ContinuousBatcher(adapter, registry=registry, recorder=recorder,
                            prefix_cache=dg.dedupe_pages,
                            prefix_cow=sc.prefix_cache.cow, role="decode")

@@ -78,6 +78,10 @@ def _req_doc(req):
         # requeue handoffs — the stitched cross-replica timeline hangs
         # off this field
         "trace_id": getattr(req, "trace_id", None),
+        # ISSUE 19: the root span id travels with the trace identity —
+        # a request restored or handed off on another rank keeps
+        # parenting its lifecycle spans onto the tree it was born into
+        "span_id": getattr(req, "span_id", None),
         # ISSUE 14 (PR-11 caveat fix): the sampling identity. With
         # sample_key + the CUMULATIVE committed-token count persisted,
         # a sampled (temperature > 0) request restores/replays with the
@@ -234,6 +238,7 @@ def resume_request(doc):
                   eos_token_id=doc.get("eos_token_id"),  # sync-ok: host
                   temperature=float(doc.get("temperature", 0.0)),
                   trace_id=doc.get("trace_id"),
+                  span_id=doc.get("span_id"),
                   sample_key=doc.get("sample_key"))
     # cumulative committed count — the sampling-index base AND the
     # prompt/generated split marker (older docs carry only this
@@ -372,6 +377,7 @@ def restore_serving(cb, host, kv, requeue_overflow=True):
                       eos_token_id=sd.get("eos_token_id"),  # snapshot doc
                       temperature=float(sd.get("temperature", 0.0)),
                       trace_id=sd.get("trace_id"),
+                      span_id=sd.get("span_id"),
                       sample_key=sd.get("sample_key"))
         req.generated = [int(t) for t in sd["generated"]]
         # sampling-index base: committed_total counts THROUGH this

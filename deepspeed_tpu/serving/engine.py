@@ -36,6 +36,7 @@ from deepspeed_tpu.serving.paged_cache import (PagedKVCache,
                                                pow2_page_bucket)
 from deepspeed_tpu.telemetry.recorder import default_recorder
 from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.telemetry.spans import new_span_id
 
 
 @dataclasses.dataclass
@@ -56,6 +57,13 @@ class Request:
     # request from N dump files. Never re-stamped: a replayed or
     # restored request keeps the identity it was born with.
     trace_id: Optional[str] = None
+    # ISSUE 19: the request's ROOT span id, minted next to trace_id at
+    # first submit and persisted through the same snapshot / restore /
+    # handoff docs. Every lifecycle span (prefill, handoff, transport
+    # legs, first decode tick) parents onto it — directly or through an
+    # intermediate span — so N per-role dump files merge into ONE
+    # causal tree per trace_id (telemetry/perfetto.py).
+    span_id: Optional[str] = None
     # ISSUE 14: per-request sampling identity (temperature > 0 only).
     # Stamped once at first submit and persisted through snapshot /
     # restore / handoff docs; every sampled token's key is
@@ -74,9 +82,15 @@ class Request:
 
 def ensure_trace_id(request) -> str:
     """Stamp a stable ``trace_id`` at first submit (idempotent — a
-    restored/replayed request arrives with the one it was born with)."""
+    restored/replayed request arrives with the one it was born with).
+    ISSUE 19: the root ``span_id`` is minted here too, under the same
+    never-re-stamped contract — it is the anchor every downstream
+    lifecycle span parents onto."""
     if getattr(request, "trace_id", None) is None:
         request.trace_id = uuid.uuid4().hex[:16]
+    if getattr(request, "span_id", None) is None:
+        from deepspeed_tpu.telemetry.spans import new_span_id
+        request.span_id = new_span_id()
     return request.trace_id
 
 
@@ -437,7 +451,8 @@ class ContinuousBatcher:
                     "pool_exhausted", rid=req.rid,
                     trace=getattr(req, "trace_id", None), need_pages=need,
                     free_pages=self.cache.available_pages,
-                    queue_depth=len(self.queue))
+                    queue_depth=len(self.queue),
+                    parent_span=getattr(req, "span_id", None))
                 if self.watchdog is not None:
                     self.watchdog.note_pool_exhausted(
                         queue_depth=len(self.queue),
@@ -467,10 +482,15 @@ class ContinuousBatcher:
                 wait_s)
             t_pf0 = time.monotonic()
             start = plan.start_pos if plan is not None else 0
+            # the admit event IS the request's root span (ISSUE 19):
+            # span_id = the id minted at first submit, no parent — every
+            # downstream lifecycle span in any rank's dump parents onto
+            # it, so the merged export has zero orphans by construction
             self._record("admit", rid=req.rid, slot=slot_id,
                          trace=getattr(req, "trace_id", None),
                          pages=len(pages), wait_s=wait_s,
-                         shared_tokens=start)
+                         shared_tokens=start,
+                         span_id=getattr(req, "span_id", None))
             if self.watchdog is not None:
                 self.watchdog.note_pool_ok()   # re-arm the pool rule
             P = self.spec.page_size
@@ -534,7 +554,10 @@ class ContinuousBatcher:
             #                            (and handoff) TTFT components
             self._record("prefill", rid=req.rid,
                          trace=getattr(req, "trace_id", None),
-                         prompt_tokens=S, ttft_s=ttft_s)
+                         prompt_tokens=S, ttft_s=ttft_s,
+                         prefill_s=max(t_tok - t_pf0, 0.0),
+                         span_id=new_span_id(),
+                         parent_span=getattr(req, "span_id", None))
             if self.watchdog is not None:
                 # the readback above was the fence — the rule sees only
                 # the host scalar it produced
@@ -579,7 +602,9 @@ class ContinuousBatcher:
         self._record("finish", rid=req.rid,
                      trace=getattr(req, "trace_id", None),
                      reason=req.finish_reason,
-                     generated=len(req.generated))
+                     generated=len(req.generated),
+                     span_id=new_span_id(),
+                     parent_span=getattr(req, "span_id", None))
         return req
 
     # multi-step dispatch caps: a tick of K steps amortizes the host
@@ -877,10 +902,17 @@ class ContinuousBatcher:
         slot.request, slot.pos, slot.last_tok = None, -1, 0
         self.stats["handoffs_out"] += 1
         self.metrics.counter("serving/handoffs_out").inc()
+        # ISSUE 19: mint the HANDOFF span here — the transport legs
+        # (encode on this rank, decode/adopt on the receiving rank)
+        # parent onto it, and extract_handoff ships it in the wire doc
+        # so the receiving rank's events can reference it
+        req._handoff_span = new_span_id()
         self._record("handoff_out", rid=req.rid,
                      trace=getattr(req, "trace_id", None),
                      slot=slot_id, pos=pos,
-                     generated=len(req.generated))
+                     generated=len(req.generated),
+                     span_id=req._handoff_span,
+                     parent_span=getattr(req, "span_id", None))
         self._note_pool()
         return req, pos, last_tok
 
@@ -911,10 +943,18 @@ class ContinuousBatcher:
         if t_first is not None:
             self.metrics.histogram("serving/handoff_s").observe(
                 max(t_done - t_first, 0.0))
+        # parent preference (ISSUE 19): the transport ENCODE span when
+        # the packet crossed the process fabric, else the handoff span
+        # minted at export, else the request root — whichever leg this
+        # packet actually traversed, the tree stays connected
+        parent = (getattr(req, "_encode_span", None)
+                  or getattr(req, "_handoff_span", None)
+                  or getattr(req, "span_id", None))
         self._record("handoff_in", rid=req.rid,
                      trace=getattr(req, "trace_id", None),
                      slot=slot_id, pos=pos,
-                     generated=len(req.generated))
+                     generated=len(req.generated),
+                     span_id=new_span_id(), parent_span=parent)
         self._note_pool()
 
     def step(self, now: Optional[float] = None) -> List[Request]:

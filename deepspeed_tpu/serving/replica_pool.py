@@ -118,8 +118,12 @@ class ReplicaPool:
                  max_replicas=None, scale_signal="watchdog",
                  max_retries=3, backoff_s=0.05,
                  scale_down_idle_rounds=40, recorder=None,
-                 watchdog=None, seed=0):
+                 watchdog=None, seed=0, slo_registry=None):
         self.factory = factory
+        # ISSUE 19: scale_signal="slo" reads the windowed slo/* gauge
+        # plane from here (an exported registry — typically the rank-0
+        # node's); None falls back to the first live replica's registry
+        self.slo_registry = slo_registry
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas
                                 if max_replicas is not None
@@ -446,6 +450,9 @@ class ReplicaPool:
         return finished
 
     def _autoscale(self):
+        if self.scale_signal == "slo":
+            self._autoscale_slo()
+            return
         if self.scale_signal != "watchdog":
             return
         trips = 0
@@ -472,6 +479,52 @@ class ReplicaPool:
         slots_per = [len(cb.slots) for _, cb in live]
         capacity_wo_one = sum(slots_per) - max(slots_per)
         if self.pending <= capacity_wo_one // 2:
+            self._idle_rounds += 1
+        else:
+            self._idle_rounds = 0
+        if self._idle_rounds >= self.scale_down_idle_rounds:
+            self._idle_rounds = 0
+            victim = self._least_loaded()
+            if victim is not None:
+                self.preempt_replica(victim, source="scale_down")
+
+    def slo_recommendation(self):
+        """The per-role ``{"prefill"|"decode": "up"|"down"|"hold"}``
+        the windowed SLO plane (telemetry/slo.py) last exported —
+        derived PURELY from ``slo/*`` gauges, never from the plane
+        object (the consumer contract ISSUE 19 pins). Empty when no
+        registry is reachable yet."""
+        from deepspeed_tpu.telemetry.slo import roles_signal
+        reg = self.slo_registry
+        if reg is None:
+            live = self._live()
+            reg = live[0][1].metrics if live else None
+        return roles_signal(reg) if reg is not None else {}
+
+    def _autoscale_slo(self):
+        """Burn-rate autoscaling (ISSUE 19): a role whose windowed
+        error-budget burn crossed ``up_burn`` spawns immediately (the
+        window IS the hysteresis — 30s of sustained violations, not
+        one bad request); scale-down needs a "down" verdict, no "up"
+        anywhere, and the same consecutive-round patience as the
+        watchdog path (two hysteresis layers on the shrink side,
+        because a wrong shrink costs a drain + restore)."""
+        roles = self.slo_recommendation()
+        if not roles:
+            return
+        hot = sorted(r for r, a in roles.items() if a == "up")
+        if hot and len(self.replicas) < self.max_replicas:
+            self._idle_rounds = 0
+            new = self._spawn(reason="slo_burn:" + ",".join(hot))
+            logger.info(f"replica pool scaled UP to "
+                        f"{len(self.replicas)} (replica {new}; "
+                        f"slo burn on {','.join(hot)})")
+            return
+        live = self._live()
+        if len(live) <= self.min_replicas or self._draining or hot:
+            self._idle_rounds = 0
+            return
+        if any(a == "down" for a in roles.values()):
             self._idle_rounds += 1
         else:
             self._idle_rounds = 0

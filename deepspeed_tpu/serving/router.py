@@ -139,6 +139,11 @@ def extract_handoff(pcb, slot_id: int) -> HandoffPacket:
                last_tok=int(slot.last_tok), n_data_pages=int(n_data),
                t_sent=time.time())
     req_out, _pos, _last = pcb.export_slot(slot_id)
+    # ISSUE 19: export_slot just minted the handoff span — ship it in
+    # the wire doc so the RECEIVING rank's handoff_in / transport spans
+    # parent onto it across the process boundary (the codec ignores
+    # keys it doesn't know, so older peers are unaffected)
+    doc["handoff_span"] = getattr(req_out, "_handoff_span", None)
     return HandoffPacket(doc, kv, req_out)
 
 
@@ -200,6 +205,15 @@ def deliver_handoff(dcb, packet: HandoffPacket,
             cache.register_prefix(slot_id, prompt_np, hashes=plan.hashes)
         req = packet.req if packet.req is not None \
             else elastic.resume_request(doc)
+        # span parents off the wire (ISSUE 19): a rebuilt request lost
+        # its in-process attributes — restore the handoff/encode span
+        # ids the doc carried so adopt_request parents correctly
+        if getattr(req, "_handoff_span", None) is None \
+                and doc.get("handoff_span"):
+            req._handoff_span = doc["handoff_span"]
+        if getattr(req, "_encode_span", None) is None \
+                and doc.get("encode_span"):
+            req._encode_span = doc["encode_span"]
         dcb.adopt_request(slot_id, req, int(doc["pos"]),
                           int(doc["last_tok"]))
         # the landing segment of the transport: scatter + adopt on the

@@ -85,7 +85,7 @@ FRAME_BASE_NBYTES = _HEAD.size
 # rank at every exchange. Senders read the decode rows for backpressure
 # (free pages/slots, cumulative absorbed pages); everyone reads rank
 # 0's MV_STOP to leave the loop at the SAME aligned exchange.
-MV_LEN = 7
+MV_LEN = 8
 MV_ROLE = 0            # 0 = prefill/router rank, 1 = decode rank
 MV_FREE_PAGES = 1      # decode pool pages currently allocatable
 MV_FREE_SLOTS = 2      # decode slots currently free
@@ -94,6 +94,9 @@ MV_DONE = 4            # cumulative requests finished on this rank
 MV_STOP = 5            # rank 0 sets 1: drain done, leave after this tick
 MV_REMAINING = 6       # est. remaining decode tokens (active + waiting)
 #   — the LPT balancing signal the router minimizes over decode ranks
+MV_TICK_S = 7          # most recent decode-tick latency on this rank
+#   (ISSUE 19) — the per-ROLE decode-latency feed the rank-0 SLO plane
+#   windows into slo/decode/* quantiles + burn rate; 0 = no tick yet
 
 
 class WireFormatError(ValueError):
@@ -314,6 +317,18 @@ class ProcessEndpoint:
         w, self._wasted = self._wasted, 0
         return w
 
+    def fabric_health(self) -> dict:
+        """Targeted-fabric liveness for /healthz (ISSUE 19 satellite):
+        the :class:`PeerFabric`'s per-peer connected flags +
+        last-payload ages. Before the fabric's lazy construction (or
+        under broadcast addressing, which has no point-to-point leg)
+        the doc says so instead of faking peers."""
+        if self._fabric is None:
+            return {"fabric": {"built": False,
+                               "addressing": self.addressing}}
+        return {"fabric": dict(self._fabric.liveness(), built=True,
+                               addressing=self.addressing)}
+
     def _filter(self, bufs, me, pad):
         """Broadcast-leg intake: keep frames addressed here (or to
         all), count everything else — mis-addressed frames and the
@@ -415,6 +430,12 @@ class DecodeNode:
             rem += max(int(doc["max_new_tokens"])
                        - len(doc["generated"]), 0)
         v[MV_REMAINING] = rem
+        # the SLO plane's decode-latency feed (ISSUE 19): the engine's
+        # most recent tick latency, already a host scalar (the token
+        # readback fenced it) — peek, never create, so an idle rank
+        # publishes 0 instead of seeding a phantom histogram
+        tick_s = cb.metrics.peek_histogram_last("serving/tick_latency_s")
+        v[MV_TICK_S] = tick_s or 0.0
         return v
 
     def _note_wasted(self):
@@ -503,6 +524,7 @@ class DecodeNode:
                      "tokens": [int(t) for t in req.tokens()],
                      "finish_reason": req.finish_reason,
                      "trace_id": getattr(req, "trace_id", None),
+                     "span_id": getattr(req, "span_id", None),
                      "generated": len(req.generated)},
                     src=self.endpoint.rank, dst=0)))
         # slot-utilization denominator counts the FULL decode budget of
@@ -584,6 +606,12 @@ class PrefillNode:
                       "decode_blocked": 0, "lost": 0, "bytes_sent": 0,
                       "wasted_bytes": 0, "slot_busy_ticks": 0,
                       "slot_cap_ticks": 0}
+        # ISSUE 19: the rank-0 SLO plane (telemetry/slo.py), attached
+        # by build_transport_node when monitor.slo asks for it. Fed +
+        # exported once per aligned exchange — prefill-role TTFT
+        # segments from the local registries, decode-role tick latency
+        # from every decode rank's MV_TICK_S slot
+        self.slo = None
 
     # ------------------------------------------------------------ intake
 
@@ -735,12 +763,24 @@ class PrefillNode:
                 load[r], -float(mat[r, MV_FREE_PAGES]),
                 r))   # sync-ok: host metrics matrix, no device read
             self._rank_blocked[dst] = False   # headroom proven: re-arm
+            # ISSUE 19: the encode leg gets its own span, child of the
+            # handoff span, SHIPPED IN THE DOC before encoding — the
+            # receiving rank's handoff_in parents onto it, so the
+            # cross-process hop is one connected edge in the merged tree
+            from deepspeed_tpu.telemetry.spans import new_span_id
+            enc_span = new_span_id()
+            packet.doc["encode_span"] = enc_span
             t_enc = time.monotonic()
             buf = encode_frame("packet", packet.doc, packet.kv,
                                src=self.endpoint.rank, dst=dst)
+            enc_s = time.monotonic() - t_enc
             self.engines[0].metrics.histogram(
-                "serving/transport_encode_s").observe(
-                time.monotonic() - t_enc)
+                "serving/transport_encode_s").observe(enc_s)
+            self.recorder.record(
+                "transport_encode", rid=packet.doc["rid"],
+                trace=packet.doc.get("trace_id"), dst=dst,
+                nbytes=len(buf), dur_s=enc_s, span_id=enc_span,
+                parent_span=packet.doc.get("handoff_span"))
             out_bufs.append((dst, buf))
             self._sent_pages[dst] += need
             unabsorbed[dst] += need
@@ -781,14 +821,52 @@ class PrefillNode:
             self.metrics.counter("router/handoff_wasted_bytes").inc(
                 wasted)
 
+    # the prefill-role window sources: (slo metric, registry histogram)
+    _SLO_FEEDS = (
+        ("ttft_s", "serving/ttft_s"),
+        ("queue_wait_s", "serving/ttft_queue_wait_s"),
+        ("transport_s", "serving/transport_encode_s"),
+        ("transport_s", "serving/transport_collective_s"),
+    )
+
+    def _feed_slo(self, mat) -> None:
+        """One SLO-plane update per aligned exchange (ISSUE 19): new
+        prefill-side histogram tails under role ``prefill``, each
+        decode rank's exchanged tick latency under role ``decode``
+        (a per-exchange SAMPLE of that rank's current latency — the
+        cadence every other backpressure signal already rides), then
+        re-export the ``slo/*`` gauges. Host floats only."""
+        plane = self.slo
+        if plane is None:
+            return
+        for cb in self.engines:
+            reg = cb.metrics
+            for metric, src in self._SLO_FEEDS:
+                n = reg.peek_histogram_count(src)
+                if n:
+                    plane.feed_counted(
+                        "prefill", metric,
+                        reg.peek_histogram_values(src), n,
+                        source=f"{cb.replica_id}:{src}")
+        for r in self.decode_ranks:
+            if mat[r, MV_ROLE] and mat[r, MV_TICK_S] > 0:
+                plane.observe("decode", "tick_s",
+                              float(mat[r, MV_TICK_S]))   # sync-ok: host metrics matrix
+        plane.export(self.metrics)
+
     def _finish(self, doc) -> None:
+        from deepspeed_tpu.telemetry.spans import new_span_id
         self.done[doc["rid"]] = doc
         # the router rank is the completion authority: its ring closes
-        # every trace even when a decode rank's ring died with it
+        # every trace even when a decode rank's ring died with it —
+        # the close parents straight onto the request ROOT (doc-borne),
+        # never onto a decode-rank span that may not have been dumped
         self.recorder.record(
             "finish", rid=doc["rid"], trace=doc.get("trace_id"),
             reason=doc.get("finish_reason"),
-            generated=doc.get("generated"))
+            generated=doc.get("generated"),
+            span_id=new_span_id(),
+            parent_span=doc.get("span_id"))
         if self.on_done is not None:
             self.on_done(doc)
 
@@ -812,6 +890,7 @@ class PrefillNode:
                         "tokens": [int(t) for t in req.tokens()],
                         "finish_reason": req.finish_reason,
                         "trace_id": getattr(req, "trace_id", None),
+                        "span_id": getattr(req, "span_id", None),
                         "generated": len(req.generated)})
                 # occupancy is sampled AFTER the step and BEFORE the
                 # sweep extracts the active slots into packets — the
@@ -825,6 +904,7 @@ class PrefillNode:
             self.engines[0].metrics.histogram(
                 "serving/transport_collective_s").observe(
                 time.monotonic() - t_coll)
+            self._feed_slo(mat)
             self._note_wasted()
             out_bufs = []
             for frame in frames:

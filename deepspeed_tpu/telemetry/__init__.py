@@ -34,7 +34,14 @@ Layout:
   metrics vector allgathered at existing fences, folded on rank 0
   into ``cluster/*`` skew gauges + the ``rank_straggler`` rule;
 - ``serve``: the live ``/metrics`` + ``/healthz`` http endpoint
-  (``monitor.serve_port``), stdlib http.server in a daemon thread.
+  (``monitor.serve_port``), stdlib http.server in a daemon thread;
+- ``slo``: the windowed per-role SLO plane (ISSUE 19) — rolling
+  quantiles + error-budget burn rate per (role, metric), exported as
+  ``slo/*`` gauges and distilled into the per-role scale
+  recommendation autoscalers consume;
+- ``perfetto``: Chrome trace-event export (ISSUE 19) — N per-rank
+  dumps merged into one ``ui.perfetto.dev`` timeline with causal
+  span ids and handoff flow arrows (``view --format perfetto``).
 """
 
 from deepspeed_tpu.telemetry.registry import (     # noqa: F401
@@ -64,6 +71,14 @@ _LAZY_ATTRS = {
     "start_metrics_server": ("deepspeed_tpu.telemetry.serve",
                              "start_metrics_server"),
     "serve": ("deepspeed_tpu.telemetry.serve", None),
+    # stdlib-only modules, lazy anyway so `import deepspeed_tpu.
+    # telemetry` stays exactly as cheap as before ISSUE 19
+    "SloPlane": ("deepspeed_tpu.telemetry.slo", "SloPlane"),
+    "slo_metric_names": ("deepspeed_tpu.telemetry.slo",
+                         "slo_metric_names"),
+    "roles_signal": ("deepspeed_tpu.telemetry.slo", "roles_signal"),
+    "slo": ("deepspeed_tpu.telemetry.slo", None),
+    "perfetto": ("deepspeed_tpu.telemetry.perfetto", None),
 }
 
 from deepspeed_tpu.utils.lazy import lazy_attrs  # noqa: E402

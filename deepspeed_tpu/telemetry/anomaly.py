@@ -49,6 +49,32 @@ from deepspeed_tpu.telemetry.recorder import default_recorder
 from deepspeed_tpu.telemetry.registry import default_registry
 from deepspeed_tpu.utils.logging import logger
 
+_PROVENANCE = None
+
+
+def _provenance_doc():
+    """Cached host/build stamp for dump headers (ISSUE 19 satellite):
+    a dump read days later off a shared scratch dir must answer "which
+    box, which sha, which restart epoch" without archaeology. Reuses
+    ``bench.provenance()`` when the repo-root module is importable
+    (the git subprocess runs ONCE per process, not per dump); degrades
+    to the same shape inline when it is not (installed package, no
+    repo checkout)."""
+    global _PROVENANCE
+    if _PROVENANCE is None:
+        try:
+            from bench import provenance
+            _PROVENANCE = provenance()
+        except Exception:
+            import platform
+            import socket
+            _PROVENANCE = {"git_sha": "unknown",
+                           "hostname": socket.gethostname(),
+                           "cpu_count": os.cpu_count(),
+                           "jax_version": "unknown",
+                           "python_version": platform.python_version()}
+    return _PROVENANCE
+
 
 class RollingOutlierRule:
     """Trip when a value exceeds ``max(factor * rolling_median,
@@ -428,7 +454,14 @@ class Watchdog:
         info = {"kind": "dump_header", "rule": rule, "dump_id": dump_id,
                 "source": self.source, "ts": time.time(),
                 "detail": detail, "n_events": len(events),
-                "recorder_capacity": self.recorder.capacity}
+                "recorder_capacity": self.recorder.capacity,
+                # ISSUE 19 satellite: which box/sha/incarnation wrote
+                # this dump — the Perfetto merger and any human reading
+                # a days-old dump both need it in the header, not in
+                # out-of-band notes
+                "provenance": dict(_provenance_doc()),
+                "restart_epoch": int(
+                    os.environ.get("DSTPU_RESTART_EPOCH", "0") or 0)}
         self.last_anomaly = {"rule": rule, "dump_id": dump_id,
                              "ts": info["ts"], "detail": detail}
         reg = self.registry

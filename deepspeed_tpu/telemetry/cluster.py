@@ -132,6 +132,12 @@ class ClusterAggregator:
         mat, rank = allgather_host_floats(vec)
         self.rank, self.world = int(rank), int(mat.shape[0])
         self.fences += 1
+        # PR-12 asymmetry fix (ISSUE 19 satellite): the registry
+        # counter tracks ``self.fences`` — counted here, on EVERY rank
+        # per exchange — not the rank-0 fold. Before this, rank 0
+        # exported N fences while every other rank exported 0, so a
+        # per-rank scrape read as "ranks 1..N-1 never fence".
+        self.registry.counter("cluster/fences").inc()
         self.last_fence_ts = time.time()
         if self.rank == 0:
             self._fold(mat, step)
@@ -151,7 +157,6 @@ class ClusterAggregator:
         skew table, the ring breadcrumb, and the straggler rule."""
         reg = self.registry
         reg.gauge("cluster/world_size").set(self.world)
-        reg.counter("cluster/fences").inc()
         table = {"step": step, "world": self.world, "metrics": {}}
         for i, m in enumerate(CLUSTER_METRICS):
             col = np.asarray(  # sync-ok: host matrix from the allgather

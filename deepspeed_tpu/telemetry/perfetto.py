@@ -1,0 +1,238 @@
+"""Perfetto / Chrome trace-event export of flight-recorder dumps.
+
+Usage::
+
+    python -m deepspeed_tpu.telemetry.view --format perfetto \\
+        flight_rank0e0_*.jsonl flight_rank1e0_*.jsonl --out trace.json
+
+Turns N per-rank / per-epoch watchdog dumps (anomaly.py) into ONE
+Chrome trace-event JSON (the format ``ui.perfetto.dev`` and
+``chrome://tracing`` both load), so the cross-rank story the text
+viewer prints as tables becomes a zoomable timeline:
+
+- each dump file becomes a **process** row, pid = the rank parsed from
+  the dump header's ``source`` (the xproc workers stamp
+  ``rank{N}e{E}``) — so two epochs of the same rank share one row —
+  with the header's provenance (host, git sha, restart epoch) in the
+  process label;
+- each engine/replica becomes a **thread** row inside its rank
+  (``replica`` field of serving events);
+- duration-bearing events (``span``, ``prefill``, ``tick``,
+  ``transport_encode``, ...) become complete slices ("X"); every other
+  event becomes an instant ("i") so nothing in the ring is invisible;
+- prefill→decode handoffs become **flow arrows** ("s"/"f") stitched
+  per ``trace_id`` from each ``handoff_out`` to the ``handoff_in``
+  that absorbed it — the causal hop ACROSS process rows;
+- span causality (ISSUE 19): every event's ``span_id`` /
+  ``parent_span`` ride in its ``args``, and :func:`orphan_spans` is
+  the merge-integrity check — in a complete dump set every
+  ``parent_span`` resolves to some event's ``span_id``; an orphan
+  means a rank's dump is missing from the merge.
+
+Pure stdlib, like view.py — the exporter must run where the dumps
+landed (laptop, CI artifact store) with no jax and no numpy;
+tests/test_metric_names.py pins the import chain and
+ci/telemetry_gate.sh round-trips a golden dump with BOTH poisoned.
+
+Output is DETERMINISTIC for a fixed input (events sorted by host
+timestamp then ring sequence, flow ids assigned in that order, keys
+sorted by the writer) — the CI golden test diffs it byte-for-byte.
+"""
+
+import json
+import re
+
+from deepspeed_tpu.telemetry.view import load_dump
+
+# kinds whose payload carries a host-measured duration: kind ->
+# (duration field, slice name; None = use the event's ``tag``). The
+# recorder stamps ``ts`` at record time — the END of the measured
+# interval — so slices start at ts - dur.
+DURATION_KINDS = {
+    "span": ("dur_s", None),
+    "prefill": ("prefill_s", "prefill"),
+    "tick": ("tick_s", "tick"),
+    "spec_round": ("tick_s", "spec_round"),
+    "transport_encode": ("dur_s", "transport_encode"),
+    "swap_drain": ("wait_s", "swap_drain"),
+}
+
+# category per kind family — Perfetto colors/filters by ``cat``
+_CATS = (
+    ("serving", ("admit", "prefill", "tick", "spec_round", "finish",
+                 "pool_exhausted", "serving_abort")),
+    ("handoff", ("handoff_out", "handoff_in", "transport_encode",
+                 "router_route", "router_block")),
+    ("elastic", ("serving_drain", "serving_snapshot", "serving_restore",
+                 "serving_requeue", "replica_scale", "replica_kill",
+                 "ckpt_begin", "ckpt_commit", "ckpt_abort",
+                 "ckpt_corrupt", "preempt_signal", "preempt", "resume",
+                 "restart", "restart_epoch", "rank_exit", "rank_hang",
+                 "world_down", "supervisor_spawn", "crash_loop")),
+    ("cluster", ("cluster_fence",)),
+    ("anomaly", ("anomaly",)),
+)
+_CAT_BY_KIND = {k: cat for cat, kinds in _CATS for k in kinds}
+
+_RANK_RE = re.compile(r"rank(\d+)")
+
+
+def _pid_for(header, idx):
+    """pid + human label for one dump file. Rank parsed from the
+    header source wins (both epochs of rank 1 belong on ONE row);
+    a rankless dump (a single-process run, a supervisor dump) gets a
+    stable per-file pid offset far from real ranks."""
+    source = (header or {}).get("source") or ""
+    m = _RANK_RE.search(str(source))
+    if m:
+        pid = int(m.group(1))
+        label = f"rank {pid}"
+    else:
+        pid = 1000 + idx
+        label = str(source) or f"dump {idx}"
+    prov = (header or {}).get("provenance") or {}
+    bits = [label]
+    if prov.get("hostname"):
+        bits.append(str(prov["hostname"]))
+    if prov.get("git_sha") and prov["git_sha"] != "unknown":
+        bits.append(str(prov["git_sha"]))
+    if (header or {}).get("restart_epoch"):
+        bits.append(f"epoch {header['restart_epoch']}")
+    return pid, " ".join(bits)
+
+
+def _args_of(ev):
+    """Everything but the envelope — span ids included, so clicking a
+    slice in the Perfetto UI shows its causal identity."""
+    return {k: v for k, v in ev.items()
+            if k not in ("ts", "seq", "kind") and v is not None}
+
+
+def orphan_spans(events):
+    """Merge-integrity check (the ISSUE 19 acceptance gate): every
+    ``parent_span`` in the merged event set must be some event's
+    ``span_id``. Returns the offending events (kind, span_id,
+    parent_span, rid) — EMPTY means the dump set tells one complete
+    causal story per trace; an orphan means the parent's rank/epoch
+    dump is missing from the merge (or a span was minted and never
+    emitted — a code bug this check is designed to catch in CI)."""
+    ids = {ev.get("span_id") for ev in events
+           if ev.get("span_id") is not None}
+    out = []
+    for ev in events:
+        parent = ev.get("parent_span")
+        if parent is not None and parent not in ids:
+            out.append({"kind": ev.get("kind"),
+                        "span_id": ev.get("span_id"),
+                        "parent_span": parent,
+                        "rid": ev.get("rid")})
+    return out
+
+
+def export(paths):
+    """N dump paths -> one Chrome trace-event document (a JSON-able
+    dict). Events keep their per-file pid; duplicate ring overlap
+    within one file is already impossible (a dump is one ring
+    snapshot), and cross-file dedup is NOT wanted here — two ranks
+    recording the same logical hop are two real rows."""
+    files = []
+    for idx, path in enumerate(paths):
+        header, events, _skipped = load_dump(path)
+        pid, label = _pid_for(header, idx)
+        files.append((pid, label, events))
+
+    ts_all = [ev["ts"] for _pid, _l, evs in files for ev in evs
+              if ev.get("ts") is not None]
+    t0 = min(ts_all) if ts_all else 0.0
+
+    def us(ts):
+        return round((ts - t0) * 1e6, 1)
+
+    out = []
+    threads = {}                       # (pid, tid) -> name
+    proc_labels = {}                   # pid -> label (first file wins)
+    for pid, label, _evs in files:
+        proc_labels.setdefault(pid, label)
+
+    flow_next = [1]
+    pending_out = {}                   # trace_id -> [flow ids in order]
+    named_tids = {}                    # (pid, replica str) -> tid
+    named_next = {}                    # pid -> named replicas seen
+
+    for pid, _label, events in files:
+        for ev in sorted(events, key=lambda e: (e.get("ts") or 0.0,
+                                                e.get("seq") or 0)):
+            ts = ev.get("ts")
+            if ts is None:
+                continue
+            kind = ev.get("kind", "?")
+            rep = ev.get("replica")
+            if rep is None:
+                tid = 0
+            else:
+                try:
+                    tid = int(rep)
+                except (TypeError, ValueError):
+                    # string replica ids ("prefill0") — stable per-pid
+                    # tids in first-seen order, offset past the
+                    # integer-id range
+                    key = (pid, str(rep))
+                    tid = named_tids.get(key)
+                    if tid is None:
+                        tid = 1000 + named_next.get(pid, 0)
+                        named_tids[key] = tid
+                        named_next[pid] = named_next.get(pid, 0) + 1
+            threads.setdefault(
+                (pid, tid),
+                f"replica {rep}" if rep is not None else "main")
+            cat = _CAT_BY_KIND.get(kind, "event")
+            args = _args_of(ev)
+            dur = DURATION_KINDS.get(kind)
+            if dur is not None and ev.get(dur[0]) is not None:
+                dur_s = float(ev[dur[0]])   # sync-ok: JSON dump field
+                name = dur[1] or str(ev.get("tag", kind))
+                out.append({"ph": "X", "name": name, "cat": cat,
+                            "pid": pid, "tid": tid,
+                            "ts": us(ts - dur_s),
+                            "dur": round(dur_s * 1e6, 1),
+                            "args": args})
+            else:
+                out.append({"ph": "i", "name": kind, "cat": cat,
+                            "pid": pid, "tid": tid, "ts": us(ts),
+                            "s": "t", "args": args})
+            # the cross-process hop: one arrow per handoff, matched
+            # oldest-first per trace (a requeued request hands off
+            # more than once — each out pairs with the NEXT in)
+            trace = ev.get("trace")
+            if kind == "handoff_out" and trace is not None:
+                fid = flow_next[0]
+                flow_next[0] += 1
+                pending_out.setdefault(trace, []).append(fid)
+                out.append({"ph": "s", "name": "handoff", "cat":
+                            "handoff", "id": fid, "pid": pid,
+                            "tid": tid, "ts": us(ts)})
+            elif kind == "handoff_in" and trace is not None:
+                queue = pending_out.get(trace)
+                if queue:
+                    fid = queue.pop(0)
+                    out.append({"ph": "f", "bp": "e", "name": "handoff",
+                                "cat": "handoff", "id": fid, "pid": pid,
+                                "tid": tid, "ts": us(ts)})
+
+    meta = []
+    for pid in sorted(proc_labels):
+        meta.append({"ph": "M", "name": "process_name", "pid": pid,
+                     "tid": 0, "args": {"name": proc_labels[pid]}})
+    for pid, tid in sorted(threads):
+        meta.append({"ph": "M", "name": "thread_name", "pid": pid,
+                     "tid": tid, "args": {"name": threads[(pid, tid)]}})
+    out.sort(key=lambda e: (e["ts"], e["pid"], e["tid"],
+                            e["ph"], e["name"]))
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
+def dumps(doc):
+    """Deterministic serialization (sorted keys, no float repr drift
+    beyond round()) — what the CI golden test diffs and ``--format
+    perfetto`` prints."""
+    return json.dumps(doc, sort_keys=True, separators=(",", ":"))

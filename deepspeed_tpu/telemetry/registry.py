@@ -179,6 +179,14 @@ class MetricsRegistry:
                 return None
             return h._values[-1]
 
+    def peek_histogram_count(self, name):
+        """Lifetime observation count of a histogram WITHOUT creating
+        it (0 when absent) — the SLO plane's new-tail cursor
+        (telemetry/slo.py feed_counted) polls this at tick cadence."""
+        with self._lock:
+            h = self._histograms.get(name)
+            return 0 if h is None else h.count
+
     def peek_histogram_values(self, name):
         """Reservoir copy WITHOUT creating the histogram ([] when
         absent) — cross-replica mergers (ReplicaPool.metrics_snapshot)

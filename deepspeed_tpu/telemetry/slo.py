@@ -1,0 +1,266 @@
+"""Windowed per-role SLO plane (ISSUE 19).
+
+The autoscaling gap this closes: the `ttft_breakdown` histograms are
+LIFETIME aggregates — a pool that was saturated ten minutes ago and
+idle now still shows a fat p99, so nothing built on them can make a
+scale decision that reacts to the last thirty seconds. This module
+keeps **rolling time-bucketed windows** per (role, metric), computes
+recent quantiles plus an **error-budget burn rate** against a
+configured target, exports them as ``slo/*`` gauges on the existing
+``/metrics`` endpoint, and distils them into the per-role scale
+recommendation (:func:`roles_signal`) the ``ReplicaPool`` autoscaler
+(``serving.autoscale.scale_signal: "slo"``) and the supervisor's
+``roles_for_world`` ladder consume.
+
+Wiring (all host floats, never a device sync — the plane only ever
+sees values some existing fence already read back):
+
+- the rank-0 :class:`~deepspeed_tpu.serving.transport.PrefillNode`
+  feeds its own registry's TTFT segments under role ``"prefill"`` and
+  every decode rank's exchanged ``MV_TICK_S`` slot under ``"decode"``
+  (sampled once per aligned exchange — the same cadence the
+  backpressure signals already ride);
+- burn rate = (fraction of windowed samples over ``targets[metric]``)
+  / ``budget``: 1.0 means violations are consuming the error budget
+  exactly as fast as allowed, above ``up_burn`` the role needs
+  capacity, below ``down_burn`` (every metric of the role) it has
+  slack.
+
+Stdlib-only on purpose: the drift-guard tests import this next to the
+jax-free viewer chain.
+"""
+
+import threading
+import time
+
+DEFAULT_WINDOW_S = 30.0
+DEFAULT_BUCKETS = 6
+DEFAULT_BUDGET = 0.1        # 10% of requests may miss the target
+DEFAULT_UP_BURN = 2.0
+DEFAULT_DOWN_BURN = 0.25
+DEFAULT_MIN_SAMPLES = 8
+
+# the pinned (role, metric) families — tests/test_metric_names.py
+# checks the exported gauge names against slo_metric_names() in BOTH
+# directions, like the cluster/* and router/* namespaces
+SLO_FAMILIES = (
+    ("prefill", "ttft_s"),
+    ("prefill", "queue_wait_s"),
+    ("prefill", "transport_s"),
+    ("decode", "tick_s"),
+)
+SLO_STATS = ("p50", "p99", "burn_rate", "samples")
+
+DEFAULT_TARGETS = {
+    "ttft_s": 1.0,
+    "queue_wait_s": 0.5,
+    "transport_s": 0.25,
+    "tick_s": 0.1,
+}
+
+
+def slo_metric_names():
+    """Every gauge the plane can export — the drift-guard contract."""
+    names = [f"slo/{role}/{metric}/{stat}"
+             for role, metric in SLO_FAMILIES for stat in SLO_STATS]
+    names.append("slo/window_s")
+    return names
+
+
+class SloWindow:
+    """Rolling time-bucketed sample store for ONE (role, metric):
+    ``n_buckets`` fixed-width time buckets spanning ``window_s``
+    seconds; a bucket older than the window drops whole (cheap
+    eviction, no per-sample timestamps kept), and each bucket caps its
+    sample count so a hot loop cannot grow the window unboundedly."""
+
+    def __init__(self, window_s=DEFAULT_WINDOW_S,
+                 n_buckets=DEFAULT_BUCKETS, per_bucket_cap=256):
+        assert window_s > 0 and n_buckets >= 1
+        self.window_s = float(window_s)   # sync-ok: config scalar
+        self.n_buckets = int(n_buckets)
+        self.bucket_s = self.window_s / self.n_buckets
+        self.per_bucket_cap = int(per_bucket_cap)
+        self._buckets = []        # list of [bucket_index, [values]]
+        self.total = 0            # lifetime observations (not windowed)
+
+    def _evict(self, now):
+        horizon = int(now / self.bucket_s) - self.n_buckets
+        self._buckets = [b for b in self._buckets if b[0] > horizon]
+
+    def observe(self, value, now=None):
+        now = time.time() if now is None else float(now)   # sync-ok: host clock
+        self._evict(now)
+        idx = int(now / self.bucket_s)
+        self.total += 1
+        if self._buckets and self._buckets[-1][0] == idx:
+            vals = self._buckets[-1][1]
+        else:
+            vals = []
+            self._buckets.append([idx, vals])
+        if len(vals) < self.per_bucket_cap:
+            vals.append(float(value))   # sync-ok: host scalar, plane contract
+
+    def samples(self, now=None):
+        now = time.time() if now is None else float(now)   # sync-ok: host clock
+        self._evict(now)
+        out = []
+        for _idx, vals in self._buckets:
+            out.extend(vals)
+        return out
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = int(round(q / 100.0 * (len(sorted_vals) - 1)))
+    return sorted_vals[min(len(sorted_vals) - 1, max(0, i))]
+
+
+class SloPlane:
+    """Per-(role, metric) windows + gauge export + the burn-rate math.
+    Thread-safe (the serving loop feeds while a /metrics scrape
+    triggers nothing — export is explicit, at tick cadence)."""
+
+    def __init__(self, window_s=DEFAULT_WINDOW_S, targets=None,
+                 budget=DEFAULT_BUDGET, up_burn=DEFAULT_UP_BURN,
+                 down_burn=DEFAULT_DOWN_BURN,
+                 min_samples=DEFAULT_MIN_SAMPLES,
+                 n_buckets=DEFAULT_BUCKETS):
+        self.window_s = float(window_s)   # sync-ok: config scalar
+        self.n_buckets = int(n_buckets)
+        self.targets = dict(DEFAULT_TARGETS)
+        if targets:
+            self.targets.update({str(k): float(v)   # sync-ok: config
+                                 for k, v in targets.items()})
+        self.budget = max(float(budget), 1e-6)   # sync-ok: config scalar
+        self.up_burn = float(up_burn)   # sync-ok: config scalar
+        self.down_burn = float(down_burn)   # sync-ok: config scalar
+        self.min_samples = int(min_samples)
+        self._windows = {}        # (role, metric) -> SloWindow
+        self._fed_counts = {}     # (role, metric) -> histogram count seen
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_config(cls, slo_cfg):
+        """Build from a parsed ``config.SloConfig`` (None when the
+        block disabled it)."""
+        if slo_cfg is None or not getattr(slo_cfg, "enabled", False):
+            return None
+        return cls(window_s=slo_cfg.window_s, targets=slo_cfg.targets,
+                   budget=slo_cfg.budget, up_burn=slo_cfg.up_burn,
+                   down_burn=slo_cfg.down_burn,
+                   min_samples=slo_cfg.min_samples)
+
+    def _window(self, role, metric):
+        key = (str(role), str(metric))
+        w = self._windows.get(key)
+        if w is None:
+            w = self._windows[key] = SloWindow(
+                self.window_s, self.n_buckets)
+        return w
+
+    def observe(self, role, metric, value, now=None):
+        with self._lock:
+            self._window(role, metric).observe(value, now=now)
+
+    def feed_counted(self, role, metric, values, count, now=None,
+                     source=None):
+        """Feed only the NEW tail of a registry histogram: ``values``
+        is the bounded reservoir, ``count`` its lifetime count. The
+        caller polls at tick cadence; this dedupes so a quiet tick
+        re-feeds nothing (a windowed quantile fed the same TTFT every
+        tick would freeze the window at the last request). ``source``
+        disambiguates when several histograms feed one window (the
+        transport segments) — each keeps its own count cursor."""
+        key = (str(role), str(metric), str(source or metric))
+        with self._lock:
+            seen = self._fed_counts.get(key, 0)
+            fresh = int(count) - seen
+            if fresh <= 0:
+                return
+            self._fed_counts[key] = int(count)
+            w = self._window(role, metric)
+            for v in values[-min(fresh, len(values)):]:
+                w.observe(v, now=now)
+
+    def stats(self, role, metric, now=None):
+        """``{p50, p99, burn_rate, samples}`` of the current window
+        (None when it holds no samples)."""
+        with self._lock:
+            key = (str(role), str(metric))
+            w = self._windows.get(key)
+            if w is None:
+                return None
+            vals = sorted(w.samples(now=now))
+        if not vals:
+            return None
+        target = self.targets.get(str(metric))
+        burn = 0.0
+        if target is not None:
+            viol = sum(1 for v in vals if v > target)
+            burn = (viol / len(vals)) / self.budget
+        return {"p50": _pct(vals, 50), "p99": _pct(vals, 99),
+                "burn_rate": burn, "samples": len(vals)}
+
+    def export(self, registry, now=None):
+        """Set the ``slo/*`` gauges for every family that has windowed
+        samples (families with no samples export nothing — same
+        no-phantom-metrics discipline as the registry peeks)."""
+        registry.gauge("slo/window_s").set(self.window_s)
+        for role, metric in SLO_FAMILIES:
+            s = self.stats(role, metric, now=now)
+            if s is None:
+                continue
+            for stat in SLO_STATS:
+                registry.gauge(f"slo/{role}/{metric}/{stat}").set(
+                    s[stat])
+
+    def recommend(self, now=None):
+        """Direct (registry-free) form of :func:`roles_signal`."""
+        out = {}
+        for role in {r for r, _m in SLO_FAMILIES}:
+            burns = []
+            for r, metric in SLO_FAMILIES:
+                if r != role:
+                    continue
+                s = self.stats(role, metric, now=now)
+                if s is not None and s["samples"] >= self.min_samples:
+                    burns.append(s["burn_rate"])
+            out[role] = _decide(burns, self.up_burn, self.down_burn)
+        return out
+
+
+def _decide(burns, up_burn, down_burn):
+    if not burns:
+        return "hold"
+    if max(burns) >= up_burn:
+        return "up"
+    if max(burns) <= down_burn:
+        return "down"
+    return "hold"
+
+
+def roles_signal(registry, up_burn=DEFAULT_UP_BURN,
+                 down_burn=DEFAULT_DOWN_BURN,
+                 min_samples=DEFAULT_MIN_SAMPLES):
+    """The per-role scale recommendation, derived PURELY from the
+    exported ``slo/*`` gauges of ``registry`` — the consumer contract:
+    an autoscaler (ReplicaPool, the supervisor ladder, an external
+    operator scraping /metrics) needs no access to the plane object,
+    only to the gauge plane it exported. Returns
+    ``{"prefill"|"decode": "up"|"down"|"hold"}``; a role with no
+    exported families (or too few windowed samples) holds."""
+    out = {}
+    for role in sorted({r for r, _m in SLO_FAMILIES}):
+        burns = []
+        for r, metric in SLO_FAMILIES:
+            if r != role:
+                continue
+            burn = registry.peek_gauge(f"slo/{role}/{metric}/burn_rate")
+            n = registry.peek_gauge(f"slo/{role}/{metric}/samples")
+            if burn is None or n is None or n < min_samples:
+                continue
+            burns.append(float(burn))   # sync-ok: gauge peek, host value
+        out[role] = _decide(burns, up_burn, down_burn)
+    return out

@@ -19,14 +19,65 @@ against execution when wrapped around hot-loop phases (the reference's
   a configured step range (``profiling.trace_dir`` +
   ``profiling.trace_steps``) — the one place a deliberate fence happens
   (at stop, so the captured steps' device work is in the trace).
+
+ISSUE 19 adds the **causal span-id layer** under the distributed trace
+plane: ``new_span_id()`` mints process-unique ids (pid-scoped, so ids
+minted on different ranks never collide when their dump files merge)
+and serving lifecycle events carry ``span_id``/``parent_span`` fields
+that ``telemetry/perfetto.py`` stitches into one parent/child tree per
+``trace_id`` — prefill on rank 0, transport encode/collective, adopt +
+per-tick decode on rank N, finish — even though every leg landed in a
+different per-role dump file. Minting is stdlib + a lock; nothing here
+touches jax (the jax-free viewer contract covers the exporter that
+consumes these ids).
 """
 
 import contextlib
+import itertools
+import os
+import threading
 import time
 
 from deepspeed_tpu.telemetry.recorder import default_recorder
 from deepspeed_tpu.telemetry.registry import default_registry
 from deepspeed_tpu.utils.logging import logger
+
+
+# ---------------------------------------------------------------- span ids
+#
+# A span id must be unique across EVERY process whose dump files end up
+# merged in one Perfetto export (N ranks × supervisor restart epochs).
+# uuid-per-span would work but costs an entropy read per serving event;
+# a pid-prefixed counter is two orders cheaper and collision-free by
+# construction: the pid names the process, the counter names the span.
+# (Pid recycling across supervisor epochs is disambiguated by the
+# startup-time nonce baked into the prefix.)
+
+_span_counter = itertools.count(1)
+_span_prefix = None
+_span_lock = threading.Lock()
+
+
+def new_span_id():
+    """Mint a process-unique span id (``"<pid-hex><nonce>-<n>"``).
+    Host-only and cheap — safe on the serving scheduler's per-request
+    path. Thread-safe; ids from concurrent threads never collide."""
+    global _span_prefix
+    if _span_prefix is None:
+        with _span_lock:
+            if _span_prefix is None:
+                _span_prefix = f"{os.getpid():x}{os.urandom(2).hex()}"
+    return f"{_span_prefix}-{next(_span_counter)}"
+
+
+def span_fields(span_id, parent_span=None):
+    """The event-field convention of the trace plane: a dict to splat
+    into a recorder event. ``parent_span=None`` marks a ROOT span —
+    the exporter renders it as the request's top-level slice."""
+    out = {"span_id": span_id}
+    if parent_span is not None:
+        out["parent_span"] = parent_span
+    return out
 
 
 def annotate(tag):

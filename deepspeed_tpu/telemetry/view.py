@@ -631,13 +631,30 @@ def main(argv=None):
                          "trace stitching)")
     ap.add_argument("--events", type=int, default=0, metavar="N",
                     help="also print the last N raw events")
+    ap.add_argument("--format", choices=("text", "perfetto"),
+                    default="text", dest="fmt",
+                    help="text report (default) or a Chrome "
+                         "trace-event JSON for ui.perfetto.dev / "
+                         "chrome://tracing (telemetry/perfetto.py)")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="write the report here instead of stdout")
     args = ap.parse_args(argv)
     try:
-        lines = render(args.dump, tail_events=args.events)
+        if args.fmt == "perfetto":
+            # lazy: perfetto imports load_dump from THIS module
+            from deepspeed_tpu.telemetry import perfetto
+            text = perfetto.dumps(perfetto.export(args.dump))
+        else:
+            text = "\n".join(render(args.dump,
+                                    tail_events=args.events))
     except OSError as e:
         print(f"cannot read {' '.join(args.dump)}: {e}", file=sys.stderr)
         return 2
-    print("\n".join(lines))
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+    else:
+        print(text)
     return 0
 
 

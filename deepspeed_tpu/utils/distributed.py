@@ -334,6 +334,12 @@ class PeerFabric:
         self.addrs = [b.decode() for b in bufs]
         self._out = {}   # dst rank -> connected socket
         self._in = {}    # src rank -> accepted socket
+        # liveness timestamps (ISSUE 19 satellite): wall clock of the
+        # last payload each direction per peer — /healthz surfaces the
+        # age so a half-dead socket mesh (connected but silent) is
+        # visible before any payload_timeout_s trips
+        self.last_send_ts = {}   # dst rank -> time.time() of last send
+        self.last_recv_ts = {}   # src rank -> time.time() of last recv
 
     def send(self, dst: int, buf: bytes) -> None:
         s = self._out.get(dst)
@@ -346,6 +352,7 @@ class PeerFabric:
             s.sendall(struct.pack("<I", self.rank))
             self._out[dst] = s
         s.sendall(buf)
+        self.last_send_ts[int(dst)] = time.time()
 
     def recv(self, src: int, nbytes: int) -> bytes:
         while src not in self._in:
@@ -355,7 +362,31 @@ class PeerFabric:
             conn.settimeout(self.timeout_s)
             peer = struct.unpack("<I", _recv_exact(conn, 4))[0]
             self._in[int(peer)] = conn
-        return _recv_exact(self._in[src], nbytes)
+        out = _recv_exact(self._in[src], nbytes)
+        self.last_recv_ts[int(src)] = time.time()
+        return out
+
+    def liveness(self) -> dict:
+        """Per-peer fabric liveness for /healthz: whether each
+        direction is connected and the seconds since its last payload
+        (None = no payload yet). Host state only — reading it can
+        never block or sync."""
+        now = time.time()
+
+        def _age(ts):
+            return None if ts is None else max(now - ts, 0.0)
+
+        peers = {}
+        for r in range(self.world):
+            if r == self.rank:
+                continue
+            peers[str(r)] = {
+                "out_connected": r in self._out,
+                "in_connected": r in self._in,
+                "last_send_age_s": _age(self.last_send_ts.get(r)),
+                "last_recv_age_s": _age(self.last_recv_ts.get(r)),
+            }
+        return {"rank": self.rank, "world": self.world, "peers": peers}
 
     def close(self) -> None:
         for s in list(self._out.values()) + list(self._in.values()) \

@@ -73,9 +73,9 @@ def test_documented_metric_names_are_emitted():
     src = _package_source()
     missing = []
     for name in sorted(documented_metric_names()):
-        if name.startswith("cluster/"):
+        if name.startswith(("cluster/", "slo/")):
             continue   # pinned exactly (both directions) by the
-            #            programmatic test below — they are f-string
+            #            programmatic tests below — they are f-string
             #            built, so no literal to find here
         tail = name.split("/", 1)[1]
         if name in src or tail in src:
@@ -102,6 +102,49 @@ def test_cluster_metric_names_documented_both_directions():
     assert documented - emitted == set(), (
         "documented but no longer emitted cluster/* names: "
         + ", ".join(sorted(documented - emitted)))
+
+
+def test_slo_metric_names_documented_both_directions():
+    """The ``slo/*`` namespace (ISSUE 19) is pinned EXACTLY like
+    cluster/*: emitted ⊆ documented and documented ⊆ emitted, against
+    ``telemetry.slo.slo_metric_names()``. slo.py is stdlib-only, so
+    this runs anywhere."""
+    from deepspeed_tpu.telemetry.slo import slo_metric_names
+    emitted = set(slo_metric_names())
+    documented = {n for n in documented_metric_names()
+                  if n.startswith("slo/")}
+    assert emitted - documented == set(), (
+        "emitted but undocumented slo/* names — add them to the "
+        "docs/observability.md slo table: "
+        + ", ".join(sorted(emitted - documented)))
+    assert documented - emitted == set(), (
+        "documented but no longer emitted slo/* names: "
+        + ", ".join(sorted(documented - emitted)))
+
+
+def test_cluster_fences_counts_on_every_rank(monkeypatch):
+    """The PR-12 asymmetry fix (ISSUE 19 satellite), pinned: the
+    ``cluster/fences`` counter increments in ``exchange()`` on EVERY
+    rank — a non-zero rank's registry must show its fences, not 0
+    (the old behavior: only the rank-0 fold counted)."""
+    import numpy as np
+    from deepspeed_tpu.telemetry.cluster import (CLUSTER_METRICS,
+                                                 ClusterAggregator)
+    from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+    world, me = 3, 1          # a NON-fold rank
+    mat = np.zeros((world, len(CLUSTER_METRICS)), np.float32)
+    monkeypatch.setattr(
+        "deepspeed_tpu.utils.distributed.allgather_host_floats",
+        lambda vec: (mat, me))
+    reg = MetricsRegistry()
+    agg = ClusterAggregator(registry=reg)
+    for _ in range(4):
+        agg.exchange({"step_time_s": 0.1})
+    assert agg.rank == 1 and agg.fences == 4
+    assert reg.counter("cluster/fences").value == 4
+    # and the fold-side gauges did NOT appear on this rank
+    assert reg.peek_gauge("cluster/step_time_s/max") is None
 
 
 def test_router_metric_names_documented_both_directions():
@@ -249,7 +292,10 @@ def test_viewer_import_chain_is_stdlib_only(tmp_path):
         [sys.executable, "-c",
          "import deepspeed_tpu.telemetry.view as v; "
          "import deepspeed_tpu.telemetry.serve; "
-         "print('STDLIB_OK', callable(v.render))"],
+         "import deepspeed_tpu.telemetry.slo; "
+         "import deepspeed_tpu.telemetry.perfetto as p; "
+         "print('STDLIB_OK', callable(v.render) and "
+         "callable(p.export))"],
         env=env, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, (
         f"viewer import chain pulled jax/numpy (or crashed):\n{r.stderr}")

@@ -1,0 +1,298 @@
+"""Windowed per-role SLO plane (ISSUE 19): rolling windows, burn-rate
+math, the ``slo/*`` gauge contract, and the two autoscaling consumers
+(ReplicaPool ``scale_signal="slo"``, the supervisor's role ladder).
+
+The consumer tests are THE acceptance pin: a role-scale recommendation
+driven purely from exported ``slo/*`` gauges — decode scale-up under a
+saturated decode window, no-op under a balanced one — with no access
+to the plane object itself.
+"""
+
+import types
+
+import pytest
+
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.telemetry.slo import (DEFAULT_TARGETS, SLO_FAMILIES,
+                                         SloPlane, SloWindow,
+                                         roles_signal, slo_metric_names)
+
+# ----------------------------------------------------------- windows
+
+
+def test_window_evicts_whole_buckets_past_horizon():
+    w = SloWindow(window_s=10.0, n_buckets=5)       # 2 s buckets
+    for i in range(5):
+        w.observe(float(i), now=100.0 + 2.0 * i)    # one per bucket
+    assert sorted(w.samples(now=109.0)) == [0.0, 1.0, 2.0, 3.0, 4.0]
+    # 4 s later the two oldest buckets fall off WHOLE
+    assert sorted(w.samples(now=113.0)) == [2.0, 3.0, 4.0]
+    # far future: empty, nothing lingers
+    assert w.samples(now=1000.0) == []
+    assert w.total == 5                             # lifetime count kept
+
+
+def test_window_caps_samples_per_bucket():
+    w = SloWindow(window_s=10.0, n_buckets=5, per_bucket_cap=16)
+    for _ in range(1000):
+        w.observe(1.0, now=100.0)
+    assert len(w.samples(now=100.0)) == 16
+
+
+# --------------------------------------------------------- burn rate
+
+
+def test_burn_rate_is_violation_fraction_over_budget():
+    p = SloPlane(targets={"tick_s": 0.1}, budget=0.1, min_samples=1)
+    # 3 of 10 over target -> 30% violations / 10% budget = 3.0
+    for i in range(10):
+        p.observe("decode", "tick_s", 0.2 if i < 3 else 0.05, now=100.0)
+    s = p.stats("decode", "tick_s", now=100.0)
+    assert s["samples"] == 10
+    assert s["burn_rate"] == pytest.approx(3.0)
+    assert s["p50"] == 0.05
+
+
+def test_stats_none_until_samples_and_windows_age_out():
+    p = SloPlane(window_s=10.0, min_samples=1)
+    assert p.stats("decode", "tick_s", now=100.0) is None
+    p.observe("decode", "tick_s", 0.5, now=100.0)
+    assert p.stats("decode", "tick_s", now=100.0)["samples"] == 1
+    # the windowed plane FORGETS — the lifetime-histogram failure mode
+    # this module exists to fix
+    assert p.stats("decode", "tick_s", now=200.0) is None
+
+
+def test_feed_counted_dedupes_by_count_cursor_and_source():
+    p = SloPlane(min_samples=1)
+    vals = [0.2, 0.3]
+    p.feed_counted("prefill", "ttft_s", vals, 2, now=100.0)
+    p.feed_counted("prefill", "ttft_s", vals, 2, now=100.0)  # re-poll
+    assert p.stats("prefill", "ttft_s", now=100.0)["samples"] == 2
+    # a third observation feeds ONLY the new tail
+    p.feed_counted("prefill", "ttft_s", vals + [0.4], 3, now=100.0)
+    assert p.stats("prefill", "ttft_s", now=100.0)["samples"] == 3
+    # two histograms feeding ONE window keep independent cursors
+    p.feed_counted("prefill", "transport_s", [0.01], 1, now=100.0,
+                   source="a:encode")
+    p.feed_counted("prefill", "transport_s", [0.02], 1, now=100.0,
+                   source="b:collective")
+    assert p.stats("prefill", "transport_s",
+                   now=100.0)["samples"] == 2
+
+
+# ------------------------------------------------------ gauge export
+
+
+def test_export_writes_only_fed_families():
+    p = SloPlane(min_samples=1)
+    for _ in range(4):
+        p.observe("decode", "tick_s", 0.05, now=100.0)
+    reg = MetricsRegistry()
+    p.export(reg, now=100.0)
+    assert reg.peek_gauge("slo/window_s") == p.window_s
+    assert reg.peek_gauge("slo/decode/tick_s/samples") == 4
+    # an unfed family exports NOTHING (no phantom zeros)
+    assert reg.peek_gauge("slo/prefill/ttft_s/samples") is None
+    exported = {n for n in slo_metric_names()
+                if reg.peek_gauge(n) is not None}
+    assert exported == {"slo/window_s", "slo/decode/tick_s/p50",
+                        "slo/decode/tick_s/p99",
+                        "slo/decode/tick_s/burn_rate",
+                        "slo/decode/tick_s/samples"}
+
+
+def _saturate(reg, role, metric, burn, samples=32):
+    reg.gauge(f"slo/{role}/{metric}/burn_rate").set(burn)
+    reg.gauge(f"slo/{role}/{metric}/samples").set(samples)
+
+
+def test_roles_signal_pinned_decisions():
+    """THE acceptance decisions, purely from gauges: saturated decode
+    -> decode up; balanced -> hold everywhere; slack everywhere ->
+    down; thin samples -> hold regardless of burn."""
+    reg = MetricsRegistry()
+    _saturate(reg, "decode", "tick_s", burn=5.0)
+    _saturate(reg, "prefill", "ttft_s", burn=0.8)
+    assert roles_signal(reg) == {"decode": "up", "prefill": "hold"}
+    # balanced: burns inside the hysteresis band on both roles
+    reg2 = MetricsRegistry()
+    _saturate(reg2, "decode", "tick_s", burn=1.0)
+    _saturate(reg2, "prefill", "ttft_s", burn=1.0)
+    assert roles_signal(reg2) == {"decode": "hold", "prefill": "hold"}
+    # slack
+    reg3 = MetricsRegistry()
+    _saturate(reg3, "decode", "tick_s", burn=0.0)
+    assert roles_signal(reg3)["decode"] == "down"
+    # thin window: a single hot sample must NOT scale anything
+    reg4 = MetricsRegistry()
+    _saturate(reg4, "decode", "tick_s", burn=99.0, samples=2)
+    assert roles_signal(reg4) == {"decode": "hold", "prefill": "hold"}
+    # the worst family of a role decides: one hot metric beats two calm
+    reg5 = MetricsRegistry()
+    _saturate(reg5, "prefill", "ttft_s", burn=0.0)
+    _saturate(reg5, "prefill", "queue_wait_s", burn=4.0)
+    assert roles_signal(reg5)["prefill"] == "up"
+
+
+def test_metric_names_cover_every_family():
+    names = set(slo_metric_names())
+    for role, metric in SLO_FAMILIES:
+        for stat in ("p50", "p99", "burn_rate", "samples"):
+            assert f"slo/{role}/{metric}/{stat}" in names
+    assert "slo/window_s" in names
+    assert all(m in DEFAULT_TARGETS for _r, m in SLO_FAMILIES)
+
+
+# ------------------------------------------------------------- config
+
+
+def test_slo_config_defaults_and_validation():
+    from deepspeed_tpu.config.config import (DeepSpeedConfigError,
+                                             SloConfig)
+    c = SloConfig({})
+    assert c.enabled and c.window_s == 30.0 and c.budget == 0.1
+    assert c.down_burn < c.up_burn
+    p = SloPlane.from_config(c)
+    assert p is not None and p.window_s == 30.0
+    assert SloPlane.from_config(
+        SloConfig({"slo": {"enabled": False}})) is None
+    assert SloPlane.from_config(None) is None
+    c2 = SloConfig({"slo": {"window_s": 5.0,
+                            "targets": {"tick_s": 0.5}}})
+    assert SloPlane.from_config(c2).targets["tick_s"] == 0.5
+    for bad in ({"window_s": 0}, {"budget": 0}, {"budget": 2},
+                {"up_burn": 1.0, "down_burn": 1.0},
+                {"targets": {"tick_s": -1}}):
+        with pytest.raises(DeepSpeedConfigError):
+            SloConfig({"slo": bad})
+
+
+# ------------------------------------------- consumers: replica pool
+
+
+def _fake_batcher(_rid):
+    slot = types.SimpleNamespace(active=False)
+    elastic = types.SimpleNamespace(
+        request_preemption=lambda source=None: None,
+        last_snapshot_dir=None)
+    return types.SimpleNamespace(
+        watchdog=None, metrics=MetricsRegistry(), queue=[],
+        slots=[slot, slot], elastic=elastic, preempted=False,
+        step=lambda now=None: [])
+
+
+def _mk_pool(reg, **kw):
+    from deepspeed_tpu.serving.replica_pool import ReplicaPool
+    kw.setdefault("n_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    return ReplicaPool(_fake_batcher, scale_signal="slo",
+                       slo_registry=reg, **kw)
+
+
+def test_pool_scales_up_on_decode_burn_from_gauges_only():
+    reg = MetricsRegistry()
+    _saturate(reg, "decode", "tick_s", burn=5.0)
+    pool = _mk_pool(reg)
+    assert len(pool.replicas) == 1
+    pool._autoscale()
+    assert len(pool.replicas) == 2
+    assert pool.stats["scale_ups"] == 1
+    ev = [e for e in pool.recorder.events()
+          if e.get("kind") == "replica_scale"]
+    assert ev and ev[-1]["reason"] == "slo_burn:decode"
+    # capped at max_replicas
+    pool._autoscale()
+    pool._autoscale()
+    assert len(pool.replicas) == 3
+    pool._autoscale()
+    assert len(pool.replicas) == 3
+
+
+def test_pool_holds_under_balanced_gauges():
+    reg = MetricsRegistry()
+    _saturate(reg, "decode", "tick_s", burn=1.0)
+    _saturate(reg, "prefill", "ttft_s", burn=1.0)
+    pool = _mk_pool(reg, n_replicas=2)
+    for _ in range(100):
+        pool._autoscale()
+    assert len(pool.replicas) == 2        # no-op, both directions
+    assert pool.stats["scale_ups"] == 0
+    assert pool.stats["scale_downs"] == 0
+
+
+def test_pool_scale_down_needs_sustained_slack():
+    reg = MetricsRegistry()
+    _saturate(reg, "decode", "tick_s", burn=0.0)
+    pool = _mk_pool(reg, n_replicas=2, scale_down_idle_rounds=5)
+    for _ in range(4):
+        pool._autoscale()
+    assert len(pool.replicas) == 2        # patience not yet spent
+    pool._autoscale()
+    # the 5th consecutive "down" round drains the least-loaded replica
+    assert pool._draining or len(pool.replicas) == 1
+
+
+def test_pool_watchdog_signal_ignores_slo_gauges():
+    from deepspeed_tpu.serving.replica_pool import ReplicaPool
+    reg = MetricsRegistry()
+    _saturate(reg, "decode", "tick_s", burn=99.0)
+    pool = ReplicaPool(_fake_batcher, n_replicas=1, max_replicas=3,
+                       scale_signal="watchdog", slo_registry=reg)
+    pool._autoscale()
+    assert len(pool.replicas) == 1
+
+
+def test_pool_slo_recommendation_is_inspectable():
+    reg = MetricsRegistry()
+    _saturate(reg, "prefill", "ttft_s", burn=3.0)
+    pool = _mk_pool(reg)
+    assert pool.slo_recommendation()["prefill"] == "up"
+
+
+# --------------------------------------------- consumer: supervisor
+
+
+def _mk_supervisor(tmp_path, roles, registry=None):
+    from deepspeed_tpu.runtime.elastic.supervisor import Supervisor
+    return Supervisor(["true"], world=3, roles=roles,
+                      heartbeat_dir=str(tmp_path / "hb"),
+                      log_dir=str(tmp_path / "logs"),
+                      registry=registry if registry is not None
+                      else MetricsRegistry())
+
+
+def test_roles_for_world_prefer_biases_only_fill_ranks(tmp_path):
+    sup = _mk_supervisor(tmp_path, {0: "prefill", 1: "decode"})
+    assert sup.roles_for_world(4) == {0: "prefill", 1: "decode",
+                                      2: "decode", 3: "decode"}
+    # prefer overrides the FILL only; configured ranks keep their role
+    assert sup.roles_for_world(4, prefer="prefill") == {
+        0: "prefill", 1: "decode", 2: "prefill", 3: "prefill"}
+    assert sup.roles_for_world(2, prefer="prefill") == {
+        0: "prefill", 1: "decode"}
+
+
+def test_supervisor_roles_preference_reads_slo_gauges(tmp_path):
+    reg = MetricsRegistry()
+    sup = _mk_supervisor(tmp_path, {0: "prefill", 1: "decode"},
+                         registry=reg)
+    assert sup.roles_preference() is None            # no gauges: no bias
+    _saturate(reg, "decode", "tick_s", burn=5.0)
+    assert sup.roles_preference() == "decode"
+    ladder = sup.roles_for_world(4, prefer=sup.roles_preference())
+    assert ladder == {0: "prefill", 1: "decode", 2: "decode",
+                      3: "decode"}
+    # a hot rank-0 role cannot re-role rank 0 — it biases the fill
+    reg2 = MetricsRegistry()
+    sup2 = _mk_supervisor(tmp_path, {0: "prefill", 1: "decode"},
+                          registry=reg2)
+    _saturate(reg2, "prefill", "ttft_s", burn=5.0)
+    _saturate(reg2, "decode", "tick_s", burn=5.0)
+    assert sup2.roles_preference() == "decode"
+
+
+def test_training_supervisor_has_no_role_preference(tmp_path):
+    sup = _mk_supervisor(tmp_path, None)
+    assert sup.roles_for_world(4) is None
+    assert sup.roles_preference() is None
