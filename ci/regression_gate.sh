@@ -36,7 +36,13 @@
 # ``serving.decode_scaleout_tok_s_ratio`` (ISSUE 18: world-3
 # aggregate decode tok/s over world-2's single decode rank on the
 # LPT-balanced targeted transport, >= 1.6x — gate against
-# BENCH_r18.json or newer to arm it).
+# BENCH_r18.json or newer to arm it). Since r19 it includes
+# ``nvme_xl.max_params_b`` (ISSUE 20: largest param count parked +
+# twice re-streamed through the O_DIRECT NVMe tier on one chip, must
+# stay >= 10B) and ``nvme_param.o_direct_stall_share`` (the O_DIRECT
+# pipelined leg's exposed-stall share of the step — the honest-cache
+# counterpart of the buffered stall gate) — gate against
+# BENCH_r19.json or newer to arm both.
 #
 # The --candidate path never imports jax and finishes in <2 s, so this
 # runs on artifact files on any CI box. Typical wiring:

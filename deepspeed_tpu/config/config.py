@@ -675,6 +675,23 @@ class AioConfig:
         self.thread_count = int(d.get(C.AIO_THREAD_COUNT, C.AIO_THREAD_COUNT_DEFAULT))
         self.single_submit = bool(d.get(C.AIO_SINGLE_SUBMIT, C.AIO_SINGLE_SUBMIT_DEFAULT))
         self.overlap_events = bool(d.get(C.AIO_OVERLAP_EVENTS, C.AIO_OVERLAP_EVENTS_DEFAULT))
+        o_direct = d.get(C.AIO_O_DIRECT, C.AIO_O_DIRECT_DEFAULT)
+        if not isinstance(o_direct, bool):
+            raise DeepSpeedConfigError(
+                f"aio.{C.AIO_O_DIRECT} must be a bool, got {o_direct!r}")
+        self.o_direct = o_direct
+        if self.block_size <= 0:
+            raise DeepSpeedConfigError(
+                f"aio.{C.AIO_BLOCK_SIZE} must be positive, got "
+                f"{self.block_size}")
+        if self.o_direct:
+            import mmap
+            if self.block_size % mmap.PAGESIZE:
+                raise DeepSpeedConfigError(
+                    f"aio.{C.AIO_O_DIRECT} requires "
+                    f"aio.{C.AIO_BLOCK_SIZE} to be a multiple of the "
+                    f"page size ({mmap.PAGESIZE}); got {self.block_size}"
+                    " — O_DIRECT transfer lengths must stay aligned")
 
 
 class TensorboardConfig:

@@ -580,6 +580,11 @@ AIO_SINGLE_SUBMIT = "single_submit"
 AIO_SINGLE_SUBMIT_DEFAULT = False
 AIO_OVERLAP_EVENTS = "overlap_events"
 AIO_OVERLAP_EVENTS_DEFAULT = True
+# O_DIRECT swap I/O (ISSUE 20): bytes-on-device instead of
+# bytes-into-page-cache; requires block_size % page == 0. Latches to
+# buffered I/O (with one loud warning) on filesystems that reject it.
+AIO_O_DIRECT = "o_direct"
+AIO_O_DIRECT_DEFAULT = False
 
 #############################################
 # Elastic snapshots (runtime/elastic, ISSUE 7): periodic async

@@ -1,9 +1,28 @@
-from deepspeed_tpu.ops.adam import FusedAdam, DeepSpeedCPUAdam
-from deepspeed_tpu.ops.lamb import FusedLamb
-from deepspeed_tpu.ops.sgd import SGD
-from deepspeed_tpu.ops import sparse_attention  # noqa: F401
-from deepspeed_tpu.ops import transformer  # noqa: F401
-from deepspeed_tpu.ops.transformer import (
-    DeepSpeedTransformerConfig,
-    DeepSpeedTransformerLayer,
-)
+"""Op registry. Public surface resolves LAZILY (PEP 562, same idiom as
+the root package): the optimizer/transformer ops import jax, but
+``deepspeed_tpu.ops.native.aio`` must stay importable without an
+accelerator stack — the swap tier constructs on machines where jax does
+not exist (ci/swap_gate.sh pins that with a poisoned-jax import).
+`ops.FusedAdam` etc. behave exactly like the old eager imports."""
+
+_LAZY_ATTRS = {
+    "FusedAdam": ("deepspeed_tpu.ops.adam", "FusedAdam"),
+    "DeepSpeedCPUAdam": ("deepspeed_tpu.ops.adam", "DeepSpeedCPUAdam"),
+    "FusedLamb": ("deepspeed_tpu.ops.lamb", "FusedLamb"),
+    "SGD": ("deepspeed_tpu.ops.sgd", "SGD"),
+    "DeepSpeedTransformerConfig": ("deepspeed_tpu.ops.transformer",
+                                   "DeepSpeedTransformerConfig"),
+    "DeepSpeedTransformerLayer": ("deepspeed_tpu.ops.transformer",
+                                  "DeepSpeedTransformerLayer"),
+    # submodules the old eager imports bound as attributes
+    "adam": ("deepspeed_tpu.ops.adam", None),
+    "lamb": ("deepspeed_tpu.ops.lamb", None),
+    "sgd": ("deepspeed_tpu.ops.sgd", None),
+    "sparse_attention": ("deepspeed_tpu.ops.sparse_attention", None),
+    "transformer": ("deepspeed_tpu.ops.transformer", None),
+    "native": ("deepspeed_tpu.ops.native", None),
+}
+
+from deepspeed_tpu.utils.lazy import lazy_attrs  # noqa: E402
+
+__getattr__, __dir__ = lazy_attrs(__name__, _LAZY_ATTRS)

@@ -168,7 +168,7 @@ class AsyncSnapshotter:
         t0 = time.perf_counter()
         files = {}     # fname -> {"crc32", "nbytes"}
         leaves = {}    # "stem:path" -> {"shape", "dtype", "pieces"}
-        fds, bufs = [], []
+        fds, bufs, sizes = [], [], []
         seq = 0
         total = 0
         from_files = 0
@@ -179,12 +179,28 @@ class AsyncSnapshotter:
                     for arr, start, stop, src in self._pieces(leaf):
                         fname = f"{stem}_r{rank}_{seq:05d}.bin"
                         seq += 1
-                        buf = np.empty(arr.nbytes, np.uint8)
+                        if getattr(self._handle, "direct_active", False):
+                            from deepspeed_tpu.ops.native.aio import \
+                                aligned_empty
+                            buf = aligned_empty(arr.nbytes)
+                        else:
+                            buf = np.empty(arr.nbytes, np.uint8)
                         np.copyto(buf, arr.view(np.uint8).reshape(-1))
-                        fd = os.open(os.path.join(stage_dir, fname),
-                                     os.O_WRONLY | os.O_CREAT, 0o644)
+                        # open through the handle so the aio.o_direct
+                        # knob applies here too (the snapshot fsync
+                        # price was page-cache-masked without it);
+                        # finalize truncates direct files back to the
+                        # exact byte count, keeping the on-disk format
+                        # (np.fromfile + crc over nbytes) unchanged
+                        fd = self._handle.open_fd(
+                            os.path.join(stage_dir, fname),
+                            os.O_WRONLY | os.O_CREAT, 0o644) \
+                            if hasattr(self._handle, "open_fd") else \
+                            os.open(os.path.join(stage_dir, fname),
+                                    os.O_WRONLY | os.O_CREAT, 0o644)
                         self._handle.async_pwrite(buf, fd)
                         fds.append(fd)
+                        sizes.append(buf.nbytes)
                         bufs.append(buf)   # alive until the drain fence
                         files[fname] = {"crc32": _crc(buf),
                                         "nbytes": buf.nbytes}
@@ -219,7 +235,8 @@ class AsyncSnapshotter:
                            stage_s=time.perf_counter() - t0)
         self._inflight = {
             "tag": str(tag), "stage": stage_dir, "final": final_dir,
-            "fds": fds, "bufs": bufs, "files": files, "leaves": leaves,
+            "fds": fds, "bufs": bufs, "sizes": sizes,
+            "files": files, "leaves": leaves,
             "bytes": total, "extra": dict(extra or {}),
             "meta": dict(meta or {}), "t_begin": t0,
         }
@@ -268,10 +285,17 @@ class AsyncSnapshotter:
             t0 = time.perf_counter()
             self._handle.wait()   # the drain fence — inside the try:
             stall = time.perf_counter() - t0   # an aio write error
+            from deepspeed_tpu.ops.native.aio import fd_is_direct
             while inf["fds"]:     # must hit the fd-closing except path
                 fd = inf["fds"][-1]    # peek: a raising fsync/close
-                if self.fsync:         # leaves the fd for the except
-                    os.fsync(fd)       # path's cleanup loop
+                if fd_is_direct(fd):   # leaves the fd for the except
+                    # direct writes landed page-aligned; restore the
+                    # exact byte count the loader/crc expects (the
+                    # fsync below is metadata-only here — the data is
+                    # already on device, which is the honest price cut)
+                    os.ftruncate(fd, inf["sizes"][len(inf["fds"]) - 1])
+                if self.fsync:         # path's cleanup loop
+                    os.fsync(fd)
                 os.close(fd)
                 inf["fds"].pop()
             index_name = f"files_index_{rank}.json"

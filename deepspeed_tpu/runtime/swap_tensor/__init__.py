@@ -2,4 +2,5 @@ from deepspeed_tpu.runtime.swap_tensor.swapper import (
     TensorSwapper,
     OptimizerStateSwapper,
     PartitionedParamSwapper,
+    sweep_stale_pid_dirs,
 )

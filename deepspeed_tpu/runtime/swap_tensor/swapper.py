@@ -47,7 +47,9 @@ from deepspeed_tpu.utils.logging import logger
 
 def _make_aio_handle(aio_config):
     """One construction point for the aio handle's tuning knobs — every
-    swapper shares the same defaults."""
+    swapper shares the same defaults, and the ``aio.o_direct`` knob
+    reaches all four handle sites (park, read window, prefetch,
+    write-behind) plus the snapshotter through here."""
     from deepspeed_tpu.ops.native.aio import AsyncIOHandle
     cfg = aio_config
     return AsyncIOHandle(
@@ -55,7 +57,57 @@ def _make_aio_handle(aio_config):
         queue_depth=getattr(cfg, "queue_depth", 8),
         single_submit=getattr(cfg, "single_submit", False),
         overlap_events=getattr(cfg, "overlap_events", True),
-        thread_count=getattr(cfg, "thread_count", 2))
+        thread_count=getattr(cfg, "thread_count", 2),
+        o_direct=getattr(cfg, "o_direct", False))
+
+
+def _aligned_empty(nbytes):
+    from deepspeed_tpu.ops.native.aio import aligned_empty
+    return aligned_empty(nbytes)
+
+
+def _fd_is_direct(fd):
+    from deepspeed_tpu.ops.native.aio import fd_is_direct
+    return fd_is_direct(fd)
+
+
+def _fsync_dir(path):
+    dfd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
+def sweep_stale_pid_dirs(nvme_path, prefix):
+    """SIGKILL leaves pid-scoped scratch dirs behind — the weakref
+    finalizers that normally rmtree them never run (ISSUE 20 fix).
+    Reclaim any ``<prefix>_<pid>`` sibling whose pid is dead before
+    creating ours; a pid we cannot signal (EPERM: alive, someone
+    else's) is left alone."""
+    try:
+        names = os.listdir(nvme_path)
+    except OSError:
+        return []
+    swept = []
+    for name in names:
+        if not name.startswith(prefix + "_"):
+            continue
+        tail = name.rsplit("_", 1)[-1]
+        if not tail.isdigit() or int(tail) == os.getpid():
+            continue
+        try:
+            os.kill(int(tail), 0)
+        except ProcessLookupError:
+            shutil.rmtree(os.path.join(nvme_path, name),
+                          ignore_errors=True)
+            swept.append(name)
+        except OSError:
+            continue
+    if swept:
+        logger.info("reclaimed %d stale swap scratch dir(s) under %s: %s",
+                    len(swept), nvme_path, ", ".join(sorted(swept)))
+    return swept
 
 
 def _registry():
@@ -86,6 +138,7 @@ class TensorSwapper:
     """Owns the swap directory + aio handle; swaps named fp32 buffers."""
 
     def __init__(self, nvme_path, aio_config=None, sub_dir="zero_swap"):
+        sweep_stale_pid_dirs(nvme_path, sub_dir)
         self.dir = os.path.join(nvme_path, f"{sub_dir}_{os.getpid()}")
         os.makedirs(self.dir, exist_ok=True)
         self.handle = _make_aio_handle(aio_config)
@@ -154,7 +207,7 @@ class _StagingArena:
     them — so the arena only defragments when nothing is live; requests it
     cannot place contiguously fall back to a plain numpy allocation."""
 
-    def __init__(self, slots=4):
+    def __init__(self, slots=4, aligned=False):
         self.arena = None
         self._live = 0
         self._max_numel = 0
@@ -162,6 +215,16 @@ class _StagingArena:
         # double-buffer minimum is 4 (2 Adam fields x 2 leaves in flight);
         # pipelined write-behind asks for more
         self._slots = max(4, int(slots))
+        # page-aligned sub-allocations (ISSUE 20): slices handed to an
+        # O_DIRECT aio handle start on page boundaries, so the aligned
+        # body of every transfer submits zero-copy
+        self._aligned = bool(aligned)
+
+    def _align_elems(self):
+        if not self._aligned:
+            return 1
+        from deepspeed_tpu.ops.native.aio import ALIGNMENT
+        return ALIGNMENT // np.dtype(np.float32).itemsize
 
     def take(self, shape):
         """Returns (tid_or_None, float32 array of `shape`)."""
@@ -173,13 +236,20 @@ class _StagingArena:
         # full fetch/store cycle (first-leaf sizing would permanently
         # exile every bigger leaf to the numpy fallback)
         self._max_numel = max(self._max_numel, numel)
+        ae = self._align_elems()
+        slot_numel = -(-self._max_numel // ae) * ae
         if self.arena is None or (
                 self._live == 0
-                and self.arena.size < self._slots * self._max_numel):
+                and self.arena.size < self._slots * slot_numel):
             self.arena = ContiguousMemoryAllocator(
-                self._slots * self._max_numel, np.float32)
-        can_place = self.arena._largest_free() >= numel or self._live == 0
-        if not can_place or numel > self.arena.total_free:
+                self._slots * slot_numel, np.float32, align_elems=ae)
+        alloc = -(-numel // ae) * ae
+        can_place = self.arena._largest_free() >= alloc or self._live == 0
+        if not can_place or alloc > self.arena.total_free:
+            if self._aligned:
+                from deepspeed_tpu.ops.native.aio import aligned_empty
+                flat = aligned_empty(numel * 4).view(np.float32)
+                return None, flat.reshape(shape)
             return None, np.empty(shape, np.float32)
         tid, view = self.arena.allocate_tensor(numel)
         self._live += 1
@@ -223,6 +293,8 @@ class PartitionedParamSwapper:
         ZeRO-Infinity at-rest files, runtime/zero/infinity.py) passes a
         stable sub_dir and durable=True: files survive the process and
         carry a meta.json sidecar so a fresh process can restore."""
+        if sub_dir is None:
+            sweep_stale_pid_dirs(nvme_path, "param_swap")
         self.dir = os.path.join(
             nvme_path, sub_dir or f"param_swap_{os.getpid()}")
         os.makedirs(self.dir, exist_ok=True)
@@ -303,27 +375,40 @@ class PartitionedParamSwapper:
     # -- file lifecycle: preallocated, no O_TRUNC churn --------------------
     def _write_fd(self, i, nbytes):
         """Cached write fd for leaf ``i``'s file, preallocated to its
-        exact size: steady-state writes reuse extents (no per-step
-        truncate/alloc), and the file size stays byte-exact for
-        ``params_on_disk_bytes`` accounting."""
+        I/O size: steady-state writes reuse extents (no per-step
+        truncate/alloc). Buffered mode preallocates byte-exact; under
+        O_DIRECT the physical size rounds up to the page (aligned
+        extents — transfer lengths must be aligned, so readers request
+        the rounded length and slice the exact bytes via ``meta``)."""
         fd = self._wfds.get(i)
         if fd is None:
-            fd = os.open(self._path(i), os.O_WRONLY | os.O_CREAT, 0o644)
+            fd = self.handle.open_fd(self._path(i),
+                                     os.O_WRONLY | os.O_CREAT)
             self._wfds[i] = fd
-        if self._fsizes.get(i) != nbytes:
-            os.ftruncate(fd, nbytes)
+        alloc = self.handle.io_nbytes(nbytes)
+        if self._fsizes.get(i) != alloc:
+            os.ftruncate(fd, alloc)
             try:
-                os.posix_fallocate(fd, 0, nbytes)
+                os.posix_fallocate(fd, 0, alloc)
             except OSError:
                 pass  # fs without fallocate: sparse until first write
-            self._fsizes[i] = nbytes
+            if self.fsync and _fd_is_direct(fd):
+                # the one metadata fsync this file needs: the direct
+                # writes themselves bypass the cache, but the size/
+                # extent change from this preallocation does not
+                os.fsync(fd)
+            self._fsizes[i] = alloc
         return fd
 
     def _readahead(self, indices):
         """fadvise(WILLNEED) the files about to be read — kernel
         readahead fills the page cache while earlier leaves process, so
         the first epoch reads at steady-state bandwidth (the BENCH_r05
-        first_read_mbps=298-vs-1640 fix)."""
+        first_read_mbps=298-vs-1640 fix). Under active O_DIRECT there
+        is no page cache to warm — the pass would be a pure syscall tax
+        per file per window, so it is gated off entirely."""
+        if self.handle.direct_active:
+            return
         for i in indices:
             try:
                 fd = os.open(self._path(i), os.O_RDONLY)
@@ -344,9 +429,12 @@ class PartitionedParamSwapper:
         """Initial population / re-park after checkpoint load: every leaf
         (device or host) → its preallocated file. Sync writes; called off
         the step path. Ends with a readahead pass so the first swap-in is
-        not cold-file-bound."""
+        not cold-file-bound. ``leaves`` may be any iterable — a generator
+        keeps host residency at one leaf while parking a >RAM model
+        (the nvme_xl path)."""
         self.drain_writes()
         self._cache.clear()
+        n = 0
         for i, leaf in enumerate(leaves):
             arr = np.ascontiguousarray(np.asarray(leaf))  # sync-ok: d2h park
             self.meta[i] = (arr.shape, arr.dtype)
@@ -355,9 +443,10 @@ class PartitionedParamSwapper:
             self.handle.sync_pwrite(b, self._write_fd(i, b.nbytes))
             self._stall_s += time.perf_counter() - t0
             self._reg().counter("swap/bytes_written").inc(b.nbytes)
+            n = i + 1
         if self._durable:
             self.save_meta()
-        self._readahead(range(len(leaves)))
+        self._readahead(range(n))
 
     # -- write-behind ------------------------------------------------------
     def _take_wbuf(self, nbytes):
@@ -366,12 +455,13 @@ class PartitionedParamSwapper:
         the pool is full; drains the write handle when every buffer is
         busy. Pool is bounded at ``buffer_count`` buffers of the largest
         leaf size seen."""
+        alloc = self.handle.io_nbytes(nbytes)
         backing = {idx for idx, _ in self._cache.values()}
         for attempt in range(2):
             free = [k for k in range(len(self._wpool))
                     if k not in self._wbusy and k not in backing]
             if not free and len(self._wpool) < self.buffer_count:
-                self._wpool.append(np.empty(nbytes, np.uint8))
+                self._wpool.append(_aligned_empty(alloc))
                 return len(self._wpool) - 1
             if not free:
                 # evict the oldest cached leaf whose buffer is idle
@@ -382,8 +472,8 @@ class PartitionedParamSwapper:
                         break
             if free:
                 idx = free[0]
-                if self._wpool[idx].nbytes < nbytes:
-                    self._wpool[idx] = np.empty(nbytes, np.uint8)
+                if self._wpool[idx].nbytes < alloc:
+                    self._wpool[idx] = _aligned_empty(alloc)
                 return idx
             # every buffer carries an in-flight write: fence and retry
             self.drain_writes()
@@ -412,7 +502,14 @@ class PartitionedParamSwapper:
         idx = self._take_wbuf(b.nbytes)
         buf = self._wpool[idx][:b.nbytes]
         np.copyto(buf, b)
-        self._write_handle().async_pwrite(buf, self._write_fd(i, b.nbytes))
+        # submit the handle's physical length: under O_DIRECT that is
+        # the aligned slice of the (page-aligned) pool buffer — a
+        # zero-copy submission; buffered mode submits the exact bytes
+        wlen = self.handle.io_nbytes(b.nbytes)
+        if wlen > b.nbytes:
+            self._wpool[idx][b.nbytes:wlen] = 0
+        self._write_handle().async_pwrite(self._wpool[idx][:wlen],
+                                          self._write_fd(i, b.nbytes))
         self._wbusy.add(idx)
         self._cache[i] = (idx, b.nbytes)
         self._pending.add(i)
@@ -423,8 +520,10 @@ class PartitionedParamSwapper:
     def drain_writes(self):
         """Fence: wait for every in-flight write-behind. Cheap no-op when
         nothing is pending. With ``fsync`` on, the fence additionally
-        fsyncs every just-written file — the config-gated durability
-        barrier the snapshot commit point rides."""
+        makes the just-written files durable: buffered fds get a data
+        fsync each; O_DIRECT fds need none (completed direct writes are
+        on the device) — only the DIRENT durability remains, one
+        directory fsync per drain instead of a per-file data flush."""
         if not self._pending and not self._wbusy:
             return
         n = len(self._pending)
@@ -432,14 +531,22 @@ class PartitionedParamSwapper:
         self._timed_wait(self._write_handle())
         if self.fsync:
             t1 = time.perf_counter()
+            need_dirent = False
             for i in self._pending:
                 fd = self._wfds.get(i)
-                if fd is not None:
+                if fd is None:
+                    continue
+                if _fd_is_direct(fd):
+                    need_dirent = True
+                else:
                     os.fsync(fd)
+            if need_dirent:
+                _fsync_dir(self.dir)
             self._stall_s += time.perf_counter() - t1
         self._wbusy.clear()
         self._pending.clear()
         _recorder().record("swap_drain", leaves=n, fsync=self.fsync,
+                           o_direct=self.handle.direct_active,
                            wait_s=time.perf_counter() - t0)
 
     @property
@@ -465,10 +572,15 @@ class PartitionedParamSwapper:
 
     # -- the swap schedule -------------------------------------------------
     def _stage(self, slot, nbytes):
+        """Staging slot sized to the handle's physical I/O length —
+        page-aligned mmap buffers, so O_DIRECT reads of the aligned
+        slice land zero-copy (``_host_view`` slices the exact leaf
+        bytes back out)."""
+        need = self.handle.io_nbytes(nbytes)
         buf = self._staging[slot]
-        if buf is None or buf.nbytes < nbytes:
-            self._staging[slot] = buf = np.empty(nbytes, np.uint8)
-        return buf[:nbytes]
+        if buf is None or buf.nbytes < need:
+            self._staging[slot] = buf = _aligned_empty(need)
+        return buf[:need]
 
     def _leaf_nbytes(self, i):
         shape, dtype = self.meta[i]
@@ -567,6 +679,47 @@ class PartitionedParamSwapper:
                                 if i in self._cache))
         return outs
 
+    def swap_in_stream(self, order=None):
+        """Generator form of the read schedule for layer-streamed
+        consumers (ISSUE 20's >RAM-scale path): yields ``(i, host_view)``
+        in ``order`` with the same sliding staging window as
+        ``swap_in_device`` but NO device materialization — host residency
+        stays bounded by the staging slots no matter the model size. The
+        yielded view aliases a staging slot and is valid only until the
+        window advances past it (consume or copy before the next
+        ``len(self._staging) // 2`` items)."""
+        n = len(self.meta)
+        order = list(order) if order is not None else list(range(n))
+        if not order:
+            return
+        if self._pending.intersection(order):
+            self.drain_writes()
+        self._readahead([i for i in order if i not in self._cache])
+        reg = self._reg()
+        slots = len(self._staging)
+        group = max(1, slots // 2)
+        groups = [order[k:k + group] for k in range(0, len(order), group)]
+        fds = {}
+
+        def submit(gi):
+            for j, i in enumerate(groups[gi]):
+                slot = (gi * group + j) % slots
+                buf = self._stage(slot, self._leaf_nbytes(i))
+                fds[i] = self.handle.open(self._path(i), False)
+                self.handle.async_pread(buf, fds[i])
+
+        submit(0)
+        for gi, g in enumerate(groups):
+            self._timed_wait(self.handle)
+            for i in g:
+                self.handle.close(fds.pop(i))
+            if gi + 1 < len(groups):
+                submit(gi + 1)   # next group's reads overlap the yields
+            for j, i in enumerate(g):
+                slot = (gi * group + j) % slots
+                reg.counter("swap/bytes_read").inc(self._leaf_nbytes(i))
+                yield i, self._host_view(self._staging[slot], i)
+
     def swap_out_device(self, leaves, write_behind=None):
         """device params → disk; frees nothing itself (callers delete the
         device arrays after). d2h transfers for all leaves start up front
@@ -594,8 +747,8 @@ class PartitionedParamSwapper:
             t0 = time.perf_counter()
             fd = self._write_fd(i, b.nbytes)
             self.handle.sync_pwrite(b, fd)
-            if self.fsync:
-                os.fsync(fd)
+            if self.fsync and not _fd_is_direct(fd):
+                os.fsync(fd)   # direct writes are on-device already
             self._stall_s += time.perf_counter() - t0
             self._cache.pop(i, None)  # staged bytes (if any) are stale
             self._reg().counter("swap/bytes_written").inc(b.nbytes)
@@ -648,7 +801,8 @@ class OptimizerStateSwapper:
         # write-behind pool sized for buffer_count leaves x 2 fields over
         # the shared arena; the arena grows to slots x largest-leaf
         self._arena = _StagingArena(
-            slots=4 + (2 * self.buffer_count if pipeline_write else 0))
+            slots=4 + (2 * self.buffer_count if pipeline_write else 0),
+            aligned=getattr(aio_config, "o_direct", False))
         self._consumed = {}  # leaf_id -> [tids] handed out by fetch()
         self._wb_handle = None
         # in-flight write sources: (leaf_id, [tids], [arrays]) — the
@@ -822,18 +976,20 @@ class OptimizerStateSwapper:
         steady-state stores reuse extents (the TensorSwapper sync path
         reopens with O_TRUNC each step — fine off the hot path)."""
         key = (leaf_id, field)
+        handle = self.swapper.handle
         fd = self._wb_fds.get(key)
         if fd is None:
-            fd = os.open(self.swapper._path(f"{leaf_id}.{field}"),
-                         os.O_WRONLY | os.O_CREAT, 0o644)
+            fd = handle.open_fd(self.swapper._path(f"{leaf_id}.{field}"),
+                                os.O_WRONLY | os.O_CREAT)
             self._wb_fds[key] = fd
-        if self._wb_sizes.get(key) != nbytes:
-            os.ftruncate(fd, nbytes)
+        alloc = handle.io_nbytes(nbytes)
+        if self._wb_sizes.get(key) != alloc:
+            os.ftruncate(fd, alloc)
             try:
-                os.posix_fallocate(fd, 0, nbytes)
+                os.posix_fallocate(fd, 0, alloc)
             except OSError:
                 pass
-            self._wb_sizes[key] = nbytes
+            self._wb_sizes[key] = alloc
         return fd
 
     def release(self):

@@ -62,12 +62,16 @@ def quick_throughput(mb=256, directory=None, queue_depth=32,
       representative regime, not an anomaly.
     - ``first_read_mbps``: the cold first pass, reported separately (the
       restart/first-touch case).
+    - ``o_direct``: the same point through the O_DIRECT alignment layer
+      (ISSUE 20) — no page cache in the path at all, so first ≈ steady
+      by construction and the numbers are device truth on both legs.
 
     All knob values ride along so the number is reproducible. Returns
     None if the native lib is unavailable.
     """
     try:
-        from deepspeed_tpu.ops.native.aio import AsyncIOHandle
+        from deepspeed_tpu.ops.native.aio import (
+            AsyncIOHandle, aligned_empty, o_direct_fallback_latched)
         handle = AsyncIOHandle(block_size=block_size, queue_depth=queue_depth,
                                thread_count=4)
     except Exception:
@@ -80,17 +84,35 @@ def quick_throughput(mb=256, directory=None, queue_depth=32,
             w, r = _run_case(handle, arr, path)
             ws.append(w)
             rs.append(r)
+        dws, drs = [], []
+        dhandle = AsyncIOHandle(block_size=block_size,
+                                queue_depth=queue_depth,
+                                thread_count=4, o_direct=True)
+        darr = aligned_empty(arr.nbytes)    # page-aligned: zero-copy leg
+        darr[:] = arr
+        for _ in range(trials):
+            w, r = _run_case(dhandle, darr, path)
+            dws.append(w)
+            drs.append(r)
         return {"backend": handle.backend,
                 "write_mbps": round(float(np.median(ws)), 1),
                 "read_mbps": round(float(np.median(rs)), 1),
                 "first_read_mbps": round(rs[0], 1),
+                "o_direct": {
+                    "write_mbps": round(float(np.median(dws)), 1),
+                    "read_mbps": round(float(np.median(drs)), 1),
+                    "first_read_mbps": round(drs[0], 1),
+                    "fallback_latched": o_direct_fallback_latched(),
+                },
                 "mb": mb, "trials": trials,
                 "queue_depth": queue_depth,
                 "block_kb": block_size >> 10,
                 "cache_note": "guest page cache dropped (fsync+fadvise) "
                               "each pass; virtio host cache uncontrollable "
                               "from the guest — median == steady-state "
-                              "(the swap tier's every-step re-read regime)"}
+                              "(the swap tier's every-step re-read regime); "
+                              "the o_direct point bypasses the guest cache "
+                              "entirely (honest first-touch == steady)"}
     finally:
         if os.path.exists(path):
             os.unlink(path)

@@ -182,6 +182,18 @@ def test_handoff_serving_metric_names_documented():
         assert name in _package_source(), name
 
 
+def test_o_direct_metric_names_documented():
+    """The O_DIRECT swap-tier additions (ISSUE 20): the device-truth
+    bandwidth gauges and the buffered-fallback breadcrumb counter must
+    stay documented AND emitted."""
+    documented = documented_metric_names()
+    for name in ("swap/device_read_mb_s", "swap/device_write_mb_s",
+                 "swap/o_direct_fallback"):
+        assert name in documented, (
+            f"{name} missing from the docs/observability.md swap table")
+        assert name in _package_source(), name
+
+
 # ------------------------------------------------------- prometheus page
 
 # the exposition-format line grammar a real scraper applies

@@ -1,0 +1,407 @@
+"""O_DIRECT swap tier (ISSUE 20): the alignment layer, the latched
+buffered fallback, and the swapper contracts that ride on them.
+
+The contracts under test:
+
+- **alignment**: leaf sizes that are not page multiples roundtrip
+  bit-exactly (aligned body zero-copy + one bounced tail; fully
+  unaligned buffers bounce whole); physical swap-file sizes round up to
+  the page while ``meta`` keeps the exact bytes; sub-``block_size``
+  tails and multi-chunk bodies split without breaking alignment.
+- **fallback**: a filesystem that rejects O_DIRECT latches the process
+  to buffered I/O with exactly ONE warning, a ``swap/o_direct_fallback``
+  counter bump and flight-recorder breadcrumb — then everything still
+  works (degrade loudly, never fail CI on an overlay FS).
+- **honesty gates**: active O_DIRECT never issues fadvise (there is no
+  page cache to warm); ``drain_writes`` + fsync does per-fd data fsync
+  only for buffered fds and one dirent fsync when direct fds are
+  pending; the snapshotter truncates direct-written shards back to the
+  exact byte count the crc/loader format expects.
+- **scratch hygiene**: pid-scoped swap dirs left by a SIGKILLed process
+  are reclaimed at the next construction (the finalizer never ran).
+
+Everything except the snapshot test stays jax-free — ci/swap_gate.sh
+runs the fast tier of this file without an accelerator stack.
+"""
+
+import errno
+import os
+import types
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.native import aio
+from deepspeed_tpu.ops.native.aio import (
+    ALIGNMENT, AsyncIOHandle, align_up, aligned_empty, fd_is_direct,
+    o_direct_fallback_latched, reset_o_direct_fallback_for_tests)
+from deepspeed_tpu.runtime.swap_tensor.swapper import (
+    OptimizerStateSwapper, PartitionedParamSwapper, TensorSwapper,
+    sweep_stale_pid_dirs)
+from deepspeed_tpu.telemetry import default_recorder, default_registry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_latch():
+    reset_o_direct_fallback_for_tests()
+    yield
+    reset_o_direct_fallback_for_tests()
+
+
+def _cfg(**kw):
+    kw.setdefault("o_direct", True)
+    return types.SimpleNamespace(**kw)
+
+
+# -- the alignment layer ---------------------------------------------------
+
+def test_align_helpers():
+    assert align_up(1) == ALIGNMENT
+    assert align_up(ALIGNMENT) == ALIGNMENT
+    assert align_up(ALIGNMENT + 1) == 2 * ALIGNMENT
+    buf = aligned_empty(100)
+    assert buf.nbytes == 100
+    assert buf.ctypes.data % ALIGNMENT == 0
+
+
+def test_arena_reuses_buffers():
+    arena = aio.AlignedArena()
+    l1 = arena.lease(1000)
+    cap = l1.cap
+    l1.release()
+    before = arena.allocated_bytes
+    l2 = arena.lease(1000)          # free-list pop, no new mmap
+    assert l2.cap == cap and arena.allocated_bytes == before
+    l2.release()
+
+
+@pytest.mark.parametrize("nbytes", [1, 7, 4096, 4097, 12345, 999999])
+def test_handle_roundtrip_odd_sizes(tmp_path, nbytes):
+    h = AsyncIOHandle(o_direct=True)
+    path = str(tmp_path / "x.bin")
+    src = np.random.default_rng(nbytes).integers(
+        0, 255, nbytes, dtype=np.uint8)
+    assert h.sync_pwrite(src, path) == nbytes
+    if not o_direct_fallback_latched():
+        # files written under O_DIRECT keep page-rounded physical sizes
+        assert os.path.getsize(path) == align_up(nbytes)
+    out = np.empty_like(src)
+    h.sync_pread(out, path)
+    np.testing.assert_array_equal(src, out)
+
+
+def test_aligned_buffer_submits_zero_copy(tmp_path):
+    h = AsyncIOHandle(o_direct=True)
+    path = str(tmp_path / "z.bin")
+    src = aligned_empty(8 * ALIGNMENT)
+    src[:] = np.arange(src.nbytes, dtype=np.uint64).view(np.uint8)[
+        :src.nbytes]
+    h.sync_pwrite(src, path)
+    out = aligned_empty(src.nbytes)
+    h.sync_pread(out, path)
+    np.testing.assert_array_equal(src, out)
+    if not o_direct_fallback_latched():
+        assert h.stats["direct_zero_copy"] == 2
+        assert h.stats["direct_bounced"] == 0
+
+
+def test_sub_block_tail_chunking(tmp_path):
+    """A transfer larger than block_size with an unaligned tail: the
+    aligned body splits into block_size chunks (the C splitter must
+    only ever see single-piece submissions) and the tail bounces as one
+    aligned rewrite."""
+    h = AsyncIOHandle(block_size=ALIGNMENT, o_direct=True)
+    path = str(tmp_path / "t.bin")
+    nbytes = 3 * ALIGNMENT + 100
+    src = aligned_empty(nbytes)
+    src[:] = np.random.default_rng(0).integers(0, 255, nbytes,
+                                               dtype=np.uint8)
+    h.sync_pwrite(src, path)
+    out = aligned_empty(nbytes)
+    h.sync_pread(out, path)
+    np.testing.assert_array_equal(src, out)
+    if not o_direct_fallback_latched():
+        assert h.stats["direct_tail_bounced"] == 2
+
+
+def test_device_bandwidth_gauges_set(tmp_path):
+    default_registry().reset()
+    h = AsyncIOHandle(o_direct=True)
+    src = aligned_empty(4 * ALIGNMENT)
+    src[:] = 7
+    h.sync_pwrite(src, str(tmp_path / "g.bin"))
+    h.sync_pread(src, str(tmp_path / "g.bin"))
+    if not o_direct_fallback_latched():
+        assert default_registry().peek_gauge("swap/device_write_mb_s") > 0
+        assert default_registry().peek_gauge("swap/device_read_mb_s") > 0
+
+
+# -- the latched fallback --------------------------------------------------
+
+def _reject_o_direct(monkeypatch):
+    real_open = os.open
+
+    def fake_open(path, flags, *a, **kw):
+        if flags & os.O_DIRECT:
+            raise OSError(errno.EINVAL, "Invalid argument", str(path))
+        return real_open(path, flags, *a, **kw)
+
+    monkeypatch.setattr(os, "open", fake_open)
+
+
+def test_fallback_latches_once_and_degrades(tmp_path, monkeypatch):
+    _reject_o_direct(monkeypatch)
+    default_registry().reset()
+    default_recorder().clear()
+    warned = []
+    real_warn = aio.logger.warning
+    monkeypatch.setattr(
+        aio.logger, "warning",
+        lambda msg, *a: (warned.append(msg % a if a else msg),
+                         real_warn(msg, *a)))
+    h = AsyncIOHandle(o_direct=True)
+    src = np.arange(5000, dtype=np.uint8)
+    h.sync_pwrite(src, str(tmp_path / "a.bin"))
+    assert o_direct_fallback_latched()
+    assert not h.direct_active
+    # a second handle on the latched process: no second warning
+    h2 = AsyncIOHandle(o_direct=True)
+    h2.sync_pwrite(src, str(tmp_path / "b.bin"))
+    warnings = [m for m in warned if "O_DIRECT unsupported" in m]
+    assert len(warnings) == 1
+    counters = default_registry().snapshot()["counters"]
+    assert counters.get("swap/o_direct_fallback", 0) >= 1
+    assert any(e["kind"] == "o_direct_fallback"
+               for e in default_recorder().events())
+    # degraded handles still do correct buffered I/O, byte-exact sizes
+    assert os.path.getsize(tmp_path / "a.bin") == src.nbytes
+    out = np.empty_like(src)
+    h.sync_pread(out, str(tmp_path / "a.bin"))
+    np.testing.assert_array_equal(src, out)
+
+
+def test_fallback_reset_helper(tmp_path, monkeypatch):
+    _reject_o_direct(monkeypatch)
+    h = AsyncIOHandle(o_direct=True)
+    h.sync_pwrite(np.zeros(10, np.uint8), str(tmp_path / "x.bin"))
+    assert o_direct_fallback_latched()
+    reset_o_direct_fallback_for_tests()
+    assert not o_direct_fallback_latched()
+
+
+# -- swapper contracts -----------------------------------------------------
+
+def test_param_swapper_odd_leaves_stream(tmp_path):
+    rng = np.random.default_rng(3)
+    leaves = [rng.standard_normal(n).astype(np.float32)
+              for n in (1000, 1024, 12345, 3, 99999)]
+    sw = PartitionedParamSwapper(str(tmp_path), aio_config=_cfg(),
+                                 pipeline_read=True, pipeline_write=True,
+                                 buffer_count=4)
+    sw.write_all(leaves)
+    seen = []
+    for i, view in sw.swap_in_stream():
+        seen.append(i)
+        np.testing.assert_array_equal(view, leaves[i])
+    assert seen == list(range(len(leaves)))
+    if not o_direct_fallback_latched():
+        for i, leaf in enumerate(leaves):
+            assert os.path.getsize(sw._path(i)) == align_up(leaf.nbytes)
+    sw.release()
+
+
+def test_param_swapper_buffer_count_floor(tmp_path):
+    """buffer_count=1 clamps to the 2-slot double-buffer minimum and
+    the sliding window still streams more leaves than slots."""
+    rng = np.random.default_rng(4)
+    leaves = [rng.standard_normal(n).astype(np.float32)
+              for n in (100, 5000, 77, 4096, 9, 131072)]
+    sw = PartitionedParamSwapper(str(tmp_path), aio_config=_cfg(),
+                                 buffer_count=1)
+    assert sw.buffer_count == 2
+    sw.write_all(leaves)
+    for i, view in sw.swap_in_stream():
+        np.testing.assert_array_equal(view, leaves[i])
+    sw.release()
+
+
+def test_param_swapper_int8_bf16_leaves(tmp_path):
+    rng = np.random.default_rng(5)
+    leaves = [
+        rng.integers(-128, 127, 12345, dtype=np.int8),
+        rng.standard_normal(4097).astype(ml_dtypes.bfloat16),
+        rng.standard_normal((33, 65)).astype(ml_dtypes.bfloat16),
+    ]
+    sw = PartitionedParamSwapper(str(tmp_path), aio_config=_cfg(),
+                                 pipeline_write=True)
+    sw.write_all(leaves)
+    # write-behind the updated values, then force the disk path
+    for i, a in enumerate(leaves):
+        sw.write_behind(i, a)
+    sw.drain_writes()
+    sw._cache.clear()
+    for i, view in sw.swap_in_stream():
+        assert view.dtype == leaves[i].dtype
+        np.testing.assert_array_equal(view, leaves[i])
+    sw.release()
+
+
+def test_no_fadvise_under_active_o_direct(tmp_path, monkeypatch):
+    calls = []
+    monkeypatch.setattr(os, "posix_fadvise",
+                        lambda *a, **kw: calls.append(a))
+    leaves = [np.arange(1000, dtype=np.float32)]
+    sw = PartitionedParamSwapper(str(tmp_path / "d"), aio_config=_cfg())
+    sw.write_all(leaves)
+    list(sw.swap_in_stream())
+    sw.release()
+    if not o_direct_fallback_latched():
+        assert calls == []
+    # the buffered tier keeps its readahead pass
+    sb = PartitionedParamSwapper(str(tmp_path / "b"))
+    sb.write_all(leaves)
+    list(sb.swap_in_stream())
+    assert calls
+    sb.release()
+
+
+def test_drain_writes_dirent_fsync_only(tmp_path, monkeypatch):
+    """Under active O_DIRECT the drain fence must not data-fsync the
+    swap fds (completed direct writes are on the device) — one dirent
+    fsync covers the name/metadata durability."""
+    leaves = [np.arange(5000, dtype=np.float32),
+              np.arange(64, dtype=np.float32)]
+    sw = PartitionedParamSwapper(str(tmp_path), aio_config=_cfg(),
+                                 pipeline_write=True, fsync=True)
+    if not sw.handle.direct_active:
+        pytest.skip("O_DIRECT unavailable on this filesystem")
+    for i, a in enumerate(leaves):
+        sw.write_behind(i, a)       # preallocation fsyncs happen here
+    sw.drain_writes()
+    fsynced = []
+    monkeypatch.setattr(os, "fsync", lambda fd: fsynced.append(fd))
+    for i, a in enumerate(leaves):
+        sw.write_behind(i, a)       # same sizes: no prealloc re-fsync
+    sw.drain_writes()
+    # exactly one fsync — the directory, not the (direct) data fds
+    assert len(fsynced) == 1
+    assert not any(fd in fsynced for fd in sw._wfds.values())
+    sw.release()
+
+
+def test_optimizer_swapper_o_direct_roundtrip(tmp_path):
+    osw = OptimizerStateSwapper(str(tmp_path), aio_config=_cfg(),
+                                pipeline_write=True)
+    rng = np.random.default_rng(6)
+    shapes = [(12345,), (7,), (4096,)]
+    for lid, s in enumerate(shapes):
+        osw.init_state(lid, s)
+    wrote = {}
+    for lid, s in enumerate(shapes):
+        m, v = osw.fetch(lid)
+        assert np.all(m == 0) and np.all(v == 0)
+        m[:] = rng.standard_normal(s).astype(np.float32)
+        v[:] = np.abs(rng.standard_normal(s)).astype(np.float32)
+        wrote[lid] = (np.array(m), np.array(v))
+        osw.store(lid, m, v)
+    osw.drain_writes()
+    for lid in range(len(shapes)):
+        osw.prefetch(lid)
+        m, v = osw.fetch(lid)
+        np.testing.assert_array_equal(m, wrote[lid][0])
+        np.testing.assert_array_equal(v, wrote[lid][1])
+
+
+def test_tensor_swapper_o_direct(tmp_path):
+    ts = TensorSwapper(str(tmp_path), aio_config=_cfg())
+    a = np.random.default_rng(7).standard_normal(777).astype(np.float32)
+    ts.swap_out("x", a)
+    out = np.empty_like(a)
+    np.testing.assert_array_equal(ts.swap_in("x", out), a)
+    ts.prefetch("x", out)
+    np.testing.assert_array_equal(ts.swap_in("x", out), a)
+    ts.release()
+
+
+# -- scratch hygiene -------------------------------------------------------
+
+def test_stale_pid_dir_sweep(tmp_path):
+    # a pid that cannot exist (> pid_max) stands in for a SIGKILLed one
+    dead = tmp_path / "param_swap_999999999"
+    dead.mkdir()
+    (dead / "param_0.swp").write_bytes(b"x")
+    mine = tmp_path / f"param_swap_{os.getpid()}"
+    mine.mkdir()
+    other = tmp_path / "param_swap_notapid"
+    other.mkdir()
+    swept = sweep_stale_pid_dirs(str(tmp_path), "param_swap")
+    assert swept == ["param_swap_999999999"]
+    assert not dead.exists()
+    assert mine.exists() and other.exists()
+
+
+def test_constructor_sweeps_stale_dirs(tmp_path):
+    dead = tmp_path / "zero_swap_999999999"
+    dead.mkdir()
+    TensorSwapper(str(tmp_path))
+    assert not dead.exists()
+    dead2 = tmp_path / "param_swap_999999999"
+    dead2.mkdir()
+    PartitionedParamSwapper(str(tmp_path))
+    assert not dead2.exists()
+
+
+# -- config validation -----------------------------------------------------
+
+def test_aio_config_o_direct_validation():
+    from deepspeed_tpu.config.config import AioConfig, DeepSpeedConfigError
+    assert AioConfig({}).o_direct is False
+    assert AioConfig({"aio": {"o_direct": True}}).o_direct is True
+    with pytest.raises(DeepSpeedConfigError):
+        AioConfig({"aio": {"o_direct": "yes"}})
+    with pytest.raises(DeepSpeedConfigError):
+        AioConfig({"aio": {"o_direct": True, "block_size": 4096 + 512}})
+    with pytest.raises(DeepSpeedConfigError):
+        AioConfig({"aio": {"block_size": 0}})
+    # buffered mode keeps accepting unaligned block sizes
+    assert AioConfig({"aio": {"block_size": 4096 + 512}}).block_size
+
+
+# -- snapshot honesty (jax needed) ----------------------------------------
+
+def test_snapshot_o_direct_exact_sizes_and_load(tmp_path):
+    jnp = pytest.importorskip("jax.numpy")
+    from deepspeed_tpu.runtime.elastic.snapshot import (
+        AsyncSnapshotter, SnapshotReader)
+    trees = {
+        "model_states": {"params": {
+            "w": jnp.asarray(np.random.RandomState(0).randn(8, 17),
+                             jnp.bfloat16),
+            "b": jnp.asarray(np.arange(33, dtype=np.float32))}},
+        "optim_states": {"opt_state": {}, "scaler": {},
+                         "global_step": jnp.int32(3),
+                         "skipped_steps": jnp.int32(0)},
+    }
+    sp = AsyncSnapshotter(str(tmp_path), aio_config=_cfg(), fsync=True)
+    if not getattr(sp._handle, "direct_active", False):
+        pytest.skip("O_DIRECT unavailable on this filesystem")
+    sp.begin("t1", trees)
+    final, _ = sp.finalize()
+    # direct writes land page-rounded; finalize must truncate each
+    # shard back to the exact nbytes the crc/loader format expects
+    import json as _json
+    with open(os.path.join(final, "manifest.json")) as fh:
+        man = _json.load(fh)
+    import glob as _glob
+    shards = _glob.glob(os.path.join(final, "*.bin"))
+    assert shards
+    for p in shards:
+        assert os.path.getsize(p) % ALIGNMENT != 0 or \
+            os.path.getsize(p) == align_up(os.path.getsize(p))
+    reader = SnapshotReader(final)   # verify=True: crc over exact bytes
+    state, _ = reader.state_and_meta()
+    reader.close()
+    np.testing.assert_array_equal(
+        np.asarray(state["params"]["b"]), np.arange(33, dtype=np.float32))
+    assert man["tag"] == "t1"
